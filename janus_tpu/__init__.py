@@ -1,0 +1,20 @@
+"""janus_tpu — a TPU-native DAP aggregation framework.
+
+A ground-up re-design of the capabilities of divviup/janus (v0.7.4) for TPU:
+the Prio3 VDAF prepare step (FLP proof verification over Field64/Field128 plus
+TurboSHAKE128 XOF expansion) runs as jax.vmap'd modular-arithmetic tensor ops
+batched across whole aggregation jobs, with output-share accumulation as
+lax.psum over a device mesh.  A bit-exact CPU oracle (fields/xof/flp/vdaf
+modules) mirrors the pure-Rust ``prio`` path.
+
+Layout (see SURVEY.md for the reference layer map this re-expresses):
+  fields, xof     — bit-exact scalar oracle for the crypto kernel
+  flp/            — FLP proof system: gadgets, circuits, prove/query/decide
+  vdaf/           — Prio3 composition, ping-pong topology, instance registry
+  ops/            — JAX/TPU kernels (u32-limb field ops, vmapped Keccak,
+                    batched prepare)
+  parallel/       — device-mesh sharding and collective accumulation
+  messages/       — DAP wire-format codec
+"""
+
+__version__ = "0.1.0"

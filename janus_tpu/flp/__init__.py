@@ -1,0 +1,24 @@
+"""FLP (fully linear proof) system for Prio3 — draft-irtf-cfrg-vdaf-08 §7.3.
+
+The reference consumes this from the external ``prio`` crate (SURVEY.md §2.2
+"prio crate surface"); here it is re-implemented natively: an exact CPU oracle
+in this package, and batched TPU kernels in ``janus_tpu.ops`` that must agree
+bit-for-bit.
+"""
+
+from .gadgets import Mul, ParallelSum, PolyEval, Range2
+from .circuits import Count, Histogram, Sum, SumVec
+from .generic import FlpError, FlpGeneric
+
+__all__ = [
+    "Mul",
+    "ParallelSum",
+    "PolyEval",
+    "Range2",
+    "Count",
+    "Histogram",
+    "Sum",
+    "SumVec",
+    "FlpError",
+    "FlpGeneric",
+]

@@ -1,0 +1,246 @@
+"""Validity circuits for the Prio3 family — draft-irtf-cfrg-vdaf-08 §7.4.
+
+These define the VDAFs the reference registers in its ``VdafInstance`` enum
+(reference: core/src/vdaf.rs:65-108): Prio3Count, Prio3Sum{bits},
+Prio3SumVec{bits,length,chunk_length}, Prio3Histogram{length,chunk_length}, and
+the Field64 multiproof SumVec variant (core/src/vdaf.rs:178-195) which reuses
+the SumVec circuit over Field64.
+
+A circuit evaluates to zero iff the measurement is valid.  ``eval`` receives
+the number of additive shares so that additive *constants* in the circuit can
+be scaled by 1/num_shares (each aggregator evaluates on its share; the shares
+of the circuit output then sum to the true output).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..fields import Field64, Field128
+from .gadgets import Gadget, Mul, ParallelSum, Range2
+
+
+class Valid:
+    """Base class: a validity circuit plus measurement encode/truncate/decode."""
+
+    field: type
+    MEAS_LEN: int
+    OUTPUT_LEN: int
+    JOINT_RAND_LEN: int
+    GADGET_CALLS: List[int]
+
+    def new_gadgets(self) -> List[Gadget]:
+        """Fresh plain gadget evaluators (prove/query wrap these)."""
+        raise NotImplementedError
+
+    def eval(self, meas, joint_rand, num_shares, gadgets) -> int:
+        raise NotImplementedError
+
+    def encode(self, measurement) -> List[int]:
+        raise NotImplementedError
+
+    def truncate(self, meas: Sequence[int]) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, output: Sequence[int], num_measurements: int):
+        raise NotImplementedError
+
+    def check_valid(self, meas, joint_rand):
+        if len(meas) != self.MEAS_LEN:
+            raise ValueError("measurement length mismatch")
+        if len(joint_rand) != self.JOINT_RAND_LEN:
+            raise ValueError("joint randomness length mismatch")
+
+
+class Count(Valid):
+    """C(x) = x*x - x; one boolean measurement.  Field64, no joint rand."""
+
+    field = Field64
+    MEAS_LEN = 1
+    OUTPUT_LEN = 1
+    JOINT_RAND_LEN = 0
+    GADGET_CALLS = [1]
+
+    def new_gadgets(self):
+        return [Mul()]
+
+    def eval(self, meas, joint_rand, num_shares, gadgets):
+        self.check_valid(meas, joint_rand)
+        squared = gadgets[0].eval(self.field, [meas[0], meas[0]])
+        return self.field.sub(squared, meas[0])
+
+    def encode(self, measurement):
+        if measurement not in (0, 1):
+            raise ValueError("Count measurement must be 0 or 1")
+        return [int(measurement)]
+
+    def truncate(self, meas):
+        return list(meas)
+
+    def decode(self, output, num_measurements):
+        return output[0]
+
+
+class Sum(Valid):
+    """Integer in [0, 2^bits); bit-decomposed, each bit range-checked."""
+
+    field = Field128
+
+    def __init__(self, bits: int):
+        if not 0 < bits < self.field.MODULUS.bit_length():
+            raise ValueError("bits out of range")
+        self.bits = bits
+        self.MEAS_LEN = bits
+        self.OUTPUT_LEN = 1
+        self.JOINT_RAND_LEN = 1
+        self.GADGET_CALLS = [bits]
+
+    def new_gadgets(self):
+        return [Range2()]
+
+    def eval(self, meas, joint_rand, num_shares, gadgets):
+        self.check_valid(meas, joint_rand)
+        f = self.field
+        out = 0
+        r = joint_rand[0]
+        for b in meas:
+            out = f.add(out, f.mul(r, gadgets[0].eval(f, [b])))
+            r = f.mul(r, joint_rand[0])
+        return out
+
+    def encode(self, measurement):
+        if not 0 <= measurement < (1 << self.bits):
+            raise ValueError("measurement out of range")
+        return [(measurement >> l) & 1 for l in range(self.bits)]
+
+    def truncate(self, meas):
+        f = self.field
+        acc = 0
+        for l, b in enumerate(meas):
+            acc = f.add(acc, f.mul(pow(2, l, f.MODULUS), b))
+        return [acc]
+
+    def decode(self, output, num_measurements):
+        return output[0]
+
+
+class SumVec(Valid):
+    """Vector of `length` integers each in [0, 2^bits); ParallelSum bit checks.
+
+    Field is parametric: Field128 for standard Prio3SumVec, Field64 for the
+    multiproof variant (reference: core/src/vdaf.rs:178-195).
+    """
+
+    def __init__(self, length: int, bits: int, chunk_length: int, field: type = Field128):
+        if length <= 0 or bits <= 0 or chunk_length <= 0:
+            raise ValueError("invalid SumVec parameters")
+        self.field = field
+        self.length = length
+        self.bits = bits
+        self.chunk_length = chunk_length
+        self.MEAS_LEN = length * bits
+        self.OUTPUT_LEN = length
+        self.GADGET_CALLS = [(self.MEAS_LEN + chunk_length - 1) // chunk_length]
+        self.JOINT_RAND_LEN = self.GADGET_CALLS[0]
+
+    def new_gadgets(self):
+        return [ParallelSum(Mul(), self.chunk_length)]
+
+    def eval(self, meas, joint_rand, num_shares, gadgets):
+        self.check_valid(meas, joint_rand)
+        f = self.field
+        out = 0
+        shares_inv = f.inv(num_shares)
+        for i in range(self.GADGET_CALLS[0]):
+            r = joint_rand[i]
+            r_power = r
+            inputs = []
+            for j in range(self.chunk_length):
+                index = i * self.chunk_length + j
+                meas_elem = meas[index] if index < len(meas) else 0
+                inputs.append(f.mul(meas_elem, r_power))
+                inputs.append(f.sub(meas_elem, shares_inv))
+                r_power = f.mul(r_power, r)
+            out = f.add(out, gadgets[0].eval(f, inputs))
+        return out
+
+    def encode(self, measurement):
+        if len(measurement) != self.length:
+            raise ValueError("measurement length mismatch")
+        meas = []
+        for v in measurement:
+            if not 0 <= v < (1 << self.bits):
+                raise ValueError("vector element out of range")
+            meas.extend((v >> l) & 1 for l in range(self.bits))
+        return meas
+
+    def truncate(self, meas):
+        f = self.field
+        out = []
+        for l in range(self.length):
+            acc = 0
+            for b in range(self.bits):
+                acc = f.add(acc, f.mul(pow(2, b, f.MODULUS), meas[l * self.bits + b]))
+            out.append(acc)
+        return out
+
+    def decode(self, output, num_measurements):
+        return list(output)
+
+
+class Histogram(Valid):
+    """One-hot vector of `length` buckets; range check + sum-to-one check."""
+
+    field = Field128
+
+    def __init__(self, length: int, chunk_length: int, field: type = Field128):
+        if length <= 0 or chunk_length <= 0:
+            raise ValueError("invalid Histogram parameters")
+        self.field = field
+        self.length = length
+        self.chunk_length = chunk_length
+        self.MEAS_LEN = length
+        self.OUTPUT_LEN = length
+        self.GADGET_CALLS = [(length + chunk_length - 1) // chunk_length]
+        self.JOINT_RAND_LEN = 2
+
+    def new_gadgets(self):
+        return [ParallelSum(Mul(), self.chunk_length)]
+
+    def eval(self, meas, joint_rand, num_shares, gadgets):
+        self.check_valid(meas, joint_rand)
+        f = self.field
+        shares_inv = f.inv(num_shares)
+        # Range check: every bucket is 0 or 1.
+        range_check = 0
+        r = joint_rand[0]
+        r_power = r
+        for i in range(self.GADGET_CALLS[0]):
+            inputs = []
+            for j in range(self.chunk_length):
+                index = i * self.chunk_length + j
+                meas_elem = meas[index] if index < len(meas) else 0
+                inputs.append(f.mul(meas_elem, r_power))
+                inputs.append(f.sub(meas_elem, shares_inv))
+                r_power = f.mul(r_power, r)
+            range_check = f.add(range_check, gadgets[0].eval(f, inputs))
+        # Sum check: buckets sum to exactly one.
+        sum_check = f.neg(shares_inv)
+        for b in meas:
+            sum_check = f.add(sum_check, b)
+        out = f.add(
+            f.mul(joint_rand[1], range_check),
+            f.mul(f.mul(joint_rand[1], joint_rand[1]), sum_check),
+        )
+        return out
+
+    def encode(self, measurement):
+        if not 0 <= measurement < self.length:
+            raise ValueError("bucket index out of range")
+        return [1 if i == measurement else 0 for i in range(self.length)]
+
+    def truncate(self, meas):
+        return list(meas)
+
+    def decode(self, output, num_measurements):
+        return list(output)

@@ -1,0 +1,97 @@
+"""FLP gadgets: arithmetic sub-circuits with bounded degree.
+
+draft-irtf-cfrg-vdaf-08 §7.3.2 (Mul, PolyEval/Range2) and §7.3.3 (ParallelSum,
+the wide-vector gadget behind SumVec/Histogram — the reference's analog of
+"chunked" wide-vector parallelism, SURVEY.md §2.3 P7).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..fields import poly_add, poly_eval, poly_mul
+
+
+class Gadget:
+    ARITY: int
+    DEGREE: int
+
+    def eval(self, field: type, inp: Sequence[int]) -> int:
+        raise NotImplementedError
+
+    def eval_poly(self, field: type, wire_polys: Sequence[Sequence[int]]) -> List[int]:
+        """Evaluate the gadget over polynomial-valued wires."""
+        raise NotImplementedError
+
+
+class Mul(Gadget):
+    ARITY = 2
+    DEGREE = 2
+
+    def eval(self, field, inp):
+        return field.mul(inp[0], inp[1])
+
+    def eval_poly(self, field, wire_polys):
+        return poly_mul(field, wire_polys[0], wire_polys[1])
+
+
+class PolyEval(Gadget):
+    """Evaluate a fixed univariate polynomial p at the (single) input wire."""
+
+    ARITY = 1
+
+    def __init__(self, poly: Sequence[int]):
+        if len(poly) < 2:
+            raise ValueError("polynomial must have degree >= 1")
+        self.poly = list(poly)  # may hold negative ints; normalized per field on use
+        self.DEGREE = len(poly) - 1
+        self._norm_cache = {}
+
+    def _norm(self, field) -> List[int]:
+        coeffs = self._norm_cache.get(field)
+        if coeffs is None:
+            coeffs = [c % field.MODULUS for c in self.poly]
+            self._norm_cache[field] = coeffs
+        return coeffs
+
+    def eval(self, field, inp):
+        return poly_eval(field, self._norm(field), inp[0])
+
+    def eval_poly(self, field, wire_polys):
+        # Horner over polynomials: p(w(x)).
+        coeffs = self._norm(field)
+        w = list(wire_polys[0])
+        out: List[int] = [coeffs[-1]]
+        for c in reversed(coeffs[:-1]):
+            out = poly_mul(field, out, w)
+            out = poly_add(field, out, [c])
+        return out
+
+
+def Range2() -> PolyEval:
+    """p(x) = x^2 - x, the bit-check gadget (§7.3.2)."""
+    return PolyEval([0, -1, 1])
+
+
+class ParallelSum(Gadget):
+    """Sum of `count` applications of an inner gadget over disjoint wire chunks."""
+
+    def __init__(self, inner: Gadget, count: int):
+        self.inner = inner
+        self.count = count
+        self.ARITY = inner.ARITY * count
+        self.DEGREE = inner.DEGREE
+
+    def eval(self, field, inp):
+        a = self.inner.ARITY
+        acc = 0
+        for i in range(self.count):
+            acc = field.add(acc, self.inner.eval(field, inp[i * a : (i + 1) * a]))
+        return acc
+
+    def eval_poly(self, field, wire_polys):
+        a = self.inner.ARITY
+        out: List[int] = []
+        for i in range(self.count):
+            out = poly_add(field, out, self.inner.eval_poly(field, wire_polys[i * a : (i + 1) * a]))
+        return out

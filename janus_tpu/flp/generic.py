@@ -1,0 +1,180 @@
+"""Generic FLP construction from a validity circuit — draft-irtf-cfrg-vdaf-08 §7.3.
+
+prove:  the prover evaluates the circuit on the measurement, recording every
+        gadget's input wires; each gadget's wires are interpolated (seeded with
+        one prove-rand element at the point alpha^0) into wire polynomials, and
+        the gadget applied to those polynomials yields the gadget polynomial
+        shipped in the proof.
+query:  each verifier evaluates the circuit on its *share*, answering gadget
+        calls from the proof's gadget polynomial (evaluated at alpha^k for call
+        k), then spot-checks the gadget polynomial at a random point t.
+decide: on the combined verifier message, check the circuit output is zero and
+        that each gadget's claimed output matches a direct gadget evaluation.
+
+The prepare-side pieces (query/decide) are what the TPU backend batches across
+reports (SURVEY.md §2.3 P1); this module is their bit-exact oracle.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..fields import next_power_of_2, poly_eval, poly_interp
+from .circuits import Valid
+from .gadgets import Gadget
+
+
+class FlpError(Exception):
+    pass
+
+
+class _ProveGadget:
+    def __init__(self, field: type, wire_seeds: Sequence[int], inner: Gadget, calls: int):
+        self.inner = inner
+        self.calls = calls
+        self.P = next_power_of_2(1 + calls)
+        self.wire = [[0] * self.P for _ in range(inner.ARITY)]
+        for j, s in enumerate(wire_seeds):
+            self.wire[j][0] = s
+        self.k = 0
+
+    def eval(self, field, inp):
+        self.k += 1
+        if self.k > self.calls:
+            raise FlpError("gadget called more times than declared")
+        for j in range(self.inner.ARITY):
+            self.wire[j][self.k] = inp[j]
+        return self.inner.eval(field, inp)
+
+
+class _QueryGadget:
+    def __init__(
+        self,
+        field: type,
+        wire_seeds: Sequence[int],
+        gadget_poly: Sequence[int],
+        inner: Gadget,
+        calls: int,
+    ):
+        self.inner = inner
+        self.calls = calls
+        self.P = next_power_of_2(1 + calls)
+        self.alpha = field.root(self.P)
+        self.gadget_poly = list(gadget_poly)
+        self.wire = [[0] * self.P for _ in range(inner.ARITY)]
+        for j, s in enumerate(wire_seeds):
+            self.wire[j][0] = s
+        self.k = 0
+
+    def eval(self, field, inp):
+        self.k += 1
+        if self.k > self.calls:
+            raise FlpError("gadget called more times than declared")
+        for j in range(self.inner.ARITY):
+            self.wire[j][self.k] = inp[j]
+        return poly_eval(field, self.gadget_poly, pow(self.alpha, self.k, field.MODULUS))
+
+
+class FlpGeneric:
+    def __init__(self, valid: Valid):
+        self.valid = valid
+        self.field = valid.field
+        gadgets = valid.new_gadgets()
+        self.MEAS_LEN = valid.MEAS_LEN
+        self.OUTPUT_LEN = valid.OUTPUT_LEN
+        self.JOINT_RAND_LEN = valid.JOINT_RAND_LEN
+        self.PROVE_RAND_LEN = sum(g.ARITY for g in gadgets)
+        self.QUERY_RAND_LEN = len(gadgets)
+        self.PROOF_LEN = 0
+        self.VERIFIER_LEN = 1
+        for g, calls in zip(gadgets, valid.GADGET_CALLS):
+            p = next_power_of_2(1 + calls)
+            self.PROOF_LEN += g.ARITY + g.DEGREE * (p - 1) + 1
+            self.VERIFIER_LEN += g.ARITY + 1
+
+    # ------------------------------------------------------------------
+    def prove(self, meas: Sequence[int], prove_rand: Sequence[int], joint_rand: Sequence[int]) -> List[int]:
+        if len(prove_rand) != self.PROVE_RAND_LEN:
+            raise FlpError("bad prove_rand length")
+        field = self.field
+        gadgets = []
+        idx = 0
+        for g, calls in zip(self.valid.new_gadgets(), self.valid.GADGET_CALLS):
+            seeds = prove_rand[idx : idx + g.ARITY]
+            idx += g.ARITY
+            gadgets.append(_ProveGadget(field, seeds, g, calls))
+        self.valid.eval(list(meas), list(joint_rand), 1, gadgets)
+        proof: List[int] = []
+        for pg in gadgets:
+            if pg.k != pg.calls:
+                raise FlpError("circuit under-used a gadget")
+            wire_polys = [poly_interp(field, w) for w in pg.wire]
+            gadget_poly = pg.inner.eval_poly(field, wire_polys)
+            want = pg.inner.DEGREE * (pg.P - 1) + 1
+            gadget_poly = list(gadget_poly[:want]) + [0] * (want - len(gadget_poly))
+            proof.extend(w[0] for w in pg.wire)
+            proof.extend(gadget_poly)
+        assert len(proof) == self.PROOF_LEN
+        return proof
+
+    # ------------------------------------------------------------------
+    def query(
+        self,
+        meas_share: Sequence[int],
+        proof_share: Sequence[int],
+        query_rand: Sequence[int],
+        joint_rand: Sequence[int],
+        num_shares: int,
+    ) -> List[int]:
+        if len(proof_share) != self.PROOF_LEN:
+            raise FlpError("bad proof length")
+        if len(query_rand) != self.QUERY_RAND_LEN:
+            raise FlpError("bad query_rand length")
+        field = self.field
+        gadgets = []
+        idx = 0
+        for g, calls in zip(self.valid.new_gadgets(), self.valid.GADGET_CALLS):
+            p = next_power_of_2(1 + calls)
+            seg_len = g.ARITY + g.DEGREE * (p - 1) + 1
+            seg = proof_share[idx : idx + seg_len]
+            idx += seg_len
+            gadgets.append(_QueryGadget(field, seg[: g.ARITY], seg[g.ARITY :], g, calls))
+        v = self.valid.eval(list(meas_share), list(joint_rand), num_shares, gadgets)
+        verifier: List[int] = [v]
+        for i, qg in enumerate(gadgets):
+            t = query_rand[i]
+            if pow(t, qg.P, field.MODULUS) == 1:
+                # Negligible probability for honestly derived query rand.
+                raise FlpError("query randomness is a root of unity")
+            for w in qg.wire:
+                verifier.append(poly_eval(field, poly_interp(field, w), t))
+            verifier.append(poly_eval(field, qg.gadget_poly, t))
+        assert len(verifier) == self.VERIFIER_LEN
+        return verifier
+
+    # ------------------------------------------------------------------
+    def decide(self, verifier: Sequence[int]) -> bool:
+        if len(verifier) != self.VERIFIER_LEN:
+            raise FlpError("bad verifier length")
+        field = self.field
+        if verifier[0] != 0:
+            return False
+        idx = 1
+        for g, _calls in zip(self.valid.new_gadgets(), self.valid.GADGET_CALLS):
+            x = verifier[idx : idx + g.ARITY]
+            idx += g.ARITY
+            y = verifier[idx]
+            idx += 1
+            if g.eval(field, x) != y:
+                return False
+        return True
+
+    # Convenience passthroughs -----------------------------------------
+    def encode(self, measurement):
+        return self.valid.encode(measurement)
+
+    def truncate(self, meas):
+        return self.valid.truncate(meas)
+
+    def decode(self, output, num_measurements):
+        return self.valid.decode(output, num_measurements)

@@ -1,0 +1,78 @@
+"""Protocol transcript helper, mirroring the reference's ``run_vdaf`` test
+utility (reference: core/src/test_util/mod.rs:48-100): run the full sharding /
+ping-pong preparation / aggregation / unsharding flow in-process and expose
+every intermediate artifact as ground truth for backend tests.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from ..vdaf.pingpong import (
+    PingPongMessage,
+    helper_initialized,
+    leader_continued,
+    leader_initialized,
+)
+from ..vdaf.prio3 import Prio3, Prio3InputShare
+
+
+@dataclass
+class ReportTranscript:
+    nonce: bytes
+    public_share: Optional[List[bytes]]
+    input_shares: List[Prio3InputShare]
+    leader_message: PingPongMessage
+    helper_message: PingPongMessage
+    leader_out_share: List[int]
+    helper_out_share: List[int]
+
+
+@dataclass
+class VdafTranscript:
+    verify_key: bytes
+    reports: List[ReportTranscript] = field(default_factory=list)
+    leader_agg_share: List[int] = field(default_factory=list)
+    helper_agg_share: List[int] = field(default_factory=list)
+    aggregate_result: Any = None
+
+
+def run_vdaf(
+    vdaf: Prio3,
+    measurements: List[Any],
+    verify_key: Optional[bytes] = None,
+    rng=secrets.token_bytes,
+) -> VdafTranscript:
+    """Run the two-party protocol end-to-end over the given measurements."""
+    if verify_key is None:
+        verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+    t = VdafTranscript(verify_key=verify_key)
+    leader_out_shares, helper_out_shares = [], []
+    for m in measurements:
+        nonce = rng(vdaf.NONCE_SIZE)
+        rand = rng(vdaf.RAND_SIZE)
+        public_share, input_shares = vdaf.shard(m, nonce, rand)
+        state, leader_msg = leader_initialized(vdaf, verify_key, nonce, public_share, input_shares[0])
+        helper_state, helper_msg = helper_initialized(
+            vdaf, verify_key, nonce, public_share, input_shares[1], leader_msg
+        )
+        leader_fin = leader_continued(vdaf, state, helper_msg)
+        t.reports.append(
+            ReportTranscript(
+                nonce=nonce,
+                public_share=public_share,
+                input_shares=input_shares,
+                leader_message=leader_msg,
+                helper_message=helper_msg,
+                leader_out_share=leader_fin.out_share,
+                helper_out_share=helper_state.out_share,
+            )
+        )
+        leader_out_shares.append(leader_fin.out_share)
+        helper_out_shares.append(helper_state.out_share)
+    t.leader_agg_share = vdaf.aggregate(leader_out_shares)
+    t.helper_agg_share = vdaf.aggregate(helper_out_shares)
+    t.aggregate_result = vdaf.unshard([t.leader_agg_share, t.helper_agg_share], len(measurements))
+    return t

@@ -1,0 +1,33 @@
+"""Prio3 VDAF composition, instance registry, and ping-pong topology."""
+
+from .prio3 import (
+    Prio3,
+    Prio3InputShare,
+    Prio3PrepareShare,
+    Prio3PrepareState,
+    VdafError,
+)
+from .instances import (
+    VDAF_INSTANCES,
+    prio3_count,
+    prio3_histogram,
+    prio3_sum,
+    prio3_sum_vec,
+    prio3_sum_vec_field64_multiproof_hmacsha256_aes128,
+    vdaf_from_instance,
+)
+
+__all__ = [
+    "Prio3",
+    "Prio3InputShare",
+    "Prio3PrepareShare",
+    "Prio3PrepareState",
+    "VdafError",
+    "VDAF_INSTANCES",
+    "prio3_count",
+    "prio3_histogram",
+    "prio3_sum",
+    "prio3_sum_vec",
+    "prio3_sum_vec_field64_multiproof_hmacsha256_aes128",
+    "vdaf_from_instance",
+]

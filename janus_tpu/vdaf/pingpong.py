@@ -1,0 +1,143 @@
+"""Ping-pong topology for two-aggregator VDAF preparation.
+
+draft-irtf-cfrg-vdaf-08 §5.8; the reference consumes this from
+``prio::topology::ping_pong`` (SURVEY.md §2.2 "prio crate surface":
+PingPongTopology::{leader_initialized, helper_initialized, leader_continued},
+PingPongState::{Continued, Finished}, PingPongMessage), driven from
+aggregator/src/aggregator/aggregation_job_driver.rs:397-414,677-711 on the
+leader and aggregator/src/aggregator.rs:2022-2040 on the helper.
+
+Prio3 is one-round: leader emits Initialize{prep_share}; the helper combines
+both prepare shares into the prepare message, finishes, and replies
+Finish{prep_msg}; the leader checks the message and finishes.  The message
+wire format (tagged union with u32-length-prefixed opaques) matches the DAP
+encoding embedded in PrepareResp/PrepareContinue.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Tuple, Union
+
+from .prio3 import Prio3, Prio3InputShare, Prio3PrepareShare, Prio3PrepareState, VdafError
+
+
+@dataclass
+class PingPongMessage:
+    """Tagged union: 0 = initialize, 1 = continue, 2 = finish."""
+
+    INITIALIZE = 0
+    CONTINUE = 1
+    FINISH = 2
+
+    variant: int
+    prep_share: Optional[bytes] = None  # initialize / continue
+    prep_msg: Optional[bytes] = None  # continue / finish
+
+    def encode(self) -> bytes:
+        out = bytes([self.variant])
+        if self.variant == self.INITIALIZE:
+            out += struct.pack(">I", len(self.prep_share)) + self.prep_share
+        elif self.variant == self.CONTINUE:
+            out += struct.pack(">I", len(self.prep_msg)) + self.prep_msg
+            out += struct.pack(">I", len(self.prep_share)) + self.prep_share
+        elif self.variant == self.FINISH:
+            out += struct.pack(">I", len(self.prep_msg)) + self.prep_msg
+        else:
+            raise VdafError("bad ping-pong variant")
+        return out
+
+    @staticmethod
+    def decode(data: bytes) -> "PingPongMessage":
+        if not data:
+            raise VdafError("empty ping-pong message")
+        variant = data[0]
+        rest = data[1:]
+
+        def take(buf: bytes) -> Tuple[bytes, bytes]:
+            if len(buf) < 4:
+                raise VdafError("truncated ping-pong message")
+            (n,) = struct.unpack(">I", buf[:4])
+            if len(buf) < 4 + n:
+                raise VdafError("truncated ping-pong message")
+            return buf[4 : 4 + n], buf[4 + n :]
+
+        if variant == PingPongMessage.INITIALIZE:
+            share, rest = take(rest)
+            if rest:
+                raise VdafError("trailing bytes")
+            return PingPongMessage(variant, prep_share=share)
+        if variant == PingPongMessage.CONTINUE:
+            msg, rest = take(rest)
+            share, rest = take(rest)
+            if rest:
+                raise VdafError("trailing bytes")
+            return PingPongMessage(variant, prep_share=share, prep_msg=msg)
+        if variant == PingPongMessage.FINISH:
+            msg, rest = take(rest)
+            if rest:
+                raise VdafError("trailing bytes")
+            return PingPongMessage(variant, prep_msg=msg)
+        raise VdafError("bad ping-pong variant")
+
+
+@dataclass
+class PingPongContinued:
+    """Waiting for the peer; holds our prepare state."""
+
+    prep_state: Prio3PrepareState
+
+
+@dataclass
+class PingPongFinished:
+    out_share: List[int]
+
+
+PingPongState = Union[PingPongContinued, PingPongFinished]
+
+
+def leader_initialized(
+    vdaf: Prio3,
+    verify_key: bytes,
+    nonce: bytes,
+    public_share: Optional[List[bytes]],
+    input_share: Prio3InputShare,
+) -> Tuple[PingPongContinued, PingPongMessage]:
+    prep_state, prep_share = vdaf.prep_init(verify_key, 0, nonce, public_share, input_share)
+    msg = PingPongMessage(PingPongMessage.INITIALIZE, prep_share=prep_share.encode(vdaf))
+    return PingPongContinued(prep_state), msg
+
+
+def helper_initialized(
+    vdaf: Prio3,
+    verify_key: bytes,
+    nonce: bytes,
+    public_share: Optional[List[bytes]],
+    input_share: Prio3InputShare,
+    inbound: PingPongMessage,
+) -> Tuple[PingPongFinished, PingPongMessage]:
+    if inbound.variant != PingPongMessage.INITIALIZE:
+        raise VdafError("expected initialize message")
+    leader_share = Prio3PrepareShare.decode(vdaf, inbound.prep_share)
+    prep_state, helper_share = vdaf.prep_init(verify_key, 1, nonce, public_share, input_share)
+    prep_msg = vdaf.prep_shares_to_prep([leader_share, helper_share])
+    out_share = vdaf.prep_next(prep_state, prep_msg)
+    msg = PingPongMessage(PingPongMessage.FINISH, prep_msg=prep_msg if prep_msg is not None else b"")
+    return PingPongFinished(out_share), msg
+
+
+def leader_continued(
+    vdaf: Prio3, state: PingPongContinued, inbound: PingPongMessage
+) -> PingPongFinished:
+    if inbound.variant != PingPongMessage.FINISH:
+        raise VdafError("expected finish message")
+    if vdaf.flp.JOINT_RAND_LEN > 0:
+        prep_msg = inbound.prep_msg
+    else:
+        # Prep message must be empty for VDAFs without joint randomness.
+        if inbound.prep_msg:
+            raise VdafError("unexpected prepare message payload")
+        prep_msg = None
+    out_share = vdaf.prep_next(state.prep_state, prep_msg)
+    return PingPongFinished(out_share)

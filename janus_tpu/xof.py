@@ -1,0 +1,202 @@
+"""Extendable output functions (XOFs) for Prio3 — bit-exact CPU oracle.
+
+Implements draft-irtf-cfrg-vdaf-08 §6.2:
+
+* ``XofTurboShake128`` — TurboSHAKE128 (Keccak-p[1600,12], rate 168, domain
+  separation byte 0x01), seed size 16.
+* ``XofHmacSha256Aes128`` — libprio-rs's non-standard XOF used by the custom
+  multiproof VDAF (reference: core/src/vdaf.rs:178-195,
+  VERIFY_KEY_LENGTH_HMACSHA256_AES128 = 32 at core/src/vdaf.rs:24), seed size
+  32: HMAC-SHA256 over (len(dst) || dst || binder) keyed by the seed yields
+  (aes_key, iv); the stream is AES128-CTR over zeros.
+
+The Keccak permutation here is the reference for the vmapped TPU version in
+``janus_tpu.ops.keccak``.  Its sponge/padding path is cross-validated against
+``hashlib.shake_128`` by running the same code with 24 rounds and domain 0x1F
+(see tests/test_xof.py).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .fields import next_power_of_2
+
+_M64 = (1 << 64) - 1
+
+# Standard Keccak-f[1600] round constants; Keccak-p[1600,12] (TurboSHAKE) uses
+# the final 12.
+ROUND_CONSTANTS = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# Rotation offsets, generated from the rho step schedule (index = x + 5*y).
+_RHO = [0] * 25
+_x, _y = 1, 0
+for _t in range(24):
+    _RHO[_x + 5 * _y] = ((_t + 1) * (_t + 2) // 2) % 64
+    _x, _y = _y, (2 * _x + 3 * _y) % 5
+
+
+def _rotl(v: int, r: int) -> int:
+    return ((v << r) | (v >> (64 - r))) & _M64
+
+
+def keccak_p(lanes: List[int], rounds: int) -> List[int]:
+    """Keccak-p[1600, rounds] permutation on 25 u64 lanes (index = x + 5*y)."""
+    a = list(lanes)
+    for rc in ROUND_CONSTANTS[24 - rounds :]:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [a[i] ^ d[i % 5] for i in range(25)]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _RHO[x + 5 * y])
+        # chi
+        a = [
+            b[i] ^ ((b[(i % 5 + 1) % 5 + 5 * (i // 5)] ^ _M64) & b[(i % 5 + 2) % 5 + 5 * (i // 5)])
+            for i in range(25)
+        ]
+        # iota
+        a[0] ^= rc
+    return a
+
+
+class _Sponge:
+    """Keccak sponge in absorb-then-squeeze mode with TurboSHAKE padding."""
+
+    def __init__(self, rate: int, rounds: int, domain: int):
+        self.rate = rate
+        self.rounds = rounds
+        self.domain = domain
+        self._buf = bytearray()
+        self._state = [0] * 25
+        self._squeezing = False
+        self._out = bytearray()
+
+    def update(self, data: bytes) -> None:
+        assert not self._squeezing, "cannot absorb after squeezing"
+        self._buf += data
+        while len(self._buf) >= self.rate:
+            self._absorb_block(bytes(self._buf[: self.rate]))
+            del self._buf[: self.rate]
+
+    def _absorb_block(self, block: bytes) -> None:
+        for i in range(self.rate // 8):
+            self._state[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        self._state = keccak_p(self._state, self.rounds)
+
+    def _pad_and_finish(self) -> None:
+        block = bytearray(self._buf)
+        del self._buf[:]
+        block.append(self.domain)
+        block += b"\x00" * (self.rate - len(block))
+        block[self.rate - 1] ^= 0x80
+        for i in range(self.rate // 8):
+            self._state[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        self._squeezing = True
+
+    def squeeze(self, n: int) -> bytes:
+        if not self._squeezing:
+            self._pad_and_finish()
+        while len(self._out) < n:
+            self._state = keccak_p(self._state, self.rounds)
+            for i in range(self.rate // 8):
+                self._out += self._state[i].to_bytes(8, "little")
+        out = bytes(self._out[:n])
+        del self._out[:n]
+        return out
+
+
+def turboshake128(message: bytes, domain: int, length: int) -> bytes:
+    """One-shot TurboSHAKE128 (rate 168, 12 rounds)."""
+    s = _Sponge(rate=168, rounds=12, domain=domain)
+    s.update(message)
+    return s.squeeze(length)
+
+
+def shake128(message: bytes, length: int) -> bytes:
+    """Standard SHAKE128 via the same sponge (24 rounds, domain 0x1F).
+
+    Only used to cross-validate the sponge against hashlib in tests.
+    """
+    s = _Sponge(rate=168, rounds=24, domain=0x1F)
+    s.update(message)
+    return s.squeeze(length)
+
+
+class Xof:
+    """Streaming XOF interface per draft-irtf-cfrg-vdaf-08 §6.2."""
+
+    SEED_SIZE: int
+
+    def next(self, length: int) -> bytes:
+        raise NotImplementedError
+
+    def next_vec(self, field: type, length: int) -> List[int]:
+        """Rejection-sample field elements from the stream (§6.2.1)."""
+        mask = next_power_of_2(field.MODULUS) - 1
+        vec: List[int] = []
+        while len(vec) < length:
+            x = int.from_bytes(self.next(field.ENCODED_SIZE), "little") & mask
+            if x < field.MODULUS:
+                vec.append(x)
+        return vec
+
+    @classmethod
+    def expand_into_vec(
+        cls, field: type, seed: bytes, dst: bytes, binder: bytes, length: int
+    ) -> List[int]:
+        return cls(seed, dst, binder).next_vec(field, length)
+
+
+class XofTurboShake128(Xof):
+    SEED_SIZE = 16
+
+    def __init__(self, seed: bytes, dst: bytes, binder: bytes):
+        if len(seed) != self.SEED_SIZE:
+            raise ValueError("bad seed size")
+        if len(dst) > 255:
+            raise ValueError("dst too long")
+        self._sponge = _Sponge(rate=168, rounds=12, domain=0x01)
+        self._sponge.update(bytes([len(dst)]))
+        self._sponge.update(dst)
+        self._sponge.update(seed)
+        self._sponge.update(binder)
+
+    def next(self, length: int) -> bytes:
+        return self._sponge.squeeze(length)
+
+
+class XofHmacSha256Aes128(Xof):
+    """libprio-rs XofHmacSha256Aes128 (non-standard; Daphne interop)."""
+
+    SEED_SIZE = 32
+
+    def __init__(self, seed: bytes, dst: bytes, binder: bytes):
+        import hmac as _hmac
+        import hashlib as _hashlib
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        if len(seed) != self.SEED_SIZE:
+            raise ValueError("bad seed size")
+        if len(dst) > 255:
+            raise ValueError("dst too long")
+        mac = _hmac.new(seed, digestmod=_hashlib.sha256)
+        mac.update(bytes([len(dst)]))
+        mac.update(dst)
+        mac.update(binder)
+        key_block = mac.digest()
+        cipher = Cipher(algorithms.AES(key_block[:16]), modes.CTR(key_block[16:]))
+        self._enc = cipher.encryptor()
+
+    def next(self, length: int) -> bytes:
+        return self._enc.update(b"\x00" * length)

@@ -1,0 +1,13 @@
+"""Test configuration: force a virtual 8-device CPU platform before jax import.
+
+Bench runs (bench.py) use the real TPU chip; tests exercise the same code on a
+virtual 8-device CPU mesh so multi-chip sharding is validated without hardware
+(mirrors how the reference tests multi-node without a cluster — SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,103 @@
+"""Field oracle tests: parameters, arithmetic laws, NTT, codec."""
+
+import random
+
+import pytest
+
+from janus_tpu.fields import (
+    Field64,
+    Field128,
+    next_power_of_2,
+    ntt,
+    poly_eval,
+    poly_interp,
+    poly_mul,
+)
+
+FIELDS = [Field64, Field128]
+
+
+def test_moduli_match_vdaf_spec():
+    # draft-irtf-cfrg-vdaf-08 §6.1 field parameter tables.
+    assert Field64.MODULUS == 18446744069414584321  # 2^32 * 4294967295 + 1
+    assert Field128.MODULUS == 340282366920938462946865773367900766209
+    assert Field64.MODULUS == 2**64 - 2**32 + 1
+    assert Field128.MODULUS == 2**66 * 4611686018427387897 + 1
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_generator_order(field):
+    p = field.MODULUS
+    g = field.gen()
+    assert pow(g, field.gen_order(), p) == 1
+    assert pow(g, field.gen_order() // 2, p) != 1
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_arithmetic(field):
+    rng = random.Random(0)
+    p = field.MODULUS
+    for _ in range(200):
+        a, b = rng.randrange(p), rng.randrange(p)
+        assert field.add(a, b) == (a + b) % p
+        assert field.sub(a, b) == (a - b) % p
+        assert field.mul(a, b) == a * b % p
+        if a:
+            assert field.mul(a, field.inv(a)) == 1
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_codec_roundtrip(field):
+    rng = random.Random(1)
+    vec = [rng.randrange(field.MODULUS) for _ in range(17)]
+    data = field.encode_vec(vec)
+    assert len(data) == 17 * field.ENCODED_SIZE
+    assert field.decode_vec(data) == vec
+
+
+def test_decode_rejects_out_of_range():
+    data = (Field64.MODULUS).to_bytes(8, "little")
+    with pytest.raises(ValueError):
+        Field64.decode_vec(data)
+
+
+@pytest.mark.parametrize("field", FIELDS)
+@pytest.mark.parametrize("n", [1, 2, 8, 64])
+def test_ntt_roundtrip(field, n):
+    rng = random.Random(2)
+    coeffs = [rng.randrange(field.MODULUS) for _ in range(n)]
+    evals = ntt(field, coeffs)
+    # Forward NTT evaluates at powers of the principal n-th root.
+    if n > 1:
+        w = field.root(n)
+        for k in range(n):
+            assert evals[k] == poly_eval(field, coeffs, pow(w, k, field.MODULUS))
+    assert ntt(field, evals, inverse=True) == coeffs
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_poly_interp(field):
+    rng = random.Random(3)
+    n = 8
+    values = [rng.randrange(field.MODULUS) for _ in range(n)]
+    coeffs = poly_interp(field, values)
+    w = field.root(n)
+    for k in range(n):
+        assert poly_eval(field, coeffs, pow(w, k, field.MODULUS)) == values[k]
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_poly_mul(field):
+    rng = random.Random(4)
+    a = [rng.randrange(field.MODULUS) for _ in range(5)]
+    b = [rng.randrange(field.MODULUS) for _ in range(7)]
+    c = poly_mul(field, a, b)
+    x = rng.randrange(field.MODULUS)
+    assert poly_eval(field, c, x) == field.mul(poly_eval(field, a, x), poly_eval(field, b, x))
+
+
+def test_next_power_of_2():
+    assert next_power_of_2(1) == 1
+    assert next_power_of_2(2) == 2
+    assert next_power_of_2(3) == 4
+    assert next_power_of_2(5) == 8

@@ -1,0 +1,117 @@
+"""FLP proof system tests: completeness, share-linearity, soundness smoke."""
+
+import random
+
+import pytest
+
+from janus_tpu.fields import Field64, Field128
+from janus_tpu.flp import Count, FlpGeneric, Histogram, Sum, SumVec
+
+CIRCUITS = [
+    ("count", lambda: Count(), 1),
+    ("sum8", lambda: Sum(8), 200),
+    ("sumvec", lambda: SumVec(length=10, bits=4, chunk_length=3), [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]),
+    ("sumvec64", lambda: SumVec(length=6, bits=2, chunk_length=4, field=Field64), [0, 1, 2, 3, 0, 1]),
+    ("histogram", lambda: Histogram(length=20, chunk_length=7), 13),
+]
+
+
+def _rand_vec(field, n, rng):
+    return [rng.randrange(field.MODULUS) for _ in range(n)]
+
+
+@pytest.mark.parametrize("name,mk,measurement", CIRCUITS, ids=[c[0] for c in CIRCUITS])
+def test_prove_query_decide_roundtrip(name, mk, measurement):
+    rng = random.Random(hash(name) & 0xFFFF)
+    flp = FlpGeneric(mk())
+    f = flp.field
+    meas = flp.encode(measurement)
+    assert len(meas) == flp.MEAS_LEN
+    prove_rand = _rand_vec(f, flp.PROVE_RAND_LEN, rng)
+    joint_rand = _rand_vec(f, flp.JOINT_RAND_LEN, rng)
+    query_rand = _rand_vec(f, flp.QUERY_RAND_LEN, rng)
+    proof = flp.prove(meas, prove_rand, joint_rand)
+    assert len(proof) == flp.PROOF_LEN
+    verifier = flp.query(meas, proof, query_rand, joint_rand, 1)
+    assert len(verifier) == flp.VERIFIER_LEN
+    assert flp.decide(verifier)
+
+
+@pytest.mark.parametrize("name,mk,measurement", CIRCUITS, ids=[c[0] for c in CIRCUITS])
+def test_shared_query_linearity(name, mk, measurement):
+    """Verifier shares computed on additive shares sum to the whole verifier."""
+    rng = random.Random(hash(name) & 0xFFF1)
+    flp = FlpGeneric(mk())
+    f = flp.field
+    meas = flp.encode(measurement)
+    prove_rand = _rand_vec(f, flp.PROVE_RAND_LEN, rng)
+    joint_rand = _rand_vec(f, flp.JOINT_RAND_LEN, rng)
+    query_rand = _rand_vec(f, flp.QUERY_RAND_LEN, rng)
+    proof = flp.prove(meas, prove_rand, joint_rand)
+
+    # Split meas and proof into 2 additive shares.
+    meas_1 = _rand_vec(f, len(meas), rng)
+    meas_0 = f.vec_sub(meas, meas_1)
+    proof_1 = _rand_vec(f, len(proof), rng)
+    proof_0 = f.vec_sub(proof, proof_1)
+
+    v0 = flp.query(meas_0, proof_0, query_rand, joint_rand, 2)
+    v1 = flp.query(meas_1, proof_1, query_rand, joint_rand, 2)
+    combined = f.vec_add(v0, v1)
+    assert flp.decide(combined)
+    whole = flp.query(meas, proof, query_rand, joint_rand, 1)
+    assert combined == whole
+
+
+@pytest.mark.parametrize(
+    "mk,bad",
+    [
+        (lambda: Count(), [2]),  # not boolean
+        (lambda: Sum(4), [0, 2, 0, 0]),  # non-bit in decomposition
+        (lambda: Histogram(length=5, chunk_length=2), [1, 1, 0, 0, 0]),  # two-hot
+        (lambda: Histogram(length=5, chunk_length=2), [0, 0, 0, 0, 0]),  # zero-hot
+        (lambda: SumVec(length=3, bits=2, chunk_length=2), [1, 0, 3, 0, 0, 1]),  # non-bit
+    ],
+)
+def test_invalid_measurement_rejected(mk, bad):
+    rng = random.Random(99)
+    flp = FlpGeneric(mk())
+    f = flp.field
+    assert len(bad) == flp.MEAS_LEN
+    rejected = 0
+    for trial in range(8):
+        prove_rand = _rand_vec(f, flp.PROVE_RAND_LEN, rng)
+        joint_rand = _rand_vec(f, flp.JOINT_RAND_LEN, rng)
+        query_rand = _rand_vec(f, flp.QUERY_RAND_LEN, rng)
+        proof = flp.prove(bad, prove_rand, joint_rand)
+        verifier = flp.query(bad, proof, query_rand, joint_rand, 1)
+        if not flp.decide(verifier):
+            rejected += 1
+    # Soundness error is ~P/|F|, so every trial should reject.
+    assert rejected == 8
+
+
+def test_tampered_proof_rejected():
+    rng = random.Random(7)
+    flp = FlpGeneric(Histogram(length=10, chunk_length=4))
+    f = flp.field
+    meas = flp.encode(3)
+    prove_rand = _rand_vec(f, flp.PROVE_RAND_LEN, rng)
+    joint_rand = _rand_vec(f, flp.JOINT_RAND_LEN, rng)
+    query_rand = _rand_vec(f, flp.QUERY_RAND_LEN, rng)
+    proof = flp.prove(meas, prove_rand, joint_rand)
+    proof[len(proof) // 2] = f.add(proof[len(proof) // 2], 1)
+    verifier = flp.query(meas, proof, query_rand, joint_rand, 1)
+    assert not flp.decide(verifier)
+
+
+def test_truncate_decode():
+    s = Sum(8)
+    flp = FlpGeneric(s)
+    assert flp.decode(flp.truncate(flp.encode(200)), 1) == 200
+    h = Histogram(length=4, chunk_length=2)
+    fh = FlpGeneric(h)
+    assert fh.decode(fh.truncate(fh.encode(2)), 1) == [0, 0, 1, 0]
+    sv = SumVec(length=3, bits=4, chunk_length=2)
+    fsv = FlpGeneric(sv)
+    assert fsv.decode(fsv.truncate(fsv.encode([15, 0, 9])), 1) == [15, 0, 9]

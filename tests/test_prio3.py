@@ -1,0 +1,137 @@
+"""End-to-end Prio3 protocol tests over the ping-pong topology."""
+
+import random
+
+import pytest
+
+from janus_tpu.utils.test_util import run_vdaf
+from janus_tpu.vdaf import (
+    Prio3InputShare,
+    VdafError,
+    prio3_count,
+    prio3_histogram,
+    prio3_sum,
+    prio3_sum_vec,
+    prio3_sum_vec_field64_multiproof_hmacsha256_aes128,
+    vdaf_from_instance,
+)
+from janus_tpu.vdaf.pingpong import (
+    PingPongMessage,
+    helper_initialized,
+    leader_continued,
+    leader_initialized,
+)
+
+
+def _det_rng(seed):
+    r = random.Random(seed)
+    return lambda n: bytes(r.getrandbits(8) for _ in range(n))
+
+
+CASES = [
+    ("count", prio3_count(), [1, 0, 1, 1, 0, 1], 4),
+    ("sum", prio3_sum(8), [1, 2, 3, 250], 256),
+    (
+        "sumvec",
+        prio3_sum_vec(length=5, bits=4, chunk_length=3),
+        [[1, 2, 3, 4, 5], [5, 4, 3, 2, 1], [15, 15, 15, 15, 15]],
+        [21, 21, 21, 21, 21],
+    ),
+    ("histogram", prio3_histogram(length=10, chunk_length=4), [0, 3, 3, 9], [1, 0, 0, 2, 0, 0, 0, 0, 0, 1]),
+    (
+        "multiproof",
+        prio3_sum_vec_field64_multiproof_hmacsha256_aes128(proofs=2, length=4, bits=3, chunk_length=2),
+        [[1, 2, 3, 4], [7, 0, 7, 0]],
+        [8, 2, 10, 4],
+    ),
+]
+
+
+@pytest.mark.parametrize("name,vdaf,measurements,expected", CASES, ids=[c[0] for c in CASES])
+def test_end_to_end(name, vdaf, measurements, expected):
+    t = run_vdaf(vdaf, measurements, rng=_det_rng(name))
+    assert t.aggregate_result == expected
+
+
+def test_deterministic_transcript():
+    vdaf = prio3_histogram(length=8, chunk_length=3)
+    t1 = run_vdaf(vdaf, [2, 5], rng=_det_rng("det"))
+    t2 = run_vdaf(vdaf, [2, 5], rng=_det_rng("det"))
+    assert t1.reports[0].leader_message.encode() == t2.reports[0].leader_message.encode()
+    assert t1.leader_agg_share == t2.leader_agg_share
+
+
+def test_wrong_verify_key_rejected():
+    vdaf = prio3_histogram(length=8, chunk_length=3)
+    rng = _det_rng("vk")
+    nonce, rand = rng(16), rng(vdaf.RAND_SIZE)
+    public_share, shares = vdaf.shard(3, nonce, rand)
+    vk_leader, vk_helper = rng(16), rng(16)
+    assert vk_leader != vk_helper
+    _, leader_msg = leader_initialized(vdaf, vk_leader, nonce, public_share, shares[0])
+    with pytest.raises(VdafError):
+        helper_initialized(vdaf, vk_helper, nonce, public_share, shares[1], leader_msg)
+
+
+def test_tampered_input_share_rejected():
+    vdaf = prio3_sum(8)
+    rng = _det_rng("tamper")
+    vk = rng(16)
+    nonce, rand = rng(16), rng(vdaf.RAND_SIZE)
+    public_share, shares = vdaf.shard(17, nonce, rand)
+    bad = list(shares[0].meas_share)
+    bad[0] = vdaf.flp.field.add(bad[0], 1)
+    tampered = Prio3InputShare(
+        meas_share=bad,
+        proofs_share=shares[0].proofs_share,
+        joint_rand_blind=shares[0].joint_rand_blind,
+    )
+    _, leader_msg = leader_initialized(vdaf, vk, nonce, public_share, tampered)
+    with pytest.raises(VdafError):
+        helper_initialized(vdaf, vk, nonce, public_share, shares[1], leader_msg)
+
+
+def test_joint_rand_mismatch_detected_by_leader():
+    # Helper replying with a corrupted joint-rand confirmation must fail the leader.
+    vdaf = prio3_sum(4)
+    rng = _det_rng("jr")
+    vk = rng(16)
+    nonce, rand = rng(16), rng(vdaf.RAND_SIZE)
+    public_share, shares = vdaf.shard(5, nonce, rand)
+    state, leader_msg = leader_initialized(vdaf, vk, nonce, public_share, shares[0])
+    _, helper_msg = helper_initialized(vdaf, vk, nonce, public_share, shares[1], leader_msg)
+    corrupted = PingPongMessage(
+        PingPongMessage.FINISH, prep_msg=bytes(b ^ 1 for b in helper_msg.prep_msg)
+    )
+    with pytest.raises(VdafError):
+        leader_continued(vdaf, state, corrupted)
+
+
+def test_input_share_codec_roundtrip():
+    for vdaf in [prio3_count(), prio3_histogram(length=6, chunk_length=2)]:
+        rng = _det_rng("codec" + str(vdaf.algorithm_id))
+        nonce, rand = rng(16), rng(vdaf.RAND_SIZE)
+        public_share, shares = vdaf.shard(1, nonce, rand)
+        for agg_id, share in enumerate(shares):
+            enc = share.encode(vdaf)
+            dec = Prio3InputShare.decode(vdaf, agg_id, enc)
+            assert dec == share
+        enc_pub = vdaf.encode_public_share(public_share)
+        assert vdaf.decode_public_share(enc_pub) == public_share
+
+
+def test_ping_pong_message_codec():
+    for msg in [
+        PingPongMessage(PingPongMessage.INITIALIZE, prep_share=b"abc"),
+        PingPongMessage(PingPongMessage.CONTINUE, prep_share=b"abc", prep_msg=b"xyz"),
+        PingPongMessage(PingPongMessage.FINISH, prep_msg=b""),
+    ]:
+        assert PingPongMessage.decode(msg.encode()) == msg
+
+
+def test_instance_registry():
+    v = vdaf_from_instance({"type": "Prio3Histogram", "length": 16, "chunk_length": 4})
+    t = run_vdaf(v, [1, 1, 2], rng=_det_rng("reg"))
+    assert t.aggregate_result[1] == 2 and t.aggregate_result[2] == 1
+    with pytest.raises(ValueError):
+        vdaf_from_instance({"type": "Nope"})

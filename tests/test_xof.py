@@ -1,0 +1,62 @@
+"""XOF oracle tests.
+
+The sponge (absorb/pad/squeeze) is cross-validated against hashlib's SHAKE128
+by running the identical code path with 24 rounds and domain byte 0x1F — this
+pins down padding, lane packing, rotation offsets, chi/theta, and the round
+constant list (SHAKE uses all 24 constants in order; TurboSHAKE128 uses the
+final 12 of the same list).
+"""
+
+import hashlib
+
+from janus_tpu.fields import Field64, Field128
+from janus_tpu.xof import (
+    XofHmacSha256Aes128,
+    XofTurboShake128,
+    shake128,
+    turboshake128,
+)
+
+
+def test_shake128_matches_hashlib():
+    for msg_len in [0, 1, 5, 167, 168, 169, 336, 1000]:
+        msg = bytes(range(256))[: msg_len % 256] * (msg_len // 256 + 1)
+        msg = msg[:msg_len]
+        for out_len in [1, 16, 32, 168, 200]:
+            expected = hashlib.shake_128(msg).digest(out_len)
+            assert shake128(msg, out_len) == expected, (msg_len, out_len)
+
+
+def test_turboshake128_streaming_consistency():
+    # Streamed squeeze must match one-shot output.
+    x = XofTurboShake128(b"\x01" * 16, b"dst", b"binder")
+    stream = x.next(5) + x.next(200) + x.next(1)
+    oneshot = turboshake128(bytes([3]) + b"dst" + b"\x01" * 16 + b"binder", 0x01, 206)
+    assert stream == oneshot
+
+
+def test_turboshake128_dst_separation():
+    a = XofTurboShake128(b"\x00" * 16, b"a", b"").next(16)
+    b = XofTurboShake128(b"\x00" * 16, b"b", b"").next(16)
+    c = XofTurboShake128(b"\x00" * 16, b"a", b"x").next(16)
+    assert a != b and a != c and b != c
+
+
+def test_next_vec_in_range_and_deterministic():
+    for field in (Field64, Field128):
+        v1 = XofTurboShake128.expand_into_vec(field, b"\x07" * 16, b"dst", b"bnd", 100)
+        v2 = XofTurboShake128.expand_into_vec(field, b"\x07" * 16, b"dst", b"bnd", 100)
+        assert v1 == v2
+        assert all(0 <= x < field.MODULUS for x in v1)
+        # 100 uniform field elements are essentially never all small
+        assert max(v1) > field.MODULUS // 2
+
+
+def test_hmac_xof_basic():
+    x1 = XofHmacSha256Aes128(b"\x05" * 32, b"dst", b"bnd")
+    x2 = XofHmacSha256Aes128(b"\x05" * 32, b"dst", b"bnd")
+    s = x1.next(64)
+    assert s == x2.next(32) + x2.next(32)
+    assert XofHmacSha256Aes128(b"\x06" * 32, b"dst", b"bnd").next(64) != s
+    v = XofHmacSha256Aes128.expand_into_vec(Field64, b"\x05" * 32, b"d", b"", 50)
+    assert all(0 <= x < Field64.MODULUS for x in v)
