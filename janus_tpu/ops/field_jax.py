@@ -1,0 +1,307 @@
+"""Batched prime-field arithmetic on u32 limb tensors (JAX, TPU-friendly).
+
+A field element is a little-endian vector of u32 limbs along the trailing
+axis: shape (..., n_limbs).  Canonical form = integer < MODULUS; Montgomery
+form = x * R mod p with R = 2^(32 n).  ``mont_mul`` is CIOS Montgomery
+multiplication built from 16-bit half-limb products (TPU has no 64-bit
+integer multiply; 16x16->32 products are exact in u32).
+
+Bit-exactness: all ops are exact integer arithmetic mod p — there is no
+rounding or reassociation hazard — so any algebraically-equal formula yields
+identical limbs.  Tests compare against janus_tpu.fields on random and edge
+values.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_U32 = jnp.uint32
+_MASK16 = np.uint32(0xFFFF)
+
+
+def _u32(x: int):
+    return jnp.asarray(np.uint32(x), dtype=_U32)
+
+
+def _mul32(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact 32x32 -> 64 multiply as (hi, lo) u32 pairs via 16-bit halves."""
+    al = a & _MASK16
+    ah = a >> 16
+    bl = b & _MASK16
+    bh = b >> 16
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    mid = (ll >> 16) + (lh & _MASK16) + (hl & _MASK16)  # < 2^18, no overflow
+    lo = (ll & _MASK16) | ((mid & _MASK16) << 16)
+    hi = hh + (lh >> 16) + (hl >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _adc(a, b, carry_in):
+    """a + b + carry_in (carry_in in {0,1}) -> (sum, carry_out in {0,1})."""
+    s = a + b
+    c1 = (s < a).astype(_U32)
+    s2 = s + carry_in
+    c2 = (s2 < s).astype(_U32)
+    return s2, c1 | c2
+
+
+def _sbb(a, b, borrow_in):
+    """a - b - borrow_in -> (diff, borrow_out in {0,1})."""
+    d = a - b
+    b1 = (a < b).astype(_U32)
+    d2 = d - borrow_in
+    b2 = (d < borrow_in).astype(_U32)
+    return d2, b1 | b2
+
+
+def _mac(a, b, acc, carry):
+    """a*b + acc + carry -> (hi, lo); fits exactly in 64 bits."""
+    hi, lo = _mul32(a, b)
+    lo, c = _adc(lo, acc, _u32(0))
+    hi = hi + c
+    lo, c = _adc(lo, carry, _u32(0))
+    hi = hi + c
+    return hi, lo
+
+
+class JField:
+    """JAX batched ops for one of the oracle fields (janus_tpu.fields)."""
+
+    def __init__(self, oracle_field: type):
+        self.oracle = oracle_field
+        p = oracle_field.MODULUS
+        self.p = p
+        self.n = oracle_field.ENCODED_SIZE // 4  # u32 limbs per element
+        bits = 32 * self.n
+        r = (1 << bits) % p
+        self.p_np = self._int_to_limbs_np(p)
+        self.r2_np = self._int_to_limbs_np(r * r % p)
+        self.one_np = self._int_to_limbs_np(1)
+        self.n_prime = np.uint32((-pow(p, -1, 1 << 32)) % (1 << 32))
+        # p - 2 bits (MSB first) for Fermat inversion.
+        self._inv_exp_bits = np.array(
+            [int(b) for b in bin(p - 2)[2:]], dtype=np.uint32
+        )
+
+    # --- host-side conversions ----------------------------------------
+    def _int_to_limbs_np(self, x: int) -> np.ndarray:
+        return np.array(
+            [(x >> (32 * i)) & 0xFFFFFFFF for i in range(self.n)], dtype=np.uint32
+        )
+
+    def to_limbs(self, values: Sequence[int]) -> np.ndarray:
+        """Host: python ints -> (..., n) u32 canonical limbs."""
+        flat = np.empty((len(values), self.n), dtype=np.uint32)
+        for i, v in enumerate(values):
+            for j in range(self.n):
+                flat[i, j] = (v >> (32 * j)) & 0xFFFFFFFF
+        return flat
+
+    def from_limbs(self, limbs: np.ndarray) -> List[int]:
+        """Host: (..., n) u32 canonical limbs -> python ints (flattened)."""
+        arr = np.asarray(limbs, dtype=np.uint32).reshape(-1, self.n)
+        out = []
+        for row in arr:
+            v = 0
+            for j in range(self.n):
+                v |= int(row[j]) << (32 * j)
+            out.append(v)
+        return out
+
+    def const(self, value: int) -> jnp.ndarray:
+        """Canonical constant as a device limb vector."""
+        return jnp.asarray(self._int_to_limbs_np(value % self.p))
+
+    def mont_const(self, value: int) -> jnp.ndarray:
+        """Constant already converted to Montgomery form (host-side)."""
+        bits = 32 * self.n
+        return jnp.asarray(self._int_to_limbs_np((value % self.p) * (1 << bits) % self.p))
+
+    # --- device ops (operate on (..., n) u32; canonical in, canonical out
+    #     for add/sub; Montgomery domain for mont_mul chains) -----------
+    def zeros(self, shape) -> jnp.ndarray:
+        return jnp.zeros(tuple(shape) + (self.n,), dtype=_U32)
+
+    def _split(self, a):
+        return [a[..., i] for i in range(self.n)]
+
+    def _join(self, limbs):
+        return jnp.stack(limbs, axis=-1)
+
+    def _cond_sub_p(self, limbs, extra_bit):
+        """limbs (list of n) + extra_bit*2^(32n); subtract p if >= p."""
+        p = [ _u32(int(x)) for x in self.p_np ]
+        d = []
+        borrow = _u32(0)
+        for i in range(self.n):
+            di, borrow = _sbb(limbs[i], p[i], borrow)
+            d.append(di)
+        # subtract if extra_bit set or no borrow (value >= p)
+        take = (extra_bit | (1 - borrow)).astype(jnp.bool_)
+        return [jnp.where(take, d[i], limbs[i]) for i in range(self.n)]
+
+    def add(self, a, b):
+        """Canonical modular addition."""
+        aa, bb = self._split(a), self._split(b)
+        s = []
+        carry = _u32(0)
+        for i in range(self.n):
+            si, carry = _adc(aa[i], bb[i], carry)
+            s.append(si)
+        return self._join(self._cond_sub_p(s, carry))
+
+    def sub(self, a, b):
+        """Canonical modular subtraction."""
+        aa, bb = self._split(a), self._split(b)
+        d = []
+        borrow = _u32(0)
+        for i in range(self.n):
+            di, borrow = _sbb(aa[i], bb[i], borrow)
+            d.append(di)
+        # add p back when we borrowed
+        p = [ _u32(int(x)) for x in self.p_np ]
+        s = []
+        carry = _u32(0)
+        for i in range(self.n):
+            si, carry = _adc(d[i], p[i], carry)
+            s.append(si)
+        use_add = borrow.astype(jnp.bool_)
+        return self._join([jnp.where(use_add, s[i], d[i]) for i in range(self.n)])
+
+    def neg(self, a):
+        return self.sub(self.zeros(a.shape[:-1]), a)
+
+    def mont_mul(self, a, b):
+        """CIOS Montgomery multiplication: returns a*b*R^-1 mod p, canonical."""
+        n = self.n
+        aa, bb = self._split(a), self._split(b)
+        p = [ _u32(int(x)) for x in self.p_np ]
+        npr = _u32(int(self.n_prime))
+        zero = jnp.zeros_like(aa[0])
+        t = [zero] * (n + 2)
+        for i in range(n):
+            carry = zero
+            for j in range(n):
+                hi, lo = _mac(aa[i], bb[j], t[j], carry)
+                t[j] = lo
+                carry = hi
+            s, c = _adc(t[n], carry, zero)
+            t[n] = s
+            t[n + 1] = t[n + 1] + c
+            m = t[0] * npr  # wrapping u32 multiply
+            hi, _lo = _mac(m, p[0], t[0], zero)
+            carry = hi
+            for j in range(1, n):
+                hi, lo = _mac(m, p[j], t[j], carry)
+                t[j - 1] = lo
+                carry = hi
+            s, c = _adc(t[n], carry, zero)
+            t[n - 1] = s
+            t[n] = t[n + 1] + c
+            t[n + 1] = zero
+        return self._join(self._cond_sub_p(t[:n], t[n]))
+
+    def to_mont(self, a):
+        r2 = jnp.asarray(self.r2_np)
+        return self.mont_mul(a, jnp.broadcast_to(r2, a.shape))
+
+    def from_mont(self, a):
+        one = jnp.asarray(self.one_np)
+        return self.mont_mul(a, jnp.broadcast_to(one, a.shape))
+
+    def mont_one(self):
+        bits = 32 * self.n
+        return jnp.asarray(self._int_to_limbs_np((1 << bits) % self.p))
+
+    def inv_mont(self, a):
+        """Fermat inversion in Montgomery domain: a^(p-2).  inv(0) = 0."""
+        bits = jnp.asarray(self._inv_exp_bits)
+        one = jnp.broadcast_to(self.mont_one(), a.shape)
+
+        def body(acc, bit):
+            acc = self.mont_mul(acc, acc)
+            mul = self.mont_mul(acc, a)
+            take = (bit == 1)
+            acc = jnp.where(take, mul, acc)
+            return acc, None
+
+        acc, _ = lax.scan(body, one, bits)
+        return acc
+
+    def eq(self, a, b):
+        """Elementwise equality of canonical limb vectors -> bool (...)."""
+        return jnp.all(a == b, axis=-1)
+
+    def is_zero(self, a):
+        return jnp.all(a == 0, axis=-1)
+
+    def sum(self, a, axis: int):
+        """Exact modular reduction (tree) along an element axis."""
+        axis = axis % (a.ndim - 1)  # never the limb axis
+        length = a.shape[axis]
+        while length > 1:
+            half = length // 2
+            lo = lax.slice_in_dim(a, 0, half, axis=axis)
+            hi = lax.slice_in_dim(a, half, 2 * half, axis=axis)
+            rest = lax.slice_in_dim(a, 2 * half, length, axis=axis)
+            a = jnp.concatenate([self.add(lo, hi), rest], axis=axis)
+            length = half + (length - 2 * half)
+        return jnp.squeeze(a, axis=axis)
+
+    def cumprod_mont(self, a, axis: int):
+        """Inclusive cumulative product (Montgomery domain) along an axis."""
+        axis = axis % (a.ndim - 1)
+        return lax.associative_scan(self.mont_mul, a, axis=axis)
+
+    def horner_mont(self, coeffs, x):
+        """Evaluate poly with coeff tensor (..., n_coeffs, n_limbs) at x (..., n_limbs).
+
+        Low-order-first coefficients (matching the oracle); Montgomery domain.
+        """
+        rev = jnp.flip(coeffs, axis=-2)
+        # scan over coefficient axis
+        cs = jnp.moveaxis(rev, -2, 0)
+
+        def body(acc, c):
+            return self.add(self.mont_mul(acc, x), c), None
+
+        acc0 = jnp.zeros_like(x)
+        acc, _ = lax.scan(body, acc0, cs)
+        return acc
+
+    def batch_inv_mont(self, a, axis: int):
+        """Montgomery-trick batched inversion along an axis (all nonzero)."""
+        axis = axis % (a.ndim - 1)
+        prefix = self.cumprod_mont(a, axis)  # inclusive
+        total = lax.slice_in_dim(prefix, a.shape[axis] - 1, a.shape[axis], axis=axis)
+        inv_total = self.inv_mont(jnp.squeeze(total, axis=axis))
+        # inv(a_k) = prefix_{k-1} * inv_suffix_k where we walk backwards.
+        # Simpler: inv_k = inv_total * prod_{j != k} a_j = inv_total *
+        # prefix_{k-1} * suffix_{k+1}.
+        ones = jnp.broadcast_to(
+            self.mont_one(), lax.slice_in_dim(a, 0, 1, axis=axis).shape
+        )
+        prefix_excl = jnp.concatenate(
+            [ones, lax.slice_in_dim(prefix, 0, a.shape[axis] - 1, axis=axis)], axis=axis
+        )
+        rev = jnp.flip(a, axis=axis)
+        suffix_incl_rev = self.cumprod_mont(rev, axis)
+        # suffix_excl[k] = prod_{j>k} a_j = suffix_incl_rev[L-2-k]; last is empty.
+        suffix_excl = jnp.concatenate(
+            [
+                jnp.flip(lax.slice_in_dim(suffix_incl_rev, 0, a.shape[axis] - 1, axis=axis), axis=axis),
+                ones,
+            ],
+            axis=axis,
+        )
+        others = self.mont_mul(prefix_excl, suffix_excl)
+        inv_b = jnp.expand_dims(inv_total, axis=axis)
+        return self.mont_mul(others, jnp.broadcast_to(inv_b, a.shape))

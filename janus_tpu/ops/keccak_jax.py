@@ -1,0 +1,177 @@
+"""Vectorized TurboSHAKE128 on u32 lane pairs (JAX, TPU-friendly).
+
+Keccak-p[1600,12] with each 64-bit lane held as two u32s (lo, hi) — TPU has no
+64-bit integer registers, and all rotations/xors decompose exactly onto u32
+lanes.  The batch axis broadcasts over reports: one call absorbs/squeezes the
+XOF streams for a whole aggregation job (the reference runs the scalar
+equivalent per report inside rayon tasks; SURVEY.md §2.3 P1).
+
+Message layouts are static per VDAF configuration, so padding is baked at
+trace time.  Byte streams are u8 tensors; lane packing is explicit arithmetic
+(no bitcasts) for backend-independent determinism.
+
+Bit-exact against the oracle in janus_tpu.xof (tests/test_ops_keccak.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..xof import ROUND_CONSTANTS, _RHO
+
+_U32 = jnp.uint32
+RATE = 168  # bytes
+RATE_WORDS = RATE // 4  # 42 u32 words = 21 lanes
+_ROUNDS = 12
+
+# Per-round constants as (lo, hi) u32 pairs for the final 12 rounds.
+_RC_PAIRS = np.array(
+    [[rc & 0xFFFFFFFF, rc >> 32] for rc in ROUND_CONSTANTS[24 - _ROUNDS :]],
+    dtype=np.uint32,
+)
+
+
+def _rotl_pair(lo, hi, r: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotate a 64-bit lane (as u32 lo/hi) left by static amount r."""
+    r = r % 64
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        return (
+            (lo << r) | (hi >> (32 - r)),
+            (hi << r) | (lo >> (32 - r)),
+        )
+    s = r - 32
+    return (
+        (hi << s) | (lo >> (32 - s)),
+        (lo << s) | (hi >> (32 - s)),
+    )
+
+
+def keccak_p_batch(state: jnp.ndarray) -> jnp.ndarray:
+    """Keccak-p[1600,12] on state (..., 50) u32: lane i = (state[2i], state[2i+1])."""
+    lanes = [(state[..., 2 * i], state[..., 2 * i + 1]) for i in range(25)]
+    for rnd in range(_ROUNDS):
+        # theta
+        c = []
+        for x in range(5):
+            lo = lanes[x][0] ^ lanes[x + 5][0] ^ lanes[x + 10][0] ^ lanes[x + 15][0] ^ lanes[x + 20][0]
+            hi = lanes[x][1] ^ lanes[x + 5][1] ^ lanes[x + 10][1] ^ lanes[x + 15][1] ^ lanes[x + 20][1]
+            c.append((lo, hi))
+        d = []
+        for x in range(5):
+            rl, rh = _rotl_pair(*c[(x + 1) % 5], 1)
+            d.append((c[(x - 1) % 5][0] ^ rl, c[(x - 1) % 5][1] ^ rh))
+        lanes = [(lanes[i][0] ^ d[i % 5][0], lanes[i][1] ^ d[i % 5][1]) for i in range(25)]
+        # rho + pi
+        b: List = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                src = x + 5 * y
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl_pair(*lanes[src], _RHO[src])
+        # chi
+        lanes = [
+            (
+                b[i][0] ^ (~b[(i % 5 + 1) % 5 + 5 * (i // 5)][0] & b[(i % 5 + 2) % 5 + 5 * (i // 5)][0]),
+                b[i][1] ^ (~b[(i % 5 + 1) % 5 + 5 * (i // 5)][1] & b[(i % 5 + 2) % 5 + 5 * (i // 5)][1]),
+            )
+            for i in range(25)
+        ]
+        # iota
+        lanes[0] = (lanes[0][0] ^ np.uint32(_RC_PAIRS[rnd, 0]), lanes[0][1] ^ np.uint32(_RC_PAIRS[rnd, 1]))
+    flat = []
+    for i in range(25):
+        flat.append(lanes[i][0])
+        flat.append(lanes[i][1])
+    return jnp.stack(flat, axis=-1)
+
+
+def bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4k) u8 -> (..., k) u32, little-endian."""
+    shape = b.shape[:-1] + (b.shape[-1] // 4, 4)
+    w = b.reshape(shape).astype(_U32)
+    return w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+
+
+def words_to_bytes(w: jnp.ndarray) -> jnp.ndarray:
+    """(..., k) u32 -> (..., 4k) u8, little-endian."""
+    parts = jnp.stack(
+        [
+            (w & 0xFF).astype(jnp.uint8),
+            ((w >> 8) & 0xFF).astype(jnp.uint8),
+            ((w >> 16) & 0xFF).astype(jnp.uint8),
+            ((w >> 24) & 0xFF).astype(jnp.uint8),
+        ],
+        axis=-1,
+    )
+    return parts.reshape(w.shape[:-1] + (w.shape[-1] * 4,))
+
+
+def _pad_message(msg: jnp.ndarray, domain: int) -> jnp.ndarray:
+    """TurboSHAKE pad: append D, zero-fill to the rate, xor 0x80 into the last
+    byte of the final block.  msg: (..., L) u8 with static L."""
+    L = msg.shape[-1]
+    nblocks = L // RATE + 1
+    pad_len = nblocks * RATE - L
+    pad = np.zeros(pad_len, dtype=np.uint8)
+    pad[0] = domain
+    pad[-1] ^= 0x80
+    pad_arr = jnp.broadcast_to(jnp.asarray(pad), msg.shape[:-1] + (pad_len,))
+    return jnp.concatenate([msg, pad_arr], axis=-1)
+
+
+def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndarray:
+    """One-shot TurboSHAKE128 over a batch: msg (..., L) u8 -> (..., out_len) u8.
+
+    L and out_len are static.  Matches janus_tpu.xof.turboshake128 exactly.
+    """
+    padded = _pad_message(msg, domain)
+    batch_shape = padded.shape[:-1]
+    nblocks = padded.shape[-1] // RATE
+    words = bytes_to_words(padded).reshape(batch_shape + (nblocks, RATE_WORDS))
+    state0 = jnp.zeros(batch_shape + (50,), dtype=_U32)
+
+    # absorb: xor each block into the rate words, permute
+    blocks = jnp.moveaxis(words, -2, 0)  # (nblocks, ..., 42)
+
+    def absorb(state, block):
+        rate_part = state[..., :RATE_WORDS] ^ block
+        state = jnp.concatenate([rate_part, state[..., RATE_WORDS:]], axis=-1)
+        return keccak_p_batch(state), None
+
+    state, _ = lax.scan(absorb, state0, blocks)
+
+    # squeeze
+    out_blocks = (out_len + RATE - 1) // RATE
+
+    def squeeze(state, _):
+        out = state[..., :RATE_WORDS]
+        return keccak_p_batch(state), out
+
+    state, outs = lax.scan(squeeze, state, None, length=out_blocks)
+    outs = jnp.moveaxis(outs, 0, -2)  # (..., out_blocks, 42)
+    out_bytes = words_to_bytes(outs.reshape(batch_shape + (out_blocks * RATE_WORDS,)))
+    return out_bytes[..., :out_len]
+
+
+def xof_turboshake128_batch(
+    seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, out_len: int
+) -> jnp.ndarray:
+    """Batched XofTurboShake128 (draft-irtf-cfrg-vdaf-08 §6.2.1): message is
+    len(dst) || dst || seed || binder with domain byte 0x01.
+
+    seed: (..., 16) u8; binder: (..., B) u8 (static B, may be 0); dst: host bytes.
+    """
+    prefix = np.frombuffer(bytes([len(dst)]) + dst, dtype=np.uint8)
+    batch_shape = seed.shape[:-1]
+    parts = [jnp.broadcast_to(jnp.asarray(prefix), batch_shape + (len(prefix),)), seed]
+    if binder.shape[-1]:
+        parts.append(binder)
+    msg = jnp.concatenate(parts, axis=-1)
+    return turboshake128_batch(msg, 0x01, out_len)

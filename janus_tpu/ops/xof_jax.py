@@ -1,0 +1,58 @@
+"""Device-side XOF field-vector expansion with exact rejection sampling.
+
+Mirrors janus_tpu.xof.Xof.next_vec (draft-irtf-cfrg-vdaf-08 §6.2.1): the XOF
+stream is consumed in ENCODED_SIZE-byte candidates, little-endian; candidates
+>= MODULUS are skipped.  Rejections are vanishingly rare (~2^-32 per candidate
+for Field64, ~2^-62 for Field128) but must be handled exactly for
+byte-compatibility with the oracle, so the kernel over-samples a margin and
+compacts valid candidates with a stable sort; an ``ok`` mask flags the
+(astronomically unlikely) case that the margin was insufficient, for host
+fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .field_jax import JField, _sbb, _u32
+from .keccak_jax import RATE, bytes_to_words, xof_turboshake128_batch
+
+
+def limbs_from_stream(jf: JField, stream: jnp.ndarray, num_elems: int) -> jnp.ndarray:
+    """(..., num_elems * 4n) u8 -> (..., num_elems, n) u32 little-endian."""
+    words = bytes_to_words(stream)
+    return words.reshape(words.shape[:-1] + (num_elems, jf.n))
+
+
+def _is_canonical(jf: JField, limbs: jnp.ndarray) -> jnp.ndarray:
+    """True where the limb value is < MODULUS.  limbs: (..., n) -> (...)."""
+    borrow = _u32(0)
+    p = jf.p_np
+    for i in range(jf.n):
+        _, borrow = _sbb(limbs[..., i], jnp.asarray(np.uint32(p[i])), borrow)
+    return borrow == 1
+
+
+def xof_next_vec_batch(
+    jf: JField, seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, length: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched XofTurboShake128(...).next_vec(field, length).
+
+    seed (..., 16) u8, binder (..., B) u8 -> (canonical limbs (..., length, n),
+    ok (...) bool).  ``ok`` False means rejections exceeded the margin and the
+    affected batch row must be recomputed on the host oracle.
+    """
+    elem_size = 4 * jf.n
+    margin = max(2, RATE // elem_size)
+    total = length + margin
+    stream = xof_turboshake128_batch(seed, dst, binder, total * elem_size)
+    cand = limbs_from_stream(jf, stream, total)  # (..., total, n)
+    valid = _is_canonical(jf, cand)  # (..., total)
+    # Stable-compact valid candidates to the front, preserving stream order.
+    order = jnp.argsort(~valid, axis=-1, stable=True)  # valid-first
+    taken = jnp.take_along_axis(cand, order[..., :length, None], axis=-2)
+    ok = jnp.sum(valid.astype(jnp.int32), axis=-1) >= length
+    return taken, ok

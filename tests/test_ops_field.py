@@ -1,0 +1,140 @@
+"""JAX limb field ops vs the scalar oracle — must agree exactly."""
+
+import random
+
+import numpy as np
+import pytest
+
+from janus_tpu.fields import Field64, Field128
+from janus_tpu.ops.field_jax import JField
+
+FIELDS = [Field64, Field128]
+
+
+def _edge_values(field):
+    p = field.MODULUS
+    vals = [0, 1, 2, p - 1, p - 2, (1 << 32) - 1, 1 << 32, (1 << 32) + 1]
+    if field.ENCODED_SIZE == 16:
+        vals += [(1 << 64) - 1, 1 << 64, (1 << 96) + 5, p - (1 << 66)]
+    return [v % p for v in vals]
+
+
+def _pairs(field, count=200, seed=0):
+    rng = random.Random(seed)
+    edges = _edge_values(field)
+    a = edges + [rng.randrange(field.MODULUS) for _ in range(count)]
+    b = list(reversed(edges)) + [rng.randrange(field.MODULUS) for _ in range(count)]
+    return a, b
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_limb_roundtrip(field):
+    jf = JField(field)
+    vals = _edge_values(field) + [12345678901234567890 % field.MODULUS]
+    limbs = jf.to_limbs(vals)
+    assert jf.from_limbs(limbs) == vals
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_add_sub(field):
+    jf = JField(field)
+    a, b = _pairs(field)
+    la, lb = jf.to_limbs(a), jf.to_limbs(b)
+    got_add = jf.from_limbs(np.asarray(jf.add(la, lb)))
+    got_sub = jf.from_limbs(np.asarray(jf.sub(la, lb)))
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert got_add[i] == field.add(x, y), (i, x, y)
+        assert got_sub[i] == field.sub(x, y), (i, x, y)
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_mont_mul(field):
+    jf = JField(field)
+    a, b = _pairs(field)
+    la, lb = jf.to_limbs(a), jf.to_limbs(b)
+    ma, mb = jf.to_mont(la), jf.to_mont(lb)
+    got = jf.from_limbs(np.asarray(jf.from_mont(jf.mont_mul(ma, mb))))
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert got[i] == field.mul(x, y), (i, x, y)
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_mont_roundtrip(field):
+    jf = JField(field)
+    vals = _edge_values(field)
+    limbs = jf.to_limbs(vals)
+    back = jf.from_limbs(np.asarray(jf.from_mont(jf.to_mont(limbs))))
+    assert back == vals
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_inv(field):
+    jf = JField(field)
+    rng = random.Random(3)
+    vals = [1, 2, field.MODULUS - 1] + [rng.randrange(1, field.MODULUS) for _ in range(20)]
+    m = jf.to_mont(jf.to_limbs(vals))
+    got = jf.from_limbs(np.asarray(jf.from_mont(jf.inv_mont(m))))
+    for i, v in enumerate(vals):
+        assert got[i] == field.inv(v), (i, v)
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_batch_inv(field):
+    jf = JField(field)
+    rng = random.Random(4)
+    vals = [rng.randrange(1, field.MODULUS) for _ in range(13)]
+    m = jf.to_mont(jf.to_limbs(vals))
+    got = jf.from_limbs(np.asarray(jf.from_mont(jf.batch_inv_mont(m, axis=0))))
+    for i, v in enumerate(vals):
+        assert got[i] == field.inv(v), (i, v)
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_sum_and_cumprod(field):
+    jf = JField(field)
+    rng = random.Random(5)
+    vals = [rng.randrange(field.MODULUS) for _ in range(11)]
+    limbs = jf.to_limbs(vals)
+    got = jf.from_limbs(np.asarray(jf.sum(limbs, axis=0)))
+    want = 0
+    for v in vals:
+        want = field.add(want, v)
+    assert got == [want]
+
+    m = jf.to_mont(limbs)
+    got_cp = jf.from_limbs(np.asarray(jf.from_mont(jf.cumprod_mont(m, axis=0))))
+    acc = 1
+    for i, v in enumerate(vals):
+        acc = field.mul(acc, v)
+        assert got_cp[i] == acc
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_horner(field):
+    from janus_tpu.fields import poly_eval
+
+    jf = JField(field)
+    rng = random.Random(6)
+    coeffs = [rng.randrange(field.MODULUS) for _ in range(9)]
+    xs = [rng.randrange(field.MODULUS) for _ in range(4)]
+    mc = jf.to_mont(jf.to_limbs(coeffs))  # (9, n)
+    mx = jf.to_mont(jf.to_limbs(xs))  # (4, n)
+    mc_b = np.broadcast_to(np.asarray(mc), (4, 9, jf.n))
+    got = jf.from_limbs(np.asarray(jf.from_mont(jf.horner_mont(mc_b, mx))))
+    for i, x in enumerate(xs):
+        assert got[i] == poly_eval(field, coeffs, x), i
+
+
+@pytest.mark.parametrize("field", FIELDS)
+def test_batched_shapes(field):
+    """Ops broadcast over leading axes (the report axis)."""
+    jf = JField(field)
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1 << 32, size=(3, 5, jf.n), dtype=np.uint32)
+    # force canonical: zero the top limb to stay < p
+    a[..., -1] = 0
+    b = np.array(a[::-1])
+    s = np.asarray(jf.add(a, b))
+    assert s.shape == (3, 5, jf.n)
+    m = np.asarray(jf.mont_mul(jf.to_mont(a), jf.to_mont(b)))
+    assert m.shape == (3, 5, jf.n)
