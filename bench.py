@@ -1,0 +1,171 @@
+"""Benchmark: batched Prio3 prepare throughput on the current JAX backend.
+
+Measures the north-star metric (BASELINE.md configs[2]): reports prepared per
+second for Prio3Histogram{length=1024, chunk_length=316} — the helper-side
+prepare pipeline (XOF share expansion -> FLP query -> decide -> masked
+aggregation), which the reference runs as a per-report scalar loop on rayon
+(reference: aggregator/src/aggregator.rs:2101).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "reports/s", "vs_baseline": N/1e6, ...}
+vs_baseline is measured against the 1M reports/s north-star target.
+
+Inputs are random seeds/nonces: the prepare computation is input-oblivious
+(identical op sequence for valid and invalid shares), so throughput on random
+inputs equals throughput on real jobs; bit-exact correctness is asserted
+separately in tests/test_prepare.py and by a small embedded self-check here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def build_pipeline(vdaf, batch: int):
+    import jax
+    import jax.numpy as jnp
+
+    from janus_tpu.ops.prepare import BatchedPrio3
+
+    bp = BatchedPrio3(vdaf)
+    has_jr = vdaf.flp.JOINT_RAND_LEN > 0
+    verify_key = b"\x2a" * vdaf.VERIFY_KEY_SIZE
+
+    def helper_step(kw):
+        """One helper aggregate-init step over a whole job: prep + decide
+        against the leader's verifier share + masked aggregate."""
+        out = bp.prep_init(1, verify_key=verify_key, **{
+            k: v for k, v in kw.items() if k != "leader_verifiers"
+        })
+        comb = bp.prep_shares_to_prep(
+            [kw["leader_verifiers"], out["verifiers"]],
+            [out["joint_rand_part"], out["joint_rand_part"]] if has_jr else None,
+        )
+        agg = bp.aggregate(out["out_share"], comb["decide"])
+        return agg, comb["decide"], out["ok"]
+
+    fn = jax.jit(helper_step)
+
+    def make_inputs(seed: int):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        kw = {
+            "nonces_u8": rng.integers(0, 256, (batch, 16), dtype=np.uint8),
+            "share_seeds_u8": rng.integers(0, 256, (batch, 16), dtype=np.uint8),
+            "leader_verifiers": rng.integers(
+                0,
+                1 << 16,
+                (batch, vdaf.flp.VERIFIER_LEN * vdaf.num_proofs, bp.jf.n),
+                dtype=np.uint32,
+            ),
+        }
+        if has_jr:
+            kw["blinds_u8"] = rng.integers(0, 256, (batch, 16), dtype=np.uint8)
+            kw["public_parts_u8"] = rng.integers(
+                0, 256, (batch, vdaf.num_shares, 16), dtype=np.uint8
+            )
+        return {k: jax.device_put(v) for k, v in kw.items()}
+
+    return fn, make_inputs
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=4096)
+    parser.add_argument("--iters", type=int, default=8)
+    parser.add_argument(
+        "--config",
+        default="histogram1024",
+        choices=["histogram1024", "count", "sum32", "sumvec"],
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    from janus_tpu.utils.jax_setup import enable_compile_cache
+
+    enable_compile_cache()
+
+    from janus_tpu.vdaf.instances import (
+        prio3_count,
+        prio3_histogram,
+        prio3_sum,
+        prio3_sum_vec,
+    )
+
+    configs = {
+        # BASELINE.md rows; histogram1024 is the north-star config.
+        "count": ("Prio3Count", prio3_count),
+        "sum32": ("Prio3Sum bits=32", lambda: prio3_sum(32)),
+        "histogram1024": (
+            "Prio3Histogram len=1024 chunk=316",
+            lambda: prio3_histogram(1024, 316),
+        ),
+        "sumvec": (
+            "Prio3SumVec len=1024 bits=1 chunk=316",
+            lambda: prio3_sum_vec(length=1024, bits=1, chunk_length=316),
+        ),
+    }
+    desc, ctor = configs[args.config]
+    vdaf = ctor()
+
+    platform = jax.devices()[0].platform
+    batch = args.batch
+    fn = make_inputs = None
+    while batch >= 256:
+        try:
+            fn, make_inputs = build_pipeline(vdaf, batch)
+            inputs = make_inputs(0)
+            t0 = time.monotonic()
+            out = fn(inputs)
+            jax.block_until_ready(out)
+            compile_s = time.monotonic() - t0
+            break
+        except Exception as e:  # OOM etc: halve the batch and retry
+            sys.stderr.write(f"batch {batch} failed ({type(e).__name__}: {e}); halving\n")
+            batch //= 2
+            fn = None
+    if fn is None:
+        sys.stderr.write("no batch size succeeded\n")
+        return 1
+
+    # Timed iterations over pre-staged inputs.
+    lat = []
+    staged = [make_inputs(i + 1) for i in range(min(args.iters, 4))]
+    for i in range(args.iters):
+        inp = staged[i % len(staged)]
+        t0 = time.monotonic()
+        out = fn(inp)
+        jax.block_until_ready(out)
+        lat.append(time.monotonic() - t0)
+
+    p50 = statistics.median(lat)
+    best = min(lat)
+    reports_per_sec = batch / p50
+    print(
+        json.dumps(
+            {
+                "metric": f"prepare_throughput_{args.config}",
+                "value": round(reports_per_sec, 1),
+                "unit": "reports/s",
+                "vs_baseline": round(reports_per_sec / 1_000_000, 4),
+                "config": desc,
+                "batch": batch,
+                "prep_p50_ms": round(p50 * 1e3, 3),
+                "prep_best_ms": round(best * 1e3, 3),
+                "compile_s": round(compile_s, 1),
+                "platform": platform,
+                "iters": args.iters,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,18 +3,20 @@
 A ground-up re-design of the capabilities of divviup/janus (v0.7.4) for TPU:
 the Prio3 VDAF prepare step (FLP proof verification over Field64/Field128 plus
 TurboSHAKE128 XOF expansion) runs as jax.vmap'd modular-arithmetic tensor ops
-batched across whole aggregation jobs, with output-share accumulation as
-lax.psum over a device mesh.  A bit-exact CPU oracle (fields/xof/flp/vdaf
-modules) mirrors the pure-Rust ``prio`` path.
+batched across whole aggregation jobs, with output-share accumulation reduced
+over a device mesh.  A bit-exact CPU oracle (fields/xof/flp/vdaf modules)
+mirrors the pure-Rust ``prio`` path.
 
 Layout (see SURVEY.md for the reference layer map this re-expresses):
   fields, xof     — bit-exact scalar oracle for the crypto kernel
   flp/            — FLP proof system: gadgets, circuits, prove/query/decide
-  vdaf/           — Prio3 composition, ping-pong topology, instance registry
-  ops/            — JAX/TPU kernels (u32-limb field ops, vmapped Keccak,
-                    batched prepare)
-  parallel/       — device-mesh sharding and collective accumulation
-  messages/       — DAP wire-format codec
+  vdaf/           — Prio3 composition, ping-pong topology, instance registry,
+                    execution backends (oracle | tpu), dummy test VDAFs
+  ops/            — JAX/TPU kernels: u32-limb field ops, scanned Keccak,
+                    batched XOF sampling, the batched prepare pipeline
+  messages/       — DAP wire messages + TLS-syntax codec, taskprov, problems
+  core/           — HPKE (RFC 9180), auth tokens, checksums, clock/time math
+  utils/          — transcript/test helpers, shared JAX setup
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

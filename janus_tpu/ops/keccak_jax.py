@@ -54,42 +54,56 @@ def _rotl_pair(lo, hi, r: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     )
 
 
-def keccak_p_batch(state: jnp.ndarray) -> jnp.ndarray:
-    """Keccak-p[1600,12] on state (..., 50) u32: lane i = (state[2i], state[2i+1])."""
+def _keccak_round(state: jnp.ndarray, rc_pair: jnp.ndarray) -> jnp.ndarray:
+    """One Keccak round on state (..., 50) u32 (lane i = pairs 2i, 2i+1)."""
     lanes = [(state[..., 2 * i], state[..., 2 * i + 1]) for i in range(25)]
-    for rnd in range(_ROUNDS):
-        # theta
-        c = []
-        for x in range(5):
-            lo = lanes[x][0] ^ lanes[x + 5][0] ^ lanes[x + 10][0] ^ lanes[x + 15][0] ^ lanes[x + 20][0]
-            hi = lanes[x][1] ^ lanes[x + 5][1] ^ lanes[x + 10][1] ^ lanes[x + 15][1] ^ lanes[x + 20][1]
-            c.append((lo, hi))
-        d = []
-        for x in range(5):
-            rl, rh = _rotl_pair(*c[(x + 1) % 5], 1)
-            d.append((c[(x - 1) % 5][0] ^ rl, c[(x - 1) % 5][1] ^ rh))
-        lanes = [(lanes[i][0] ^ d[i % 5][0], lanes[i][1] ^ d[i % 5][1]) for i in range(25)]
-        # rho + pi
-        b: List = [None] * 25
-        for x in range(5):
-            for y in range(5):
-                src = x + 5 * y
-                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl_pair(*lanes[src], _RHO[src])
-        # chi
-        lanes = [
-            (
-                b[i][0] ^ (~b[(i % 5 + 1) % 5 + 5 * (i // 5)][0] & b[(i % 5 + 2) % 5 + 5 * (i // 5)][0]),
-                b[i][1] ^ (~b[(i % 5 + 1) % 5 + 5 * (i // 5)][1] & b[(i % 5 + 2) % 5 + 5 * (i // 5)][1]),
-            )
-            for i in range(25)
-        ]
-        # iota
-        lanes[0] = (lanes[0][0] ^ np.uint32(_RC_PAIRS[rnd, 0]), lanes[0][1] ^ np.uint32(_RC_PAIRS[rnd, 1]))
+    # theta
+    c = []
+    for x in range(5):
+        lo = lanes[x][0] ^ lanes[x + 5][0] ^ lanes[x + 10][0] ^ lanes[x + 15][0] ^ lanes[x + 20][0]
+        hi = lanes[x][1] ^ lanes[x + 5][1] ^ lanes[x + 10][1] ^ lanes[x + 15][1] ^ lanes[x + 20][1]
+        c.append((lo, hi))
+    d = []
+    for x in range(5):
+        rl, rh = _rotl_pair(*c[(x + 1) % 5], 1)
+        d.append((c[(x - 1) % 5][0] ^ rl, c[(x - 1) % 5][1] ^ rh))
+    lanes = [(lanes[i][0] ^ d[i % 5][0], lanes[i][1] ^ d[i % 5][1]) for i in range(25)]
+    # rho + pi
+    b: List = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            src = x + 5 * y
+            b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl_pair(*lanes[src], _RHO[src])
+    # chi
+    lanes = [
+        (
+            b[i][0] ^ (~b[(i % 5 + 1) % 5 + 5 * (i // 5)][0] & b[(i % 5 + 2) % 5 + 5 * (i // 5)][0]),
+            b[i][1] ^ (~b[(i % 5 + 1) % 5 + 5 * (i // 5)][1] & b[(i % 5 + 2) % 5 + 5 * (i // 5)][1]),
+        )
+        for i in range(25)
+    ]
+    # iota
+    lanes[0] = (lanes[0][0] ^ rc_pair[0], lanes[0][1] ^ rc_pair[1])
     flat = []
     for i in range(25):
         flat.append(lanes[i][0])
         flat.append(lanes[i][1])
     return jnp.stack(flat, axis=-1)
+
+
+def keccak_p_batch(state: jnp.ndarray) -> jnp.ndarray:
+    """Keccak-p[1600,12] on state (..., 50) u32: lane i = (state[2i], state[2i+1]).
+
+    Rounds run under lax.scan (they are sequential by construction) so each
+    XOF site contributes one round body to the graph, not twelve — an order
+    of magnitude off XLA compile time for the prepare pipelines.
+    """
+
+    def body(s, rc_pair):
+        return _keccak_round(s, rc_pair), None
+
+    out, _ = lax.scan(body, state, jnp.asarray(_RC_PAIRS))
+    return out
 
 
 def bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:

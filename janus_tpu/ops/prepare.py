@@ -1,0 +1,483 @@
+"""Batched Prio3 prepare on device — the north-star hot loop.
+
+This composes the leaf kernels (``field_jax`` limb arithmetic, ``keccak_jax``
+batched TurboSHAKE, ``xof_jax`` rejection sampling) into the full per-report
+prepare pipeline, vmapped over an aggregation job:
+
+    seeds/nonces → XOF expand (meas + proof shares, query/joint rands)
+                 → FLP query (gadget wires, Lagrange eval, gadget poly)
+                 → verifier shares + out shares,
+    then ``prep_shares_to_prep``: combine verifiers, decide, joint-rand seed.
+
+The reference runs the scalar equivalent per report on a rayon pool
+(reference: aggregator/src/aggregator/aggregation_job_driver.rs:397-428 leader,
+aggregator/src/aggregator.rs:2101 helper).  Here one XLA launch handles the
+whole batch; every output is byte-identical to the CPU oracle
+(janus_tpu.vdaf.prio3) — asserted in tests/test_prepare.py.
+
+Montgomery domain convention: XOF output limbs are canonical; multiplication-
+heavy circuit code runs in Montgomery form (``to_mont`` at entry, ``from_mont``
+at the wire edges).  All arithmetic is exact integer math mod p, so there is
+no reassociation hazard.
+
+Wire-polynomial evaluation avoids a device NTT: the verifier needs each wire
+polynomial only *evaluated at t*, and the wire values live on the P-th roots
+of unity, so barycentric Lagrange applies:
+
+    poly(t) = (t^P - 1)/P * sum_k  val_k * w^k / (t - w^k)
+
+with one batched Montgomery inversion over the k axis (field_jax.batch_inv_mont).
+Values at unused points are zero, so only calls+1 terms are needed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..fields import next_power_of_2
+from ..flp.circuits import Count, Histogram, Sum, SumVec
+from ..vdaf.prio3 import (
+    USAGE_JOINT_RAND_PART,
+    USAGE_JOINT_RAND_SEED,
+    USAGE_JOINT_RANDOMNESS,
+    USAGE_MEAS_SHARE,
+    USAGE_PROOF_SHARE,
+    USAGE_QUERY_RANDOMNESS,
+    Prio3,
+)
+from ..xof import XofTurboShake128
+from .field_jax import JField
+from .keccak_jax import bytes_to_words, words_to_bytes, xof_turboshake128_batch
+from .xof_jax import xof_next_vec_batch
+
+_U32 = jnp.uint32
+
+
+def limbs_to_bytes(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Canonical (..., L, n) u32 limbs -> (..., L*4n) u8 little-endian wire bytes."""
+    flat = limbs.reshape(limbs.shape[:-2] + (limbs.shape[-2] * limbs.shape[-1],))
+    return words_to_bytes(flat)
+
+
+def bytes_to_limbs(jf: JField, data: jnp.ndarray, num_elems: int) -> jnp.ndarray:
+    """(..., num_elems*4n) u8 wire bytes -> (..., num_elems, n) u32 limbs."""
+    words = bytes_to_words(data)
+    return words.reshape(words.shape[:-1] + (num_elems, jf.n))
+
+
+class _DeviceCircuit:
+    """Device twin of one FLP validity circuit (all have exactly one gadget)."""
+
+    def __init__(self, valid):
+        self.valid = valid
+        self.calls = valid.GADGET_CALLS[0]
+        (g,) = valid.new_gadgets()
+        self.arity = g.ARITY
+        self.degree = g.DEGREE
+        self.P = next_power_of_2(1 + self.calls)
+        self.glen = self.degree * (self.P - 1) + 1
+
+    # subclasses: inputs(), v(), truncate(), gadget_eval()
+
+
+class _DCount(_DeviceCircuit):
+    def inputs(self, jf, meas_m, jr_m, consts):
+        # Single call: [meas0, meas0].
+        m0 = meas_m[:, 0:1]  # (B, 1, n)
+        return jnp.stack([m0, m0], axis=2)  # (B, 1, 2, n)
+
+    def v(self, jf, gk, meas_m, jr_m, consts):
+        return jf.sub(gk[:, 0], meas_m[:, 0])
+
+    def truncate(self, jf, meas_m, consts):
+        return meas_m
+
+    def gadget_eval(self, jf, x_m):
+        return jf.mont_mul(x_m[:, 0], x_m[:, 1])
+
+
+class _DSum(_DeviceCircuit):
+    def inputs(self, jf, meas_m, jr_m, consts):
+        return meas_m[:, :, None, :]  # (B, bits, 1, n)
+
+    def v(self, jf, gk, meas_m, jr_m, consts):
+        r = jr_m[:, 0]  # (B, n)
+        r_b = jnp.broadcast_to(r[:, None, :], gk.shape)
+        r_pows = jf.cumprod_mont(r_b, axis=1)  # r^(k+1) at call k
+        return jf.sum(jf.mont_mul(r_pows, gk), axis=1)
+
+    def truncate(self, jf, meas_m, consts):
+        w = consts["pow2_m"]  # (bits, n) mont constants 2^b
+        return jf.sum(jf.mont_mul(meas_m, w[None]), axis=1)[:, None, :]
+
+    def gadget_eval(self, jf, x_m):
+        x0 = x_m[:, 0]
+        return jf.sub(jf.mont_mul(x0, x0), x0)
+
+
+class _DChunked(_DeviceCircuit):
+    """Shared machinery for the ParallelSum(Mul, chunk) circuits."""
+
+    def __init__(self, valid):
+        super().__init__(valid)
+        self.chunk = valid.chunk_length
+        self.pad_len = self.calls * self.chunk - valid.MEAS_LEN
+
+    def _pad(self, jf, meas_m):
+        if self.pad_len == 0:
+            return meas_m
+        B = meas_m.shape[0]
+        zeros = jnp.zeros((B, self.pad_len, jf.n), dtype=_U32)
+        return jnp.concatenate([meas_m, zeros], axis=1)
+
+    def _interleave(self, a, b):
+        # wire order per call: [a_0, b_0, a_1, b_1, ...]
+        B, calls, chunk, n = a.shape
+        return jnp.stack([a, b], axis=3).reshape(B, calls, 2 * chunk, n)
+
+    def gadget_eval(self, jf, x_m):
+        B, arity, n = x_m.shape
+        pairs = x_m.reshape(B, arity // 2, 2, n)
+        prod = jf.mont_mul(pairs[:, :, 0], pairs[:, :, 1])
+        return jf.sum(prod, axis=1)
+
+
+class _DSumVec(_DChunked):
+    def inputs(self, jf, meas_m, jr_m, consts):
+        B = meas_m.shape[0]
+        m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
+        # r_power resets per call: jr[i]^(j+1)
+        jr_b = jnp.broadcast_to(jr_m[:, :, None, :], m.shape)
+        r_pows = jf.cumprod_mont(jr_b, axis=2)
+        a = jf.mont_mul(m, r_pows)
+        b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_m"], m.shape))
+        return self._interleave(a, b)
+
+    def v(self, jf, gk, meas_m, jr_m, consts):
+        return jf.sum(gk, axis=1)
+
+    def truncate(self, jf, meas_m, consts):
+        B = meas_m.shape[0]
+        w = consts["pow2_m"]  # (bits, n)
+        m = meas_m.reshape(B, self.valid.length, self.valid.bits, jf.n)
+        return jf.sum(jf.mont_mul(m, w[None, None]), axis=2)
+
+
+class _DHistogram(_DChunked):
+    def inputs(self, jf, meas_m, jr_m, consts):
+        B = meas_m.shape[0]
+        m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
+        # r_power is global: r^(index+1) over the padded, flattened axis.
+        r = jr_m[:, 0]  # (B, n)
+        r_flat = jnp.broadcast_to(r[:, None, :], (B, self.calls * self.chunk, jf.n))
+        r_pows = jf.cumprod_mont(r_flat, axis=1).reshape(m.shape)
+        a = jf.mont_mul(m, r_pows)
+        b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_m"], m.shape))
+        return self._interleave(a, b)
+
+    def v(self, jf, gk, meas_m, jr_m, consts):
+        range_check = jf.sum(gk, axis=1)
+        meas_sum = jf.sum(meas_m, axis=1)  # (B, n)
+        sum_check = jf.sub(
+            meas_sum, jnp.broadcast_to(consts["shares_inv_m"], meas_sum.shape)
+        )
+        jr1 = jr_m[:, 1]
+        out = jf.add(
+            jf.mont_mul(jr1, range_check),
+            jf.mont_mul(jf.mont_mul(jr1, jr1), sum_check),
+        )
+        return out
+
+    def truncate(self, jf, meas_m, consts):
+        return meas_m
+
+
+def _device_circuit(valid) -> _DeviceCircuit:
+    if isinstance(valid, Count):
+        return _DCount(valid)
+    if isinstance(valid, Sum):
+        return _DSum(valid)
+    if isinstance(valid, SumVec):
+        return _DSumVec(valid)
+    if isinstance(valid, Histogram):
+        return _DHistogram(valid)
+    raise NotImplementedError(f"no device circuit for {type(valid).__name__}")
+
+
+class BatchedPrio3:
+    """Device-batched prepare for one Prio3 instance (TurboSHAKE XOF only).
+
+    All shapes are static per instance; the batch axis is the report axis.
+    Outputs are canonical u32 limb tensors / u8 byte tensors that are
+    byte-identical to the CPU oracle.
+    """
+
+    def __init__(self, prio3: Prio3):
+        if prio3.xof is not XofTurboShake128:
+            raise NotImplementedError("device path requires XofTurboShake128")
+        self.prio3 = prio3
+        self.flp = prio3.flp
+        self.jf = JField(self.flp.field)
+        self.circ = _device_circuit(self.flp.valid)
+        jf, circ, field = self.jf, self.circ, self.flp.field
+        p = field.MODULUS
+
+        def mont_np(x: int) -> np.ndarray:
+            return jf._int_to_limbs_np((x % p) * (1 << (32 * jf.n)) % p)
+
+        # Host-precomputed Montgomery constants.
+        w = field.root(circ.P)
+        p_inv = pow(circ.P, p - 2, p)
+        self.consts: Dict[str, jnp.ndarray] = {}
+        self.consts["shares_inv_m"] = jnp.asarray(
+            mont_np(pow(prio3.num_shares, p - 2, p))
+        )
+        # alpha^k for k=1..calls (gadget poly eval points).
+        self.alpha_pows_m = jnp.asarray(
+            np.stack([mont_np(pow(w, k, p)) for k in range(1, circ.calls + 1)])
+        )
+        # Barycentric constants w^k / P for k=0..calls.
+        self.bary_c_m = jnp.asarray(
+            np.stack([mont_np(pow(w, k, p) * p_inv % p) for k in range(circ.calls + 1)])
+        )
+        self.roots_m = jnp.asarray(
+            np.stack([mont_np(pow(w, k, p)) for k in range(circ.calls + 1)])
+        )
+        if hasattr(self.flp.valid, "bits"):
+            bits = self.flp.valid.bits
+            self.consts["pow2_m"] = jnp.asarray(
+                np.stack([mont_np(1 << b) for b in range(bits)])
+            )
+        self._log2_P = circ.P.bit_length() - 1
+
+    # -- XOF helpers ----------------------------------------------------
+    def _dst(self, usage: int) -> bytes:
+        return self.prio3._dst(usage)
+
+    def _expand_vec(self, seed_u8, dst, binder_u8, length) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """XOF -> (canonical limbs (B, length, n), ok (B,))."""
+        return xof_next_vec_batch(self.jf, seed_u8, dst, binder_u8, length)
+
+    def _xof_seed(self, seed_u8, dst, binder_u8) -> jnp.ndarray:
+        """XOF -> one seed-sized output (B, SEED)."""
+        return xof_turboshake128_batch(seed_u8, dst, binder_u8, self.prio3.xof.SEED_SIZE)
+
+    # -- share expansion (helper side) ----------------------------------
+    def helper_shares(self, agg_id: int, share_seeds_u8: jnp.ndarray):
+        """Expand a helper's (meas, proofs) shares from its seed.
+
+        Oracle twin: Prio3._helper_meas_share / _helper_proofs_share.
+        Returns (meas (B,MEAS_LEN,n), proofs (B,num_proofs*PROOF_LEN,n), ok (B,)).
+        """
+        B = share_seeds_u8.shape[0]
+        binder = jnp.broadcast_to(
+            jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1)
+        )
+        meas, ok1 = self._expand_vec(
+            share_seeds_u8, self._dst(USAGE_MEAS_SHARE), binder, self.flp.MEAS_LEN
+        )
+        proofs, ok2 = self._expand_vec(
+            share_seeds_u8,
+            self._dst(USAGE_PROOF_SHARE),
+            binder,
+            self.flp.PROOF_LEN * self.prio3.num_proofs,
+        )
+        return meas, proofs, ok1 & ok2
+
+    # -- FLP query (one proof) ------------------------------------------
+    def _query_one(self, meas_m, proof_m, jr_m, t_m):
+        """Device FLP query for one proof. All inputs Montgomery.
+
+        meas_m (B,MEAS_LEN,n), proof_m (B,PROOF_LEN,n), jr_m (B,JR_LEN,n),
+        t_m (B,n) -> (verifier_m (B,VERIFIER_LEN,n), t_ok (B,)).
+        Oracle twin: FlpGeneric.query.
+        """
+        jf, circ = self.jf, self.circ
+        B = meas_m.shape[0]
+        seeds = proof_m[:, : circ.arity]  # (B, arity, n)
+        gpoly = proof_m[:, circ.arity :]  # (B, glen, n)
+
+        inp = circ.inputs(jf, meas_m, jr_m, self.consts)  # (B, calls, arity, n)
+
+        # Gadget outputs at alpha^k via Horner over the gadget polynomial.
+        def horner_step(acc, c):
+            return jf.add(jf.mont_mul(acc, self.alpha_pows_m[None]), c[:, None, :]), None
+
+        coeffs_rev = jnp.moveaxis(jnp.flip(gpoly, axis=1), 1, 0)  # (glen, B, n)
+        acc0 = jnp.zeros((B, circ.calls, jf.n), dtype=_U32)
+        gk, _ = lax.scan(horner_step, acc0, coeffs_rev)  # (B, calls, n)
+
+        v = circ.v(jf, gk, meas_m, jr_m, self.consts)  # (B, n)
+
+        # Wire evaluations at t via barycentric Lagrange on the P-th roots.
+        t_pow = t_m
+        for _ in range(self._log2_P):
+            t_pow = jf.mont_mul(t_pow, t_pow)
+        z = jf.sub(t_pow, jnp.broadcast_to(jf.mont_one(), t_pow.shape))  # t^P - 1
+        t_ok = ~jf.is_zero(z)
+        K = circ.calls + 1
+        denom = jf.sub(t_m[:, None, :], self.roots_m[None])  # (B, K, n)
+        inv_denom = jf.batch_inv_mont(denom, axis=1)
+        lag = jf.mont_mul(
+            jf.mont_mul(jnp.broadcast_to(z[:, None, :], denom.shape), self.bary_c_m[None]),
+            inv_denom,
+        )  # (B, K, n)
+        wires = jnp.concatenate([seeds[:, None], inp], axis=1)  # (B, K, arity, n)
+        wire_evals = jf.sum(jf.mont_mul(wires, lag[:, :, None, :]), axis=1)  # (B, arity, n)
+
+        gp_t = jf.horner_mont(gpoly, t_m)  # (B, n)
+
+        verifier = jnp.concatenate(
+            [v[:, None], wire_evals, gp_t[:, None]], axis=1
+        )  # (B, VERIFIER_LEN, n)
+        return verifier, t_ok
+
+    # -- prep init ------------------------------------------------------
+    def prep_init(
+        self,
+        agg_id: int,
+        verify_key,  # bytes, or (SEED,) u8 array (traced — per-task data)
+        nonces_u8: jnp.ndarray,
+        *,
+        share_seeds_u8: Optional[jnp.ndarray] = None,
+        meas_limbs: Optional[jnp.ndarray] = None,
+        proofs_limbs: Optional[jnp.ndarray] = None,
+        blinds_u8: Optional[jnp.ndarray] = None,
+        public_parts_u8: Optional[jnp.ndarray] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Batched Prio3.prep_init for one aggregator.
+
+        Leader (agg_id=0) passes canonical ``meas_limbs``/``proofs_limbs``;
+        helpers pass ``share_seeds_u8``.  ``public_parts_u8`` is (B, S, SEED)
+        when the circuit uses joint randomness.  Returns canonical tensors:
+        out_share (B,OUT,n), verifiers (B,num_proofs*VER,n),
+        joint_rand_part/corrected_seed (B,SEED) u8 (if applicable), and
+        ok (B,) flagging rows needing host fallback.
+
+        Oracle twin: Prio3.prep_init (janus_tpu/vdaf/prio3.py).
+        """
+        prio3, flp, jf = self.prio3, self.flp, self.jf
+        B = nonces_u8.shape[0]
+        ok = jnp.ones((B,), dtype=bool)
+        if agg_id == 0:
+            meas, proofs = meas_limbs, proofs_limbs
+        else:
+            meas, proofs, ok_h = self.helper_shares(agg_id, share_seeds_u8)
+            ok = ok & ok_h
+
+        if isinstance(verify_key, (bytes, bytearray)):
+            verify_key = jnp.asarray(np.frombuffer(bytes(verify_key), dtype=np.uint8))
+        vk = jnp.broadcast_to(verify_key, (B, verify_key.shape[-1]))
+        qr, ok_q = self._expand_vec(
+            vk,
+            self._dst(USAGE_QUERY_RANDOMNESS),
+            nonces_u8,
+            flp.QUERY_RAND_LEN * prio3.num_proofs,
+        )
+        ok = ok & ok_q
+
+        out: Dict[str, jnp.ndarray] = {}
+        jr = None
+        if flp.JOINT_RAND_LEN > 0:
+            # joint_rand_part = XOF(blind, dst, agg_id || nonce || enc(meas))
+            agg_b = jnp.broadcast_to(
+                jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1)
+            )
+            meas_bytes = limbs_to_bytes(meas)
+            part_binder = jnp.concatenate([agg_b, nonces_u8, meas_bytes], axis=-1)
+            part = self._xof_seed(blinds_u8, self._dst(USAGE_JOINT_RAND_PART), part_binder)
+            # corrected joint rand seed over parts with ours substituted.
+            S = prio3.num_shares
+            pieces = []
+            if agg_id > 0:
+                pieces.append(public_parts_u8[:, :agg_id].reshape(B, -1))
+            pieces.append(part)
+            if agg_id < S - 1:
+                pieces.append(public_parts_u8[:, agg_id + 1 :].reshape(B, -1))
+            seed_binder = jnp.concatenate(pieces, axis=-1)
+            zero_seed = jnp.zeros((B, prio3.xof.SEED_SIZE), dtype=jnp.uint8)
+            corrected = self._xof_seed(zero_seed, self._dst(USAGE_JOINT_RAND_SEED), seed_binder)
+            jr_vec, ok_j = self._expand_vec(
+                corrected,
+                self._dst(USAGE_JOINT_RANDOMNESS),
+                jnp.zeros((B, 0), dtype=jnp.uint8),
+                flp.JOINT_RAND_LEN * prio3.num_proofs,
+            )
+            ok = ok & ok_j
+            jr = jr_vec
+            out["joint_rand_part"] = part
+            out["corrected_seed"] = corrected
+
+        # Montgomery domain for the circuit.
+        meas_m = jf.to_mont(meas)
+        proofs_m = jf.to_mont(proofs)
+        qr_m = jf.to_mont(qr)
+        jr_m = jf.to_mont(jr) if jr is not None else None
+
+        verifiers = []
+        for i in range(prio3.num_proofs):
+            pm = proofs_m[:, i * flp.PROOF_LEN : (i + 1) * flp.PROOF_LEN]
+            ti = qr_m[:, i * flp.QUERY_RAND_LEN]  # QUERY_RAND_LEN == 1 per gadget
+            ji = (
+                jr_m[:, i * flp.JOINT_RAND_LEN : (i + 1) * flp.JOINT_RAND_LEN]
+                if jr_m is not None
+                else jnp.zeros((B, 0, jf.n), dtype=_U32)
+            )
+            ver_m, t_ok = self._query_one(meas_m, pm, ji, ti)
+            ok = ok & t_ok
+            verifiers.append(ver_m)
+        verifier_m = jnp.concatenate(verifiers, axis=1)
+
+        out["verifiers"] = jf.from_mont(verifier_m)
+        out["out_share"] = jf.from_mont(self.circ.truncate(jf, meas_m, self.consts))
+        out["ok"] = ok
+        return out
+
+    # -- prep shares -> prep message ------------------------------------
+    def prep_shares_to_prep(
+        self,
+        verifier_shares: List[jnp.ndarray],
+        joint_rand_parts_u8: Optional[List[jnp.ndarray]] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Combine verifier shares and decide; derive the joint-rand seed.
+
+        verifier_shares: num_shares tensors (B, num_proofs*VER_LEN, n) canonical.
+        Returns {"decide": (B,) bool, "prep_msg_seed": (B,SEED) u8 (if joint rand)}.
+        Oracle twin: Prio3.prep_shares_to_prep.
+        """
+        prio3, flp, jf, circ = self.prio3, self.flp, self.jf, self.circ
+        combined = verifier_shares[0]
+        for vs in verifier_shares[1:]:
+            combined = jf.add(combined, vs)
+        B = combined.shape[0]
+        decide = jnp.ones((B,), dtype=bool)
+        for i in range(prio3.num_proofs):
+            ver = combined[:, i * flp.VERIFIER_LEN : (i + 1) * flp.VERIFIER_LEN]
+            v = ver[:, 0]
+            x = jf.to_mont(ver[:, 1 : 1 + circ.arity])
+            y = jf.to_mont(ver[:, 1 + circ.arity])
+            g = circ.gadget_eval(jf, x)
+            decide = decide & jf.is_zero(v) & jf.eq(g, y)
+        out: Dict[str, jnp.ndarray] = {"decide": decide}
+        if flp.JOINT_RAND_LEN > 0:
+            binder = jnp.concatenate(list(joint_rand_parts_u8), axis=-1)
+            zero_seed = jnp.zeros((B, prio3.xof.SEED_SIZE), dtype=jnp.uint8)
+            out["prep_msg_seed"] = self._xof_seed(
+                zero_seed, self._dst(USAGE_JOINT_RAND_SEED), binder
+            )
+        return out
+
+    # -- aggregation -----------------------------------------------------
+    def aggregate(self, out_shares: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        """Masked modular sum of out shares over the batch axis.
+
+        out_shares (B, OUTPUT_LEN, n) canonical, mask (B,) bool ->
+        (OUTPUT_LEN, n).  TPU analog of sharded batch-aggregation accumulation
+        (reference: aggregator/src/aggregator/aggregation_job_writer.rs:591-698).
+        """
+        masked = jnp.where(mask[:, None, None], out_shares, jnp.zeros_like(out_shares))
+        return self.jf.sum(masked, axis=0)

@@ -1,0 +1,22 @@
+"""Shared JAX runtime configuration for tests, bench, and driver entries."""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def enable_compile_cache(cache_dir: str = None) -> None:
+    """Point XLA's persistent compilation cache at <repo>/.jax_cache.
+
+    The limb-arithmetic graphs are large; caching makes every re-run of the
+    same (circuit, batch) shape start in milliseconds instead of minutes.
+    """
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", cache_dir or os.path.join(_REPO_ROOT, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

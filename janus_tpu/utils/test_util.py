@@ -7,8 +7,26 @@ every intermediate artifact as ground truth for backend tests.
 from __future__ import annotations
 
 import secrets
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
+
+
+def det_rng(name: str):
+    """Deterministic byte stream keyed by a test name via crc32 (reproducible
+    across processes — PYTHONHASHSEED-independent)."""
+    state = {"ctr": 0, "seed": zlib.crc32(name.encode())}
+
+    def rng(n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            out += zlib.crc32(
+                state["seed"].to_bytes(4, "big") + state["ctr"].to_bytes(8, "big")
+            ).to_bytes(4, "big")
+            state["ctr"] += 1
+        return out[:n]
+
+    return rng
 
 from ..vdaf.pingpong import (
     PingPongMessage,
@@ -54,10 +72,13 @@ def run_vdaf(
         nonce = rng(vdaf.NONCE_SIZE)
         rand = rng(vdaf.RAND_SIZE)
         public_share, input_shares = vdaf.shard(m, nonce, rand)
-        state, leader_msg = leader_initialized(vdaf, verify_key, nonce, public_share, input_shares[0])
-        helper_state, helper_msg = helper_initialized(
-            vdaf, verify_key, nonce, public_share, input_shares[1], leader_msg
+        state, leader_msg = leader_initialized(
+            vdaf, verify_key, None, nonce, public_share, input_shares[0]
         )
+        transition = helper_initialized(
+            vdaf, verify_key, None, nonce, public_share, input_shares[1], leader_msg
+        )
+        helper_state, helper_msg = transition.evaluate(vdaf)
         leader_fin = leader_continued(vdaf, state, helper_msg)
         t.reports.append(
             ReportTranscript(

@@ -1,0 +1,267 @@
+"""Execution backends for Prio3 preparation: CPU oracle vs batched TPU.
+
+This is the real dispatch seam the reference expresses as ``vdaf_dispatch!`` /
+``VdafOps`` (reference: core/src/vdaf.rs:516-532,
+aggregator/src/aggregator.rs:1168-1340): one switch routes a whole aggregation
+job's prepare work either through the scalar oracle (janus_tpu.vdaf.prio3) or
+through one jitted device launch (janus_tpu.ops.prepare), with identical
+results — the agreement is asserted in tests/test_backend.py.
+
+Both backends speak oracle-level types (Prio3InputShare / Prio3PrepareShare /
+Prio3PrepareState), so role logic above the seam is backend-agnostic.  The
+device backend pads batches to power-of-two buckets to bound recompilation,
+and falls back to the oracle for any row whose XOF rejection-sampling margin
+overflowed (``ok`` mask — astronomically rare, but exact).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..xof import XofTurboShake128
+from .prio3 import (
+    Prio3,
+    Prio3InputShare,
+    Prio3PrepareShare,
+    Prio3PrepareState,
+    VdafError,
+)
+
+#: A per-report prepare outcome: either a result or the error that rejected it.
+PrepOutcome = Union[Tuple[Prio3PrepareState, Prio3PrepareShare], VdafError]
+
+
+class OracleBackend:
+    """Scalar per-report loop — the analog of the reference's rayon hop
+    (reference: aggregator/src/aggregator.rs:2101)."""
+
+    name = "oracle"
+
+    def __init__(self, vdaf: Prio3):
+        self.vdaf = vdaf
+
+    def prep_init_batch(
+        self,
+        verify_key: bytes,
+        agg_id: int,
+        reports: Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]],
+    ) -> List[PrepOutcome]:
+        out: List[PrepOutcome] = []
+        for nonce, public_share, input_share in reports:
+            try:
+                out.append(
+                    self.vdaf.prep_init(verify_key, agg_id, nonce, public_share, input_share)
+                )
+            except VdafError as e:
+                out.append(e)
+        return out
+
+    def prep_shares_to_prep_batch(
+        self, prep_shares: Sequence[Sequence[Prio3PrepareShare]]
+    ) -> List[Union[Optional[bytes], VdafError]]:
+        out: List[Union[Optional[bytes], VdafError]] = []
+        for shares in prep_shares:
+            try:
+                out.append(self.vdaf.prep_shares_to_prep(shares))
+            except VdafError as e:
+                out.append(e)
+        return out
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class TpuBackend:
+    """Batched device prepare: one XLA launch per aggregation job."""
+
+    name = "tpu"
+
+    def __init__(self, vdaf: Prio3):
+        if vdaf.xof is not XofTurboShake128:
+            raise VdafError("TPU backend requires the TurboSHAKE XOF")
+        import jax
+
+        from ..ops.prepare import BatchedPrio3
+
+        self.vdaf = vdaf
+        self.bp = BatchedPrio3(vdaf)
+        self.oracle = OracleBackend(vdaf)
+        self._jax = jax
+        self._prep_fns: Dict[int, object] = {}
+        self._combine_fn = None
+
+    # -- jit caches ------------------------------------------------------
+    def _prep_fn(self, agg_id: int):
+        # verify_key flows as a traced input (it is per-task data), so one
+        # compilation per agg_id serves every task.
+        fn = self._prep_fns.get(agg_id)
+        if fn is None:
+            fn = self._jax.jit(
+                lambda kw: self.bp.prep_init(
+                    agg_id, verify_key=kw.pop("verify_key_u8"), **kw
+                )
+            )
+            self._prep_fns[agg_id] = fn
+        return fn
+
+    def _combine(self):
+        if self._combine_fn is None:
+            has_jr = self.vdaf.flp.JOINT_RAND_LEN > 0
+            if has_jr:
+                self._combine_fn = self._jax.jit(
+                    lambda vs, parts: self.bp.prep_shares_to_prep(vs, parts)
+                )
+            else:
+                self._combine_fn = self._jax.jit(
+                    lambda vs, parts: self.bp.prep_shares_to_prep(vs)
+                )
+        return self._combine_fn
+
+    # -- marshaling ------------------------------------------------------
+    def _marshal(self, agg_id, reports, pad_to: int) -> Dict[str, np.ndarray]:
+        vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
+        B = len(reports)
+        seed_size = vdaf.xof.SEED_SIZE
+
+        def stack_bytes(rows, width) -> np.ndarray:
+            arr = np.frombuffer(b"".join(rows), dtype=np.uint8).reshape(B, width)
+            return np.concatenate([arr, np.repeat(arr[-1:], pad_to - B, axis=0)])
+
+        kw: Dict[str, np.ndarray] = {
+            "nonces_u8": stack_bytes([r[0] for r in reports], vdaf.NONCE_SIZE)
+        }
+        if flp.JOINT_RAND_LEN > 0:
+            kw["public_parts_u8"] = stack_bytes(
+                [b"".join(r[1]) for r in reports], vdaf.num_shares * seed_size
+            ).reshape(pad_to, vdaf.num_shares, seed_size)
+            kw["blinds_u8"] = stack_bytes(
+                [r[2].joint_rand_blind for r in reports], seed_size
+            )
+        if agg_id == 0:
+            meas = jf.to_limbs([x for r in reports for x in r[2].meas_share]).reshape(
+                B, flp.MEAS_LEN, jf.n
+            )
+            proofs = jf.to_limbs(
+                [x for r in reports for x in r[2].proofs_share]
+            ).reshape(B, flp.PROOF_LEN * vdaf.num_proofs, jf.n)
+            kw["meas_limbs"] = np.concatenate(
+                [meas, np.repeat(meas[-1:], pad_to - B, axis=0)]
+            )
+            kw["proofs_limbs"] = np.concatenate(
+                [proofs, np.repeat(proofs[-1:], pad_to - B, axis=0)]
+            )
+        else:
+            kw["share_seeds_u8"] = stack_bytes([r[2].share_seed for r in reports], seed_size)
+        return kw
+
+    # -- batch APIs ------------------------------------------------------
+    def prep_init_batch(
+        self,
+        verify_key: bytes,
+        agg_id: int,
+        reports: Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]],
+    ) -> List[PrepOutcome]:
+        if not reports:
+            return []
+        vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
+        B = len(reports)
+        pad_to = _next_pow2(B)
+        kw = self._marshal(agg_id, reports, pad_to)
+        kw["verify_key_u8"] = np.frombuffer(verify_key, dtype=np.uint8)
+        out = self._prep_fn(agg_id)(kw)
+
+        ok = np.asarray(out["ok"])[:B]
+        verifiers = np.asarray(out["verifiers"])[:B]
+        out_shares = np.asarray(out["out_share"])[:B]
+        has_jr = flp.JOINT_RAND_LEN > 0
+        if has_jr:
+            parts = np.asarray(out["joint_rand_part"])[:B]
+            corrected = np.asarray(out["corrected_seed"])[:B]
+
+        results: List[PrepOutcome] = []
+        for b in range(B):
+            if not ok[b]:
+                # Exact-path fallback: the device margin overflowed for this row.
+                results.extend(
+                    self.oracle.prep_init_batch(verify_key, agg_id, [reports[b]])
+                )
+                continue
+            state = Prio3PrepareState(
+                out_share=jf.from_limbs(out_shares[b]),
+                corrected_joint_rand_seed=corrected[b].tobytes() if has_jr else None,
+            )
+            share = Prio3PrepareShare(
+                verifiers_share=jf.from_limbs(verifiers[b]),
+                joint_rand_part=parts[b].tobytes() if has_jr else None,
+            )
+            results.append((state, share))
+        return results
+
+    def prep_shares_to_prep_batch(
+        self, prep_shares: Sequence[Sequence[Prio3PrepareShare]]
+    ) -> List[Union[Optional[bytes], VdafError]]:
+        if not prep_shares:
+            return []
+        vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
+        S = vdaf.num_shares
+        # Rows with the wrong share count must fail exactly like the oracle
+        # ("wrong number of prepare shares"), not be truncated or crash.
+        bad_rows = {i for i, row in enumerate(prep_shares) if len(row) != S}
+        if bad_rows:
+            results = []
+            good = [row for i, row in enumerate(prep_shares) if i not in bad_rows]
+            good_iter = iter(self.prep_shares_to_prep_batch(good))
+            for i in range(len(prep_shares)):
+                if i in bad_rows:
+                    results.append(VdafError("wrong number of prepare shares"))
+                else:
+                    results.append(next(good_iter))
+            return results
+        B = len(prep_shares)
+        pad_to = _next_pow2(B)
+        has_jr = flp.JOINT_RAND_LEN > 0
+
+        ver_len = flp.VERIFIER_LEN * vdaf.num_proofs
+        vs = []
+        parts = []
+        for a in range(S):
+            limbs = jf.to_limbs(
+                [x for row in prep_shares for x in row[a].verifiers_share]
+            ).reshape(B, ver_len, jf.n)
+            vs.append(np.concatenate([limbs, np.repeat(limbs[-1:], pad_to - B, axis=0)]))
+            if has_jr:
+                arr = np.frombuffer(
+                    b"".join(row[a].joint_rand_part for row in prep_shares), dtype=np.uint8
+                ).reshape(B, vdaf.xof.SEED_SIZE)
+                parts.append(
+                    np.concatenate([arr, np.repeat(arr[-1:], pad_to - B, axis=0)])
+                )
+
+        out = self._combine()(vs, parts)
+        decide = np.asarray(out["decide"])[:B]
+        seeds = np.asarray(out["prep_msg_seed"])[:B] if has_jr else None
+
+        results: List[Union[Optional[bytes], VdafError]] = []
+        for b in range(B):
+            if not decide[b]:
+                results.append(VdafError("proof verification failed"))
+            elif has_jr:
+                results.append(seeds[b].tobytes())
+            else:
+                results.append(None)
+        return results
+
+
+BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
+
+
+def make_backend(vdaf: Prio3, backend: str = "oracle"):
+    """Backend factory — the dispatch gate named in the north star."""
+    try:
+        cls = BACKENDS[backend]
+    except KeyError:
+        raise VdafError(f"unknown backend {backend!r}")
+    return cls(vdaf)

@@ -78,11 +78,21 @@ VDAF_INSTANCES: Dict[str, Callable[..., Prio3]] = {
 }
 
 
-def vdaf_from_instance(instance: Dict[str, Any]) -> Prio3:
+def vdaf_from_instance(instance: Dict[str, Any], backend: str = None) -> Prio3:
     """Instantiate from a serialized description, e.g.
-    ``{"type": "Prio3Histogram", "length": 1024, "chunk_length": 316}``."""
+    ``{"type": "Prio3Histogram", "length": 1024, "chunk_length": 316}``.
+
+    ``backend`` selects the prepare execution path ("oracle" | "tpu") and
+    attaches it as ``vdaf.backend`` — the analog of ``vdaf_dispatch!``
+    monomorphizing over the instance (reference: core/src/vdaf.rs:516-532).
+    """
     kind = instance["type"]
     if kind not in VDAF_INSTANCES:
         raise ValueError(f"unknown VDAF instance: {kind}")
     params = {k: v for k, v in instance.items() if k != "type"}
-    return VDAF_INSTANCES[kind](**params)
+    vdaf = VDAF_INSTANCES[kind](**params)
+    if backend is not None:
+        from .backend import make_backend
+
+        vdaf.backend = make_backend(vdaf, backend)
+    return vdaf

@@ -1,8 +1,16 @@
-"""Test configuration: force a virtual 8-device CPU platform before jax import.
+"""Test configuration: pin tests to a virtual 8-device CPU platform.
 
 Bench runs (bench.py) use the real TPU chip; tests exercise the same code on a
 virtual 8-device CPU mesh so multi-chip sharding is validated without hardware
 (mirrors how the reference tests multi-node without a cluster — SURVEY.md §4).
+
+The environment may register an out-of-process TPU platform plugin that wins
+the default-backend election regardless of JAX_PLATFORMS, so merely setting
+env vars is not enough: we also pin ``jax_default_device`` to a CPU device.
+Mesh tests must request ``jax.devices("cpu")`` explicitly.
+
+A persistent XLA compilation cache under .jax_cache keeps repeat test runs
+fast (first run pays the compile; later runs replay it).
 """
 
 import os
@@ -11,3 +19,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+from janus_tpu.utils.jax_setup import enable_compile_cache
+
+enable_compile_cache()
+try:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+except RuntimeError:
+    pass

@@ -1,0 +1,96 @@
+"""Backend dispatch agreement: the same aggregation job stepped through the
+oracle and TPU backends must produce identical prepare artifacts — the product
+guarantee behind the dispatch seam (reference analog: core/src/vdaf.rs:516)."""
+
+from __future__ import annotations
+
+import pytest
+
+from janus_tpu.vdaf.backend import OracleBackend, TpuBackend, make_backend
+from janus_tpu.vdaf.instances import vdaf_from_instance
+from janus_tpu.vdaf.prio3 import VdafError
+
+
+from janus_tpu.utils.test_util import det_rng
+
+
+def test_backend_dispatch_gate():
+    vdaf = vdaf_from_instance({"type": "Prio3Count"}, backend="oracle")
+    assert isinstance(vdaf.backend, OracleBackend)
+    vdaf = vdaf_from_instance({"type": "Prio3Count"}, backend="tpu")
+    assert isinstance(vdaf.backend, TpuBackend)
+    with pytest.raises(VdafError):
+        make_backend(vdaf, "gpu")
+    # The HMAC XOF instance has no device path.
+    hm = vdaf_from_instance(
+        {
+            "type": "Prio3SumVecField64MultiproofHmacSha256Aes128",
+            "proofs": 2,
+            "length": 3,
+            "bits": 2,
+            "chunk_length": 2,
+        }
+    )
+    with pytest.raises(VdafError):
+        make_backend(hm, "tpu")
+
+
+def test_backends_agree_on_job():
+    """Oracle and TPU backends step the same job to identical artifacts,
+    including a tampered report both must reject."""
+    vdaf = vdaf_from_instance({"type": "Prio3Histogram", "length": 6, "chunk_length": 2})
+    rng = det_rng("backend-agree")
+    verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+
+    reports = []
+    for m in [0, 5, 2, 2, 1]:
+        nonce = rng(vdaf.NONCE_SIZE)
+        public_share, input_shares = vdaf.shard(m, nonce, rng(vdaf.RAND_SIZE))
+        reports.append((nonce, public_share, input_shares))
+    # Tamper report 3's helper seed.
+    bad = bytearray(reports[3][2][1].share_seed)
+    bad[3] ^= 0x55
+    reports[3][2][1].share_seed = bytes(bad)
+
+    oracle = make_backend(vdaf, "oracle")
+    tpu = make_backend(vdaf, "tpu")
+
+    results = {}
+    for backend in (oracle, tpu):
+        per_agg = []
+        for agg_id in (0, 1):
+            per_agg.append(
+                backend.prep_init_batch(
+                    verify_key,
+                    agg_id,
+                    [(n, p, shares[agg_id]) for n, p, shares in reports],
+                )
+            )
+        # No init-time failures for either backend on these inputs.
+        assert all(not isinstance(r, VdafError) for row in per_agg for r in row)
+        combined = backend.prep_shares_to_prep_batch(
+            [
+                [per_agg[0][b][1], per_agg[1][b][1]]
+                for b in range(len(reports))
+            ]
+        )
+        results[backend.name] = (per_agg, combined)
+
+    o_init, o_comb = results["oracle"]
+    t_init, t_comb = results["tpu"]
+    for agg_id in (0, 1):
+        for b in range(len(reports)):
+            o_state, o_share = o_init[agg_id][b]
+            t_state, t_share = t_init[agg_id][b]
+            assert o_share.encode(vdaf) == t_share.encode(vdaf), (agg_id, b)
+            assert o_state.out_share == t_state.out_share
+            assert o_state.corrected_joint_rand_seed == t_state.corrected_joint_rand_seed
+    for b in range(len(reports)):
+        if b == 3:
+            assert isinstance(o_comb[b], VdafError)
+            assert isinstance(t_comb[b], VdafError)
+        else:
+            assert o_comb[b] == t_comb[b]
+            # Healthy reports finish: prep_next accepts on both states.
+            state = t_init[0][b][0]
+            assert vdaf.prep_next(state, t_comb[b]) == state.out_share

@@ -1,0 +1,109 @@
+"""Tests for the core shell: auth tokens, report-ID checksums, clock math."""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from janus_tpu.core import (
+    AuthenticationToken,
+    MockClock,
+    checksum_combined,
+    checksum_for_report_id,
+    checksum_updated_with,
+    interval_contains_interval,
+    interval_merge,
+    intervals_overlap,
+    time_to_batch_interval_start,
+)
+from janus_tpu.core.auth_tokens import extract_bearer_token
+from janus_tpu.messages import Duration, Interval, ReportId, ReportIdChecksum, Time
+
+
+def test_bearer_token():
+    tok = AuthenticationToken.new_bearer("abcDEF123-._~+/==")
+    header, value = tok.request_authentication()
+    assert header == "Authorization"
+    assert value == "Bearer abcDEF123-._~+/=="
+    assert tok.hash().validate(tok)
+    assert not tok.hash().validate(AuthenticationToken.new_bearer("other"))
+    # DAP auth token of a different kind never validates against a bearer hash.
+    assert not tok.hash().validate(AuthenticationToken.new_dap_auth("abcDEF123-._~+/"))
+    with pytest.raises(ValueError):
+        AuthenticationToken.new_bearer("has spaces")
+    with pytest.raises(ValueError):
+        AuthenticationToken.new_bearer("")
+
+
+def test_dap_auth_token():
+    tok = AuthenticationToken.new_dap_auth("token-value")
+    header, value = tok.request_authentication()
+    assert header == "DAP-Auth-Token"
+    assert value == "token-value"
+    with pytest.raises(ValueError):
+        AuthenticationToken.new_dap_auth("has%percent")
+    with pytest.raises(ValueError):
+        AuthenticationToken.new_dap_auth("ctrl\x01char")
+
+
+def test_token_flag_parsing():
+    assert AuthenticationToken.from_str("bearer:abc").kind == AuthenticationToken.BEARER
+    assert AuthenticationToken.from_str("dap:abc").kind == AuthenticationToken.DAP_AUTH
+    with pytest.raises(ValueError):
+        AuthenticationToken.from_str("abc")
+
+
+def test_extract_from_headers():
+    tok = extract_bearer_token({"Authorization": "Bearer xyz"})
+    assert tok.token == "xyz"
+    tok = extract_bearer_token({"DAP-Auth-Token": "abc"})
+    assert tok.kind == AuthenticationToken.DAP_AUTH
+    assert extract_bearer_token({}) is None
+
+
+def test_hash_roundtrip_serialization():
+    tok = AuthenticationToken.random_bearer()
+    h = tok.hash()
+    from janus_tpu.core import AuthenticationTokenHash
+
+    assert AuthenticationTokenHash.from_dict(h.to_dict()) == h
+
+
+def test_checksum():
+    """XOR-of-SHA256 semantics (reference: core/src/report_id.rs:7-34)."""
+    rid1 = ReportId(bytes(range(16)))
+    rid2 = ReportId(bytes(range(16, 32)))
+    c1 = checksum_for_report_id(rid1)
+    assert c1.data == hashlib.sha256(rid1.data).digest()
+    c12 = checksum_updated_with(c1, rid2)
+    c21 = checksum_updated_with(checksum_for_report_id(rid2), rid1)
+    assert c12 == c21  # order independent
+    assert checksum_combined(c12, c1) == checksum_for_report_id(rid2)
+    # XOR with itself cancels.
+    assert checksum_combined(c1, c1) == ReportIdChecksum.zero()
+
+
+def test_mock_clock():
+    clock = MockClock(Time(1000))
+    assert clock.now() == Time(1000)
+    clock.advance(Duration(500))
+    assert clock.now() == Time(1500)
+
+
+def test_batch_interval_rounding():
+    assert time_to_batch_interval_start(Time(3601), Duration(3600)) == Time(3600)
+    assert time_to_batch_interval_start(Time(3600), Duration(3600)) == Time(3600)
+
+
+def test_interval_math():
+    a = Interval(Time(0), Duration(100))
+    b = Interval(Time(50), Duration(100))
+    c = Interval(Time(200), Duration(100))
+    assert intervals_overlap(a, b)
+    assert not intervals_overlap(a, c)
+    merged = interval_merge(a, c)
+    assert merged == Interval(Time(0), Duration(300))
+    assert interval_contains_interval(merged, a)
+    assert not interval_contains_interval(a, merged)
+    assert interval_merge(Interval.EMPTY, a) == a

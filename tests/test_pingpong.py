@@ -1,0 +1,144 @@
+"""Ping-pong topology tests: stored transitions, multi-round dummy VDAFs,
+and persistence of transitions across (simulated) process boundaries —
+the property the reference's driver relies on
+(aggregator_core/src/datastore/models.rs:898 WaitingLeader)."""
+
+from __future__ import annotations
+
+import pytest
+
+from janus_tpu.vdaf.dummy import DummyVdaf, FakeFailsPrepInit, FakeFailsPrepStep
+from janus_tpu.vdaf.instances import prio3_histogram
+from janus_tpu.vdaf.pingpong import (
+    PingPongContinued,
+    PingPongFinished,
+    PingPongMessage,
+    PingPongTransition,
+    continued,
+    helper_initialized,
+    leader_initialized,
+)
+from janus_tpu.vdaf.prio3 import VdafError
+
+
+def run_two_party(vdaf, measurement, store_and_reload=False):
+    """Drive the generic topology to completion for any round count.
+
+    With store_and_reload, every transition crosses an encode/decode
+    boundary first (simulating datastore persistence between driver steps).
+    """
+    nonce = b"\x01" * vdaf.NONCE_SIZE
+    verify_key = b"\x02" * vdaf.VERIFY_KEY_SIZE
+    public_share, input_shares = vdaf.shard(measurement, nonce, b"")
+
+    leader_state, outbound = leader_initialized(
+        vdaf, verify_key, None, nonce, public_share, input_shares[0]
+    )
+    transition = helper_initialized(
+        vdaf, verify_key, None, nonce, public_share, input_shares[1], outbound
+    )
+    helper_state = None
+    roles = [("leader", leader_state), ("helper", helper_state)]
+    # Helper evaluates its transition; then parties alternate.
+    current = "helper"
+    out_shares = {}
+    while True:
+        if store_and_reload:
+            transition = PingPongTransition.decode(vdaf, transition.encode(vdaf))
+        state, msg = transition.evaluate(vdaf)
+        if isinstance(state, PingPongFinished):
+            out_shares[current] = state.out_share
+        else:
+            roles = dict(roles)
+            roles[current] = state
+        # Peer consumes the message.
+        peer = "leader" if current == "helper" else "helper"
+        peer_state = leader_state if peer == "leader" else helper_state
+        value = continued(vdaf, peer == "leader", peer_state, msg)
+        if value.out_share is not None:
+            out_shares[peer] = value.out_share
+            if isinstance(state, PingPongFinished):
+                break
+            raise AssertionError("peer finished while we continued")
+        transition = value.transition
+        if isinstance(state, PingPongContinued):
+            if peer == "leader":
+                helper_state = None  # helper's state lives in the transition
+            # Track continued states for the next consume step.
+            if current == "helper":
+                helper_state = state
+            else:
+                leader_state = state
+        current = peer
+    return out_shares
+
+
+@pytest.mark.parametrize("rounds", [1, 2, 3])
+@pytest.mark.parametrize("reload", [False, True])
+def test_dummy_multi_round(rounds, reload):
+    vdaf = DummyVdaf(rounds=rounds)
+    out = run_two_party(vdaf, 7, store_and_reload=reload)
+    assert out["leader"] == [7]
+    assert out["helper"] == [7]
+    agg = vdaf.aggregate([out["leader"]])
+    assert vdaf.unshard([agg, vdaf.aggregate([out["helper"]])], 1) == 7
+
+
+def test_prio3_transition_roundtrip():
+    """Prio3 helper transitions survive serialization and still evaluate."""
+    vdaf = prio3_histogram(length=4, chunk_length=2)
+    nonce = b"\x03" * 16
+    verify_key = b"\x04" * 16
+    rand = bytes(range(vdaf.RAND_SIZE))
+    public_share, input_shares = vdaf.shard(2, nonce, rand)
+    _, leader_msg = leader_initialized(
+        vdaf, verify_key, None, nonce, public_share, input_shares[0]
+    )
+    transition = helper_initialized(
+        vdaf, verify_key, None, nonce, public_share, input_shares[1], leader_msg
+    )
+    restored = PingPongTransition.decode(vdaf, transition.encode(vdaf))
+    assert restored.round == transition.round
+    assert restored.current_prepare_message == transition.current_prepare_message
+    s1, m1 = transition.evaluate(vdaf)
+    s2, m2 = restored.evaluate(vdaf)
+    assert isinstance(s1, PingPongFinished) and isinstance(s2, PingPongFinished)
+    assert s1.out_share == s2.out_share
+    assert m1.encode() == m2.encode()
+
+
+def test_round_mismatch_detected():
+    """A 2-round helper against a 1-round leader must error, not desync."""
+    one = DummyVdaf(rounds=1)
+    two = DummyVdaf(rounds=2)
+    nonce, vk = b"\x01" * 16, b""
+    _, shares = one.shard(3, nonce, b"")
+    leader_state, msg = leader_initialized(one, vk, None, nonce, None, shares[0])
+    transition = helper_initialized(two, vk, None, nonce, None, shares[1], msg)
+    _state, reply = transition.evaluate(two)  # helper says CONTINUE
+    assert reply.variant == PingPongMessage.CONTINUE
+    with pytest.raises(VdafError):
+        continued(one, True, leader_state, reply)  # leader expected FINISH
+
+
+def test_fake_failure_vdafs():
+    nonce, vk = b"\x00" * 16, b""
+    vdaf = FakeFailsPrepInit()
+    _, shares = vdaf.shard(1, nonce, b"")
+    with pytest.raises(VdafError):
+        leader_initialized(vdaf, vk, None, nonce, None, shares[0])
+
+    vdaf = FakeFailsPrepStep()
+    _, shares = vdaf.shard(1, nonce, b"")
+    state, msg = leader_initialized(vdaf, vk, None, nonce, None, shares[0])
+    with pytest.raises(VdafError):
+        helper_initialized(vdaf, vk, None, nonce, None, shares[1], msg)
+
+
+def test_initialize_message_rejected_mid_protocol():
+    vdaf = DummyVdaf(rounds=2)
+    nonce, vk = b"\x05" * 16, b""
+    _, shares = vdaf.shard(4, nonce, b"")
+    state, msg = leader_initialized(vdaf, vk, None, nonce, None, shares[0])
+    with pytest.raises(VdafError):
+        continued(vdaf, True, state, msg)  # INITIALIZE inbound is invalid here

@@ -1,0 +1,231 @@
+"""Device batched prepare (janus_tpu.ops.prepare) vs the CPU oracle.
+
+Byte-identical checks for every artifact of the prepare flow — helper share
+expansion, verifier shares, joint-rand parts/seeds, out shares, decide, and
+masked aggregation — across all four TurboSHAKE circuits, 2 and 3 shares,
+including rejected (tampered) reports.  Mirrors the loop the reference runs
+per report (aggregator/src/aggregator/aggregation_job_driver.rs:397-428).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from janus_tpu.ops.prepare import BatchedPrio3, bytes_to_limbs, limbs_to_bytes
+from janus_tpu.vdaf.instances import (
+    prio3_count,
+    prio3_histogram,
+    prio3_sum,
+    prio3_sum_vec,
+)
+from janus_tpu.vdaf.prio3 import VdafError
+
+
+from janus_tpu.utils.test_util import det_rng
+
+
+CASES = [
+    ("count", prio3_count(), [0, 1, 1, 0]),
+    ("sum8", prio3_sum(8), [0, 1, 77, 255]),
+    ("sumvec", prio3_sum_vec(length=7, bits=3, chunk_length=4), [[1, 2, 3, 4, 5, 6, 7], [0] * 7, [7] * 7, [3, 0, 1, 2, 0, 7, 5]]),
+    ("hist", prio3_histogram(length=10, chunk_length=3), [0, 3, 9, 5]),
+    ("hist3sh", prio3_histogram(length=5, chunk_length=2, num_shares=3), [0, 4, 2, 1]),
+]
+
+
+def shard_batch(vdaf, measurements, rng):
+    """Host-shard a batch; return per-report artifacts + stacked arrays."""
+    reports = []
+    for m in measurements:
+        nonce = rng(vdaf.NONCE_SIZE)
+        rand = rng(vdaf.RAND_SIZE)
+        public_share, input_shares = vdaf.shard(m, nonce, rand)
+        reports.append((nonce, public_share, input_shares))
+    return reports
+
+
+def to_u8(rows):
+    return np.frombuffer(b"".join(rows), dtype=np.uint8).reshape(len(rows), -1)
+
+
+def jit_prep_init(bp, agg_id, verify_key):
+    """Trace prep_init once (eager dispatch is prohibitively slow)."""
+    return jax.jit(lambda kw: bp.prep_init(agg_id, verify_key=verify_key, **kw))
+
+
+def jit_prep_combine(bp, has_jr):
+    if has_jr:
+        return jax.jit(lambda vs, parts: bp.prep_shares_to_prep(vs, parts))
+    return jax.jit(lambda vs, parts: bp.prep_shares_to_prep(vs))
+
+
+@pytest.mark.parametrize("name,vdaf,measurements", CASES, ids=[c[0] for c in CASES])
+def test_device_prepare_matches_oracle(name, vdaf, measurements):
+    rng = det_rng(name)
+    B = len(measurements)
+    verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+    reports = shard_batch(vdaf, measurements, rng)
+    bp = BatchedPrio3(vdaf)
+    jf = bp.jf
+    flp = vdaf.flp
+    S = vdaf.num_shares
+
+    nonces = to_u8([r[0] for r in reports])
+    has_jr = flp.JOINT_RAND_LEN > 0
+    public_parts = None
+    if has_jr:
+        public_parts = to_u8([b"".join(r[1]) for r in reports]).reshape(
+            B, S, vdaf.xof.SEED_SIZE
+        )
+
+    # Oracle expected values per aggregator.
+    oracle = []  # [agg_id] -> list over reports of (state, share)
+    for agg_id in range(S):
+        per = []
+        for nonce, public_share, input_shares in reports:
+            per.append(
+                vdaf.prep_init(verify_key, agg_id, nonce, public_share, input_shares[agg_id])
+            )
+        oracle.append(per)
+
+    device_out = []
+    for agg_id in range(S):
+        kwargs = dict(
+            nonces_u8=jax.numpy.asarray(nonces),
+        )
+        if has_jr:
+            kwargs["blinds_u8"] = jax.numpy.asarray(
+                to_u8([r[2][agg_id].joint_rand_blind for r in reports])
+            )
+            kwargs["public_parts_u8"] = jax.numpy.asarray(public_parts)
+        if agg_id == 0:
+            kwargs["meas_limbs"] = jax.numpy.asarray(
+                jf.to_limbs(
+                    [x for r in reports for x in r[2][0].meas_share]
+                ).reshape(B, flp.MEAS_LEN, jf.n)
+            )
+            kwargs["proofs_limbs"] = jax.numpy.asarray(
+                jf.to_limbs(
+                    [x for r in reports for x in r[2][0].proofs_share]
+                ).reshape(B, flp.PROOF_LEN * vdaf.num_proofs, jf.n)
+            )
+        else:
+            kwargs["share_seeds_u8"] = jax.numpy.asarray(
+                to_u8([r[2][agg_id].share_seed for r in reports])
+            )
+        out = jit_prep_init(bp, agg_id, verify_key)(kwargs)
+        device_out.append(out)
+        assert np.asarray(out["ok"]).all()
+
+        # Verifier shares byte-identical to the oracle prepare shares.
+        ver_bytes = np.asarray(limbs_to_bytes(out["verifiers"]))
+        for b in range(B):
+            state, share = oracle[agg_id][b]
+            expect = flp.field.encode_vec(share.verifiers_share)
+            assert ver_bytes[b].tobytes() == expect, f"verifier agg={agg_id} report={b}"
+            out_share = jf.from_limbs(np.asarray(out["out_share"][b]))
+            assert out_share == state.out_share
+            if has_jr:
+                assert np.asarray(out["joint_rand_part"][b]).tobytes() == share.joint_rand_part
+                assert (
+                    np.asarray(out["corrected_seed"][b]).tobytes()
+                    == state.corrected_joint_rand_seed
+                )
+
+    # prep_shares_to_prep: decide + prep message seed.
+    comb = jit_prep_combine(bp, has_jr)(
+        [device_out[a]["verifiers"] for a in range(S)],
+        [device_out[a]["joint_rand_part"] for a in range(S)] if has_jr else [],
+    )
+    assert np.asarray(comb["decide"]).all()
+    for b in range(B):
+        expect_msg = vdaf.prep_shares_to_prep([oracle[a][b][1] for a in range(S)])
+        if has_jr:
+            assert np.asarray(comb["prep_msg_seed"][b]).tobytes() == expect_msg
+        else:
+            assert expect_msg is None
+
+    # Masked aggregation matches the oracle aggregate.
+    mask = jax.numpy.asarray(np.array([True] * B))
+    for agg_id in range(S):
+        agg = jf.from_limbs(np.asarray(bp.aggregate(device_out[agg_id]["out_share"], mask)))
+        expect = vdaf.aggregate([oracle[agg_id][b][0].out_share for b in range(B)])
+        assert agg == expect
+
+
+def test_tampered_report_fails_decide():
+    """A corrupted helper seed must fail decide on device and oracle alike."""
+    vdaf = prio3_histogram(length=6, chunk_length=2)
+    rng = det_rng("tamper")
+    verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+    reports = shard_batch(vdaf, [1, 2, 3], rng)
+    # Corrupt report 1's helper share seed.
+    bad = bytearray(reports[1][2][1].share_seed)
+    bad[0] ^= 0xFF
+    reports[1][2][1].share_seed = bytes(bad)
+
+    bp = BatchedPrio3(vdaf)
+    jf = bp.jf
+    B = len(reports)
+    S = vdaf.num_shares
+    nonces = to_u8([r[0] for r in reports])
+    public_parts = to_u8([b"".join(r[1]) for r in reports]).reshape(B, S, 16)
+
+    outs = []
+    for agg_id in range(S):
+        kwargs = dict(nonces_u8=jax.numpy.asarray(nonces))
+        kwargs["blinds_u8"] = jax.numpy.asarray(
+            to_u8([r[2][agg_id].joint_rand_blind for r in reports])
+        )
+        kwargs["public_parts_u8"] = jax.numpy.asarray(public_parts)
+        if agg_id == 0:
+            flp = vdaf.flp
+            kwargs["meas_limbs"] = jax.numpy.asarray(
+                jf.to_limbs([x for r in reports for x in r[2][0].meas_share]).reshape(
+                    B, flp.MEAS_LEN, jf.n
+                )
+            )
+            kwargs["proofs_limbs"] = jax.numpy.asarray(
+                jf.to_limbs([x for r in reports for x in r[2][0].proofs_share]).reshape(
+                    B, flp.PROOF_LEN, jf.n
+                )
+            )
+        else:
+            kwargs["share_seeds_u8"] = jax.numpy.asarray(
+                to_u8([r[2][agg_id].share_seed for r in reports])
+            )
+        outs.append(jit_prep_init(bp, agg_id, verify_key)(kwargs))
+
+    comb = jit_prep_combine(bp, True)(
+        [outs[a]["verifiers"] for a in range(S)],
+        [outs[a]["joint_rand_part"] for a in range(S)],
+    )
+    decide = np.asarray(comb["decide"])
+    assert list(decide) == [True, False, True]
+
+    # Oracle agrees: the tampered report raises.
+    for b, expect_ok in enumerate(decide):
+        shares = []
+        for agg_id in range(S):
+            nonce, public_share, input_shares = reports[b]
+            shares.append(
+                vdaf.prep_init(verify_key, agg_id, nonce, public_share, input_shares[agg_id])[1]
+            )
+        if expect_ok:
+            vdaf.prep_shares_to_prep(shares)
+        else:
+            with pytest.raises(VdafError):
+                vdaf.prep_shares_to_prep(shares)
+
+
+def test_roundtrip_limb_bytes():
+    vdaf = prio3_sum(4)
+    bp = BatchedPrio3(vdaf)
+    jf = bp.jf
+    vals = [0, 1, jf.p - 1, 12345678901234567890 % jf.p]
+    limbs = jax.numpy.asarray(jf.to_limbs(vals).reshape(1, len(vals), jf.n))
+    data = limbs_to_bytes(limbs)
+    back = bytes_to_limbs(jf, data, len(vals))
+    assert jf.from_limbs(np.asarray(back)) == vals

@@ -68,9 +68,9 @@ def test_wrong_verify_key_rejected():
     public_share, shares = vdaf.shard(3, nonce, rand)
     vk_leader, vk_helper = rng(16), rng(16)
     assert vk_leader != vk_helper
-    _, leader_msg = leader_initialized(vdaf, vk_leader, nonce, public_share, shares[0])
+    _, leader_msg = leader_initialized(vdaf, vk_leader, None, nonce, public_share, shares[0])
     with pytest.raises(VdafError):
-        helper_initialized(vdaf, vk_helper, nonce, public_share, shares[1], leader_msg)
+        helper_initialized(vdaf, vk_helper, None, nonce, public_share, shares[1], leader_msg).evaluate(vdaf)
 
 
 def test_tampered_input_share_rejected():
@@ -86,9 +86,9 @@ def test_tampered_input_share_rejected():
         proofs_share=shares[0].proofs_share,
         joint_rand_blind=shares[0].joint_rand_blind,
     )
-    _, leader_msg = leader_initialized(vdaf, vk, nonce, public_share, tampered)
+    _, leader_msg = leader_initialized(vdaf, vk, None, nonce, public_share, tampered)
     with pytest.raises(VdafError):
-        helper_initialized(vdaf, vk, nonce, public_share, shares[1], leader_msg)
+        helper_initialized(vdaf, vk, None, nonce, public_share, shares[1], leader_msg).evaluate(vdaf)
 
 
 def test_joint_rand_mismatch_detected_by_leader():
@@ -98,8 +98,8 @@ def test_joint_rand_mismatch_detected_by_leader():
     vk = rng(16)
     nonce, rand = rng(16), rng(vdaf.RAND_SIZE)
     public_share, shares = vdaf.shard(5, nonce, rand)
-    state, leader_msg = leader_initialized(vdaf, vk, nonce, public_share, shares[0])
-    _, helper_msg = helper_initialized(vdaf, vk, nonce, public_share, shares[1], leader_msg)
+    state, leader_msg = leader_initialized(vdaf, vk, None, nonce, public_share, shares[0])
+    _, helper_msg = helper_initialized(vdaf, vk, None, nonce, public_share, shares[1], leader_msg).evaluate(vdaf)
     corrupted = PingPongMessage(
         PingPongMessage.FINISH, prep_msg=bytes(b ^ 1 for b in helper_msg.prep_msg)
     )
