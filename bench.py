@@ -13,7 +13,7 @@ vs_baseline is measured against the 1M reports/s north-star target.
 Inputs are random seeds/nonces: the prepare computation is input-oblivious
 (identical op sequence for valid and invalid shares), so throughput on random
 inputs equals throughput on real jobs; bit-exact correctness is asserted
-separately in tests/test_prepare.py and by a small embedded self-check here.
+separately in tests/test_prepare.py and tests/test_backend.py.
 """
 
 from __future__ import annotations

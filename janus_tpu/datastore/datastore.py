@@ -1,0 +1,1580 @@
+"""SQLite-backed datastore with the reference's Postgres semantics.
+
+The analog of ``Datastore``/``Transaction`` (reference:
+aggregator_core/src/datastore.rs:108,249): all framework components
+coordinate exclusively through this store; every protocol step commits a
+state machine transition, so the database is the checkpoint.
+
+Mapping of Postgres machinery onto SQLite:
+
+- ``run_tx`` retry loop (reference datastore.rs:249-298): transactions run
+  under ``BEGIN IMMEDIATE`` (writer) and retry on SQLITE_BUSY the way the
+  reference retries serialization failures at RepeatableRead.
+- ``FOR UPDATE SKIP LOCKED`` lease acquisition (reference datastore.rs:1916):
+  SQLite has one writer at a time, so a single atomic
+  ``UPDATE … WHERE id IN (SELECT …) RETURNING`` has the same effect — two
+  concurrent acquirers can never lease the same job.
+- Column crypto: AES-GCM via :class:`~janus_tpu.datastore.crypter.Crypter`
+  with AAD = (table, row-ident, column) (reference datastore.rs:5622).
+
+The SQL dialect is confined to this module so a Postgres driver could be
+slotted in behind the same Transaction API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import secrets
+import sqlite3
+import threading
+import time as _time
+from typing import Any, Callable, List, Optional, Sequence, Tuple, TypeVar
+
+from ..core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
+from ..core.hpke import HpkeKeypair
+from ..core.time import Clock
+from ..messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchId,
+    CollectionJobId,
+    Duration,
+    Extension,
+    FixedSize,
+    HpkeCiphertext,
+    HpkeConfig,
+    Interval,
+    PrepareError,
+    PrepareResp,
+    Query,
+    ReportId,
+    ReportIdChecksum,
+    ReportMetadata,
+    Role,
+    TaskId,
+    Time,
+    TimeInterval,
+)
+from ..messages.codec import Decoder, Encoder
+from .crypter import Crypter
+from .models import (
+    AcquiredAggregationJob,
+    AcquiredCollectionJob,
+    AggregateShareJob,
+    AggregationJob,
+    AggregationJobState,
+    BatchAggregation,
+    BatchAggregationState,
+    CollectionJob,
+    CollectionJobState,
+    GlobalHpkeKeypair,
+    HpkeKeyState,
+    Lease,
+    LeaseToken,
+    LeaderStoredReport,
+    OutstandingBatch,
+    ReportAggregation,
+    ReportAggregationMetadata,
+    ReportAggregationState,
+    TaskUploadCounter,
+)
+from .schema import SCHEMA, SCHEMA_VERSION
+from .task import AggregatorTask, TaskQueryType
+
+T = TypeVar("T")
+
+#: task-level query-type kind -> wire query-type class
+QUERY_TYPES = {"TimeInterval": TimeInterval, "FixedSize": FixedSize}
+
+
+class DatastoreError(Exception):
+    pass
+
+
+class TxConflict(DatastoreError):
+    """A uniqueness/state conflict the caller must handle (maps the
+    reference's Error::MutationTargetAlreadyExists and friends)."""
+
+
+class TaskNotFound(DatastoreError):
+    pass
+
+
+def _encode_extensions(extensions: Sequence[Extension]) -> bytes:
+    w = Encoder()
+    w.items_u16(extensions, lambda ww, e: e.encode(ww))
+    return w.take()
+
+
+def _decode_extensions(data: bytes) -> List[Extension]:
+    r = Decoder(data)
+    out = r.items_u16(Extension._decode)
+    r.finish()
+    return out
+
+
+class Datastore:
+    """Thread-safe handle; one SQLite connection per thread."""
+
+    def __init__(
+        self,
+        path: str,
+        crypter: Crypter,
+        clock: Clock,
+        max_transaction_retries: int = 30,
+    ):
+        self.path = path
+        self.crypter = crypter
+        self.clock = clock
+        self.max_transaction_retries = max_transaction_retries
+        self._local = threading.local()
+        self._init_schema()
+
+    # -- connections ----------------------------------------------------
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=10.0, isolation_level=None)
+            conn.execute("PRAGMA journal_mode = WAL")
+            conn.execute("PRAGMA synchronous = NORMAL")
+            conn.execute("PRAGMA foreign_keys = ON")
+            conn.execute("PRAGMA busy_timeout = 10000")
+            self._local.conn = conn
+        return conn
+
+    def _init_schema(self) -> None:
+        conn = self._conn()
+        conn.executescript(SCHEMA)
+        row = conn.execute("SELECT version FROM schema_version").fetchone()
+        if row is None:
+            conn.execute("INSERT INTO schema_version (version) VALUES (?)", (SCHEMA_VERSION,))
+            conn.commit()
+        elif row[0] != SCHEMA_VERSION:
+            # reference: supported_schema_versions! (datastore.rs:77-104)
+            raise DatastoreError(
+                f"unsupported schema version {row[0]} (want {SCHEMA_VERSION})"
+            )
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # -- transactions ---------------------------------------------------
+    def run_tx(self, name: str, fn: Callable[["Transaction"], T]) -> T:
+        """Run ``fn`` in one transaction, retrying on lock contention
+        (reference: datastore.rs:249 run_tx / :298 run_tx_once)."""
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_transaction_retries):
+            conn = self._conn()
+            try:
+                conn.execute("BEGIN IMMEDIATE")
+            except sqlite3.OperationalError as e:
+                last_err = e
+                _time.sleep(min(0.05 * (attempt + 1), 0.5))
+                continue
+            tx = Transaction(self, conn)
+            try:
+                result = fn(tx)
+                conn.execute("COMMIT")
+                return result
+            except sqlite3.OperationalError as e:
+                conn.execute("ROLLBACK")
+                if "locked" in str(e) or "busy" in str(e):
+                    last_err = e
+                    _time.sleep(min(0.05 * (attempt + 1), 0.5))
+                    continue
+                raise
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+        raise DatastoreError(f"transaction {name!r} exhausted retries: {last_err}")
+
+    async def run_tx_async(self, name: str, fn: Callable[["Transaction"], T]) -> T:
+        """Async wrapper: runs the (synchronous) transaction in a worker
+        thread so the aiohttp event loop is never blocked on the database."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.run_tx(name, fn)
+        )
+
+    def now(self) -> Time:
+        return self.clock.now()
+
+
+class Transaction:
+    """Typed query methods over one open transaction
+    (reference: aggregator_core/src/datastore.rs Transaction)."""
+
+    def __init__(self, ds: Datastore, conn: sqlite3.Connection):
+        self.ds = ds
+        self.conn = conn
+        self.crypter = ds.crypter
+        self.clock = ds.clock
+
+    # ------------------------------------------------------------------
+    # helpers
+
+    def _task_pk(self, task_id: TaskId) -> int:
+        row = self.conn.execute(
+            "SELECT id FROM tasks WHERE task_id = ?", (task_id.data,)
+        ).fetchone()
+        if row is None:
+            raise TaskNotFound(str(task_id))
+        return row[0]
+
+    def _now_s(self) -> int:
+        return self.clock.now().seconds
+
+    # ------------------------------------------------------------------
+    # tasks (reference: datastore.rs put_aggregator_task / get_aggregator_task)
+
+    def put_aggregator_task(self, task: AggregatorTask) -> None:
+        enc_vk = self.crypter.encrypt(
+            "tasks", task.task_id.data, "vdaf_verify_key", task.vdaf_verify_key
+        )
+        agg_token = agg_token_type = None
+        if task.aggregator_auth_token is not None:
+            agg_token_type = task.aggregator_auth_token.kind
+            agg_token = self.crypter.encrypt(
+                "tasks",
+                task.task_id.data,
+                "aggregator_auth_token",
+                task.aggregator_auth_token.as_bytes(),
+            )
+        try:
+            cur = self.conn.execute(
+                """INSERT INTO tasks (task_id, aggregator_role,
+                    peer_aggregator_endpoint, query_type, vdaf, task_expiration,
+                    report_expiry_age, min_batch_size, time_precision,
+                    tolerable_clock_skew, collector_hpke_config, vdaf_verify_key,
+                    aggregator_auth_token_type, aggregator_auth_token,
+                    aggregator_auth_token_hash, collector_auth_token_hash,
+                    created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    task.task_id.data,
+                    task.role.name.capitalize() if isinstance(task.role, Role) else str(task.role),
+                    task.peer_aggregator_endpoint,
+                    task.query_type.to_json(),
+                    json.dumps(task.vdaf, sort_keys=True),
+                    task.task_expiration.seconds if task.task_expiration else None,
+                    task.report_expiry_age.seconds if task.report_expiry_age else None,
+                    task.min_batch_size,
+                    task.time_precision.seconds,
+                    task.tolerable_clock_skew.seconds,
+                    task.collector_hpke_config.get_encoded()
+                    if task.collector_hpke_config
+                    else None,
+                    enc_vk,
+                    agg_token_type,
+                    agg_token,
+                    json.dumps(task.aggregator_auth_token_hash.to_dict())
+                    if task.aggregator_auth_token_hash
+                    else None,
+                    json.dumps(task.collector_auth_token_hash.to_dict())
+                    if task.collector_auth_token_hash
+                    else None,
+                    self._now_s(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict(f"task {task.task_id} already exists") from e
+        pk = cur.lastrowid
+        for kp in task.hpke_keys:
+            enc_sk = self.crypter.encrypt(
+                "task_hpke_keys", task.task_id.data, "private_key", kp.private_key
+            )
+            self.conn.execute(
+                """INSERT INTO task_hpke_keys (task_id, config_id, config, private_key)
+                   VALUES (?,?,?,?)""",
+                (pk, kp.config.id, kp.config.get_encoded(), enc_sk),
+            )
+
+    def _task_from_row(self, row: sqlite3.Row) -> AggregatorTask:
+        (
+            pk,
+            task_id_b,
+            role_s,
+            peer,
+            query_type_s,
+            vdaf_s,
+            expiration,
+            expiry_age,
+            min_batch,
+            precision,
+            skew,
+            collector_cfg_b,
+            enc_vk,
+            tok_type,
+            tok_enc,
+            agg_hash_s,
+            col_hash_s,
+        ) = row
+        task_id = TaskId(task_id_b)
+        vk = self.crypter.decrypt("tasks", task_id_b, "vdaf_verify_key", enc_vk)
+        token = None
+        if tok_enc is not None:
+            raw = self.crypter.decrypt("tasks", task_id_b, "aggregator_auth_token", tok_enc)
+            token = AuthenticationToken(tok_type, raw.decode())
+        keys = []
+        for cfg_b, sk_enc in self.conn.execute(
+            "SELECT config, private_key FROM task_hpke_keys WHERE task_id = ?"
+            " ORDER BY config_id",
+            (pk,),
+        ):
+            sk = self.crypter.decrypt("task_hpke_keys", task_id_b, "private_key", sk_enc)
+            keys.append(HpkeKeypair(HpkeConfig.get_decoded(cfg_b), sk))
+        return AggregatorTask(
+            task_id=task_id,
+            peer_aggregator_endpoint=peer,
+            query_type=TaskQueryType.from_json(query_type_s),
+            vdaf=json.loads(vdaf_s),
+            role=Role[role_s.upper()],
+            vdaf_verify_key=vk,
+            min_batch_size=min_batch,
+            time_precision=Duration(precision),
+            task_expiration=Time(expiration) if expiration is not None else None,
+            report_expiry_age=Duration(expiry_age) if expiry_age is not None else None,
+            tolerable_clock_skew=Duration(skew),
+            aggregator_auth_token=token,
+            aggregator_auth_token_hash=AuthenticationTokenHash.from_dict(
+                json.loads(agg_hash_s)
+            )
+            if agg_hash_s
+            else None,
+            collector_auth_token_hash=AuthenticationTokenHash.from_dict(
+                json.loads(col_hash_s)
+            )
+            if col_hash_s
+            else None,
+            collector_hpke_config=HpkeConfig.get_decoded(collector_cfg_b)
+            if collector_cfg_b
+            else None,
+            hpke_keys=keys,
+        )
+
+    _TASK_COLS = """id, task_id, aggregator_role, peer_aggregator_endpoint,
+        query_type, vdaf, task_expiration, report_expiry_age, min_batch_size,
+        time_precision, tolerable_clock_skew, collector_hpke_config,
+        vdaf_verify_key, aggregator_auth_token_type, aggregator_auth_token,
+        aggregator_auth_token_hash, collector_auth_token_hash"""
+
+    def get_aggregator_task(self, task_id: TaskId) -> Optional[AggregatorTask]:
+        row = self.conn.execute(
+            f"SELECT {self._TASK_COLS} FROM tasks WHERE task_id = ?",
+            (task_id.data,),
+        ).fetchone()
+        return self._task_from_row(row) if row else None
+
+    def get_aggregator_tasks(self) -> List[AggregatorTask]:
+        rows = self.conn.execute(
+            f"SELECT {self._TASK_COLS} FROM tasks ORDER BY id"
+        ).fetchall()
+        return [self._task_from_row(r) for r in rows]
+
+    def delete_task(self, task_id: TaskId) -> None:
+        cur = self.conn.execute("DELETE FROM tasks WHERE task_id = ?", (task_id.data,))
+        if cur.rowcount == 0:
+            raise TaskNotFound(str(task_id))
+
+    def update_task_expiration(self, task_id: TaskId, expiration: Optional[Time]) -> None:
+        cur = self.conn.execute(
+            "UPDATE tasks SET task_expiration = ? WHERE task_id = ?",
+            (expiration.seconds if expiration else None, task_id.data),
+        )
+        if cur.rowcount == 0:
+            raise TaskNotFound(str(task_id))
+
+    def get_task_ids(self) -> List[TaskId]:
+        return [
+            TaskId(r[0])
+            for r in self.conn.execute("SELECT task_id FROM tasks ORDER BY id")
+        ]
+
+    # ------------------------------------------------------------------
+    # client reports (reference: datastore.rs:1254,1393,1590,1663)
+
+    def put_client_report(self, report: LeaderStoredReport) -> None:
+        pk = self._task_pk(report.task_id)
+        row_ident = report.task_id.data + report.report_id.data
+        enc_share = self.crypter.encrypt(
+            "client_reports", row_ident, "leader_input_share", report.leader_input_share
+        )
+        try:
+            self.conn.execute(
+                """INSERT INTO client_reports (task_id, report_id, client_timestamp,
+                    extensions, public_share, leader_input_share,
+                    helper_encrypted_input_share, created_at)
+                   VALUES (?,?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    report.report_id.data,
+                    report.time.seconds,
+                    _encode_extensions(report.leader_extensions),
+                    report.public_share,
+                    enc_share,
+                    report.helper_encrypted_input_share.get_encoded(),
+                    self._now_s(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict(f"report {report.report_id} already exists") from e
+
+    def get_client_report(
+        self, task_id: TaskId, report_id: ReportId
+    ) -> Optional[LeaderStoredReport]:
+        pk = self._task_pk(task_id)
+        row = self.conn.execute(
+            """SELECT client_timestamp, extensions, public_share,
+                      leader_input_share, helper_encrypted_input_share
+               FROM client_reports WHERE task_id = ? AND report_id = ?""",
+            (pk, report_id.data),
+        ).fetchone()
+        if row is None:
+            return None
+        ts, ext_b, public_share, enc_share, helper_b = row
+        if enc_share is None:
+            return None  # scrubbed
+        row_ident = task_id.data + report_id.data
+        share = self.crypter.decrypt(
+            "client_reports", row_ident, "leader_input_share", enc_share
+        )
+        return LeaderStoredReport(
+            task_id=task_id,
+            metadata=ReportMetadata(report_id, Time(ts)),
+            public_share=public_share,
+            leader_extensions=_decode_extensions(ext_b) if ext_b else [],
+            leader_input_share=share,
+            helper_encrypted_input_share=HpkeCiphertext.get_decoded(helper_b),
+        )
+
+    def check_client_report_exists(self, task_id: TaskId, report_id: ReportId) -> bool:
+        pk = self._task_pk(task_id)
+        return (
+            self.conn.execute(
+                "SELECT 1 FROM client_reports WHERE task_id = ? AND report_id = ?",
+                (pk, report_id.data),
+            ).fetchone()
+            is not None
+        )
+
+    def get_unaggregated_client_reports_for_task(
+        self, task_id: TaskId, limit: int
+    ) -> List[ReportMetadata]:
+        """Atomically claim up to ``limit`` unaggregated reports (sets
+        aggregation_started, reference datastore.rs:1254 + the partial
+        index).  Claimed reports must be assigned to jobs or released via
+        ``mark_reports_unaggregated``."""
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """UPDATE client_reports SET aggregation_started = 1
+               WHERE id IN (
+                   SELECT id FROM client_reports
+                   WHERE task_id = ? AND aggregation_started = 0
+                   ORDER BY client_timestamp LIMIT ?)
+               RETURNING report_id, client_timestamp""",
+            (pk, limit),
+        ).fetchall()
+        return [ReportMetadata(ReportId(r[0]), Time(r[1])) for r in rows]
+
+    def mark_reports_unaggregated(
+        self, task_id: TaskId, report_ids: Sequence[ReportId]
+    ) -> None:
+        """reference: datastore.rs:1393 mark_report_unaggregated"""
+        pk = self._task_pk(task_id)
+        self.conn.executemany(
+            "UPDATE client_reports SET aggregation_started = 0"
+            " WHERE task_id = ? AND report_id = ?",
+            [(pk, rid.data) for rid in report_ids],
+        )
+
+    def scrub_client_report(self, task_id: TaskId, report_id: ReportId) -> None:
+        """Null out share payloads once packed into an aggregation job
+        (reference: datastore.rs:1663)."""
+        pk = self._task_pk(task_id)
+        self.conn.execute(
+            """UPDATE client_reports SET extensions = NULL, public_share = NULL,
+               leader_input_share = NULL, helper_encrypted_input_share = NULL,
+               aggregation_started = 1
+               WHERE task_id = ? AND report_id = ?""",
+            (pk, report_id.data),
+        )
+
+    def count_client_reports_for_interval(
+        self, task_id: TaskId, interval: Interval
+    ) -> int:
+        pk = self._task_pk(task_id)
+        return self.conn.execute(
+            """SELECT COUNT(*) FROM client_reports
+               WHERE task_id = ? AND client_timestamp >= ? AND client_timestamp < ?""",
+            (pk, interval.start.seconds, interval.end().seconds),
+        ).fetchone()[0]
+
+    def count_unaggregated_client_reports_for_interval(
+        self, task_id: TaskId, interval: Interval
+    ) -> int:
+        """Collection readiness gate input (reference:
+        collection_job_driver.rs:124-262)."""
+        pk = self._task_pk(task_id)
+        return self.conn.execute(
+            """SELECT COUNT(*) FROM client_reports
+               WHERE task_id = ? AND aggregation_started = 0
+                 AND client_timestamp >= ? AND client_timestamp < ?""",
+            (pk, interval.start.seconds, interval.end().seconds),
+        ).fetchone()[0]
+
+    def delete_expired_client_reports(self, task_id: TaskId, expiry: Time, limit: int) -> int:
+        """reference: datastore.rs:4691"""
+        pk = self._task_pk(task_id)
+        cur = self.conn.execute(
+            """DELETE FROM client_reports WHERE id IN (
+                 SELECT id FROM client_reports
+                 WHERE task_id = ? AND client_timestamp < ? LIMIT ?)""",
+            (pk, expiry.seconds, limit),
+        )
+        return cur.rowcount
+
+    # ------------------------------------------------------------------
+    # aggregation jobs (reference: datastore.rs:1916-2188)
+
+    def put_aggregation_job(self, job: AggregationJob) -> None:
+        pk = self._task_pk(job.task_id)
+        now = self._now_s()
+        try:
+            self.conn.execute(
+                """INSERT INTO aggregation_jobs (task_id, aggregation_job_id,
+                    aggregation_param, batch_id, client_timestamp_interval_start,
+                    client_timestamp_interval_duration, state, step,
+                    last_request_hash, created_at, updated_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    job.aggregation_job_id.data,
+                    job.aggregation_parameter,
+                    job.partial_batch_identifier.data
+                    if job.partial_batch_identifier
+                    else None,
+                    job.client_timestamp_interval.start.seconds,
+                    job.client_timestamp_interval.duration.seconds,
+                    job.state.value,
+                    int(job.step),
+                    job.last_request_hash,
+                    now,
+                    now,
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict(f"aggregation job {job.aggregation_job_id} exists") from e
+
+    def get_aggregation_job(
+        self, task_id: TaskId, aggregation_job_id: AggregationJobId
+    ) -> Optional[AggregationJob]:
+        pk = self._task_pk(task_id)
+        row = self.conn.execute(
+            """SELECT aggregation_param, batch_id, client_timestamp_interval_start,
+                      client_timestamp_interval_duration, state, step,
+                      last_request_hash
+               FROM aggregation_jobs WHERE task_id = ? AND aggregation_job_id = ?""",
+            (pk, aggregation_job_id.data),
+        ).fetchone()
+        if row is None:
+            return None
+        param, batch_id, istart, idur, state, step, req_hash = row
+        return AggregationJob(
+            task_id=task_id,
+            aggregation_job_id=aggregation_job_id,
+            aggregation_parameter=param,
+            partial_batch_identifier=BatchId(batch_id) if batch_id else None,
+            client_timestamp_interval=Interval(Time(istart), Duration(idur)),
+            state=AggregationJobState(state),
+            step=AggregationJobStep(step),
+            last_request_hash=req_hash,
+        )
+
+    def update_aggregation_job(self, job: AggregationJob) -> None:
+        pk = self._task_pk(job.task_id)
+        cur = self.conn.execute(
+            """UPDATE aggregation_jobs SET state = ?, step = ?,
+                 last_request_hash = ?, updated_at = ?
+               WHERE task_id = ? AND aggregation_job_id = ?""",
+            (
+                job.state.value,
+                int(job.step),
+                job.last_request_hash,
+                self._now_s(),
+                pk,
+                job.aggregation_job_id.data,
+            ),
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError(f"no aggregation job {job.aggregation_job_id}")
+
+    def get_aggregation_jobs_for_task(self, task_id: TaskId) -> List[AggregationJob]:
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT aggregation_job_id, aggregation_param, batch_id,
+                      client_timestamp_interval_start,
+                      client_timestamp_interval_duration, state, step,
+                      last_request_hash
+               FROM aggregation_jobs WHERE task_id = ? ORDER BY id""",
+            (pk,),
+        ).fetchall()
+        return [
+            AggregationJob(
+                task_id=task_id,
+                aggregation_job_id=AggregationJobId(job_id),
+                aggregation_parameter=param,
+                partial_batch_identifier=BatchId(batch_id) if batch_id else None,
+                client_timestamp_interval=Interval(Time(istart), Duration(idur)),
+                state=AggregationJobState(state),
+                step=AggregationJobStep(step),
+                last_request_hash=req_hash,
+            )
+            for job_id, param, batch_id, istart, idur, state, step, req_hash in rows
+        ]
+
+    def acquire_incomplete_aggregation_jobs(
+        self, lease_duration: Duration, limit: int
+    ) -> List[Lease]:
+        """Lease InProgress jobs whose lease expired — the reference's
+        ``FOR UPDATE … SKIP LOCKED`` loop (datastore.rs:1916-1985), expressed
+        as one atomic UPDATE under SQLite's single-writer transaction."""
+        now = self._now_s()
+        expiry = now + lease_duration.seconds
+        token = secrets.token_bytes(16)
+        rows = self.conn.execute(
+            """UPDATE aggregation_jobs
+               SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
+                   updated_at = ?
+               WHERE id IN (
+                   SELECT id FROM aggregation_jobs
+                   WHERE state = 'InProgress' AND lease_expiry <= ?
+                   ORDER BY id LIMIT ?)
+               RETURNING task_id, aggregation_job_id, lease_attempts""",
+            (expiry, token, now, now, limit),
+        ).fetchall()
+        leases = []
+        for task_pk, job_id, attempts in rows:
+            trow = self.conn.execute(
+                "SELECT task_id, query_type, vdaf FROM tasks WHERE id = ?", (task_pk,)
+            ).fetchone()
+            leases.append(
+                Lease(
+                    leased=AcquiredAggregationJob(
+                        task_id=TaskId(trow[0]),
+                        aggregation_job_id=AggregationJobId(job_id),
+                        query_type=TaskQueryType.from_json(trow[1]).kind,
+                        vdaf=json.loads(trow[2]),
+                    ),
+                    lease_expiry=Time(expiry),
+                    lease_token=LeaseToken(token),
+                    lease_attempts=attempts,
+                )
+            )
+        return leases
+
+    def release_aggregation_job(
+        self, lease: Lease, reacquire_delay: Optional[Duration] = None
+    ) -> None:
+        """reference: datastore.rs:1991 (release_aggregation_job); the token
+        check fences stale lease holders."""
+        job = lease.leased
+        pk = self._task_pk(job.task_id)
+        new_expiry = (
+            self._now_s() + reacquire_delay.seconds if reacquire_delay is not None else 0
+        )
+        cur = self.conn.execute(
+            """UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = NULL
+               WHERE task_id = ? AND aggregation_job_id = ? AND lease_token = ?""",
+            (new_expiry, pk, job.aggregation_job_id.data, lease.lease_token.data),
+        )
+        if cur.rowcount == 0:
+            raise TxConflict("lease no longer held")
+
+    # ------------------------------------------------------------------
+    # report aggregations (reference: datastore.rs:2190-2519)
+
+    def put_report_aggregation(self, ra: ReportAggregation) -> None:
+        pk = self._task_pk(ra.task_id)
+        jrow = self.conn.execute(
+            "SELECT id FROM aggregation_jobs WHERE task_id = ? AND aggregation_job_id = ?",
+            (pk, ra.aggregation_job_id.data),
+        ).fetchone()
+        if jrow is None:
+            raise DatastoreError(f"no aggregation job {ra.aggregation_job_id}")
+        cols = self._ra_payload_cols(ra)
+        try:
+            self.conn.execute(
+                """INSERT INTO report_aggregations (task_id, aggregation_job_id, ord,
+                    report_id, client_timestamp, last_prep_resp, state, public_share,
+                    leader_extensions, leader_input_share, helper_encrypted_input_share,
+                    leader_prep_transition, helper_prep_state, error_code)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    jrow[0],
+                    ra.ord,
+                    ra.report_id.data,
+                    ra.time.seconds,
+                    ra.last_prep_resp.get_encoded() if ra.last_prep_resp else None,
+                    ra.state.value,
+                    *cols,
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict(f"report aggregation ord {ra.ord} already exists") from e
+
+    def _ra_payload_cols(self, ra: ReportAggregation) -> Tuple:
+        row_ident = ra.task_id.data + ra.aggregation_job_id.data + ra.report_id.data
+        enc_input = (
+            self.crypter.encrypt(
+                "report_aggregations", row_ident, "leader_input_share",
+                ra.leader_input_share,
+            )
+            if ra.leader_input_share is not None
+            else None
+        )
+        enc_transition = (
+            self.crypter.encrypt(
+                "report_aggregations", row_ident, "leader_prep_transition",
+                ra.leader_prep_transition,
+            )
+            if ra.leader_prep_transition is not None
+            else None
+        )
+        enc_helper_state = (
+            self.crypter.encrypt(
+                "report_aggregations", row_ident, "helper_prep_state",
+                ra.helper_prep_state,
+            )
+            if ra.helper_prep_state is not None
+            else None
+        )
+        return (
+            ra.public_share,
+            _encode_extensions(ra.leader_extensions) if ra.leader_extensions else None,
+            enc_input,
+            ra.helper_encrypted_input_share.get_encoded()
+            if ra.helper_encrypted_input_share
+            else None,
+            enc_transition,
+            enc_helper_state,
+            int(ra.error) if ra.error is not None else None,
+        )
+
+    def update_report_aggregation(self, ra: ReportAggregation) -> None:
+        pk = self._task_pk(ra.task_id)
+        cols = self._ra_payload_cols(ra)
+        cur = self.conn.execute(
+            """UPDATE report_aggregations SET last_prep_resp = ?, state = ?,
+                 public_share = ?, leader_extensions = ?, leader_input_share = ?,
+                 helper_encrypted_input_share = ?, leader_prep_transition = ?,
+                 helper_prep_state = ?, error_code = ?
+               WHERE task_id = ? AND report_id = ? AND aggregation_job_id =
+                 (SELECT id FROM aggregation_jobs
+                  WHERE task_id = ? AND aggregation_job_id = ?)""",
+            (
+                ra.last_prep_resp.get_encoded() if ra.last_prep_resp else None,
+                ra.state.value,
+                *cols,
+                pk,
+                ra.report_id.data,
+                pk,
+                ra.aggregation_job_id.data,
+            ),
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError(f"no report aggregation for {ra.report_id}")
+
+    def get_report_aggregations_for_aggregation_job(
+        self, task_id: TaskId, aggregation_job_id: AggregationJobId
+    ) -> List[ReportAggregation]:
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT ra.ord, ra.report_id, ra.client_timestamp, ra.last_prep_resp,
+                      ra.state, ra.public_share, ra.leader_extensions,
+                      ra.leader_input_share, ra.helper_encrypted_input_share,
+                      ra.leader_prep_transition, ra.helper_prep_state, ra.error_code
+               FROM report_aggregations ra
+               JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+               WHERE aj.task_id = ? AND aj.aggregation_job_id = ?
+               ORDER BY ra.ord""",
+            (pk, aggregation_job_id.data),
+        ).fetchall()
+        out = []
+        for (
+            ord_,
+            rid,
+            ts,
+            prep_resp_b,
+            state,
+            public_share,
+            ext_b,
+            enc_input,
+            helper_b,
+            enc_trans,
+            enc_hstate,
+            err,
+        ) in rows:
+            row_ident = task_id.data + aggregation_job_id.data + rid
+            out.append(
+                ReportAggregation(
+                    task_id=task_id,
+                    aggregation_job_id=aggregation_job_id,
+                    report_id=ReportId(rid),
+                    time=Time(ts),
+                    ord=ord_,
+                    state=ReportAggregationState(state),
+                    last_prep_resp=PrepareResp.get_decoded(prep_resp_b)
+                    if prep_resp_b
+                    else None,
+                    public_share=public_share,
+                    leader_extensions=_decode_extensions(ext_b) if ext_b else [],
+                    leader_input_share=self.crypter.decrypt(
+                        "report_aggregations", row_ident, "leader_input_share", enc_input
+                    )
+                    if enc_input
+                    else None,
+                    helper_encrypted_input_share=HpkeCiphertext.get_decoded(helper_b)
+                    if helper_b
+                    else None,
+                    leader_prep_transition=self.crypter.decrypt(
+                        "report_aggregations", row_ident, "leader_prep_transition", enc_trans
+                    )
+                    if enc_trans
+                    else None,
+                    helper_prep_state=self.crypter.decrypt(
+                        "report_aggregations", row_ident, "helper_prep_state", enc_hstate
+                    )
+                    if enc_hstate
+                    else None,
+                    error=PrepareError(err) if err is not None else None,
+                )
+            )
+        return out
+
+    def put_report_aggregation_metadata(self, meta: ReportAggregationMetadata) -> None:
+        """Creator path: StartLeader rows without payloads (the report data is
+        scrubbed from client_reports only after packing; reference
+        aggregation_job_creator.rs:718-731 stores metadata-only rows)."""
+        pk = self._task_pk(meta.task_id)
+        jrow = self.conn.execute(
+            "SELECT id FROM aggregation_jobs WHERE task_id = ? AND aggregation_job_id = ?",
+            (pk, meta.aggregation_job_id.data),
+        ).fetchone()
+        if jrow is None:
+            raise DatastoreError(f"no aggregation job {meta.aggregation_job_id}")
+        try:
+            self.conn.execute(
+                """INSERT INTO report_aggregations (task_id, aggregation_job_id, ord,
+                    report_id, client_timestamp, state)
+                   VALUES (?,?,?,?,?,?)""",
+                (
+                    pk,
+                    jrow[0],
+                    meta.ord,
+                    meta.report_id.data,
+                    meta.time.seconds,
+                    ReportAggregationState.START_LEADER.value,
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict(f"report aggregation ord {meta.ord} already exists") from e
+
+    def check_report_aggregation_exists(
+        self,
+        task_id: TaskId,
+        report_id: ReportId,
+        exclude_aggregation_job_id: Optional[AggregationJobId] = None,
+    ) -> bool:
+        """Helper replay check: has this report been aggregated in another
+        job? (reference: aggregator.rs:1765 dup-report-ID check)"""
+        pk = self._task_pk(task_id)
+        if exclude_aggregation_job_id is not None:
+            row = self.conn.execute(
+                """SELECT 1 FROM report_aggregations ra
+                   JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+                   WHERE ra.task_id = ? AND ra.report_id = ?
+                     AND aj.aggregation_job_id != ? LIMIT 1""",
+                (pk, report_id.data, exclude_aggregation_job_id.data),
+            ).fetchone()
+        else:
+            row = self.conn.execute(
+                "SELECT 1 FROM report_aggregations WHERE task_id = ? AND report_id = ?"
+                " LIMIT 1",
+                (pk, report_id.data),
+            ).fetchone()
+        return row is not None
+
+    # ------------------------------------------------------------------
+    # batch aggregations (reference: datastore.rs:3626-4008)
+
+    def put_batch_aggregation(self, ba: BatchAggregation) -> None:
+        pk = self._task_pk(ba.task_id)
+        try:
+            self.conn.execute(
+                """INSERT INTO batch_aggregations (task_id, batch_identifier,
+                    aggregation_param, ord, state, aggregate_share, report_count,
+                    checksum, client_timestamp_interval_start,
+                    client_timestamp_interval_duration, aggregation_jobs_created,
+                    aggregation_jobs_terminated, created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    ba.batch_identifier,
+                    ba.aggregation_parameter,
+                    ba.ord,
+                    ba.state.value,
+                    ba.aggregate_share,
+                    ba.report_count,
+                    ba.checksum.data,
+                    ba.client_timestamp_interval.start.seconds,
+                    ba.client_timestamp_interval.duration.seconds,
+                    ba.aggregation_jobs_created,
+                    ba.aggregation_jobs_terminated,
+                    self._now_s(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict("batch aggregation shard already exists") from e
+
+    def update_batch_aggregation(self, ba: BatchAggregation) -> None:
+        pk = self._task_pk(ba.task_id)
+        cur = self.conn.execute(
+            """UPDATE batch_aggregations SET state = ?, aggregate_share = ?,
+                 report_count = ?, checksum = ?,
+                 client_timestamp_interval_start = ?,
+                 client_timestamp_interval_duration = ?,
+                 aggregation_jobs_created = ?, aggregation_jobs_terminated = ?
+               WHERE task_id = ? AND batch_identifier = ? AND aggregation_param = ?
+                 AND ord = ?""",
+            (
+                ba.state.value,
+                ba.aggregate_share,
+                ba.report_count,
+                ba.checksum.data,
+                ba.client_timestamp_interval.start.seconds,
+                ba.client_timestamp_interval.duration.seconds,
+                ba.aggregation_jobs_created,
+                ba.aggregation_jobs_terminated,
+                pk,
+                ba.batch_identifier,
+                ba.aggregation_parameter,
+                ba.ord,
+            ),
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError("no batch aggregation shard to update")
+
+    def get_batch_aggregation(
+        self,
+        task_id: TaskId,
+        batch_identifier: bytes,
+        aggregation_parameter: bytes,
+        ord: int,
+    ) -> Optional[BatchAggregation]:
+        rows = self._get_batch_aggregations(
+            task_id, batch_identifier, aggregation_parameter, ord
+        )
+        return rows[0] if rows else None
+
+    def get_batch_aggregations_for_batch(
+        self, task_id: TaskId, batch_identifier: bytes, aggregation_parameter: bytes
+    ) -> List[BatchAggregation]:
+        return self._get_batch_aggregations(task_id, batch_identifier, aggregation_parameter)
+
+    def _get_batch_aggregations(
+        self,
+        task_id: TaskId,
+        batch_identifier: bytes,
+        aggregation_parameter: bytes,
+        ord: Optional[int] = None,
+    ) -> List[BatchAggregation]:
+        pk = self._task_pk(task_id)
+        sql = """SELECT ord, state, aggregate_share, report_count, checksum,
+                        client_timestamp_interval_start,
+                        client_timestamp_interval_duration,
+                        aggregation_jobs_created, aggregation_jobs_terminated
+                 FROM batch_aggregations
+                 WHERE task_id = ? AND batch_identifier = ? AND aggregation_param = ?"""
+        args: List[Any] = [pk, batch_identifier, aggregation_parameter]
+        if ord is not None:
+            sql += " AND ord = ?"
+            args.append(ord)
+        sql += " ORDER BY ord"
+        out = []
+        for row in self.conn.execute(sql, args):
+            (
+                ord_,
+                state,
+                share,
+                count,
+                checksum,
+                istart,
+                idur,
+                created,
+                terminated,
+            ) = row
+            out.append(
+                BatchAggregation(
+                    task_id=task_id,
+                    batch_identifier=batch_identifier,
+                    aggregation_parameter=aggregation_parameter,
+                    ord=ord_,
+                    state=BatchAggregationState(state),
+                    aggregate_share=share,
+                    report_count=count,
+                    checksum=ReportIdChecksum(checksum),
+                    client_timestamp_interval=Interval(Time(istart), Duration(idur)),
+                    aggregation_jobs_created=created,
+                    aggregation_jobs_terminated=terminated,
+                )
+            )
+        return out
+
+    def get_batch_aggregations_overlapping_interval(
+        self, task_id: TaskId, interval: Interval
+    ) -> List[Tuple[bytes, bytes]]:
+        """(batch_identifier, aggregation_param) pairs whose client timestamp
+        interval overlaps — used for TimeInterval collection validation."""
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT DISTINCT batch_identifier, aggregation_param
+               FROM batch_aggregations
+               WHERE task_id = ?
+                 AND client_timestamp_interval_start < ?
+                 AND client_timestamp_interval_start
+                     + client_timestamp_interval_duration > ?""",
+            (pk, interval.end().seconds, interval.start.seconds),
+        ).fetchall()
+        return [(r[0], r[1]) for r in rows]
+
+    # ------------------------------------------------------------------
+    # collection jobs (reference: datastore.rs:3222-3397)
+
+    def put_collection_job(self, job: CollectionJob) -> None:
+        pk = self._task_pk(job.task_id)
+        row_ident = job.task_id.data + job.collection_job_id.data
+        enc_share = (
+            self.crypter.encrypt(
+                "collection_jobs", row_ident, "leader_aggregate_share",
+                job.leader_aggregate_share,
+            )
+            if job.leader_aggregate_share is not None
+            else None
+        )
+        now = self._now_s()
+        try:
+            self.conn.execute(
+                """INSERT INTO collection_jobs (task_id, collection_job_id, query,
+                    aggregation_param, batch_identifier, state, report_count,
+                    client_timestamp_interval_start, client_timestamp_interval_duration,
+                    leader_aggregate_share, helper_aggregate_share,
+                    created_at, updated_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    job.collection_job_id.data,
+                    job.query.get_encoded(),
+                    job.aggregation_parameter,
+                    job.batch_identifier,
+                    job.state.value,
+                    job.report_count,
+                    job.client_timestamp_interval.start.seconds
+                    if job.client_timestamp_interval
+                    else None,
+                    job.client_timestamp_interval.duration.seconds
+                    if job.client_timestamp_interval
+                    else None,
+                    enc_share,
+                    job.helper_aggregate_share.get_encoded()
+                    if job.helper_aggregate_share
+                    else None,
+                    now,
+                    now,
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict(f"collection job {job.collection_job_id} exists") from e
+
+    def get_collection_job(
+        self, task_id: TaskId, collection_job_id: CollectionJobId, query_kind: str
+    ) -> Optional[CollectionJob]:
+        pk = self._task_pk(task_id)
+        row = self.conn.execute(
+            """SELECT query, aggregation_param, batch_identifier, state,
+                      report_count, client_timestamp_interval_start,
+                      client_timestamp_interval_duration, leader_aggregate_share,
+                      helper_aggregate_share
+               FROM collection_jobs WHERE task_id = ? AND collection_job_id = ?""",
+            (pk, collection_job_id.data),
+        ).fetchone()
+        if row is None:
+            return None
+        return self._collection_job_from_row(task_id, collection_job_id, query_kind, row)
+
+    def _collection_job_from_row(
+        self, task_id, collection_job_id, query_kind: str, row
+    ) -> CollectionJob:
+        (
+            query_b,
+            param,
+            batch_ident,
+            state,
+            count,
+            istart,
+            idur,
+            enc_share,
+            helper_b,
+        ) = row
+        row_ident = task_id.data + collection_job_id.data
+        return CollectionJob(
+            task_id=task_id,
+            collection_job_id=collection_job_id,
+            query=Query.get_decoded(query_b, QUERY_TYPES[query_kind]),
+            aggregation_parameter=param,
+            batch_identifier=batch_ident,
+            state=CollectionJobState(state),
+            report_count=count,
+            client_timestamp_interval=Interval(Time(istart), Duration(idur))
+            if istart is not None
+            else None,
+            leader_aggregate_share=self.crypter.decrypt(
+                "collection_jobs", row_ident, "leader_aggregate_share", enc_share
+            )
+            if enc_share
+            else None,
+            helper_aggregate_share=HpkeCiphertext.get_decoded(helper_b)
+            if helper_b
+            else None,
+        )
+
+    def update_collection_job(self, job: CollectionJob) -> None:
+        pk = self._task_pk(job.task_id)
+        row_ident = job.task_id.data + job.collection_job_id.data
+        enc_share = (
+            self.crypter.encrypt(
+                "collection_jobs", row_ident, "leader_aggregate_share",
+                job.leader_aggregate_share,
+            )
+            if job.leader_aggregate_share is not None
+            else None
+        )
+        cur = self.conn.execute(
+            """UPDATE collection_jobs SET state = ?, report_count = ?,
+                 client_timestamp_interval_start = ?,
+                 client_timestamp_interval_duration = ?,
+                 leader_aggregate_share = ?, helper_aggregate_share = ?,
+                 updated_at = ?
+               WHERE task_id = ? AND collection_job_id = ?""",
+            (
+                job.state.value,
+                job.report_count,
+                job.client_timestamp_interval.start.seconds
+                if job.client_timestamp_interval
+                else None,
+                job.client_timestamp_interval.duration.seconds
+                if job.client_timestamp_interval
+                else None,
+                enc_share,
+                job.helper_aggregate_share.get_encoded()
+                if job.helper_aggregate_share
+                else None,
+                self._now_s(),
+                pk,
+                job.collection_job_id.data,
+            ),
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError(f"no collection job {job.collection_job_id}")
+
+    def get_collection_jobs_by_batch_identifier(
+        self, task_id: TaskId, batch_identifier: bytes, query_kind: str
+    ) -> List[CollectionJob]:
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT collection_job_id, query, aggregation_param, batch_identifier,
+                      state, report_count, client_timestamp_interval_start,
+                      client_timestamp_interval_duration, leader_aggregate_share,
+                      helper_aggregate_share
+               FROM collection_jobs WHERE task_id = ? AND batch_identifier = ?""",
+            (pk, batch_identifier),
+        ).fetchall()
+        return [
+            self._collection_job_from_row(task_id, CollectionJobId(r[0]), query_kind, r[1:])
+            for r in rows
+        ]
+
+    def increment_collection_job_step_attempts(
+        self, task_id: TaskId, collection_job_id: CollectionJobId
+    ) -> int:
+        pk = self._task_pk(task_id)
+        row = self.conn.execute(
+            """UPDATE collection_jobs SET step_attempts = step_attempts + 1
+               WHERE task_id = ? AND collection_job_id = ?
+               RETURNING step_attempts""",
+            (pk, collection_job_id.data),
+        ).fetchone()
+        if row is None:
+            raise DatastoreError(f"no collection job {collection_job_id}")
+        return row[0]
+
+    def acquire_incomplete_collection_jobs(
+        self, lease_duration: Duration, limit: int
+    ) -> List[Lease]:
+        """reference: datastore.rs:3295"""
+        now = self._now_s()
+        expiry = now + lease_duration.seconds
+        token = secrets.token_bytes(16)
+        rows = self.conn.execute(
+            """UPDATE collection_jobs
+               SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
+                   updated_at = ?
+               WHERE id IN (
+                   SELECT id FROM collection_jobs
+                   WHERE state = 'Start' AND lease_expiry <= ?
+                   ORDER BY id LIMIT ?)
+               RETURNING task_id, collection_job_id, lease_attempts, step_attempts""",
+            (expiry, token, now, now, limit),
+        ).fetchall()
+        leases = []
+        for task_pk, job_id, attempts, step_attempts in rows:
+            trow = self.conn.execute(
+                "SELECT task_id, query_type, vdaf FROM tasks WHERE id = ?", (task_pk,)
+            ).fetchone()
+            leases.append(
+                Lease(
+                    leased=AcquiredCollectionJob(
+                        task_id=TaskId(trow[0]),
+                        collection_job_id=CollectionJobId(job_id),
+                        query_type=TaskQueryType.from_json(trow[1]).kind,
+                        vdaf=json.loads(trow[2]),
+                        step_attempts=step_attempts,
+                    ),
+                    lease_expiry=Time(expiry),
+                    lease_token=LeaseToken(token),
+                    lease_attempts=attempts,
+                )
+            )
+        return leases
+
+    def release_collection_job(
+        self, lease: Lease, reacquire_delay: Optional[Duration] = None
+    ) -> None:
+        """reference: datastore.rs:3397"""
+        job = lease.leased
+        pk = self._task_pk(job.task_id)
+        new_expiry = (
+            self._now_s() + reacquire_delay.seconds if reacquire_delay is not None else 0
+        )
+        cur = self.conn.execute(
+            """UPDATE collection_jobs SET lease_expiry = ?, lease_token = NULL
+               WHERE task_id = ? AND collection_job_id = ? AND lease_token = ?""",
+            (new_expiry, pk, job.collection_job_id.data, lease.lease_token.data),
+        )
+        if cur.rowcount == 0:
+            raise TxConflict("lease no longer held")
+
+    # ------------------------------------------------------------------
+    # aggregate share jobs (reference: datastore.rs:4086-4328)
+
+    def put_aggregate_share_job(self, job: AggregateShareJob) -> None:
+        pk = self._task_pk(job.task_id)
+        row_ident = job.task_id.data + job.batch_identifier
+        enc = self.crypter.encrypt(
+            "aggregate_share_jobs", row_ident, "helper_aggregate_share",
+            job.helper_aggregate_share,
+        )
+        try:
+            self.conn.execute(
+                """INSERT INTO aggregate_share_jobs (task_id, batch_identifier,
+                    aggregation_param, helper_aggregate_share, report_count,
+                    checksum, created_at)
+                   VALUES (?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    job.batch_identifier,
+                    job.aggregation_parameter,
+                    enc,
+                    job.report_count,
+                    job.checksum.data,
+                    self._now_s(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict("aggregate share job already exists") from e
+
+    def get_aggregate_share_job(
+        self, task_id: TaskId, batch_identifier: bytes, aggregation_parameter: bytes
+    ) -> Optional[AggregateShareJob]:
+        pk = self._task_pk(task_id)
+        row = self.conn.execute(
+            """SELECT helper_aggregate_share, report_count, checksum
+               FROM aggregate_share_jobs
+               WHERE task_id = ? AND batch_identifier = ? AND aggregation_param = ?""",
+            (pk, batch_identifier, aggregation_parameter),
+        ).fetchone()
+        if row is None:
+            return None
+        row_ident = task_id.data + batch_identifier
+        return AggregateShareJob(
+            task_id=task_id,
+            batch_identifier=batch_identifier,
+            aggregation_parameter=aggregation_parameter,
+            helper_aggregate_share=self.crypter.decrypt(
+                "aggregate_share_jobs", row_ident, "helper_aggregate_share", row[0]
+            ),
+            report_count=row[1],
+            checksum=ReportIdChecksum(row[2]),
+        )
+
+    def count_aggregate_share_jobs_for_batch(
+        self, task_id: TaskId, batch_identifier: bytes
+    ) -> int:
+        pk = self._task_pk(task_id)
+        return self.conn.execute(
+            "SELECT COUNT(*) FROM aggregate_share_jobs"
+            " WHERE task_id = ? AND batch_identifier = ?",
+            (pk, batch_identifier),
+        ).fetchone()[0]
+
+    # ------------------------------------------------------------------
+    # outstanding batches (reference: datastore.rs:4394-4646)
+
+    def put_outstanding_batch(
+        self, task_id: TaskId, batch_id: BatchId, time_bucket_start: Optional[Time]
+    ) -> None:
+        pk = self._task_pk(task_id)
+        try:
+            self.conn.execute(
+                """INSERT INTO outstanding_batches (task_id, batch_id,
+                    time_bucket_start, created_at) VALUES (?,?,?,?)""",
+                (
+                    pk,
+                    batch_id.data,
+                    time_bucket_start.seconds if time_bucket_start else None,
+                    self._now_s(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict("outstanding batch already exists") from e
+
+    def get_unfilled_outstanding_batches(
+        self, task_id: TaskId, time_bucket_start: Optional[Time]
+    ) -> List[OutstandingBatch]:
+        pk = self._task_pk(task_id)
+        if time_bucket_start is None:
+            rows = self.conn.execute(
+                """SELECT batch_id, time_bucket_start FROM outstanding_batches
+                   WHERE task_id = ? AND filled = 0 AND time_bucket_start IS NULL""",
+                (pk,),
+            ).fetchall()
+        else:
+            rows = self.conn.execute(
+                """SELECT batch_id, time_bucket_start FROM outstanding_batches
+                   WHERE task_id = ? AND filled = 0 AND time_bucket_start = ?""",
+                (pk, time_bucket_start.seconds),
+            ).fetchall()
+        out = []
+        for batch_id_b, bucket in rows:
+            size_min, size_max = self._outstanding_batch_size(pk, batch_id_b)
+            out.append(
+                OutstandingBatch(
+                    task_id=task_id,
+                    batch_id=BatchId(batch_id_b),
+                    time_bucket_start=Time(bucket) if bucket is not None else None,
+                    size_min=size_min,
+                    size_max=size_max,
+                )
+            )
+        return out
+
+    def _outstanding_batch_size(self, task_pk: int, batch_id: bytes) -> Tuple[int, int]:
+        """Possible report-count range for a batch: min counts Finished report
+        aggregations, max also counts in-flight ones
+        (reference: datastore.rs read_batch_size)."""
+        row = self.conn.execute(
+            """SELECT
+                 SUM(CASE WHEN ra.state = 'Finished' THEN 1 ELSE 0 END),
+                 SUM(CASE WHEN ra.state != 'Failed' THEN 1 ELSE 0 END)
+               FROM report_aggregations ra
+               JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+               WHERE aj.task_id = ? AND aj.batch_id = ?""",
+            (task_pk, batch_id),
+        ).fetchone()
+        return (row[0] or 0, row[1] or 0)
+
+    def mark_outstanding_batch_filled(self, task_id: TaskId, batch_id: BatchId) -> None:
+        pk = self._task_pk(task_id)
+        self.conn.execute(
+            "UPDATE outstanding_batches SET filled = 1 WHERE task_id = ? AND batch_id = ?",
+            (pk, batch_id.data),
+        )
+
+    def acquire_filled_outstanding_batch(
+        self, task_id: TaskId, min_size: int
+    ) -> Optional[BatchId]:
+        """Pick (and remove) one outstanding batch with at least ``min_size``
+        finished reports — serves FixedSizeQuery::CurrentBatch
+        (reference: datastore.rs acquire_outstanding_batch_with_report_count)."""
+        pk = self._task_pk(task_id)
+        for (batch_id_b,) in self.conn.execute(
+            "SELECT batch_id FROM outstanding_batches WHERE task_id = ? ORDER BY created_at",
+            (pk,),
+        ).fetchall():
+            size_min, _ = self._outstanding_batch_size(pk, batch_id_b)
+            if size_min >= min_size:
+                self.conn.execute(
+                    "DELETE FROM outstanding_batches WHERE task_id = ? AND batch_id = ?",
+                    (pk, batch_id_b),
+                )
+                return BatchId(batch_id_b)
+        return None
+
+    def delete_outstanding_batch(self, task_id: TaskId, batch_id: BatchId) -> None:
+        pk = self._task_pk(task_id)
+        self.conn.execute(
+            "DELETE FROM outstanding_batches WHERE task_id = ? AND batch_id = ?",
+            (pk, batch_id.data),
+        )
+
+    # ------------------------------------------------------------------
+    # GC (reference: datastore.rs:4733,4793)
+
+    def delete_expired_aggregation_artifacts(
+        self, task_id: TaskId, expiry: Time, limit: int
+    ) -> int:
+        """Delete aggregation jobs (and their report aggregations, via
+        cascade) whose entire client-timestamp interval is before expiry."""
+        pk = self._task_pk(task_id)
+        cur = self.conn.execute(
+            """DELETE FROM aggregation_jobs WHERE id IN (
+                 SELECT id FROM aggregation_jobs
+                 WHERE task_id = ?
+                   AND client_timestamp_interval_start
+                       + client_timestamp_interval_duration < ?
+                   AND state != 'InProgress'
+                 LIMIT ?)""",
+            (pk, expiry.seconds, limit),
+        )
+        return cur.rowcount
+
+    def delete_expired_collection_artifacts(
+        self, task_id: TaskId, expiry: Time, limit: int
+    ) -> int:
+        pk = self._task_pk(task_id)
+        n = self.conn.execute(
+            """DELETE FROM collection_jobs WHERE id IN (
+                 SELECT id FROM collection_jobs
+                 WHERE task_id = ? AND state IN ('Finished','Abandoned','Deleted')
+                   AND client_timestamp_interval_start IS NOT NULL
+                   AND client_timestamp_interval_start
+                       + client_timestamp_interval_duration < ?
+                 LIMIT ?)""",
+            (pk, expiry.seconds, limit),
+        ).rowcount
+        n += self.conn.execute(
+            """DELETE FROM batch_aggregations WHERE id IN (
+                 SELECT id FROM batch_aggregations
+                 WHERE task_id = ? AND state != 'Aggregating'
+                   AND client_timestamp_interval_start
+                       + client_timestamp_interval_duration < ?
+                 LIMIT ?)""",
+            (pk, expiry.seconds, limit),
+        ).rowcount
+        n += self.conn.execute(
+            """DELETE FROM aggregate_share_jobs WHERE id IN (
+                 SELECT id FROM aggregate_share_jobs
+                 WHERE task_id = ? AND created_at < ? LIMIT ?)""",
+            (pk, expiry.seconds, limit),
+        ).rowcount
+        return n
+
+    # ------------------------------------------------------------------
+    # global HPKE keys (reference: datastore.rs:4857-4983)
+
+    def put_global_hpke_keypair(self, keypair: HpkeKeypair) -> None:
+        enc = self.crypter.encrypt(
+            "global_hpke_keys",
+            bytes([keypair.config.id]),
+            "private_key",
+            keypair.private_key,
+        )
+        try:
+            self.conn.execute(
+                """INSERT INTO global_hpke_keys (config_id, config, private_key,
+                    state, updated_at) VALUES (?,?,?,?,?)""",
+                (
+                    keypair.config.id,
+                    keypair.config.get_encoded(),
+                    enc,
+                    HpkeKeyState.PENDING.value,
+                    self._now_s(),
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict("global HPKE key id already exists") from e
+
+    def get_global_hpke_keypairs(self) -> List[GlobalHpkeKeypair]:
+        out = []
+        for config_id, cfg_b, enc, state, updated in self.conn.execute(
+            "SELECT config_id, config, private_key, state, updated_at"
+            " FROM global_hpke_keys ORDER BY config_id"
+        ):
+            sk = self.crypter.decrypt(
+                "global_hpke_keys", bytes([config_id]), "private_key", enc
+            )
+            out.append(
+                GlobalHpkeKeypair(
+                    config=HpkeConfig.get_decoded(cfg_b),
+                    private_key=sk,
+                    state=HpkeKeyState(state),
+                    updated_at=Time(updated),
+                )
+            )
+        return out
+
+    def set_global_hpke_keypair_state(self, config_id: int, state: HpkeKeyState) -> None:
+        cur = self.conn.execute(
+            "UPDATE global_hpke_keys SET state = ?, updated_at = ? WHERE config_id = ?",
+            (state.value, self._now_s(), config_id),
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError(f"no global HPKE key {config_id}")
+
+    def delete_global_hpke_keypair(self, config_id: int) -> None:
+        cur = self.conn.execute(
+            "DELETE FROM global_hpke_keys WHERE config_id = ?", (config_id,)
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError(f"no global HPKE key {config_id}")
+
+    # ------------------------------------------------------------------
+    # upload counters (reference: datastore.rs:5326-5429)
+
+    def increment_task_upload_counter(
+        self, task_id: TaskId, ord: int, counter: TaskUploadCounter
+    ) -> None:
+        pk = self._task_pk(task_id)
+        self.conn.execute(
+            """INSERT INTO task_upload_counters (task_id, ord) VALUES (?, ?)
+               ON CONFLICT(task_id, ord) DO NOTHING""",
+            (pk, ord),
+        )
+        sets = ", ".join(f"{c} = {c} + ?" for c in TaskUploadCounter.COLUMNS)
+        self.conn.execute(
+            f"UPDATE task_upload_counters SET {sets} WHERE task_id = ? AND ord = ?",
+            tuple(getattr(counter, c) for c in TaskUploadCounter.COLUMNS) + (pk, ord),
+        )
+
+    def get_task_upload_counter(self, task_id: TaskId) -> TaskUploadCounter:
+        pk = self._task_pk(task_id)
+        sums = ", ".join(f"COALESCE(SUM({c}), 0)" for c in TaskUploadCounter.COLUMNS)
+        row = self.conn.execute(
+            f"SELECT {sums} FROM task_upload_counters WHERE task_id = ?", (pk,)
+        ).fetchone()
+        return TaskUploadCounter(
+            task_id, **dict(zip(TaskUploadCounter.COLUMNS, row))
+        )

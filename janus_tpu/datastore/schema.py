@@ -1,0 +1,232 @@
+"""Datastore SQL schema.
+
+The analog of the reference's migrations (reference:
+db/00000000000001_initial_schema.up.sql).  SQLite dialect: BLOBs for ids and
+ciphertexts, INTEGER seconds for times/durations, TEXT for JSON-serialized
+enums/configs.  Structure (tables, uniqueness, indexes incl. the partial
+index on unaggregated reports and lease-expiry indexes) mirrors the
+reference schema; GiST interval indexes become ordinary (start, end) b-trees.
+
+``SCHEMA_VERSION`` guards compatibility the way the reference's
+``supported_schema_versions!`` does (aggregator_core/src/datastore.rs:77-104).
+"""
+
+SCHEMA_VERSION = 1
+
+SCHEMA = """
+PRAGMA journal_mode = WAL;
+
+CREATE TABLE IF NOT EXISTS schema_version (
+    version INTEGER NOT NULL
+);
+
+-- reference: initial_schema.up.sql `tasks`
+CREATE TABLE IF NOT EXISTS tasks (
+    id INTEGER PRIMARY KEY,
+    task_id BLOB NOT NULL UNIQUE,
+    aggregator_role TEXT NOT NULL,              -- 'Leader' | 'Helper'
+    peer_aggregator_endpoint TEXT NOT NULL,
+    query_type TEXT NOT NULL,                   -- TaskQueryType JSON
+    vdaf TEXT NOT NULL,                         -- VdafInstance JSON
+    task_expiration INTEGER,
+    report_expiry_age INTEGER,
+    min_batch_size INTEGER NOT NULL,
+    time_precision INTEGER NOT NULL,
+    tolerable_clock_skew INTEGER NOT NULL,
+    collector_hpke_config BLOB,
+    vdaf_verify_key BLOB NOT NULL,              -- encrypted
+    aggregator_auth_token_type TEXT,
+    aggregator_auth_token BLOB,                 -- encrypted (leader only)
+    aggregator_auth_token_hash TEXT,            -- JSON (helper only)
+    collector_auth_token_hash TEXT,             -- JSON (leader only)
+    created_at INTEGER NOT NULL
+);
+
+-- reference: initial_schema.up.sql `task_hpke_keys`
+CREATE TABLE IF NOT EXISTS task_hpke_keys (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    config_id INTEGER NOT NULL,
+    config BLOB NOT NULL,
+    private_key BLOB NOT NULL,                  -- encrypted
+    UNIQUE(task_id, config_id)
+);
+
+-- reference: initial_schema.up.sql `client_reports` (:204 partial index)
+CREATE TABLE IF NOT EXISTS client_reports (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    report_id BLOB NOT NULL,
+    client_timestamp INTEGER NOT NULL,
+    extensions BLOB,
+    public_share BLOB,
+    leader_input_share BLOB,                    -- encrypted
+    helper_encrypted_input_share BLOB,
+    aggregation_started INTEGER NOT NULL DEFAULT 0,
+    created_at INTEGER NOT NULL,
+    UNIQUE(task_id, report_id)
+);
+CREATE INDEX IF NOT EXISTS client_reports_task_unaggregated
+    ON client_reports(task_id, client_timestamp) WHERE aggregation_started = 0;
+
+-- reference: initial_schema.up.sql `aggregation_jobs` (lease index :239)
+CREATE TABLE IF NOT EXISTS aggregation_jobs (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    aggregation_job_id BLOB NOT NULL,
+    aggregation_param BLOB NOT NULL,
+    batch_id BLOB,                              -- fixed-size tasks only
+    client_timestamp_interval_start INTEGER NOT NULL,
+    client_timestamp_interval_duration INTEGER NOT NULL,
+    state TEXT NOT NULL,                        -- AggregationJobState
+    step INTEGER NOT NULL DEFAULT 0,
+    last_request_hash BLOB,
+    lease_expiry INTEGER NOT NULL DEFAULT 0,
+    lease_token BLOB,
+    lease_attempts INTEGER NOT NULL DEFAULT 0,
+    created_at INTEGER NOT NULL,
+    updated_at INTEGER NOT NULL,
+    UNIQUE(task_id, aggregation_job_id)
+);
+CREATE INDEX IF NOT EXISTS aggregation_jobs_state_lease
+    ON aggregation_jobs(state, lease_expiry) WHERE state = 'InProgress';
+
+-- reference: initial_schema.up.sql `report_aggregations`
+CREATE TABLE IF NOT EXISTS report_aggregations (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    aggregation_job_id INTEGER NOT NULL
+        REFERENCES aggregation_jobs(id) ON DELETE CASCADE,
+    ord INTEGER NOT NULL,
+    report_id BLOB NOT NULL,
+    client_timestamp INTEGER NOT NULL,
+    last_prep_resp BLOB,
+    state TEXT NOT NULL,                        -- ReportAggregationState
+    -- state-specific payloads (reference: models.rs:898-1105):
+    public_share BLOB,                          -- StartLeader
+    leader_extensions BLOB,                     -- StartLeader
+    leader_input_share BLOB,                    -- StartLeader, encrypted
+    helper_encrypted_input_share BLOB,          -- StartLeader
+    leader_prep_transition BLOB,                -- WaitingLeader, encrypted
+    helper_prep_state BLOB,                     -- WaitingHelper, encrypted
+    error_code INTEGER,                         -- Failed
+    UNIQUE(aggregation_job_id, ord)
+);
+CREATE INDEX IF NOT EXISTS report_aggregations_by_report
+    ON report_aggregations(task_id, report_id);
+
+-- reference: initial_schema.up.sql `batch_aggregations` (sharded accumulators)
+CREATE TABLE IF NOT EXISTS batch_aggregations (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    batch_identifier BLOB NOT NULL,             -- encoded Interval or BatchId
+    aggregation_param BLOB NOT NULL,
+    ord INTEGER NOT NULL,                       -- shard index
+    state TEXT NOT NULL,                        -- Aggregating|Collected|Scrubbed
+    aggregate_share BLOB,
+    report_count INTEGER NOT NULL DEFAULT 0,
+    checksum BLOB,
+    client_timestamp_interval_start INTEGER NOT NULL,
+    client_timestamp_interval_duration INTEGER NOT NULL,
+    aggregation_jobs_created INTEGER NOT NULL DEFAULT 0,
+    aggregation_jobs_terminated INTEGER NOT NULL DEFAULT 0,
+    created_at INTEGER NOT NULL,
+    UNIQUE(task_id, batch_identifier, aggregation_param, ord)
+);
+CREATE INDEX IF NOT EXISTS batch_aggregations_by_interval
+    ON batch_aggregations(task_id, client_timestamp_interval_start);
+
+-- reference: initial_schema.up.sql `collection_jobs` (GiST :363 -> b-tree)
+CREATE TABLE IF NOT EXISTS collection_jobs (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    collection_job_id BLOB NOT NULL,
+    query BLOB NOT NULL,
+    aggregation_param BLOB NOT NULL,
+    batch_identifier BLOB NOT NULL,
+    state TEXT NOT NULL,                        -- Start|Finished|Abandoned|Deleted
+    report_count INTEGER,
+    client_timestamp_interval_start INTEGER,
+    client_timestamp_interval_duration INTEGER,
+    leader_aggregate_share BLOB,                -- encrypted
+    helper_aggregate_share BLOB,
+    lease_expiry INTEGER NOT NULL DEFAULT 0,
+    lease_token BLOB,
+    lease_attempts INTEGER NOT NULL DEFAULT 0,
+    step_attempts INTEGER NOT NULL DEFAULT 0,
+    created_at INTEGER NOT NULL,
+    updated_at INTEGER NOT NULL,
+    UNIQUE(task_id, collection_job_id)
+);
+CREATE INDEX IF NOT EXISTS collection_jobs_state_lease
+    ON collection_jobs(state, lease_expiry) WHERE state = 'Start';
+CREATE INDEX IF NOT EXISTS collection_jobs_by_batch
+    ON collection_jobs(task_id, batch_identifier);
+
+-- reference: initial_schema.up.sql `aggregate_share_jobs` (helper cache)
+CREATE TABLE IF NOT EXISTS aggregate_share_jobs (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    batch_identifier BLOB NOT NULL,
+    aggregation_param BLOB NOT NULL,
+    helper_aggregate_share BLOB NOT NULL,       -- encrypted
+    report_count INTEGER NOT NULL,
+    checksum BLOB NOT NULL,
+    created_at INTEGER NOT NULL,
+    UNIQUE(task_id, batch_identifier, aggregation_param)
+);
+
+-- reference: initial_schema.up.sql `outstanding_batches`
+CREATE TABLE IF NOT EXISTS outstanding_batches (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    batch_id BLOB NOT NULL,
+    time_bucket_start INTEGER,
+    filled INTEGER NOT NULL DEFAULT 0,
+    created_at INTEGER NOT NULL,
+    UNIQUE(task_id, batch_id)
+);
+CREATE INDEX IF NOT EXISTS outstanding_batches_open
+    ON outstanding_batches(task_id, time_bucket_start) WHERE filled = 0;
+
+-- reference: initial_schema.up.sql `global_hpke_keys`
+CREATE TABLE IF NOT EXISTS global_hpke_keys (
+    config_id INTEGER PRIMARY KEY,
+    config BLOB NOT NULL,
+    private_key BLOB NOT NULL,                  -- encrypted
+    state TEXT NOT NULL,                        -- Pending|Active|Expired
+    updated_at INTEGER NOT NULL
+);
+
+-- reference: taskprov_* tables
+CREATE TABLE IF NOT EXISTS taskprov_peer_aggregators (
+    id INTEGER PRIMARY KEY,
+    endpoint TEXT NOT NULL,
+    role TEXT NOT NULL,
+    verify_key_init BLOB NOT NULL,              -- encrypted
+    collector_hpke_config BLOB NOT NULL,
+    report_expiry_age INTEGER,
+    tolerable_clock_skew INTEGER NOT NULL,
+    aggregator_auth_token_type TEXT,
+    aggregator_auth_token BLOB,                 -- encrypted
+    aggregator_auth_token_hash TEXT,            -- JSON
+    collector_auth_token_hash TEXT,             -- JSON
+    UNIQUE(endpoint, role)
+);
+
+-- reference: task_upload_counters (:5326), sharded
+CREATE TABLE IF NOT EXISTS task_upload_counters (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    ord INTEGER NOT NULL,
+    interval_collected INTEGER NOT NULL DEFAULT 0,
+    report_decode_failure INTEGER NOT NULL DEFAULT 0,
+    report_decrypt_failure INTEGER NOT NULL DEFAULT 0,
+    report_expired INTEGER NOT NULL DEFAULT 0,
+    report_outdated_key INTEGER NOT NULL DEFAULT 0,
+    report_success INTEGER NOT NULL DEFAULT 0,
+    report_too_early INTEGER NOT NULL DEFAULT 0,
+    task_expired INTEGER NOT NULL DEFAULT 0,
+    UNIQUE(task_id, ord)
+);
+"""

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..fields import next_power_of_2
 from ..xof import XofTurboShake128
 from .prio3 import (
     Prio3,
@@ -68,10 +69,6 @@ class OracleBackend:
             except VdafError as e:
                 out.append(e)
         return out
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
 
 
 class TpuBackend:
@@ -168,7 +165,7 @@ class TpuBackend:
             return []
         vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
         B = len(reports)
-        pad_to = _next_pow2(B)
+        pad_to = next_power_of_2(B)
         kw = self._marshal(agg_id, reports, pad_to)
         kw["verify_key_u8"] = np.frombuffer(verify_key, dtype=np.uint8)
         out = self._prep_fn(agg_id)(kw)
@@ -221,7 +218,7 @@ class TpuBackend:
                     results.append(next(good_iter))
             return results
         B = len(prep_shares)
-        pad_to = _next_pow2(B)
+        pad_to = next_power_of_2(B)
         has_jr = flp.JOINT_RAND_LEN > 0
 
         ver_len = flp.VERIFIER_LEN * vdaf.num_proofs

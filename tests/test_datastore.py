@@ -1,0 +1,690 @@
+"""Datastore tests: task/report/job round-trips, leases, crypter, GC.
+
+Mirrors the reference's datastore test strategy (SURVEY.md §4.2; reference:
+aggregator_core/src/datastore/tests.rs) against the ephemeral harness.
+"""
+
+import threading
+
+import pytest
+
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import (
+    AggregateShareJob,
+    AggregationJob,
+    AggregationJobState,
+    AggregatorTask,
+    BatchAggregation,
+    BatchAggregationState,
+    CollectionJob,
+    CollectionJobState,
+    Crypter,
+    CrypterError,
+    HpkeKeyState,
+    LeaderStoredReport,
+    ReportAggregation,
+    ReportAggregationState,
+    TaskQueryType,
+    TaskUploadCounter,
+    TxConflict,
+    generate_key,
+)
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchId,
+    CollectionJobId,
+    Duration,
+    Extension,
+    ExtensionType,
+    HpkeCiphertext,
+    Interval,
+    PrepareError,
+    Query,
+    ReportId,
+    ReportIdChecksum,
+    ReportMetadata,
+    Role,
+    TaskId,
+    Time,
+)
+
+
+def make_task(role=Role.LEADER, query_type=None, vdaf=None) -> AggregatorTask:
+    return AggregatorTask(
+        task_id=TaskId.random(),
+        peer_aggregator_endpoint="https://peer.example.com/",
+        query_type=query_type or TaskQueryType.time_interval(),
+        vdaf=vdaf or {"type": "Prio3Count"},
+        role=role,
+        vdaf_verify_key=b"\x01" * 16,
+        min_batch_size=10,
+        time_precision=Duration(3600),
+        aggregator_auth_token=AuthenticationToken.new_bearer("token-abc")
+        if role == Role.LEADER
+        else None,
+        aggregator_auth_token_hash=AuthenticationToken.new_bearer("token-abc").hash()
+        if role == Role.HELPER
+        else None,
+        collector_auth_token_hash=AuthenticationToken.new_bearer("col-tok").hash()
+        if role == Role.LEADER
+        else None,
+        hpke_keys=[HpkeKeypair.generate(1)],
+    )
+
+
+def make_report(task_id: TaskId, t: int = 1_600_000_000) -> LeaderStoredReport:
+    return LeaderStoredReport(
+        task_id=task_id,
+        metadata=ReportMetadata(ReportId.random(), Time(t)),
+        public_share=b"public",
+        leader_extensions=[Extension(ExtensionType.TBD, b"ext")],
+        leader_input_share=b"leader-share-plaintext",
+        helper_encrypted_input_share=HpkeCiphertext(1, b"enc", b"payload"),
+    )
+
+
+@pytest.fixture()
+def ds():
+    eds = EphemeralDatastore()
+    yield eds.datastore
+    eds.cleanup()
+
+
+class TestCrypter:
+    def test_round_trip_and_aad_binding(self):
+        c = Crypter([generate_key()])
+        ct = c.encrypt("tasks", b"row1", "col", b"secret")
+        assert c.decrypt("tasks", b"row1", "col", ct) == b"secret"
+        with pytest.raises(CrypterError):
+            c.decrypt("tasks", b"row2", "col", ct)
+        with pytest.raises(CrypterError):
+            c.decrypt("tasks", b"row1", "other", ct)
+        with pytest.raises(CrypterError):
+            c.decrypt("other", b"row1", "col", ct)
+
+    def test_key_rotation(self):
+        old, new = generate_key(), generate_key()
+        ct = Crypter([old]).encrypt("t", b"r", "c", b"v")
+        assert Crypter([new, old]).decrypt("t", b"r", "c", ct) == b"v"
+        with pytest.raises(CrypterError):
+            Crypter([new]).decrypt("t", b"r", "c", ct)
+
+
+class TestTasks:
+    def test_round_trip(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        got = ds.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id))
+        assert got == task
+        assert ds.run_tx("ids", lambda tx: tx.get_task_ids()) == [task.task_id]
+
+    def test_helper_round_trip(self, ds):
+        task = make_task(role=Role.HELPER)
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        got = ds.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id))
+        assert got == task
+        assert got.aggregator_auth_token is None
+        assert got.aggregator_auth_token_hash.validate(
+            AuthenticationToken.new_bearer("token-abc")
+        )
+
+    def test_duplicate_put_conflicts(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        with pytest.raises(TxConflict):
+            ds.run_tx("put2", lambda tx: tx.put_aggregator_task(task))
+
+    def test_delete(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        ds.run_tx("del", lambda tx: tx.delete_task(task.task_id))
+        assert ds.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id)) is None
+
+
+class TestClientReports:
+    def test_round_trip_and_dedup(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        report = make_report(task.task_id)
+        ds.run_tx("putr", lambda tx: tx.put_client_report(report))
+        got = ds.run_tx(
+            "getr", lambda tx: tx.get_client_report(task.task_id, report.report_id)
+        )
+        assert got == report
+        with pytest.raises(TxConflict):
+            ds.run_tx("putr2", lambda tx: tx.put_client_report(report))
+
+    def test_claim_and_release(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        reports = [make_report(task.task_id, 1_600_000_000 + i) for i in range(5)]
+        for r in reports:
+            ds.run_tx("putr", lambda tx, r=r: tx.put_client_report(r))
+
+        claimed = ds.run_tx(
+            "claim",
+            lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 3),
+        )
+        assert len(claimed) == 3
+        # second claim gets only the remaining two
+        claimed2 = ds.run_tx(
+            "claim2",
+            lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 10),
+        )
+        assert len(claimed2) == 2
+        # release the first three; they become claimable again
+        ds.run_tx(
+            "rel",
+            lambda tx: tx.mark_reports_unaggregated(
+                task.task_id, [m.report_id for m in claimed]
+            ),
+        )
+        claimed3 = ds.run_tx(
+            "claim3",
+            lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 10),
+        )
+        assert {m.report_id for m in claimed3} == {m.report_id for m in claimed}
+
+    def test_scrub(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        report = make_report(task.task_id)
+        ds.run_tx("putr", lambda tx: tx.put_client_report(report))
+        ds.run_tx(
+            "scrub", lambda tx: tx.scrub_client_report(task.task_id, report.report_id)
+        )
+        assert (
+            ds.run_tx(
+                "getr", lambda tx: tx.get_client_report(task.task_id, report.report_id)
+            )
+            is None
+        )
+        # still counted as existing (upload dedup)
+        assert ds.run_tx(
+            "chk",
+            lambda tx: tx.check_client_report_exists(task.task_id, report.report_id),
+        )
+
+    def test_counts_and_gc(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        for i in range(4):
+            ds.run_tx(
+                "putr",
+                lambda tx, i=i: tx.put_client_report(
+                    make_report(task.task_id, 1_600_000_000 + i * 100)
+                ),
+            )
+        interval = Interval(Time(1_600_000_000), Duration(250))
+        assert (
+            ds.run_tx(
+                "cnt",
+                lambda tx: tx.count_client_reports_for_interval(task.task_id, interval),
+            )
+            == 3
+        )
+        deleted = ds.run_tx(
+            "gc",
+            lambda tx: tx.delete_expired_client_reports(
+                task.task_id, Time(1_600_000_150), 10
+            ),
+        )
+        assert deleted == 2
+
+
+def put_job(ds, task, job_id=None, batch_id=None):
+    job = AggregationJob(
+        task_id=task.task_id,
+        aggregation_job_id=job_id or AggregationJobId.random(),
+        aggregation_parameter=b"",
+        partial_batch_identifier=batch_id,
+        client_timestamp_interval=Interval(Time(1_600_000_000), Duration(3600)),
+        state=AggregationJobState.IN_PROGRESS,
+        step=AggregationJobStep(0),
+    )
+    ds.run_tx("putj", lambda tx: tx.put_aggregation_job(job))
+    return job
+
+
+class TestAggregationJobs:
+    def test_round_trip_update(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job = put_job(ds, task)
+        got = ds.run_tx(
+            "getj",
+            lambda tx: tx.get_aggregation_job(task.task_id, job.aggregation_job_id),
+        )
+        assert got == job
+        updated = job.with_state(AggregationJobState.FINISHED).with_step(
+            AggregationJobStep(1)
+        ).with_last_request_hash(b"\x11" * 32)
+        ds.run_tx("updj", lambda tx: tx.update_aggregation_job(updated))
+        got = ds.run_tx(
+            "getj2",
+            lambda tx: tx.get_aggregation_job(task.task_id, job.aggregation_job_id),
+        )
+        assert got == updated
+
+    def test_lease_acquire_release(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job = put_job(ds, task)
+
+        leases = ds.run_tx(
+            "acq",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+        )
+        assert len(leases) == 1
+        lease = leases[0]
+        assert lease.leased.aggregation_job_id == job.aggregation_job_id
+        assert lease.leased.vdaf == {"type": "Prio3Count"}
+        assert lease.lease_attempts == 1
+
+        # while leased, nothing else can acquire
+        assert (
+            ds.run_tx(
+                "acq2",
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+            )
+            == []
+        )
+        ds.run_tx("rel", lambda tx: tx.release_aggregation_job(lease))
+        leases2 = ds.run_tx(
+            "acq3",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+        )
+        assert len(leases2) == 1
+        assert leases2[0].lease_attempts == 2
+        # stale lease token can no longer release
+        with pytest.raises(TxConflict):
+            ds.run_tx("rel2", lambda tx: tx.release_aggregation_job(lease))
+
+    def test_lease_expiry_reacquire(self, ds):
+        clock: MockClock = ds.clock
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        put_job(ds, task)
+        leases = ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)
+        )
+        assert len(leases) == 1
+        clock.advance(Duration(601))
+        leases2 = ds.run_tx(
+            "acq2", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)
+        )
+        assert len(leases2) == 1
+        assert leases2[0].lease_attempts == 2
+
+    def test_concurrent_acquirers_no_overlap(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        for _ in range(8):
+            put_job(ds, task)
+
+        acquired = []
+        lock = threading.Lock()
+
+        def worker():
+            got = ds.run_tx(
+                "acq",
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 3),
+            )
+            with lock:
+                acquired.extend(got)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ids = [l.leased.aggregation_job_id for l in acquired]
+        assert len(ids) == len(set(ids)) == 8
+
+    def test_release_with_delay(self, ds):
+        clock: MockClock = ds.clock
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        put_job(ds, task)
+        (lease,) = ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)
+        )
+        ds.run_tx(
+            "rel", lambda tx: tx.release_aggregation_job(lease, Duration(300))
+        )
+        assert (
+            ds.run_tx(
+                "acq2",
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+            )
+            == []
+        )
+        clock.advance(Duration(301))
+        assert (
+            len(
+                ds.run_tx(
+                    "acq3",
+                    lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+                )
+            )
+            == 1
+        )
+
+
+class TestReportAggregations:
+    def test_all_states_round_trip(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job = put_job(ds, task)
+
+        ras = [
+            ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=ReportId.random(),
+                time=Time(1_600_000_000),
+                ord=0,
+                state=ReportAggregationState.START_LEADER,
+                public_share=b"ps",
+                leader_extensions=[Extension(ExtensionType.TBD, b"x")],
+                leader_input_share=b"lis",
+                helper_encrypted_input_share=HpkeCiphertext(2, b"ek", b"pl"),
+            ),
+            ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=ReportId.random(),
+                time=Time(1_600_000_001),
+                ord=1,
+                state=ReportAggregationState.WAITING_LEADER,
+                leader_prep_transition=b"transition-bytes",
+            ),
+            ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=ReportId.random(),
+                time=Time(1_600_000_002),
+                ord=2,
+                state=ReportAggregationState.WAITING_HELPER,
+                helper_prep_state=b"helper-state",
+            ),
+            ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=ReportId.random(),
+                time=Time(1_600_000_003),
+                ord=3,
+                state=ReportAggregationState.FINISHED,
+            ),
+            ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=ReportId.random(),
+                time=Time(1_600_000_004),
+                ord=4,
+                state=ReportAggregationState.FAILED,
+                error=PrepareError.VDAF_PREP_ERROR,
+            ),
+        ]
+        for ra in ras:
+            ds.run_tx("putra", lambda tx, ra=ra: tx.put_report_aggregation(ra))
+        got = ds.run_tx(
+            "getra",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                task.task_id, job.aggregation_job_id
+            ),
+        )
+        assert got == ras
+
+        # state transition: StartLeader -> WaitingLeader clears payloads
+        updated = ras[0].with_state(
+            ReportAggregationState.WAITING_LEADER, leader_prep_transition=b"t2"
+        )
+        ds.run_tx("updra", lambda tx: tx.update_report_aggregation(updated))
+        got = ds.run_tx(
+            "getra2",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                task.task_id, job.aggregation_job_id
+            ),
+        )
+        assert got[0] == updated
+        assert got[0].public_share is None
+
+    def test_replay_check(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job1 = put_job(ds, task)
+        job2 = put_job(ds, task)
+        rid = ReportId.random()
+        ra = ReportAggregation(
+            task_id=task.task_id,
+            aggregation_job_id=job1.aggregation_job_id,
+            report_id=rid,
+            time=Time(1_600_000_000),
+            ord=0,
+            state=ReportAggregationState.FINISHED,
+        )
+        ds.run_tx("putra", lambda tx: tx.put_report_aggregation(ra))
+        assert ds.run_tx(
+            "chk",
+            lambda tx: tx.check_report_aggregation_exists(
+                task.task_id, rid, exclude_aggregation_job_id=job2.aggregation_job_id
+            ),
+        )
+        assert not ds.run_tx(
+            "chk2",
+            lambda tx: tx.check_report_aggregation_exists(
+                task.task_id, rid, exclude_aggregation_job_id=job1.aggregation_job_id
+            ),
+        )
+
+
+class TestBatchAggregations:
+    def test_round_trip(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        ident = Interval(Time(1_600_000_000), Duration(3600)).get_encoded()
+        ba = BatchAggregation(
+            task_id=task.task_id,
+            batch_identifier=ident,
+            aggregation_parameter=b"",
+            ord=3,
+            state=BatchAggregationState.AGGREGATING,
+            aggregate_share=b"share-bytes",
+            report_count=7,
+            checksum=ReportIdChecksum(b"\x05" * 32),
+            client_timestamp_interval=Interval(Time(1_600_000_000), Duration(3600)),
+            aggregation_jobs_created=2,
+            aggregation_jobs_terminated=1,
+        )
+        ds.run_tx("putba", lambda tx: tx.put_batch_aggregation(ba))
+        got = ds.run_tx(
+            "getba",
+            lambda tx: tx.get_batch_aggregations_for_batch(task.task_id, ident, b""),
+        )
+        assert got == [ba]
+        scrubbed = ba.scrubbed()
+        ds.run_tx("updba", lambda tx: tx.update_batch_aggregation(scrubbed))
+        got2 = ds.run_tx(
+            "getba2",
+            lambda tx: tx.get_batch_aggregation(task.task_id, ident, b"", 3),
+        )
+        assert got2 == scrubbed
+        with pytest.raises(TxConflict):
+            ds.run_tx("putba2", lambda tx: tx.put_batch_aggregation(ba))
+
+
+class TestCollectionJobs:
+    def test_round_trip_and_leases(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        interval = Interval(Time(1_600_000_000), Duration(3600))
+        job = CollectionJob(
+            task_id=task.task_id,
+            collection_job_id=CollectionJobId.random(),
+            query=Query.new_time_interval(interval),
+            aggregation_parameter=b"",
+            batch_identifier=interval.get_encoded(),
+            state=CollectionJobState.START,
+        )
+        ds.run_tx("putcj", lambda tx: tx.put_collection_job(job))
+        got = ds.run_tx(
+            "getcj",
+            lambda tx: tx.get_collection_job(
+                task.task_id, job.collection_job_id, "TimeInterval"
+            ),
+        )
+        assert got == job
+
+        (lease,) = ds.run_tx(
+            "acq", lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 10)
+        )
+        assert lease.leased.collection_job_id == job.collection_job_id
+
+        finished = job.finished(
+            report_count=12,
+            client_timestamp_interval=interval,
+            leader_aggregate_share=b"leader-share",
+            helper_aggregate_share=HpkeCiphertext(1, b"ek", b"helper-share"),
+        )
+        ds.run_tx("updcj", lambda tx: tx.update_collection_job(finished))
+        ds.run_tx("rel", lambda tx: tx.release_collection_job(lease))
+        got2 = ds.run_tx(
+            "getcj2",
+            lambda tx: tx.get_collection_job(
+                task.task_id, job.collection_job_id, "TimeInterval"
+            ),
+        )
+        assert got2 == finished
+        # Finished jobs are not acquirable
+        assert (
+            ds.run_tx(
+                "acq2",
+                lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 10),
+            )
+            == []
+        )
+
+
+class TestAggregateShareJobs:
+    def test_round_trip(self, ds):
+        task = make_task(role=Role.HELPER)
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        ident = Interval(Time(1_600_000_000), Duration(3600)).get_encoded()
+        job = AggregateShareJob(
+            task_id=task.task_id,
+            batch_identifier=ident,
+            aggregation_parameter=b"",
+            helper_aggregate_share=b"helper-share-plain",
+            report_count=20,
+            checksum=ReportIdChecksum(b"\x07" * 32),
+        )
+        ds.run_tx("putasj", lambda tx: tx.put_aggregate_share_job(job))
+        got = ds.run_tx(
+            "getasj",
+            lambda tx: tx.get_aggregate_share_job(task.task_id, ident, b""),
+        )
+        assert got == job
+        assert (
+            ds.run_tx(
+                "cnt",
+                lambda tx: tx.count_aggregate_share_jobs_for_batch(task.task_id, ident),
+            )
+            == 1
+        )
+
+
+class TestOutstandingBatches:
+    def test_fill_cycle(self, ds):
+        task = make_task(query_type=TaskQueryType.fixed_size(max_batch_size=100))
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        batch_id = BatchId.random()
+        ds.run_tx(
+            "putob", lambda tx: tx.put_outstanding_batch(task.task_id, batch_id, None)
+        )
+        got = ds.run_tx(
+            "getob",
+            lambda tx: tx.get_unfilled_outstanding_batches(task.task_id, None),
+        )
+        assert len(got) == 1 and got[0].batch_id == batch_id
+        assert (got[0].size_min, got[0].size_max) == (0, 0)
+
+        # attach an aggregation job with 3 report aggregations (2 finished)
+        job = put_job(ds, task, batch_id=batch_id)
+        states = [
+            ReportAggregationState.FINISHED,
+            ReportAggregationState.FINISHED,
+            ReportAggregationState.START_LEADER,
+        ]
+        for i, st in enumerate(states):
+            ra = ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=ReportId.random(),
+                time=Time(1_600_000_000),
+                ord=i,
+                state=st,
+            )
+            ds.run_tx("putra", lambda tx, ra=ra: tx.put_report_aggregation(ra))
+        got = ds.run_tx(
+            "getob2",
+            lambda tx: tx.get_unfilled_outstanding_batches(task.task_id, None),
+        )
+        assert (got[0].size_min, got[0].size_max) == (2, 3)
+
+        assert (
+            ds.run_tx(
+                "acqob", lambda tx: tx.acquire_filled_outstanding_batch(task.task_id, 3)
+            )
+            is None
+        )
+        assert (
+            ds.run_tx(
+                "acqob2", lambda tx: tx.acquire_filled_outstanding_batch(task.task_id, 2)
+            )
+            == batch_id
+        )
+        assert (
+            ds.run_tx(
+                "getob3",
+                lambda tx: tx.get_unfilled_outstanding_batches(task.task_id, None),
+            )
+            == []
+        )
+
+
+class TestGlobalHpkeKeys:
+    def test_lifecycle(self, ds):
+        kp = HpkeKeypair.generate(7)
+        ds.run_tx("putk", lambda tx: tx.put_global_hpke_keypair(kp))
+        (got,) = ds.run_tx("getk", lambda tx: tx.get_global_hpke_keypairs())
+        assert got.config == kp.config
+        assert got.private_key == kp.private_key
+        assert got.state == HpkeKeyState.PENDING
+        ds.run_tx(
+            "setk", lambda tx: tx.set_global_hpke_keypair_state(7, HpkeKeyState.ACTIVE)
+        )
+        (got,) = ds.run_tx("getk2", lambda tx: tx.get_global_hpke_keypairs())
+        assert got.state == HpkeKeyState.ACTIVE
+        ds.run_tx("delk", lambda tx: tx.delete_global_hpke_keypair(7))
+        assert ds.run_tx("getk3", lambda tx: tx.get_global_hpke_keypairs()) == []
+
+
+class TestUploadCounters:
+    def test_sharded_increment(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        for ord_ in (0, 1, 0):
+            ds.run_tx(
+                "inc",
+                lambda tx, o=ord_: tx.increment_task_upload_counter(
+                    task.task_id, o, TaskUploadCounter(task.task_id, report_success=2)
+                ),
+            )
+        got = ds.run_tx("get", lambda tx: tx.get_task_upload_counter(task.task_id))
+        assert got.report_success == 6
+        assert got.report_decode_failure == 0
