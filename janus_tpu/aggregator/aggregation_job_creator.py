@@ -1,0 +1,207 @@
+"""Aggregation job creation (leader).
+
+The analog of ``AggregationJobCreator`` + ``BatchCreator`` (reference:
+aggregator/src/aggregator/aggregation_job_creator.rs:67-981,
+batch_creator.rs:32-517): periodically claims unaggregated reports, groups
+them into aggregation jobs of [min, max] size — per batch interval for
+TimeInterval tasks, via outstanding-batch filling for FixedSize tasks —
+moves each report's payload into its StartLeader report aggregation, and
+scrubs the client report.  Metadata-only: no VDAF compute happens here.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..core.time import time_to_batch_interval_start
+from ..datastore import (
+    AggregationJob,
+    AggregationJobState,
+    Datastore,
+    ReportAggregation,
+    ReportAggregationState,
+    Transaction,
+)
+from ..datastore.task import AggregatorTask
+from ..messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchId,
+    Duration,
+    Interval,
+    ReportMetadata,
+    Role,
+    Time,
+)
+from .aggregation_job_writer import AggregationJobWriter
+
+logger = logging.getLogger("janus_tpu.aggregation_job_creator")
+
+
+@dataclass
+class CreatorConfig:
+    """reference: aggregation_job_creator.rs config fields"""
+
+    min_aggregation_job_size: int = 10
+    max_aggregation_job_size: int = 256
+    reports_per_round: int = 5000
+    batch_aggregation_shard_count: int = 8
+
+
+class AggregationJobCreator:
+    def __init__(self, datastore: Datastore, config: Optional[CreatorConfig] = None):
+        self.datastore = datastore
+        self.config = config or CreatorConfig()
+
+    async def run_once(self) -> int:
+        """One creation pass over every leader task; returns jobs created."""
+        tasks = await self.datastore.run_tx_async(
+            "creator_tasks", lambda tx: tx.get_aggregator_tasks()
+        )
+        created = 0
+        for task in tasks:
+            if task.role != Role.LEADER:
+                continue
+            try:
+                created += await self.datastore.run_tx_async(
+                    "create_aggregation_jobs",
+                    lambda tx, task=task: self.create_jobs_for_task(tx, task),
+                )
+            except Exception:
+                logger.exception("job creation failed for task %s", task.task_id)
+        return created
+
+    # -- per-task creation (one transaction) ----------------------------
+    def create_jobs_for_task(self, tx: Transaction, task: AggregatorTask) -> int:
+        metas = tx.get_unaggregated_client_reports_for_task(
+            task.task_id, self.config.reports_per_round
+        )
+        if not metas:
+            return 0
+        if task.query_type.kind == "TimeInterval":
+            jobs, leftover = self._group_time_interval(task, metas)
+        else:
+            jobs, leftover = self._group_fixed_size(tx, task, metas)
+
+        # leftover reports go back to the unaggregated pool
+        # (reference: aggregation_job_creator.rs:607-717)
+        if leftover:
+            tx.mark_reports_unaggregated(task.task_id, [m.report_id for m in leftover])
+
+        vdaf = task.vdaf_instance()
+        writer = AggregationJobWriter(
+            task,
+            vdaf,
+            batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+            initial_write=True,
+        )
+        count = 0
+        for batch_id, group in jobs:
+            job_id = AggregationJobId.random()
+            start = min(m.time.seconds for m in group)
+            end = max(m.time.seconds for m in group) + 1
+            job = AggregationJob(
+                task_id=task.task_id,
+                aggregation_job_id=job_id,
+                aggregation_parameter=b"",
+                partial_batch_identifier=batch_id,
+                client_timestamp_interval=Interval(Time(start), Duration(end - start)),
+                state=AggregationJobState.IN_PROGRESS,
+                step=AggregationJobStep(0),
+            )
+            ras = []
+            for ord_, meta in enumerate(group):
+                # move payload from client_reports into the StartLeader row,
+                # then scrub (reference: :718-731)
+                report = tx.get_client_report(task.task_id, meta.report_id)
+                if report is None:
+                    continue
+                ras.append(
+                    ReportAggregation(
+                        task_id=task.task_id,
+                        aggregation_job_id=job_id,
+                        report_id=meta.report_id,
+                        time=meta.time,
+                        ord=ord_,
+                        state=ReportAggregationState.START_LEADER,
+                        public_share=report.public_share,
+                        leader_extensions=report.leader_extensions,
+                        leader_input_share=report.leader_input_share,
+                        helper_encrypted_input_share=report.helper_encrypted_input_share,
+                    )
+                )
+                tx.scrub_client_report(task.task_id, meta.report_id)
+            if not ras:
+                continue
+            writer.put(job, ras)
+            count += 1
+        writer.write(tx)
+        return count
+
+    def _group_time_interval(
+        self, task: AggregatorTask, metas: List[ReportMetadata]
+    ) -> Tuple[List[Tuple[Optional[BatchId], List[ReportMetadata]]], List[ReportMetadata]]:
+        """Group by batch interval, then chunk into [min, max]-sized jobs
+        (reference: aggregation_job_creator.rs:563-741)."""
+        by_interval: Dict[int, List[ReportMetadata]] = {}
+        for m in metas:
+            start = time_to_batch_interval_start(m.time, task.time_precision).seconds
+            by_interval.setdefault(start, []).append(m)
+        jobs: List[Tuple[Optional[BatchId], List[ReportMetadata]]] = []
+        leftover: List[ReportMetadata] = []
+        for group in by_interval.values():
+            for i in range(0, len(group), self.config.max_aggregation_job_size):
+                chunk = group[i : i + self.config.max_aggregation_job_size]
+                if len(chunk) >= self.config.min_aggregation_job_size:
+                    jobs.append((None, chunk))
+                else:
+                    leftover.extend(chunk)
+        return jobs, leftover
+
+    def _group_fixed_size(
+        self, tx: Transaction, task: AggregatorTask, metas: List[ReportMetadata]
+    ) -> Tuple[List[Tuple[Optional[BatchId], List[ReportMetadata]]], List[ReportMetadata]]:
+        """Incremental batch filling (reference: batch_creator.rs:32-517):
+        route reports into unfilled outstanding batches (most-full first),
+        creating batches as needed; mark batches filled when they reach the
+        fill target."""
+        fill_target = task.query_type.max_batch_size or task.min_batch_size
+        btws = task.query_type.batch_time_window_size
+
+        def bucket_of(m: ReportMetadata) -> Optional[int]:
+            if btws is None:
+                return None
+            return m.time.seconds - m.time.seconds % btws.seconds
+
+        by_bucket: Dict[Optional[int], List[ReportMetadata]] = {}
+        for m in metas:
+            by_bucket.setdefault(bucket_of(m), []).append(m)
+
+        jobs: List[Tuple[Optional[BatchId], List[ReportMetadata]]] = []
+        for bucket, group in by_bucket.items():
+            bucket_time = Time(bucket) if bucket is not None else None
+            batches = tx.get_unfilled_outstanding_batches(task.task_id, bucket_time)
+            # most-full first (reference: priority queue by remaining headroom)
+            batches.sort(key=lambda b: fill_target - b.size_max)
+            idx = 0
+            while group:
+                if idx < len(batches):
+                    batch = batches[idx]
+                    headroom = max(0, fill_target - batch.size_max)
+                    batch_id = batch.batch_id
+                    idx += 1
+                else:
+                    batch_id = BatchId.random()
+                    tx.put_outstanding_batch(task.task_id, batch_id, bucket_time)
+                    headroom = fill_target
+                if headroom == 0:
+                    tx.mark_outstanding_batch_filled(task.task_id, batch_id)
+                    continue
+                take, group = group[:headroom], group[headroom:]
+                if headroom - len(take) == 0:
+                    tx.mark_outstanding_batch_filled(task.task_id, batch_id)
+                for i in range(0, len(take), self.config.max_aggregation_job_size):
+                    jobs.append((batch_id, take[i : i + self.config.max_aggregation_job_size]))
+        return jobs, []

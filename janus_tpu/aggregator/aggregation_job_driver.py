@@ -1,0 +1,475 @@
+"""Aggregation job stepping (leader) — the north-star hot path.
+
+The analog of ``AggregationJobDriver`` (reference:
+aggregator/src/aggregator/aggregation_job_driver.rs:59-1046): steps leased
+aggregation jobs through init (leader prepare → PUT init request to helper)
+and continue (evaluate stored ping-pong transitions → POST continue
+request), merges the helper's responses, and commits everything through the
+AggregationJobWriter.  The per-report leader prepare loop the reference
+ships to rayon (:449) is ONE batched device launch via the backend seam.
+
+Abandonment: after ``maximum_attempts_before_failure`` lease attempts the
+job is abandoned with a best-effort DELETE to the helper (reference
+:977-1026); errors are classified retryable vs fatal (:1030-1045).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.retries import HttpRetryPolicy, retry_http_request
+from ..datastore import (
+    AggregationJob,
+    AggregationJobState,
+    Datastore,
+    Lease,
+    ReportAggregation,
+    ReportAggregationState,
+)
+from ..datastore.task import AggregatorTask
+from ..messages import (
+    AggregationJobContinueReq,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    AggregationJobStep,
+    Duration,
+    PartialBatchSelector,
+    PrepareContinue,
+    PrepareError,
+    PrepareInit,
+    PrepareResp,
+    PrepareStepResult,
+    ReportShare,
+    ReportMetadata,
+)
+from ..vdaf import pingpong as pp
+from ..vdaf.backend import make_backend
+from ..vdaf.prio3 import Prio3, VdafError
+from .aggregation_job_writer import AggregationJobWriter
+
+logger = logging.getLogger("janus_tpu.aggregation_job_driver")
+
+
+class JobStepError(Exception):
+    def __init__(self, detail: str, retryable: bool):
+        super().__init__(detail)
+        self.retryable = retryable
+
+
+@dataclass
+class DriverConfig:
+    batch_aggregation_shard_count: int = 8
+    maximum_attempts_before_failure: int = 10
+    vdaf_backend: str = "oracle"
+    http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
+
+
+class AggregationJobDriver:
+    def __init__(
+        self,
+        datastore: Datastore,
+        session_factory,
+        config: Optional[DriverConfig] = None,
+    ):
+        self.datastore = datastore
+        self._session_factory = session_factory
+        self._session = None
+        self.config = config or DriverConfig()
+        self._backends: Dict[bytes, object] = {}
+
+    def _get_session(self):
+        """One shared connection-pooled session per driver (the analog of the
+        reference's shared reqwest client)."""
+        if self._session is None or self._session.closed:
+            self._session = self._session_factory()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # ------------------------------------------------------------------
+    async def step_aggregation_job(self, lease: Lease) -> None:
+        """Stepper callback for the JobDriver
+        (reference: aggregation_job_driver.rs:126 step_aggregation_job)."""
+        if lease.lease_attempts > self.config.maximum_attempts_before_failure:
+            await self.abandon_aggregation_job(lease)
+            return
+        try:
+            await self._step(lease)
+        except JobStepError as e:
+            if e.retryable:
+                logger.warning("retryable step failure: %s", e)
+                await self.datastore.run_tx_async(
+                    "release_agg_job", lambda tx: tx.release_aggregation_job(lease)
+                )
+            else:
+                logger.error("fatal step failure: %s", e)
+                await self.abandon_aggregation_job(lease)
+
+    async def _step(self, lease: Lease) -> None:
+        acq = lease.leased
+        # tx1: load task, job, report aggregations (reference :169-220)
+        def load(tx):
+            task = tx.get_aggregator_task(acq.task_id)
+            job = tx.get_aggregation_job(acq.task_id, acq.aggregation_job_id)
+            ras = tx.get_report_aggregations_for_aggregation_job(
+                acq.task_id, acq.aggregation_job_id
+            )
+            return task, job, ras
+
+        task, job, ras = await self.datastore.run_tx_async("step_agg_job_1", load)
+        if task is None or job is None:
+            raise JobStepError("job or task vanished", retryable=False)
+        if job.state != AggregationJobState.IN_PROGRESS:
+            await self.datastore.run_tx_async(
+                "release_done", lambda tx: tx.release_aggregation_job(lease)
+            )
+            return
+        vdaf = task.vdaf_instance()
+
+        start_ras = [ra for ra in ras if ra.state == ReportAggregationState.START_LEADER]
+        waiting_ras = [
+            ra for ra in ras if ra.state == ReportAggregationState.WAITING_LEADER
+        ]
+        if start_ras:
+            await self._step_init(lease, task, vdaf, job, ras, start_ras)
+        elif waiting_ras:
+            await self._step_continue(lease, task, vdaf, job, ras, waiting_ras)
+        else:
+            # nothing to do; close the job out
+            job = job.with_state(AggregationJobState.FINISHED)
+            writer = AggregationJobWriter(
+                task,
+                vdaf,
+                batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+                initial_write=False,
+            )
+            writer.put(job, [], {})
+
+            def tx_fn(tx):
+                writer.write(tx)
+                tx.release_aggregation_job(lease)
+
+            await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
+
+    # ------------------------------------------------------------------
+    def _backend_for(self, task: AggregatorTask, vdaf):
+        key = task.task_id.data
+        b = self._backends.get(key)
+        if b is None and isinstance(vdaf, Prio3):
+            try:
+                b = make_backend(vdaf, self.config.vdaf_backend)
+            except VdafError:
+                b = make_backend(vdaf, "oracle")
+            self._backends[key] = b
+        return b
+
+    def _leader_prep_init(self, task, vdaf, job, start_ras):
+        """Batched leader prepare (device launch for Prio3;
+        reference mirror: aggregation_job_driver.rs:397-428 on rayon)."""
+        agg_param = vdaf.decode_agg_param(job.aggregation_parameter)
+        outcomes: Dict[bytes, object] = {}  # report_id -> (state, msg) | PrepareError
+        rows = []
+        for ra in start_ras:
+            try:
+                public_parts = vdaf.decode_public_share(ra.public_share or b"")
+                input_share = vdaf.decode_input_share(0, ra.leader_input_share)
+            except (VdafError, Exception):
+                outcomes[ra.report_id.data] = PrepareError.INVALID_MESSAGE
+                continue
+            rows.append((ra, public_parts, input_share))
+
+        backend = self._backend_for(task, vdaf)
+        if backend is not None:
+            prep_in = [
+                (ra.report_id.data, public, share) for ra, public, share in rows
+            ]
+            prep_out = backend.prep_init_batch(task.vdaf_verify_key, 0, prep_in)
+            for (ra, _pub, _sh), outcome in zip(rows, prep_out):
+                if isinstance(outcome, VdafError):
+                    outcomes[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
+                    continue
+                state, share = outcome
+                msg = pp.PingPongMessage(
+                    pp.PingPongMessage.INITIALIZE,
+                    prep_share=vdaf.ping_pong_encode_prep_share(share),
+                )
+                outcomes[ra.report_id.data] = (pp.PingPongContinued(state, 0), msg)
+        else:
+            for ra, public, share in rows:
+                try:
+                    state, msg = pp.leader_initialized(
+                        vdaf,
+                        task.vdaf_verify_key,
+                        agg_param,
+                        ra.report_id.data,
+                        public,
+                        share,
+                    )
+                    outcomes[ra.report_id.data] = (state, msg)
+                except (VdafError, pp.PingPongError):
+                    outcomes[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
+        return outcomes
+
+    async def _step_init(self, lease, task, vdaf, job, all_ras, start_ras):
+        loop = asyncio.get_running_loop()
+        outcomes = await loop.run_in_executor(
+            None, lambda: self._leader_prep_init(task, vdaf, job, start_ras)
+        )
+        prepare_inits = []
+        states: Dict[bytes, pp.PingPongContinued] = {}
+        failed: Dict[bytes, PrepareError] = {}
+        for ra in start_ras:
+            outcome = outcomes[ra.report_id.data]
+            if isinstance(outcome, PrepareError):
+                failed[ra.report_id.data] = outcome
+                continue
+            state, msg = outcome
+            states[ra.report_id.data] = state
+            prepare_inits.append(
+                PrepareInit(
+                    ReportShare(
+                        ReportMetadata(ra.report_id, ra.time),
+                        ra.public_share or b"",
+                        ra.helper_encrypted_input_share,
+                    ),
+                    msg,
+                )
+            )
+
+        if task.query_type.kind == "FixedSize":
+            pbs = PartialBatchSelector.new_fixed_size(job.partial_batch_identifier)
+        else:
+            pbs = PartialBatchSelector.new_time_interval()
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=job.aggregation_parameter,
+            partial_batch_selector=pbs,
+            prepare_inits=prepare_inits,
+        )
+        resp = await self._send_to_helper(
+            task,
+            "PUT",
+            f"aggregation_jobs/{job.aggregation_job_id}",
+            req.get_encoded(),
+            AggregationJobInitializeReq.MEDIA_TYPE,
+        )
+        await self._process_helper_resp(
+            lease, task, vdaf, job, all_ras, states, failed, resp
+        )
+
+    async def _step_continue(self, lease, task, vdaf, job, all_ras, waiting_ras):
+        """Evaluate stored transitions, send continue, process responses
+        (reference: :527-626)."""
+        states: Dict[bytes, pp.PingPongContinued] = {}
+        failed: Dict[bytes, PrepareError] = {}
+        finished_now: Dict[bytes, Sequence[int]] = {}
+        conts = []
+        for ra in waiting_ras:
+            try:
+                trans = pp.PingPongTransition.decode(vdaf, ra.leader_prep_transition)
+                state, msg = trans.evaluate(vdaf)
+            except (VdafError, pp.PingPongError):
+                failed[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
+                continue
+            conts.append(PrepareContinue(ra.report_id, msg))
+            if isinstance(state, pp.PingPongFinished):
+                finished_now[ra.report_id.data] = state.out_share
+            else:
+                states[ra.report_id.data] = state
+
+        # The wire step is the leader's CURRENT step: after init the leader
+        # job is at step 1 while the helper is at 0, and the helper requires
+        # req.step == helper_step + 1 — i.e. exactly the leader's step.
+        wire_step = AggregationJobStep(int(job.step))
+        req = AggregationJobContinueReq(wire_step, conts)
+        resp = await self._send_to_helper(
+            task,
+            "POST",
+            f"aggregation_jobs/{job.aggregation_job_id}",
+            req.get_encoded(),
+            AggregationJobContinueReq.MEDIA_TYPE,
+        )
+        await self._process_helper_resp(
+            lease,
+            task,
+            vdaf,
+            job,
+            all_ras,
+            states,
+            failed,
+            resp,
+            finished_now=finished_now,
+            next_step=AggregationJobStep(int(wire_step) + 1),
+        )
+
+    # ------------------------------------------------------------------
+    async def _process_helper_resp(
+        self,
+        lease,
+        task,
+        vdaf,
+        job,
+        all_ras,
+        states: Dict[bytes, pp.PingPongContinued],
+        failed: Dict[bytes, PrepareError],
+        resp: AggregationJobResp,
+        *,
+        finished_now: Optional[Dict[bytes, Sequence[int]]] = None,
+        next_step: Optional[AggregationJobStep] = None,
+    ) -> None:
+        """Merge helper PrepareResps into report aggregations
+        (reference: :629-793 process_response_from_helper)."""
+        finished_now = finished_now or {}
+        by_id = {pr.report_id.data: pr for pr in resp.prepare_resps}
+        new_ras: List[ReportAggregation] = []
+        out_shares: Dict[bytes, Sequence[int]] = {}
+        for ra in all_ras:
+            rid = ra.report_id.data
+            if ra.state in (
+                ReportAggregationState.FINISHED,
+                ReportAggregationState.FAILED,
+            ):
+                continue  # already terminal; no update needed
+            if rid in failed:
+                new_ras.append(ra.failed(failed[rid]))
+                continue
+            pr = by_id.get(rid)
+            if pr is None:
+                new_ras.append(ra.failed(PrepareError.REPORT_DROPPED))
+                continue
+            if pr.result.variant == PrepareStepResult.REJECT:
+                new_ras.append(ra.failed(pr.result.error))
+                continue
+            if rid in finished_now:
+                if pr.result.variant != PrepareStepResult.FINISHED:
+                    new_ras.append(ra.failed(PrepareError.VDAF_PREP_ERROR))
+                    continue
+                new_ras.append(ra.with_state(ReportAggregationState.FINISHED))
+                out_shares[rid] = finished_now[rid]
+                continue
+            if pr.result.variant != PrepareStepResult.CONTINUE:
+                new_ras.append(ra.failed(PrepareError.VDAF_PREP_ERROR))
+                continue
+            state = states.get(rid)
+            if state is None:
+                new_ras.append(ra.failed(PrepareError.VDAF_PREP_ERROR))
+                continue
+            try:
+                value = pp.continued(
+                    vdaf, True, state, pr.result.message,
+                    vdaf.decode_agg_param(job.aggregation_parameter),
+                )
+            except (VdafError, pp.PingPongError):
+                new_ras.append(ra.failed(PrepareError.VDAF_PREP_ERROR))
+                continue
+            if value.out_share is not None:
+                new_ras.append(ra.with_state(ReportAggregationState.FINISHED))
+                out_shares[rid] = value.out_share
+            else:
+                new_ras.append(
+                    ra.with_state(
+                        ReportAggregationState.WAITING_LEADER,
+                        leader_prep_transition=value.transition.encode(vdaf),
+                    )
+                )
+
+        any_waiting = any(
+            ra.state == ReportAggregationState.WAITING_LEADER for ra in new_ras
+        )
+        job = job.with_step(
+            next_step if next_step is not None else AggregationJobStep(int(job.step) + 1)
+        )
+        job = job.with_state(
+            AggregationJobState.IN_PROGRESS
+            if any_waiting
+            else AggregationJobState.FINISHED
+        )
+
+        writer = AggregationJobWriter(
+            task,
+            vdaf,
+            batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+            initial_write=False,
+        )
+        writer.put(job, new_ras, out_shares)
+
+        def tx_fn(tx):
+            writer.write(tx)
+            tx.release_aggregation_job(lease)
+
+        await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
+
+    # ------------------------------------------------------------------
+    async def abandon_aggregation_job(self, lease: Lease) -> None:
+        """reference: :977-1026 (abandon + best-effort helper DELETE)"""
+        acq = lease.leased
+
+        def tx_fn(tx):
+            task = tx.get_aggregator_task(acq.task_id)
+            job = tx.get_aggregation_job(acq.task_id, acq.aggregation_job_id)
+            if job is not None and job.state == AggregationJobState.IN_PROGRESS:
+                tx.update_aggregation_job(job.with_state(AggregationJobState.ABANDONED))
+            tx.release_aggregation_job(lease)
+            return task
+
+        task = await self.datastore.run_tx_async("abandon_agg_job", tx_fn)
+        if task is not None:
+            try:
+                await self._send_to_helper(
+                    task,
+                    "DELETE",
+                    f"aggregation_jobs/{acq.aggregation_job_id}",
+                    None,
+                    None,
+                    expect_body=False,
+                )
+            except Exception:
+                logger.warning("best-effort helper DELETE failed", exc_info=True)
+
+    # ------------------------------------------------------------------
+    async def _send_to_helper(
+        self,
+        task: AggregatorTask,
+        method: str,
+        resource: str,
+        body: Optional[bytes],
+        media_type: Optional[str],
+        expect_body: bool = True,
+    ) -> Optional[AggregationJobResp]:
+        """HTTPS to the peer aggregator with retry/backoff
+        (reference: aggregator.rs:3200 send_request_to_helper)."""
+        url = (
+            task.peer_aggregator_endpoint.rstrip("/")
+            + f"/tasks/{task.task_id}/{resource}"
+        )
+        headers = {}
+        if media_type:
+            headers["Content-Type"] = media_type
+        if task.aggregator_auth_token is not None:
+            name, value = task.aggregator_auth_token.request_authentication()
+            headers[name] = value
+        try:
+            status, resp_body, _ = await retry_http_request(
+                self._get_session(),
+                method,
+                url,
+                data=body,
+                headers=headers,
+                policy=self.config.http_retry,
+            )
+        except Exception as e:
+            raise JobStepError(f"helper request failed: {e}", retryable=True)
+        if status >= 400:
+            # 4xx = fatal (bad request will not heal); 5xx = retryable
+            # (reference: aggregation_job_driver.rs:1030 error classification)
+            raise JobStepError(
+                f"helper returned {status}: {resp_body[:200]!r}",
+                retryable=status >= 500,
+            )
+        if not expect_body:
+            return None
+        return AggregationJobResp.get_decoded(resp_body)

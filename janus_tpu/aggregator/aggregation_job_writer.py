@@ -1,0 +1,232 @@
+"""Transactional write-combiner for aggregation jobs.
+
+The analog of ``AggregationJobWriter`` (reference:
+aggregator/src/aggregator/aggregation_job_writer.rs:35-861): writes an
+aggregation job plus its report aggregations in one transaction, accumulating
+every Finished report's output share into a randomly-chosen shard of the
+batch's ``batch_aggregations`` accumulator — the write-contention sharding the
+TPU path later merges with ``lax.psum`` (SURVEY.md §2.3 P4).  Reports whose
+batch has already been collected are failed with BatchCollected.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.report_id import checksum_combined, checksum_updated_with
+from ..core.time import interval_merge, time_to_batch_interval
+from ..datastore import (
+    AggregationJob,
+    AggregationJobState,
+    BatchAggregation,
+    BatchAggregationState,
+    ReportAggregation,
+    ReportAggregationState,
+    Transaction,
+    TxConflict,
+)
+from ..datastore.query_type import strategy_for
+from ..datastore.task import AggregatorTask
+from ..messages import Interval, PrepareError, ReportIdChecksum
+
+
+class AggregationJobWriter:
+    """Collects job + report-aggregation writes, then commits them with
+    batch-aggregation accumulation inside the caller's transaction.
+
+    ``initial_write=True`` is the creation path (jobs counted into
+    aggregation_jobs_created); False is the update path (terminal jobs
+    counted into aggregation_jobs_terminated), mirroring the reference's
+    InitialWrite/UpdateWrite strategies.
+
+    ``out_shares`` maps a finished report's id bytes to its VDAF output-share
+    vector; shares are accumulated here and never persisted per report
+    (the reference does the same: out shares exist only inside this write).
+    """
+
+    def __init__(
+        self,
+        task: AggregatorTask,
+        vdaf,
+        batch_aggregation_shard_count: int = 8,
+        initial_write: bool = True,
+    ):
+        self.task = task
+        self.vdaf = vdaf
+        self.shard_count = batch_aggregation_shard_count
+        self.initial_write = initial_write
+        self._jobs: List[
+            Tuple[AggregationJob, List[ReportAggregation], Dict[bytes, Sequence[int]]]
+        ] = []
+
+    def put(
+        self,
+        job: AggregationJob,
+        report_aggregations: List[ReportAggregation],
+        out_shares: Optional[Dict[bytes, Sequence[int]]] = None,
+    ):
+        self._jobs.append((job, report_aggregations, out_shares or {}))
+
+    # ------------------------------------------------------------------
+    def write(self, tx: Transaction) -> Dict[bytes, PrepareError]:
+        """Write everything; returns {report_id.data: error} for reports that
+        were failed during the write (batch already collected)."""
+        strategy = strategy_for(self.task)
+        failures: Dict[bytes, PrepareError] = {}
+        collected: Dict[bytes, bool] = {}
+
+        def ident_for(job: AggregationJob, ra: ReportAggregation) -> bytes:
+            if job.partial_batch_identifier is not None:
+                return job.partial_batch_identifier.get_encoded()
+            return strategy.to_batch_identifier(self.task, ra.time)
+
+        def is_collected(ident: bytes, param: bytes) -> bool:
+            if ident not in collected:
+                bas = tx.get_batch_aggregations_for_batch(
+                    self.task.task_id, ident, param
+                )
+                collected[ident] = any(
+                    ba.state != BatchAggregationState.AGGREGATING for ba in bas
+                )
+            return collected[ident]
+
+        for job, ras, out_shares in self._jobs:
+            # Fail reports aimed at collected batches
+            # (reference: aggregation_job_writer.rs:591-698).
+            checked: List[ReportAggregation] = []
+            for ra in ras:
+                if ra.state != ReportAggregationState.FAILED and is_collected(
+                    ident_for(job, ra), job.aggregation_parameter
+                ):
+                    ra = ra.failed(PrepareError.BATCH_COLLECTED)
+                    failures[ra.report_id.data] = PrepareError.BATCH_COLLECTED
+                    out_shares.pop(ra.report_id.data, None)
+                checked.append(ra)
+            ras = checked
+
+            if self.initial_write:
+                tx.put_aggregation_job(job)
+                for ra in ras:
+                    tx.put_report_aggregation(ra)
+            else:
+                tx.update_aggregation_job(job)
+                for ra in ras:
+                    tx.update_report_aggregation(ra)
+
+            self._accumulate(tx, job, ras, out_shares, ident_for)
+        return failures
+
+    # ------------------------------------------------------------------
+    def _accumulate(self, tx, job, ras, out_shares, ident_for) -> None:
+        """Merge finished out-shares into per-batch shard accumulators and
+        update the created/terminated job counters the collection readiness
+        gate relies on (reference: collection_job_driver.rs:124-262)."""
+        by_batch: Dict[bytes, List[ReportAggregation]] = {}
+        for ra in ras:
+            if (
+                ra.state == ReportAggregationState.FINISHED
+                and ra.report_id.data in out_shares
+            ):
+                by_batch.setdefault(ident_for(job, ra), []).append(ra)
+
+        # Job-level counters land on every batch the job touched; for a job
+        # with no finished reports, on the batch of its interval start.
+        job_batches = set(by_batch)
+        if job.partial_batch_identifier is not None:
+            job_batches.add(job.partial_batch_identifier.get_encoded())
+        elif not job_batches:
+            job_batches.add(
+                time_to_batch_interval(
+                    job.client_timestamp_interval.start, self.task.time_precision
+                ).get_encoded()
+            )
+
+        field = self.vdaf.field
+        terminal = job.state in (
+            AggregationJobState.FINISHED,
+            AggregationJobState.ABANDONED,
+        )
+        for ident in job_batches:
+            finished = by_batch.get(ident, [])
+            shard = random.randrange(self.shard_count)
+            agg_share: Optional[List[int]] = None
+            count = 0
+            checksum = ReportIdChecksum.zero()
+            interval = Interval.EMPTY
+            for ra in finished:
+                share = out_shares[ra.report_id.data]
+                agg_share = (
+                    list(share)
+                    if agg_share is None
+                    else field.vec_add(agg_share, share)
+                )
+                count += 1
+                checksum = checksum_updated_with(checksum, ra.report_id)
+                interval = interval_merge(
+                    interval,
+                    time_to_batch_interval(ra.time, self.task.time_precision),
+                )
+            delta = BatchAggregation(
+                task_id=self.task.task_id,
+                batch_identifier=ident,
+                aggregation_parameter=job.aggregation_parameter,
+                ord=shard,
+                state=BatchAggregationState.AGGREGATING,
+                aggregate_share=field.encode_vec(agg_share)
+                if agg_share is not None
+                else None,
+                report_count=count,
+                checksum=checksum,
+                client_timestamp_interval=interval,
+                aggregation_jobs_created=1 if self.initial_write else 0,
+                aggregation_jobs_terminated=1
+                if (not self.initial_write and terminal)
+                else 0,
+            )
+            existing = tx.get_batch_aggregation(
+                self.task.task_id, ident, job.aggregation_parameter, shard
+            )
+            if existing is not None:
+                tx.update_batch_aggregation(merge_batch_aggregations(field, existing, delta))
+            else:
+                try:
+                    tx.put_batch_aggregation(delta)
+                except TxConflict:
+                    fresh = tx.get_batch_aggregation(
+                        self.task.task_id, ident, job.aggregation_parameter, shard
+                    )
+                    tx.update_batch_aggregation(
+                        merge_batch_aggregations(field, fresh, delta)
+                    )
+
+
+def merge_batch_aggregations(
+    field, base: BatchAggregation, add: BatchAggregation
+) -> BatchAggregation:
+    """Pointwise merge of two shard accumulators (same batch/param/ord)."""
+    share_a = field.decode_vec(base.aggregate_share) if base.aggregate_share else None
+    share_b = field.decode_vec(add.aggregate_share) if add.aggregate_share else None
+    if share_a is None:
+        merged = share_b
+    elif share_b is None:
+        merged = share_a
+    else:
+        merged = field.vec_add(share_a, share_b)
+    return BatchAggregation(
+        task_id=base.task_id,
+        batch_identifier=base.batch_identifier,
+        aggregation_parameter=base.aggregation_parameter,
+        ord=base.ord,
+        state=base.state,
+        aggregate_share=field.encode_vec(merged) if merged is not None else None,
+        report_count=base.report_count + add.report_count,
+        checksum=checksum_combined(base.checksum, add.checksum),
+        client_timestamp_interval=interval_merge(
+            base.client_timestamp_interval, add.client_timestamp_interval
+        ),
+        aggregation_jobs_created=base.aggregation_jobs_created
+        + add.aggregation_jobs_created,
+        aggregation_jobs_terminated=base.aggregation_jobs_terminated
+        + add.aggregation_jobs_terminated,
+    )

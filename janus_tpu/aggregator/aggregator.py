@@ -1,0 +1,983 @@
+"""Per-process aggregator façade and role logic.
+
+The analog of ``Aggregator<C>`` / ``TaskAggregator`` / ``VdafOps``
+(reference: aggregator/src/aggregator.rs:133,868,1168): a task cache resolves
+each task's VDAF instance and execution backend once; handlers implement the
+DAP endpoints.  The helper's aggregate-init pipeline replaces the reference's
+per-report rayon loop (aggregator.rs:2101) with ONE batched device launch via
+the backend seam (janus_tpu.vdaf.backend) — the north-star hot path.
+
+Handlers are async: datastore transactions run on a worker thread
+(run_tx_async) and the batched VDAF launch runs in an executor, so the event
+loop is never blocked (the analog of L0's tokio/rayon split, SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.auth_tokens import AuthenticationToken
+from ..core.hpke import HpkeApplicationInfo, HpkeError, HpkeKeypair, Label, open_, seal
+from ..core.time import Clock, interval_merge, time_add, time_to_batch_interval
+from ..datastore import (
+    AggregateShareJob,
+    AggregationJob,
+    AggregationJobState,
+    AggregatorTask,
+    BatchAggregationState,
+    CollectionJob,
+    CollectionJobState,
+    Datastore,
+    LeaderStoredReport,
+    ReportAggregation,
+    ReportAggregationState,
+    TaskNotFound,
+    TxConflict,
+)
+from ..datastore.datastore import QUERY_TYPES
+from ..datastore.query_type import strategy_for
+from ..messages import (
+    AggregateShare,
+    AggregateShareAad,
+    AggregateShareReq,
+    AggregationJobContinueReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    AggregationJobStep,
+    BatchId,
+    BatchSelector,
+    Collection,
+    CollectionJobId,
+    CollectionReq,
+    Duration,
+    FixedSizeQuery,
+    HpkeConfigList,
+    InputShareAad,
+    Interval,
+    PartialBatchSelector,
+    PlaintextInputShare,
+    PrepareError,
+    PrepareResp,
+    PrepareStepResult,
+    Query,
+    Report,
+    ReportId,
+    Role,
+    TaskId,
+    Time,
+)
+from ..vdaf import pingpong as pp
+from ..vdaf.backend import make_backend
+from ..vdaf.prio3 import Prio3, VdafError
+from .aggregation_job_writer import AggregationJobWriter
+from .aggregate_share import compute_aggregate_share
+from .error import (
+    AggregatorError,
+    BatchInvalid,
+    BatchMismatch,
+    BatchOverlap,
+    BatchQueriedTooManyTimes,
+    DeletedCollectionJob,
+    ForbiddenMutation,
+    InvalidBatchSize,
+    InvalidMessage,
+    ReportRejection,
+    StepMismatch,
+    UnauthorizedRequest,
+    UnrecognizedAggregationJob,
+    UnrecognizedCollectionJob,
+    UnrecognizedTask,
+)
+from .report_writer import ReportWriteBatcher
+
+
+@dataclass
+class Config:
+    """reference: aggregator/src/aggregator.rs:180 Config"""
+
+    max_upload_batch_size: int = 100
+    max_upload_batch_write_delay: float = 0.25
+    batch_aggregation_shard_count: int = 8
+    task_counter_shard_count: int = 8
+    task_cache_ttl: float = 30.0
+    #: VDAF execution backend: "tpu" (batched device launch) or "oracle".
+    vdaf_backend: str = "oracle"
+    collection_job_retry_after: int = 10
+
+
+class TaskAggregator:
+    """A task with its VDAF instance + backend resolved once
+    (reference: aggregator.rs:868-1137)."""
+
+    def __init__(self, task: AggregatorTask, backend_name: str):
+        self.task = task
+        self.vdaf = task.vdaf_instance()
+        self.backend_name = backend_name
+        self._backend = None
+
+    @property
+    def backend(self):
+        if self._backend is None:
+            try:
+                self._backend = make_backend(self.vdaf, self.backend_name)
+            except VdafError:
+                # e.g. HMAC-XOF instances have no device path yet
+                self._backend = make_backend(self.vdaf, "oracle")
+        return self._backend
+
+    @property
+    def query_class(self):
+        return QUERY_TYPES[self.task.query_type.kind]
+
+    def check_aggregator_auth(self, token: Optional[AuthenticationToken]) -> None:
+        h = self.task.aggregator_auth_token_hash
+        if h is None or token is None or not h.validate(token):
+            raise UnauthorizedRequest("invalid aggregator auth token")
+
+    def check_collector_auth(self, token: Optional[AuthenticationToken]) -> None:
+        h = self.task.collector_auth_token_hash
+        if h is None or token is None or not h.validate(token):
+            raise UnauthorizedRequest("invalid collector auth token")
+
+    def hpke_config_list(self) -> HpkeConfigList:
+        return HpkeConfigList([self.task.current_hpke_keypair().config])
+
+
+class Aggregator:
+    """reference: aggregator/src/aggregator.rs:133"""
+
+    def __init__(self, datastore: Datastore, clock: Clock, config: Config = None):
+        self.datastore = datastore
+        self.clock = clock
+        self.config = config or Config()
+        self._task_cache: Dict[bytes, Tuple[float, TaskAggregator]] = {}
+        self.report_writer = ReportWriteBatcher(
+            datastore,
+            max_batch_size=self.config.max_upload_batch_size,
+            max_batch_write_delay=self.config.max_upload_batch_write_delay,
+            counter_shard_count=self.config.task_counter_shard_count,
+        )
+
+    # ------------------------------------------------------------------
+    # task cache (reference: aggregator.rs:675 task_aggregator_for)
+
+    async def task_aggregator_for(self, task_id: TaskId) -> TaskAggregator:
+        import time as _t
+
+        key = task_id.data
+        hit = self._task_cache.get(key)
+        if hit is not None and hit[0] > _t.monotonic():
+            return hit[1]
+        task = await self.datastore.run_tx_async(
+            "get_task", lambda tx: tx.get_aggregator_task(task_id)
+        )
+        if task is None:
+            raise UnrecognizedTask(str(task_id))
+        ta = TaskAggregator(task, self.config.vdaf_backend)
+        self._task_cache[key] = (_t.monotonic() + self.config.task_cache_ttl, ta)
+        return ta
+
+    # ------------------------------------------------------------------
+    # GET hpke_config (reference: http_handlers.rs "hpke_config" route)
+
+    async def handle_hpke_config(self, task_id: Optional[TaskId]) -> HpkeConfigList:
+        if task_id is not None:
+            ta = await self.task_aggregator_for(task_id)
+            return ta.hpke_config_list()
+        # global keys
+        keypairs = await self.datastore.run_tx_async(
+            "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs()
+        )
+        active = [kp.config for kp in keypairs if kp.state.value == "Active"]
+        if not active:
+            raise UnrecognizedTask("no HPKE configuration available")
+        return HpkeConfigList(active)
+
+    # ------------------------------------------------------------------
+    # upload (reference: aggregator.rs:1522 handle_upload_generic)
+
+    async def handle_upload(self, task_id: TaskId, report: Report) -> None:
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        if task.role != Role.LEADER:
+            raise UnrecognizedTask("upload to non-leader")
+        try:
+            stored = self._validate_and_open_report(ta, report)
+        except ReportRejection as rej:
+            await self.report_writer.write_rejection(task_id, rej)
+            raise rej.to_error()
+        await self.report_writer.write_report(stored)
+
+    def _validate_and_open_report(self, ta: TaskAggregator, report: Report) -> LeaderStoredReport:
+        task = ta.task
+        now = self.clock.now()
+        t = report.metadata.time
+        # clock skew / expiry / GC eligibility (reference: aggregator.rs:1552-1581)
+        if t.seconds > time_add(now, task.tolerable_clock_skew).seconds:
+            raise ReportRejection(ReportRejection.TOO_EARLY, "report too far in future")
+        if task.task_expiration is not None and t.seconds > task.task_expiration.seconds:
+            raise ReportRejection(ReportRejection.TASK_EXPIRED, "task expired")
+        if (
+            task.report_expiry_age is not None
+            and t.seconds < now.seconds - task.report_expiry_age.seconds
+        ):
+            raise ReportRejection(ReportRejection.EXPIRED, "report expired")
+
+        # decode public share (reference: aggregator.rs:1587)
+        try:
+            ta.vdaf.decode_public_share(report.public_share)
+        except Exception:
+            raise ReportRejection(ReportRejection.DECODE_FAILURE, "bad public share")
+
+        # HPKE-open the leader input share (task keys; reference :1587-1662)
+        keypair = task.hpke_keypair_for(report.leader_encrypted_input_share.config_id)
+        if keypair is None:
+            raise ReportRejection(
+                ReportRejection.OUTDATED_KEY,
+                f"unknown HPKE config id {report.leader_encrypted_input_share.config_id}",
+            )
+        aad = InputShareAad(
+            task.task_id, report.metadata, report.public_share
+        ).get_encoded()
+        info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+        try:
+            plaintext = open_(keypair, info, report.leader_encrypted_input_share, aad)
+        except HpkeError:
+            raise ReportRejection(ReportRejection.DECRYPT_FAILURE, "decrypt failed")
+        try:
+            plain = PlaintextInputShare.get_decoded(plaintext)
+            _check_extensions(plain.extensions)
+            ta.vdaf.decode_input_share(0, plain.payload)
+        except Exception as e:
+            raise ReportRejection(ReportRejection.DECODE_FAILURE, f"bad input share: {e}")
+
+        return LeaderStoredReport(
+            task_id=task.task_id,
+            metadata=report.metadata,
+            public_share=report.public_share,
+            leader_extensions=list(plain.extensions),
+            leader_input_share=plain.payload,
+            helper_encrypted_input_share=report.helper_encrypted_input_share,
+        )
+
+    # ------------------------------------------------------------------
+    # helper aggregate init (reference: aggregator.rs:1720 handle_aggregate_init_generic)
+
+    async def handle_aggregate_init(
+        self,
+        task_id: TaskId,
+        aggregation_job_id: AggregationJobId,
+        body: bytes,
+        auth_token: Optional[AuthenticationToken],
+    ) -> AggregationJobResp:
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        if task.role != Role.HELPER:
+            raise UnrecognizedTask("aggregate-init on non-helper")
+        ta.check_aggregator_auth(auth_token)
+        req = AggregationJobInitializeReq.get_decoded(body, ta.query_class)
+        request_hash = hashlib.sha256(body).digest()
+
+        # replay/idempotency check (reference: aggregator.rs:1748,2173-2209)
+        existing = await self.datastore.run_tx_async(
+            "agg_init_replay",
+            lambda tx: tx.get_aggregation_job(task_id, aggregation_job_id),
+        )
+        if existing is not None:
+            if existing.last_request_hash == request_hash:
+                return await self._stored_job_resp(task_id, aggregation_job_id)
+            raise ForbiddenMutation("aggregation job replayed with different request")
+
+        # duplicate report IDs in one request are rejected outright
+        # (reference: aggregator.rs:1765)
+        seen = set()
+        for pi in req.prepare_inits:
+            rid = pi.report_share.metadata.report_id.data
+            if rid in seen:
+                raise InvalidMessage("duplicate report id in request")
+            seen.add(rid)
+
+        # Per-report validation + HPKE open (host side, async-friendly).
+        failed: Dict[int, PrepareError] = {}
+        replay_ids = await self.datastore.run_tx_async(
+            "agg_init_conflicts",
+            lambda tx: [
+                pi.report_share.metadata.report_id.data
+                for pi in req.prepare_inits
+                if tx.check_report_aggregation_exists(
+                    task_id,
+                    pi.report_share.metadata.report_id,
+                    exclude_aggregation_job_id=aggregation_job_id,
+                )
+            ],
+        )
+        replay_set = set(replay_ids)
+        now = self.clock.now()
+        decoded: List[Tuple[int, tuple]] = []  # (idx, (nonce, public, share, msg))
+        for idx, pi in enumerate(req.prepare_inits):
+            err = self._helper_validate_report_share(ta, pi, replay_set, now)
+            if err is not None:
+                failed[idx] = err
+                continue
+            item = self._helper_decode_report_share(ta, pi)
+            if isinstance(item, PrepareError):
+                failed[idx] = item
+            else:
+                decoded.append((idx, item))
+
+        # Batched prepare: ONE device launch for the whole job (north star).
+        try:
+            agg_param = ta.vdaf.decode_agg_param(req.aggregation_parameter)
+        except VdafError:
+            raise InvalidMessage("bad aggregation parameter")
+        loop = asyncio.get_running_loop()
+        results = await loop.run_in_executor(
+            None, lambda: self._helper_prepare_batch(ta, decoded, agg_param)
+        )
+
+        # Assemble responses + report aggregations in request order.
+        ras: List[ReportAggregation] = []
+        out_shares: Dict[bytes, Sequence[int]] = {}
+        resps: List[PrepareResp] = []
+        interval = Interval.EMPTY
+        for idx, pi in enumerate(req.prepare_inits):
+            rid = pi.report_share.metadata.report_id
+            t = pi.report_share.metadata.time
+            interval = interval_merge(
+                interval, time_to_batch_interval(t, task.time_precision)
+            )
+            base = dict(
+                task_id=task_id,
+                aggregation_job_id=aggregation_job_id,
+                report_id=rid,
+                time=t,
+                ord=idx,
+            )
+            if idx in failed:
+                err = failed[idx]
+                resp = PrepareResp(rid, PrepareStepResult.reject(err))
+                ras.append(
+                    ReportAggregation(
+                        state=ReportAggregationState.FAILED, error=err,
+                        last_prep_resp=resp, **base
+                    )
+                )
+                resps.append(resp)
+                continue
+            outcome = results[idx]
+            if isinstance(outcome, PrepareError):
+                resp = PrepareResp(rid, PrepareStepResult.reject(outcome))
+                ras.append(
+                    ReportAggregation(
+                        state=ReportAggregationState.FAILED, error=outcome,
+                        last_prep_resp=resp, **base
+                    )
+                )
+                resps.append(resp)
+                continue
+            kind, payload, outbound = outcome
+            resp = PrepareResp(rid, PrepareStepResult.new_continue(outbound))
+            if kind == "finished":
+                out_shares[rid.data] = payload
+                ras.append(
+                    ReportAggregation(
+                        state=ReportAggregationState.FINISHED,
+                        last_prep_resp=resp, **base
+                    )
+                )
+            else:  # continued (multi-round VDAF)
+                ras.append(
+                    ReportAggregation(
+                        state=ReportAggregationState.WAITING_HELPER,
+                        helper_prep_state=payload,
+                        last_prep_resp=resp, **base
+                    )
+                )
+            resps.append(resp)
+
+        job = AggregationJob(
+            task_id=task_id,
+            aggregation_job_id=aggregation_job_id,
+            aggregation_parameter=req.aggregation_parameter,
+            partial_batch_identifier=req.partial_batch_selector.batch_identifier
+            if task.query_type.kind == "FixedSize"
+            else None,
+            client_timestamp_interval=interval,
+            state=AggregationJobState.FINISHED
+            if all(
+                ra.state
+                in (ReportAggregationState.FINISHED, ReportAggregationState.FAILED)
+                for ra in ras
+            )
+            else AggregationJobState.IN_PROGRESS,
+            step=AggregationJobStep(0),
+            last_request_hash=request_hash,
+        )
+
+        writer = AggregationJobWriter(
+            task,
+            ta.vdaf,
+            batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+            initial_write=True,
+        )
+        writer.put(job, ras, out_shares)
+
+        def tx_fn(tx):
+            return writer.write(tx)
+
+        try:
+            failures = await self.datastore.run_tx_async("agg_init_write", tx_fn)
+        except TxConflict:
+            # racing identical request: return the stored response
+            return await self._stored_job_resp(task_id, aggregation_job_id)
+        if failures:
+            resps = [
+                PrepareResp(r.report_id, PrepareStepResult.reject(failures[r.report_id.data]))
+                if r.report_id.data in failures
+                else r
+                for r in resps
+            ]
+        return AggregationJobResp(resps)
+
+    def _helper_validate_report_share(
+        self, ta: TaskAggregator, pi, replay_set, now
+    ) -> Optional[PrepareError]:
+        task = ta.task
+        meta = pi.report_share.metadata
+        if meta.report_id.data in replay_set:
+            return PrepareError.REPORT_REPLAYED
+        if (
+            task.task_expiration is not None
+            and meta.time.seconds > task.task_expiration.seconds
+        ):
+            return PrepareError.TASK_EXPIRED
+        if (
+            task.report_expiry_age is not None
+            and meta.time.seconds < now.seconds - task.report_expiry_age.seconds
+        ):
+            return PrepareError.REPORT_DROPPED
+        if meta.time.seconds > time_add(now, task.tolerable_clock_skew).seconds:
+            return PrepareError.REPORT_TOO_EARLY
+        return None
+
+    def _helper_decode_report_share(self, ta: TaskAggregator, pi):
+        """HPKE open + decode; returns (nonce, public_parts, input_share,
+        leader_msg) or a PrepareError."""
+        task = ta.task
+        meta = pi.report_share.metadata
+        keypair = task.hpke_keypair_for(pi.report_share.encrypted_input_share.config_id)
+        if keypair is None:
+            return PrepareError.HPKE_UNKNOWN_CONFIG_ID
+        aad = InputShareAad(
+            task.task_id, meta, pi.report_share.public_share
+        ).get_encoded()
+        info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER)
+        try:
+            plaintext = open_(keypair, info, pi.report_share.encrypted_input_share, aad)
+        except HpkeError:
+            return PrepareError.HPKE_DECRYPT_ERROR
+        try:
+            plain = PlaintextInputShare.get_decoded(plaintext)
+            _check_extensions(plain.extensions)
+        except Exception:
+            return PrepareError.INVALID_MESSAGE
+        try:
+            input_share = ta.vdaf.decode_input_share(1, plain.payload)
+            public_parts = ta.vdaf.decode_public_share(pi.report_share.public_share)
+        except (VdafError, Exception):
+            return PrepareError.INVALID_MESSAGE
+        if pi.message.variant != pp.PingPongMessage.INITIALIZE:
+            return PrepareError.INVALID_MESSAGE
+        return (meta.report_id.data, public_parts, input_share, pi.message)
+
+    def _helper_prepare_batch(self, ta: TaskAggregator, decoded, agg_param):
+        """Batched helper_initialized over the surviving reports.
+
+        Prio3 rides the backend seam (ONE batched device launch); other
+        VDAFs (multi-round test doubles, Poplar1) step per report through
+        the generic ping-pong topology (reference mirror:
+        aggregator.rs:2022-2040 helper_initialized on rayon)."""
+        vdaf = ta.vdaf
+        if isinstance(vdaf, Prio3):
+            return self._helper_prepare_batch_prio3(ta, decoded)
+        results: Dict[int, object] = {}
+        vk = ta.task.vdaf_verify_key
+        for idx, (nonce, public_parts, input_share, leader_msg) in decoded:
+            try:
+                trans = pp.helper_initialized(
+                    vdaf, vk, agg_param, nonce, public_parts, input_share, leader_msg
+                )
+                state, outbound = trans.evaluate(vdaf)
+            except (VdafError, pp.PingPongError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            if isinstance(state, pp.PingPongFinished):
+                results[idx] = ("finished", state.out_share, outbound)
+            else:
+                results[idx] = (
+                    "continued",
+                    vdaf.ping_pong_encode_state(state.prep_state),
+                    outbound,
+                )
+        return results
+
+    def _helper_prepare_batch_prio3(self, ta: TaskAggregator, decoded):
+        """The north-star path: one batched launch for prep + combine."""
+        vdaf = ta.vdaf
+        results: Dict[int, object] = {}
+        rows = []
+        for idx, (nonce, public_parts, input_share, leader_msg) in decoded:
+            try:
+                leader_share = vdaf.ping_pong_decode_prep_share(
+                    leader_msg.prep_share, round=0
+                )
+            except VdafError:
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            rows.append((idx, nonce, public_parts, input_share, leader_share))
+        if not rows:
+            return results
+
+        prep_in = [(nonce, public, share) for (_, nonce, public, share, _) in rows]
+        prep_out = ta.backend.prep_init_batch(ta.task.vdaf_verify_key, 1, prep_in)
+        combine_rows = []
+        for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
+            if isinstance(outcome, VdafError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            state, helper_share = outcome
+            combine_rows.append((idx, state, leader_share, helper_share))
+        combined = ta.backend.prep_shares_to_prep_batch(
+            [[ls, hs] for (_, _, ls, hs) in combine_rows]
+        )
+        for (idx, state, _ls, hs), prep_msg in zip(combine_rows, combined):
+            if isinstance(prep_msg, VdafError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            try:
+                out_share = vdaf.prep_next(state, prep_msg)
+            except VdafError:
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            outbound = pp.PingPongMessage(
+                pp.PingPongMessage.FINISH, prep_msg=prep_msg or b""
+            )
+            results[idx] = ("finished", out_share, outbound)
+        return results
+
+    async def _stored_job_resp(
+        self, task_id: TaskId, aggregation_job_id: AggregationJobId
+    ) -> AggregationJobResp:
+        """Reconstruct the last response from stored report aggregations."""
+        ras = await self.datastore.run_tx_async(
+            "stored_resp",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                task_id, aggregation_job_id
+            ),
+        )
+        resps = [ra.last_prep_resp for ra in ras if ra.last_prep_resp is not None]
+        return AggregationJobResp(resps)
+
+    # ------------------------------------------------------------------
+    # helper aggregate continue (reference: aggregation_job_continue.rs:38)
+
+    async def handle_aggregate_continue(
+        self,
+        task_id: TaskId,
+        aggregation_job_id: AggregationJobId,
+        body: bytes,
+        auth_token: Optional[AuthenticationToken],
+    ) -> AggregationJobResp:
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        if task.role != Role.HELPER:
+            raise UnrecognizedTask("aggregate-continue on non-helper")
+        ta.check_aggregator_auth(auth_token)
+        req = AggregationJobContinueReq.get_decoded(body)
+        if int(req.step) == 0:
+            raise InvalidMessage("continue cannot request step 0")
+
+        job = await self.datastore.run_tx_async(
+            "agg_cont_load",
+            lambda tx: tx.get_aggregation_job(task_id, aggregation_job_id),
+        )
+        if job is None:
+            raise UnrecognizedAggregationJob(str(aggregation_job_id))
+        # step skew (reference: aggregation_job_continue.rs:38-286)
+        if int(req.step) == int(job.step):
+            # replay of the previous request: only an identical body may be
+            # answered from cache; a mutated request is a conflict
+            if job.last_request_hash == hashlib.sha256(body).digest():
+                return await self._stored_job_resp(task_id, aggregation_job_id)
+            raise ForbiddenMutation("continue replayed with different request")
+        if int(req.step) != int(job.step) + 1:
+            raise StepMismatch(
+                f"request step {int(req.step)} vs job step {int(job.step)}"
+            )
+
+        ras = await self.datastore.run_tx_async(
+            "agg_cont_ras",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                task_id, aggregation_job_id
+            ),
+        )
+        by_id = {ra.report_id.data: ra for ra in ras}
+
+        loop = asyncio.get_running_loop()
+        stepped = await loop.run_in_executor(
+            None, lambda: self._helper_continue_batch(ta, job, req, by_id)
+        )
+        new_ras, out_shares, resps = stepped
+
+        job = job.with_step(AggregationJobStep(int(req.step))).with_last_request_hash(
+            hashlib.sha256(body).digest()
+        )
+        if all(
+            ra.state
+            in (ReportAggregationState.FINISHED, ReportAggregationState.FAILED)
+            for ra in new_ras
+        ):
+            job = job.with_state(AggregationJobState.FINISHED)
+
+        writer = AggregationJobWriter(
+            task,
+            ta.vdaf,
+            batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+            initial_write=False,
+        )
+        writer.put(job, new_ras, out_shares)
+        failures = await self.datastore.run_tx_async(
+            "agg_cont_write", lambda tx: writer.write(tx)
+        )
+        if failures:
+            resps = [
+                PrepareResp(r.report_id, PrepareStepResult.reject(failures[r.report_id.data]))
+                if r.report_id.data in failures
+                else r
+                for r in resps
+            ]
+        return AggregationJobResp(resps)
+
+    def _helper_continue_batch(self, ta: TaskAggregator, job, req, by_id):
+        """Step WaitingHelper reports with the leader's continue messages."""
+        vdaf = ta.vdaf
+        new_ras: List[ReportAggregation] = []
+        out_shares: Dict[bytes, Sequence[int]] = {}
+        resps: List[PrepareResp] = []
+        for pc in req.prepare_continues:
+            ra = by_id.get(pc.report_id.data)
+            if ra is None or ra.state != ReportAggregationState.WAITING_HELPER:
+                raise InvalidMessage(
+                    f"report {pc.report_id} not in WaitingHelper state"
+                )
+            try:
+                agg_param = vdaf.decode_agg_param(job.aggregation_parameter)
+                state = vdaf.ping_pong_decode_state(ra.helper_prep_state)
+                # the helper's stored state after evaluating round k's
+                # transition is at round k; step k+1's continue finds it at
+                # round == req.step
+                value = pp.continued(
+                    vdaf,
+                    False,
+                    pp.PingPongContinued(state, int(req.step)),
+                    pc.message,
+                    agg_param,
+                )
+            except (VdafError, pp.PingPongError):
+                resp = PrepareResp(
+                    pc.report_id, PrepareStepResult.reject(PrepareError.VDAF_PREP_ERROR)
+                )
+                new_ras.append(
+                    ra.failed(PrepareError.VDAF_PREP_ERROR).with_last_prep_resp(resp)
+                )
+                resps.append(resp)
+                continue
+            if value.out_share is not None:
+                resp = PrepareResp(pc.report_id, PrepareStepResult.finished())
+                new_ras.append(
+                    ra.with_state(ReportAggregationState.FINISHED).with_last_prep_resp(resp)
+                )
+                out_shares[pc.report_id.data] = value.out_share
+            else:
+                next_state, outbound = value.transition.evaluate(vdaf)
+                resp = PrepareResp(
+                    pc.report_id, PrepareStepResult.new_continue(outbound)
+                )
+                if isinstance(next_state, pp.PingPongFinished):
+                    new_ras.append(
+                        ra.with_state(ReportAggregationState.FINISHED).with_last_prep_resp(resp)
+                    )
+                    out_shares[pc.report_id.data] = next_state.output_share
+                else:
+                    new_ras.append(
+                        ra.with_state(
+                            ReportAggregationState.WAITING_HELPER,
+                            helper_prep_state=vdaf.ping_pong_encode_state(
+                                next_state.prep_state
+                            ),
+                        ).with_last_prep_resp(resp)
+                    )
+            resps.append(resp)
+        # reports absent from the request keep their state
+        present = {pc.report_id.data for pc in req.prepare_continues}
+        for rid, ra in by_id.items():
+            if rid not in present and ra.state == ReportAggregationState.WAITING_HELPER:
+                new_ras.append(ra.failed(PrepareError.REPORT_DROPPED))
+        return new_ras, out_shares, resps
+
+    # ------------------------------------------------------------------
+    # helper aggregation job delete
+
+    async def handle_aggregate_delete(
+        self,
+        task_id: TaskId,
+        aggregation_job_id: AggregationJobId,
+        auth_token: Optional[AuthenticationToken],
+    ) -> None:
+        ta = await self.task_aggregator_for(task_id)
+        ta.check_aggregator_auth(auth_token)
+
+        def tx_fn(tx):
+            job = tx.get_aggregation_job(task_id, aggregation_job_id)
+            if job is None:
+                raise UnrecognizedAggregationJob(str(aggregation_job_id))
+            tx.update_aggregation_job(job.with_state(AggregationJobState.DELETED))
+
+        await self.datastore.run_tx_async("agg_delete", tx_fn)
+
+    # ------------------------------------------------------------------
+    # collection jobs (leader; reference: aggregator.rs:2461-2757)
+
+    async def handle_create_collection_job(
+        self,
+        task_id: TaskId,
+        collection_job_id: CollectionJobId,
+        body: bytes,
+        auth_token: Optional[AuthenticationToken],
+    ) -> None:
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        if task.role != Role.LEADER:
+            raise UnrecognizedTask("collection on non-leader")
+        ta.check_collector_auth(auth_token)
+        req = CollectionReq.get_decoded(body, ta.query_class)
+        strategy = strategy_for(task)
+        err = strategy.validate_query(task, req.query)
+        if err is not None:
+            raise BatchInvalid(err)
+
+        def tx_fn(tx):
+            existing = tx.get_collection_job(
+                task_id, collection_job_id, task.query_type.kind
+            )
+            if existing is not None:
+                if (
+                    existing.query == req.query
+                    and existing.aggregation_parameter == req.aggregation_parameter
+                ):
+                    return  # idempotent re-PUT
+                raise ForbiddenMutation("collection job mutated")
+
+            if task.query_type.kind == "TimeInterval":
+                ident = req.query.query_body.get_encoded()
+                # batch overlap check (reference: batch queried at most once)
+                for other in tx.get_collection_jobs_by_batch_identifier(
+                    task_id, ident, task.query_type.kind
+                ):
+                    if other.aggregation_parameter == req.aggregation_parameter:
+                        raise BatchQueriedTooManyTimes("batch already queried")
+            else:
+                fsq: FixedSizeQuery = req.query.query_body
+                if fsq.variant == FixedSizeQuery.BY_BATCH_ID:
+                    batch_id = fsq.batch_id
+                    for other in tx.get_collection_jobs_by_batch_identifier(
+                        task_id, batch_id.get_encoded(), task.query_type.kind
+                    ):
+                        if other.aggregation_parameter == req.aggregation_parameter:
+                            raise BatchQueriedTooManyTimes("batch already queried")
+                else:  # current batch
+                    batch_id = tx.acquire_filled_outstanding_batch(
+                        task_id, task.min_batch_size
+                    )
+                    if batch_id is None:
+                        raise InvalidBatchSize("no batch ready for collection")
+                ident = batch_id.get_encoded()
+
+            tx.put_collection_job(
+                CollectionJob(
+                    task_id=task_id,
+                    collection_job_id=collection_job_id,
+                    query=req.query,
+                    aggregation_parameter=req.aggregation_parameter,
+                    batch_identifier=ident,
+                    state=CollectionJobState.START,
+                )
+            )
+
+        await self.datastore.run_tx_async("create_collection_job", tx_fn)
+
+    async def handle_get_collection_job(
+        self,
+        task_id: TaskId,
+        collection_job_id: CollectionJobId,
+        auth_token: Optional[AuthenticationToken],
+    ) -> Optional[Collection]:
+        """Returns the Collection when finished, None when still running
+        (HTTP layer turns None into 202 + Retry-After)."""
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        ta.check_collector_auth(auth_token)
+        job = await self.datastore.run_tx_async(
+            "get_collection_job",
+            lambda tx: tx.get_collection_job(
+                task_id, collection_job_id, task.query_type.kind
+            ),
+        )
+        if job is None:
+            raise UnrecognizedCollectionJob(str(collection_job_id))
+        if job.state == CollectionJobState.START:
+            return None
+        if job.state == CollectionJobState.DELETED:
+            raise DeletedCollectionJob("collection job deleted")
+        if job.state == CollectionJobState.ABANDONED:
+            raise AggregatorError("collection job abandoned")
+
+        # Finished: seal the leader share to the collector
+        # (reference: aggregator.rs:2648-2757).
+        if task.query_type.kind == "TimeInterval":
+            batch_selector = BatchSelector.new_time_interval(
+                Interval.get_decoded(job.batch_identifier)
+            )
+            pbs = PartialBatchSelector.new_time_interval()
+        else:
+            batch_selector = BatchSelector.new_fixed_size(
+                BatchId.get_decoded(job.batch_identifier)
+            )
+            pbs = PartialBatchSelector.new_fixed_size(
+                BatchId.get_decoded(job.batch_identifier)
+            )
+        aad = AggregateShareAad(
+            task_id, job.aggregation_parameter, batch_selector
+        ).get_encoded()
+        leader_encrypted = seal(
+            task.collector_hpke_config,
+            HpkeApplicationInfo.new(Label.AGGREGATE_SHARE, Role.LEADER, Role.COLLECTOR),
+            job.leader_aggregate_share,
+            aad,
+        )
+        return Collection(
+            partial_batch_selector=pbs,
+            report_count=job.report_count,
+            interval=job.client_timestamp_interval,
+            leader_encrypted_agg_share=leader_encrypted,
+            helper_encrypted_agg_share=job.helper_aggregate_share,
+        )
+
+    async def handle_delete_collection_job(
+        self,
+        task_id: TaskId,
+        collection_job_id: CollectionJobId,
+        auth_token: Optional[AuthenticationToken],
+    ) -> None:
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        ta.check_collector_auth(auth_token)
+
+        def tx_fn(tx):
+            job = tx.get_collection_job(task_id, collection_job_id, task.query_type.kind)
+            if job is None:
+                raise UnrecognizedCollectionJob(str(collection_job_id))
+            if job.state != CollectionJobState.DELETED:
+                tx.update_collection_job(job.with_state(CollectionJobState.DELETED))
+
+        await self.datastore.run_tx_async("delete_collection_job", tx_fn)
+
+    # ------------------------------------------------------------------
+    # helper aggregate share (reference: aggregator.rs:2878 handle_aggregate_share_generic)
+
+    async def handle_aggregate_share(
+        self,
+        task_id: TaskId,
+        body: bytes,
+        auth_token: Optional[AuthenticationToken],
+    ) -> AggregateShare:
+        ta = await self.task_aggregator_for(task_id)
+        task = ta.task
+        if task.role != Role.HELPER:
+            raise UnrecognizedTask("aggregate-share on non-helper")
+        ta.check_aggregator_auth(auth_token)
+        req = AggregateShareReq.get_decoded(body, ta.query_class)
+        strategy = strategy_for(task)
+        ident = req.batch_selector.batch_identifier.get_encoded()
+
+        def tx_fn(tx):
+            cached = tx.get_aggregate_share_job(
+                task_id, ident, req.aggregation_parameter
+            )
+            if cached is not None:
+                if (
+                    cached.report_count != req.report_count
+                    or cached.checksum.data != req.checksum.data
+                ):
+                    raise BatchMismatch("cached aggregate share mismatch")
+                return cached.helper_aggregate_share
+
+            share, count, checksum, _interval = compute_aggregate_share(
+                task, ta.vdaf, tx, ident, req.aggregation_parameter
+            )
+            # cross-aggregator consistency checks (reference: aggregate_share.rs:21-118)
+            if count != req.report_count or checksum.data != req.checksum.data:
+                raise BatchMismatch(
+                    f"count/checksum mismatch: {count} vs {req.report_count}"
+                )
+            if count < task.min_batch_size:
+                raise InvalidBatchSize(f"batch too small: {count}")
+            if share is None:
+                raise InvalidBatchSize("empty batch")
+            encoded = ta.vdaf.field.encode_vec(share)
+            tx.put_aggregate_share_job(
+                AggregateShareJob(
+                    task_id=task_id,
+                    batch_identifier=ident,
+                    aggregation_parameter=req.aggregation_parameter,
+                    helper_aggregate_share=encoded,
+                    report_count=count,
+                    checksum=checksum,
+                )
+            )
+            # scrub contributing batch aggregations (reference: :2878-3123)
+            for bident in strategy.batch_identifiers_for_collection_identifier(
+                task, ident
+            ):
+                for ba in tx.get_batch_aggregations_for_batch(
+                    task_id, bident, req.aggregation_parameter
+                ):
+                    if ba.state == BatchAggregationState.AGGREGATING:
+                        tx.update_batch_aggregation(ba.scrubbed())
+            return encoded
+
+        encoded_share = await self.datastore.run_tx_async("aggregate_share", tx_fn)
+        aad = AggregateShareAad(
+            task_id, req.aggregation_parameter, req.batch_selector
+        ).get_encoded()
+        encrypted = seal(
+            task.collector_hpke_config,
+            HpkeApplicationInfo.new(Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR),
+            encoded_share,
+            aad,
+        )
+        return AggregateShare(encrypted)
+
+
+def _check_extensions(extensions) -> None:
+    """Duplicate extension types are rejected (reference: aggregator.rs upload
+    and init validation)."""
+    seen = set()
+    for ext in extensions:
+        if ext.extension_type in seen:
+            raise InvalidMessage("duplicate extension")
+        seen.add(ext.extension_type)

@@ -1,0 +1,271 @@
+"""Collection job stepping (leader).
+
+The analog of ``CollectionJobDriver`` (reference:
+aggregator/src/aggregator/collection_job_driver.rs:43-650): a leased
+collection job steps through a readiness gate (no unaggregated reports in
+scope AND every started aggregation job terminated), marks the batch
+Collected (writing empty fence shards so concurrent aggregation writers
+fail fast), computes the leader share from the shard accumulators, applies
+the differential-privacy hook, requests the helper's encrypted aggregate
+share, and stores the Finished job.  Not-ready jobs are released with a
+stepped retry delay (reference RetryStrategy :723-792).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..core.report_id import checksum_combined
+from ..core.retries import HttpRetryPolicy, retry_http_request
+from ..datastore import (
+    BatchAggregation,
+    BatchAggregationState,
+    CollectionJobState,
+    Datastore,
+    Lease,
+)
+from ..datastore.query_type import strategy_for
+from ..datastore.task import AggregatorTask
+from ..messages import (
+    AggregateShare,
+    AggregateShareReq,
+    BatchId,
+    BatchSelector,
+    Duration,
+    Interval,
+    ReportIdChecksum,
+)
+from .aggregate_share import compute_aggregate_share
+from .aggregation_job_writer import merge_batch_aggregations
+from .error import InvalidBatchSize
+
+logger = logging.getLogger("janus_tpu.collection_job_driver")
+
+
+class NoDifferentialPrivacy:
+    """No-op DP strategy (reference: core/src/dp.rs:38; the noise hook is
+    collection_job_driver.rs:338 add_noise_to_agg_share)."""
+
+    def add_noise_to_agg_share(self, vdaf, agg_share: List[int], report_count: int):
+        return agg_share
+
+
+@dataclass
+class CollectionDriverConfig:
+    maximum_attempts_before_failure: int = 10
+    retry_initial_delay: Duration = Duration(5)
+    retry_max_delay: Duration = Duration(300)
+    http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
+
+
+class CollectionJobDriver:
+    def __init__(
+        self,
+        datastore: Datastore,
+        session_factory,
+        config: Optional[CollectionDriverConfig] = None,
+        dp_strategy=None,
+    ):
+        self.datastore = datastore
+        self._session_factory = session_factory
+        self._session = None
+        self.config = config or CollectionDriverConfig()
+        self.dp_strategy = dp_strategy or NoDifferentialPrivacy()
+
+    def _get_session(self):
+        if self._session is None or self._session.closed:
+            self._session = self._session_factory()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # ------------------------------------------------------------------
+    async def step_collection_job(self, lease: Lease) -> None:
+        acq = lease.leased
+        if lease.lease_attempts > self.config.maximum_attempts_before_failure:
+            await self.abandon_collection_job(lease)
+            return
+
+        def tx1(tx):
+            task = tx.get_aggregator_task(acq.task_id)
+            job = tx.get_collection_job(
+                acq.task_id, acq.collection_job_id, acq.query_type
+            )
+            if task is None or job is None:
+                return None
+            if job.state != CollectionJobState.START:
+                tx.release_collection_job(lease)
+                return None
+            vdaf = task.vdaf_instance()
+            if not self._ready(tx, task, job):
+                # stepped retry delay (reference: :255-262, :723-792)
+                attempts = tx.increment_collection_job_step_attempts(
+                    acq.task_id, acq.collection_job_id
+                )
+                delay = min(
+                    self.config.retry_initial_delay.seconds * (2 ** (attempts - 1)),
+                    self.config.retry_max_delay.seconds,
+                )
+                tx.release_collection_job(lease, Duration(delay))
+                return None
+            # mark batch aggregations Collected + fence (reference: :283-316)
+            strategy = strategy_for(task)
+            for ident in strategy.batch_identifiers_for_collection_identifier(
+                task, job.batch_identifier
+            ):
+                for ba in tx.get_batch_aggregations_for_batch(
+                    acq.task_id, ident, job.aggregation_parameter
+                ):
+                    if ba.state == BatchAggregationState.AGGREGATING:
+                        tx.update_batch_aggregation(
+                            BatchAggregation(
+                                task_id=ba.task_id,
+                                batch_identifier=ba.batch_identifier,
+                                aggregation_parameter=ba.aggregation_parameter,
+                                ord=ba.ord,
+                                state=BatchAggregationState.COLLECTED,
+                                aggregate_share=ba.aggregate_share,
+                                report_count=ba.report_count,
+                                checksum=ba.checksum,
+                                client_timestamp_interval=ba.client_timestamp_interval,
+                                aggregation_jobs_created=ba.aggregation_jobs_created,
+                                aggregation_jobs_terminated=ba.aggregation_jobs_terminated,
+                            )
+                        )
+            share, count, checksum, interval = compute_aggregate_share(
+                task, vdaf, tx, job.batch_identifier, job.aggregation_parameter
+            )
+            return task, job, vdaf, share, count, checksum, interval
+
+        loaded = await self.datastore.run_tx_async("step_collection_job_1", tx1)
+        if loaded is None:
+            return
+        task, job, vdaf, share, count, checksum, interval = loaded
+
+        if share is None or count < task.min_batch_size:
+            logger.warning(
+                "collection job %s batch too small (%d < %d); abandoning",
+                acq.collection_job_id,
+                count,
+                task.min_batch_size,
+            )
+            await self.abandon_collection_job(lease)
+            return
+
+        # DP noise hook (reference: :338-344)
+        share = self.dp_strategy.add_noise_to_agg_share(vdaf, share, count)
+
+        # request the helper's encrypted aggregate share (reference: :347-377)
+        if task.query_type.kind == "TimeInterval":
+            batch_selector = BatchSelector.new_time_interval(
+                Interval.get_decoded(job.batch_identifier)
+            )
+        else:
+            batch_selector = BatchSelector.new_fixed_size(
+                BatchId.get_decoded(job.batch_identifier)
+            )
+        req = AggregateShareReq(
+            batch_selector=batch_selector,
+            aggregation_parameter=job.aggregation_parameter,
+            report_count=count,
+            checksum=checksum,
+        )
+        url = (
+            task.peer_aggregator_endpoint.rstrip("/")
+            + f"/tasks/{task.task_id}/aggregate_shares"
+        )
+        headers = {"Content-Type": AggregateShareReq.MEDIA_TYPE}
+        if task.aggregator_auth_token is not None:
+            name, value = task.aggregator_auth_token.request_authentication()
+            headers[name] = value
+        try:
+            status, body, _ = await retry_http_request(
+                self._get_session(),
+                "POST",
+                url,
+                data=req.get_encoded(),
+                headers=headers,
+                policy=self.config.http_retry,
+            )
+        except Exception:
+            logger.warning("helper aggregate-share request failed; releasing")
+            await self.datastore.run_tx_async(
+                "release_coll_job", lambda tx: tx.release_collection_job(lease)
+            )
+            return
+        if status >= 400:
+            logger.warning("helper aggregate-share returned %d; releasing", status)
+            await self.datastore.run_tx_async(
+                "release_coll_job", lambda tx: tx.release_collection_job(lease)
+            )
+            return
+        helper_share = AggregateShare.get_decoded(body)
+
+        finished = job.finished(
+            report_count=count,
+            client_timestamp_interval=interval,
+            leader_aggregate_share=vdaf.field.encode_vec(share),
+            helper_aggregate_share=helper_share.encrypted_aggregate_share,
+        )
+
+        def tx2(tx):
+            tx.update_collection_job(finished)
+            # scrub batch aggregations (reference: :380-463)
+            strategy = strategy_for(task)
+            for ident in strategy.batch_identifiers_for_collection_identifier(
+                task, job.batch_identifier
+            ):
+                for ba in tx.get_batch_aggregations_for_batch(
+                    task.task_id, ident, job.aggregation_parameter
+                ):
+                    if ba.state == BatchAggregationState.COLLECTED:
+                        tx.update_batch_aggregation(ba.scrubbed())
+            tx.release_collection_job(lease)
+
+        await self.datastore.run_tx_async("step_collection_job_2", tx2)
+
+    # ------------------------------------------------------------------
+    def _ready(self, tx, task: AggregatorTask, job) -> bool:
+        """Readiness gate (reference: :124-262): no unaggregated reports in
+        scope and all created aggregation jobs terminated."""
+        if task.query_type.kind == "TimeInterval":
+            interval = Interval.get_decoded(job.batch_identifier)
+            if tx.count_unaggregated_client_reports_for_interval(
+                task.task_id, interval
+            ):
+                return False
+        strategy = strategy_for(task)
+        for ident in strategy.batch_identifiers_for_collection_identifier(
+            task, job.batch_identifier
+        ):
+            # counters are sharded: a job's created/terminated increments may
+            # land on different shards, so compare per-batch sums
+            # (reference: models.rs:1421 counters summed over shards)
+            created = terminated = 0
+            for ba in tx.get_batch_aggregations_for_batch(
+                task.task_id, ident, job.aggregation_parameter
+            ):
+                created += ba.aggregation_jobs_created
+                terminated += ba.aggregation_jobs_terminated
+            if created != terminated:
+                return False
+        return True
+
+    async def abandon_collection_job(self, lease: Lease) -> None:
+        """reference: :568-629"""
+        acq = lease.leased
+
+        def tx_fn(tx):
+            job = tx.get_collection_job(
+                acq.task_id, acq.collection_job_id, acq.query_type
+            )
+            if job is not None and job.state == CollectionJobState.START:
+                tx.update_collection_job(job.with_state(CollectionJobState.ABANDONED))
+            tx.release_collection_job(lease)
+
+        await self.datastore.run_tx_async("abandon_collection_job", tx_fn)

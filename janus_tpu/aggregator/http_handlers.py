@@ -1,0 +1,241 @@
+"""DAP HTTP API over aiohttp.
+
+The analog of the trillium router (reference:
+aggregator/src/aggregator/http_handlers.rs:283-357): all DAP routes, CORS
+preflight for browser clients, RFC 7807 problem documents on errors, and
+bearer/DAP-Auth-Token extraction.  Routes:
+
+    GET    /hpke_config?task_id=...
+    PUT    /tasks/:task_id/reports
+    PUT    /tasks/:task_id/aggregation_jobs/:aggregation_job_id
+    POST   /tasks/:task_id/aggregation_jobs/:aggregation_job_id
+    DELETE /tasks/:task_id/aggregation_jobs/:aggregation_job_id
+    PUT    /tasks/:task_id/collection_jobs/:collection_job_id
+    POST   /tasks/:task_id/collection_jobs/:collection_job_id
+    DELETE /tasks/:task_id/collection_jobs/:collection_job_id
+    POST   /tasks/:task_id/aggregate_shares
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..core.auth_tokens import DAP_AUTH_HEADER, AuthenticationToken
+from ..messages import (
+    AggregateShare,
+    AggregationJobId,
+    AggregationJobResp,
+    CollectionJobId,
+    HpkeConfigList,
+    Report,
+    TaskId,
+)
+from ..messages.codec import CodecError
+from ..messages.problem_type import problem_document
+from .aggregator import Aggregator
+from .error import AggregatorError, DeletedCollectionJob
+
+logger = logging.getLogger("janus_tpu.http")
+
+PROBLEM_CONTENT_TYPE = "application/problem+json"
+
+
+def _extract_auth(request: web.Request) -> Optional[AuthenticationToken]:
+    """Bearer header first, then DAP-Auth-Token
+    (reference: core/src/auth_tokens.rs)."""
+    auth = request.headers.get("Authorization")
+    if auth and auth.startswith("Bearer "):
+        try:
+            return AuthenticationToken.new_bearer(auth[len("Bearer ") :])
+        except ValueError:
+            return None
+    dap = request.headers.get(DAP_AUTH_HEADER)
+    if dap:
+        try:
+            return AuthenticationToken.new_dap_auth(dap)
+        except ValueError:
+            return None
+    return None
+
+
+def _problem(err: AggregatorError, task_id: Optional[TaskId]) -> web.Response:
+    if err.problem is None:
+        return web.Response(status=err.status, text=err.detail or "")
+    doc = problem_document(err.problem, task_id=task_id, detail=err.detail or None)
+    return web.Response(
+        status=err.status,
+        content_type=PROBLEM_CONTENT_TYPE,
+        text=json.dumps(doc),
+    )
+
+
+def _wire(body: bytes, media_type: str, status: int = 200) -> web.Response:
+    return web.Response(status=status, body=body, content_type=media_type)
+
+
+def _route(handler):
+    """Wrap a handler: task-id parsing + error → problem-document mapping
+    (reference: http_handlers.rs error mapping + instrumented spans)."""
+
+    async def wrapped(request: web.Request) -> web.Response:
+        task_id = None
+        try:
+            if "task_id" in request.match_info:
+                try:
+                    task_id = TaskId.from_str(request.match_info["task_id"])
+                except Exception:
+                    from .error import InvalidMessage
+
+                    raise InvalidMessage("malformed task id")
+            return await handler(request, task_id)
+        except DeletedCollectionJob:
+            return web.Response(status=204)
+        except AggregatorError as err:
+            return _problem(err, task_id)
+        except CodecError as err:
+            from .error import InvalidMessage
+
+            return _problem(InvalidMessage(str(err)), task_id)
+        except Exception:
+            logger.exception("internal error in %s", request.path)
+            return web.Response(status=500, text="internal error")
+
+    return wrapped
+
+
+def aggregator_app(aggregator: Aggregator) -> web.Application:
+    """Build the DAP service (reference: http_handlers.rs:283
+    aggregator_handler)."""
+
+    @_route
+    async def hpke_config(request: web.Request, _tid) -> web.Response:
+        task_id = None
+        if "task_id" in request.query:
+            task_id = TaskId.from_str(request.query["task_id"])
+        config_list = await aggregator.handle_hpke_config(task_id)
+        return _wire(config_list.get_encoded(), HpkeConfigList.MEDIA_TYPE)
+
+    @_route
+    async def upload(request: web.Request, task_id) -> web.Response:
+        body = await request.read()
+        report = Report.get_decoded(body)
+        await aggregator.handle_upload(task_id, report)
+        return web.Response(status=201)
+
+    @_route
+    async def aggregation_job_put(request: web.Request, task_id) -> web.Response:
+        job_id = AggregationJobId.from_str(request.match_info["aggregation_job_id"])
+        body = await request.read()
+        resp = await aggregator.handle_aggregate_init(
+            task_id, job_id, body, _extract_auth(request)
+        )
+        return _wire(resp.get_encoded(), AggregationJobResp.MEDIA_TYPE)
+
+    @_route
+    async def aggregation_job_post(request: web.Request, task_id) -> web.Response:
+        job_id = AggregationJobId.from_str(request.match_info["aggregation_job_id"])
+        body = await request.read()
+        resp = await aggregator.handle_aggregate_continue(
+            task_id, job_id, body, _extract_auth(request)
+        )
+        return _wire(resp.get_encoded(), AggregationJobResp.MEDIA_TYPE)
+
+    @_route
+    async def aggregation_job_delete(request: web.Request, task_id) -> web.Response:
+        job_id = AggregationJobId.from_str(request.match_info["aggregation_job_id"])
+        await aggregator.handle_aggregate_delete(task_id, job_id, _extract_auth(request))
+        return web.Response(status=204)
+
+    @_route
+    async def collection_job_put(request: web.Request, task_id) -> web.Response:
+        job_id = CollectionJobId.from_str(request.match_info["collection_job_id"])
+        body = await request.read()
+        await aggregator.handle_create_collection_job(
+            task_id, job_id, body, _extract_auth(request)
+        )
+        return web.Response(status=201)
+
+    @_route
+    async def collection_job_post(request: web.Request, task_id) -> web.Response:
+        job_id = CollectionJobId.from_str(request.match_info["collection_job_id"])
+        collection = await aggregator.handle_get_collection_job(
+            task_id, job_id, _extract_auth(request)
+        )
+        if collection is None:
+            return web.Response(
+                status=202,
+                headers={"Retry-After": str(aggregator.config.collection_job_retry_after)},
+            )
+        from ..messages import Collection
+
+        return _wire(collection.get_encoded(), Collection.MEDIA_TYPE)
+
+    @_route
+    async def collection_job_delete(request: web.Request, task_id) -> web.Response:
+        job_id = CollectionJobId.from_str(request.match_info["collection_job_id"])
+        await aggregator.handle_delete_collection_job(
+            task_id, job_id, _extract_auth(request)
+        )
+        return web.Response(status=204)
+
+    @_route
+    async def aggregate_shares(request: web.Request, task_id) -> web.Response:
+        body = await request.read()
+        share = await aggregator.handle_aggregate_share(
+            task_id, body, _extract_auth(request)
+        )
+        return _wire(share.get_encoded(), AggregateShare.MEDIA_TYPE)
+
+    async def healthz(_request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def cors_preflight(_request: web.Request) -> web.Response:
+        # reference: http_handlers.rs CORS preflight for upload from browsers
+        return web.Response(
+            status=204,
+            headers={
+                "Access-Control-Allow-Origin": "*",
+                "Access-Control-Allow-Methods": "PUT, POST, GET",
+                "Access-Control-Allow-Headers": "content-type",
+            },
+        )
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app.add_routes(
+        [
+            web.get("/hpke_config", hpke_config),
+            web.get("/healthz", healthz),
+            web.put("/tasks/{task_id}/reports", upload),
+            web.options("/tasks/{task_id}/reports", cors_preflight),
+            web.put(
+                "/tasks/{task_id}/aggregation_jobs/{aggregation_job_id}",
+                aggregation_job_put,
+            ),
+            web.post(
+                "/tasks/{task_id}/aggregation_jobs/{aggregation_job_id}",
+                aggregation_job_post,
+            ),
+            web.delete(
+                "/tasks/{task_id}/aggregation_jobs/{aggregation_job_id}",
+                aggregation_job_delete,
+            ),
+            web.put(
+                "/tasks/{task_id}/collection_jobs/{collection_job_id}",
+                collection_job_put,
+            ),
+            web.post(
+                "/tasks/{task_id}/collection_jobs/{collection_job_id}",
+                collection_job_post,
+            ),
+            web.delete(
+                "/tasks/{task_id}/collection_jobs/{collection_job_id}",
+                collection_job_delete,
+            ),
+            web.post("/tasks/{task_id}/aggregate_shares", aggregate_shares),
+        ]
+    )
+    return app

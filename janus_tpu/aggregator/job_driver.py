@@ -1,0 +1,88 @@
+"""Generic lease-based job driver loop.
+
+The analog of ``JobDriver`` (reference:
+aggregator/src/binary_utils/job_driver.rs:26-266): periodically acquires
+leases on incomplete jobs (with jitter on the discovery interval),
+steps them concurrently under a semaphore bound, applies a per-job timeout
+derived from the lease expiry minus a clock-skew allowance, and drains
+gracefully on stop.  Crash recovery is inherent: an expired lease makes the
+job re-acquirable by any replica (SURVEY.md §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Awaitable, Callable, List, Optional
+
+from ..core.time import Clock
+from ..datastore.models import Lease
+from ..messages import Duration
+
+logger = logging.getLogger("janus_tpu.job_driver")
+
+
+class JobDriver:
+    def __init__(
+        self,
+        clock: Clock,
+        acquirer: Callable[[Duration, int], Awaitable[List[Lease]]],
+        stepper: Callable[[Lease], Awaitable[None]],
+        *,
+        job_discovery_interval: float = 1.0,
+        max_concurrent_job_workers: int = 10,
+        worker_lease_duration: Duration = Duration(600),
+        worker_lease_clock_skew_allowance: Duration = Duration(60),
+    ):
+        self.clock = clock
+        self.acquirer = acquirer
+        self.stepper = stepper
+        self.job_discovery_interval = job_discovery_interval
+        self.max_concurrent_job_workers = max_concurrent_job_workers
+        self.worker_lease_duration = worker_lease_duration
+        self.worker_lease_clock_skew_allowance = worker_lease_clock_skew_allowance
+        self._inflight: set = set()
+
+    async def run(self, stop: asyncio.Event) -> None:
+        """Run until ``stop`` is set, then drain in-flight steppers
+        (reference: job_driver.rs:100-149)."""
+        sem = asyncio.Semaphore(self.max_concurrent_job_workers)
+        while not stop.is_set():
+            free = self.max_concurrent_job_workers - len(self._inflight)
+            leases: List[Lease] = []
+            if free > 0:
+                try:
+                    leases = await self.acquirer(self.worker_lease_duration, free)
+                except Exception:
+                    logger.exception("job acquisition failed")
+            for lease in leases:
+                task = asyncio.ensure_future(self._step(sem, lease))
+                self._inflight.add(task)
+                task.add_done_callback(self._inflight.discard)
+            # jittered discovery sleep (reference: job_driver.rs discovery
+            # interval w/ jitter); cut short if stop is requested.
+            delay = self.job_discovery_interval * (0.5 + random.random())
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=delay)
+            except asyncio.TimeoutError:
+                pass
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+
+    async def _step(self, sem: asyncio.Semaphore, lease: Lease) -> None:
+        async with sem:
+            # per-job timeout: remaining lease minus skew allowance
+            # (reference: job_driver.rs:222-247)
+            timeout = max(
+                1.0,
+                lease.lease_expiry.seconds
+                - self.clock.now().seconds
+                - self.worker_lease_clock_skew_allowance.seconds,
+            )
+            try:
+                await asyncio.wait_for(self.stepper(lease), timeout=timeout)
+            except asyncio.TimeoutError:
+                logger.warning("job step timed out; lease will expire naturally")
+            except Exception:
+                logger.exception("job step failed")

@@ -1,0 +1,122 @@
+"""Batched upload writer.
+
+The analog of ``ReportWriteBatcher`` (reference:
+aggregator/src/aggregator/report_writer.rs:39-246): uploaded reports from all
+tasks are funneled into one background batcher that commits up to
+``max_batch_size`` of them in a single datastore transaction (or after
+``max_batch_write_delay`` elapses), fanning per-report results back to the
+waiting upload handlers.  In-batch duplicates by (task, report id) are
+resolved to a single write.  Rejected uploads increment the task's sharded
+upload counters (reference: report_writer.rs:324 TaskUploadCounters).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..datastore import Datastore, LeaderStoredReport, TaskUploadCounter, TxConflict
+from ..messages import TaskId
+from .error import ReportRejection
+
+
+class ReportWriteBatcher:
+    def __init__(
+        self,
+        datastore: Datastore,
+        max_batch_size: int = 100,
+        max_batch_write_delay: float = 0.25,
+        counter_shard_count: int = 8,
+    ):
+        self.datastore = datastore
+        self.max_batch_size = max_batch_size
+        self.max_batch_write_delay = max_batch_write_delay
+        self.counter_shard_count = counter_shard_count
+        self._queue: List[Tuple[object, asyncio.Future]] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------
+    async def write_report(self, report: LeaderStoredReport) -> None:
+        """Enqueue a validated report; resolves when its batch commits.
+        Raises ReportRejection if the store rejected it."""
+        fut = asyncio.get_running_loop().create_future()
+        async with self._lock:
+            self._queue.append((report, fut))
+            if len(self._queue) >= self.max_batch_size:
+                await self._flush_locked()
+            elif self._flush_handle is None:
+                loop = asyncio.get_running_loop()
+                self._flush_handle = loop.call_later(
+                    self.max_batch_write_delay,
+                    lambda: asyncio.ensure_future(self._flush()),
+                )
+        await fut
+
+    async def write_rejection(self, task_id: TaskId, rejection: ReportRejection) -> None:
+        """Record a rejected upload in the task's sharded counters."""
+        shard = random.randrange(self.counter_shard_count)
+        counter = TaskUploadCounter(task_id, **{rejection.category: 1})
+
+        def tx_fn(tx):
+            tx.increment_task_upload_counter(task_id, shard, counter)
+
+        await self.datastore.run_tx_async("upload_rejection", tx_fn)
+
+    async def _flush(self) -> None:
+        async with self._lock:
+            await self._flush_locked()
+
+    async def _flush_locked(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        batch, self._queue = self._queue, []
+        if not batch:
+            return
+        # In-batch dedup by (task, report id): first wins, dups succeed as
+        # idempotent uploads (reference: report_writer.rs:159-237).
+        seen: Dict[bytes, int] = {}
+        unique: List[Tuple[object, List[asyncio.Future]]] = []
+        for report, fut in batch:
+            key = report.task_id.data + report.report_id.data
+            if key in seen:
+                unique[seen[key]][1].append(fut)
+            else:
+                seen[key] = len(unique)
+                unique.append((report, [fut]))
+
+        def tx_fn(tx):
+            outcomes = []
+            shard = random.randrange(self.counter_shard_count)
+            for report, _futs in unique:
+                try:
+                    tx.put_client_report(report)
+                    tx.increment_task_upload_counter(
+                        report.task_id,
+                        shard,
+                        TaskUploadCounter(report.task_id, report_success=1),
+                    )
+                    outcomes.append(None)
+                except TxConflict:
+                    # duplicate upload: idempotent success
+                    outcomes.append(None)
+            return outcomes
+
+        try:
+            outcomes = await self.datastore.run_tx_async("upload_batch", tx_fn)
+        except Exception as e:  # commit failed: fan the error to every waiter
+            for _report, futs in unique:
+                for fut in futs:
+                    if not fut.done():
+                        fut.set_exception(e)
+            return
+        for (report, futs), outcome in zip(unique, outcomes):
+            for fut in futs:
+                if fut.done():
+                    continue
+                if outcome is None:
+                    fut.set_result(None)
+                else:
+                    fut.set_exception(outcome)

@@ -1,0 +1,1 @@
+"""Binaries: daemons + ops CLI (reference: aggregator/src/binaries/)."""

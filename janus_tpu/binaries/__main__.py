@@ -1,0 +1,5 @@
+from .main import main
+
+import sys
+
+sys.exit(main())

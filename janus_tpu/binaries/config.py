@@ -1,0 +1,139 @@
+"""Layered configuration: YAML file + environment-variable secrets.
+
+The analog of the reference's config system (reference:
+aggregator/src/config.rs:31-199, binary_utils.rs:49,207-238): a
+``CommonConfig`` shared by every binary (database, health port, logging),
+per-binary sections with defaults, and secrets (datastore keys, auth tokens)
+taken from the environment, never the file.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import yaml
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class DbConfig:
+    """reference: config.rs:75 DbConfig"""
+
+    path: str = "janus_tpu.sqlite3"
+
+
+@dataclass
+class CommonConfig:
+    """reference: config.rs:31 CommonConfig"""
+
+    database: DbConfig = field(default_factory=DbConfig)
+    health_check_listen_address: str = "127.0.0.1:8000"
+    max_transaction_retries: int = 30
+    log_level: str = "INFO"
+
+
+@dataclass
+class JobDriverConfig:
+    """reference: config.rs:172 JobDriverConfig"""
+
+    job_discovery_interval_s: float = 10.0
+    max_concurrent_job_workers: int = 10
+    worker_lease_duration_s: int = 600
+    worker_lease_clock_skew_allowance_s: int = 60
+    maximum_attempts_before_failure: int = 10
+
+
+@dataclass
+class AggregatorConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    listen_address: str = "0.0.0.0:8080"
+    max_upload_batch_size: int = 100
+    max_upload_batch_write_delay_ms: int = 250
+    batch_aggregation_shard_count: int = 8
+    task_counter_shard_count: int = 8
+    #: "tpu" routes whole-job prepare through one batched device launch.
+    vdaf_backend: str = "tpu"
+    garbage_collection_interval_s: Optional[float] = None
+
+
+@dataclass
+class JobCreatorConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    aggregation_job_creation_interval_s: float = 60.0
+    min_aggregation_job_size: int = 10
+    max_aggregation_job_size: int = 256
+    batch_aggregation_shard_count: int = 8
+
+
+@dataclass
+class JobDriverBinaryConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    job_driver: JobDriverConfig = field(default_factory=JobDriverConfig)
+    batch_aggregation_shard_count: int = 8
+    vdaf_backend: str = "tpu"
+
+
+def _merge_dataclass(cls, data: dict):
+    """Build a (possibly nested) config dataclass from a YAML dict, applying
+    defaults for absent keys and rejecting unknown ones."""
+    import dataclasses
+
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"expected mapping for {cls.__name__}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ConfigError(f"unknown {cls.__name__} keys: {sorted(unknown)}")
+    # `from __future__ import annotations` makes f.type a string; resolve
+    # nested config classes by name.
+    nested = {c.__name__: c for c in (CommonConfig, DbConfig, JobDriverConfig)}
+    kwargs = {}
+    for name, f in fields.items():
+        if name not in data:
+            continue
+        type_name = f.type if isinstance(f.type, str) else getattr(f.type, "__name__", "")
+        if type_name in nested:
+            kwargs[name] = _merge_dataclass(nested[type_name], data[name])
+        else:
+            kwargs[name] = data[name]
+    return cls(**kwargs)
+
+
+def load_config(cls, path: Optional[str] = None, text: Optional[str] = None):
+    """Load a binary's config from YAML (path or literal text)."""
+    if text is None:
+        if path is None:
+            return cls()
+        with open(path) as f:
+            text = f.read()
+    return _merge_dataclass(cls, yaml.safe_load(text))
+
+
+# -- secrets from the environment (reference: binary_utils.rs:207-238) ------
+
+
+def datastore_keys_from_env() -> List[bytes]:
+    """DATASTORE_KEYS: comma-separated base64url AES-128 keys; first one
+    encrypts (reference: janus_cli create-datastore-key)."""
+    raw = os.environ.get("DATASTORE_KEYS")
+    if not raw:
+        raise ConfigError("DATASTORE_KEYS environment variable is required")
+    keys = []
+    for part in raw.split(","):
+        part = part.strip()
+        pad = "=" * (-len(part) % 4)
+        keys.append(base64.urlsafe_b64decode(part + pad))
+    return keys
+
+
+def parse_listen_address(addr: str):
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)

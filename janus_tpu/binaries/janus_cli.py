@@ -1,0 +1,304 @@
+"""Ops CLI.
+
+The analog of ``janus_cli`` plus the ``tools`` crate binaries (reference:
+aggregator/src/binaries/janus_cli.rs:70-177, tools/src/bin/{dap_decode,
+hpke_keygen}.rs, tools/src/bin/collect): task provisioning from YAML,
+datastore/HPKE key generation, wire-message decoding, and a collector
+front-end.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import secrets
+import sys
+
+import click
+
+
+def _b64u(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64u(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+@click.group()
+def cli():
+    """janus_tpu operations CLI."""
+
+
+@cli.command("create-datastore-key")
+def create_datastore_key():
+    """Generate a datastore column-encryption key (reference:
+    janus_cli.rs create-datastore-key)."""
+    click.echo(_b64u(secrets.token_bytes(16)))
+
+
+@cli.command("generate-hpke-key")
+@click.option("--id", "config_id", type=int, default=1, help="HPKE config id")
+def generate_hpke_key(config_id: int):
+    """Generate an HPKE keypair (reference: tools/src/bin/hpke_keygen.rs:13)."""
+    from ..core.hpke import HpkeKeypair
+
+    kp = HpkeKeypair.generate(config_id)
+    click.echo(
+        json.dumps(
+            {
+                "config": _b64u(kp.config.get_encoded()),
+                "private_key": _b64u(kp.private_key),
+                "id": config_id,
+            }
+        )
+    )
+
+
+@cli.command("provision-tasks")
+@click.argument("tasks_file", type=click.Path(exists=True))
+@click.option("--config-file", type=click.Path(exists=True), default=None)
+def provision_tasks(tasks_file: str, config_file):
+    """Provision tasks from a YAML file into the datastore (reference:
+    janus_cli.rs provision-tasks).
+
+    Each task entry: task_id (b64url, optional — generated if absent),
+    peer_aggregator_endpoint, query_type ({kind, max_batch_size?}), vdaf
+    ({type, ...params}), role (Leader|Helper), vdaf_verify_key (b64url),
+    min_batch_size, time_precision_s, auth tokens, collector_hpke_config,
+    hpke_keys.
+    """
+    import yaml
+
+    from ..core.auth_tokens import AuthenticationToken
+    from ..core.hpke import HpkeKeypair
+    from ..core.time import RealClock
+    from ..datastore import (
+        AggregatorTask,
+        Crypter,
+        Datastore,
+        TaskQueryType,
+    )
+    from ..messages import Duration, HpkeConfig, Role, TaskId, Time
+    from .config import AggregatorConfig, datastore_keys_from_env, load_config
+
+    cfg = load_config(AggregatorConfig, config_file)
+    ds = Datastore(
+        cfg.common.database.path, Crypter(datastore_keys_from_env()), RealClock()
+    )
+    with open(tasks_file) as f:
+        entries = yaml.safe_load(f)
+    for entry in entries:
+        qt = entry.get("query_type", {"kind": "TimeInterval"})
+        btws = qt.get("batch_time_window_size")
+        task = AggregatorTask(
+            task_id=TaskId(_unb64u(entry["task_id"]))
+            if "task_id" in entry
+            else TaskId.random(),
+            peer_aggregator_endpoint=entry["peer_aggregator_endpoint"],
+            query_type=TaskQueryType(
+                qt["kind"],
+                qt.get("max_batch_size"),
+                Duration(btws) if btws is not None else None,
+            ),
+            vdaf=entry["vdaf"],
+            role=Role[entry["role"].upper()],
+            vdaf_verify_key=_unb64u(entry["vdaf_verify_key"]),
+            min_batch_size=entry["min_batch_size"],
+            time_precision=Duration(entry["time_precision_s"]),
+            task_expiration=Time(entry["task_expiration"])
+            if entry.get("task_expiration")
+            else None,
+            report_expiry_age=Duration(entry["report_expiry_age_s"])
+            if entry.get("report_expiry_age_s")
+            else None,
+            aggregator_auth_token=AuthenticationToken.new_bearer(
+                entry["aggregator_auth_token"]
+            )
+            if entry.get("aggregator_auth_token")
+            else None,
+            aggregator_auth_token_hash=AuthenticationToken.new_bearer(
+                entry["aggregator_auth_token_for_hash"]
+            ).hash()
+            if entry.get("aggregator_auth_token_for_hash")
+            else None,
+            collector_auth_token_hash=AuthenticationToken.new_bearer(
+                entry["collector_auth_token_for_hash"]
+            ).hash()
+            if entry.get("collector_auth_token_for_hash")
+            else None,
+            collector_hpke_config=HpkeConfig.get_decoded(
+                _unb64u(entry["collector_hpke_config"])
+            )
+            if entry.get("collector_hpke_config")
+            else None,
+            hpke_keys=[
+                HpkeKeypair(
+                    HpkeConfig.get_decoded(_unb64u(k["config"])),
+                    _unb64u(k["private_key"]),
+                )
+                for k in entry.get("hpke_keys", [])
+            ],
+        )
+        ds.run_tx("provision_task", lambda tx, t=task: tx.put_aggregator_task(t))
+        click.echo(f"provisioned task {task.task_id}")
+
+
+@cli.command("generate-global-hpke-key")
+@click.option("--id", "config_id", type=int, required=True)
+@click.option("--config-file", type=click.Path(exists=True), default=None)
+def generate_global_hpke_key(config_id: int, config_file):
+    """Generate + store a global HPKE key (reference: janus_cli.rs
+    generate-global-hpke-key)."""
+    from ..core.hpke import HpkeKeypair
+    from ..core.time import RealClock
+    from ..datastore import Crypter, Datastore
+    from .config import AggregatorConfig, datastore_keys_from_env, load_config
+
+    cfg = load_config(AggregatorConfig, config_file)
+    ds = Datastore(
+        cfg.common.database.path, Crypter(datastore_keys_from_env()), RealClock()
+    )
+    kp = HpkeKeypair.generate(config_id)
+    ds.run_tx("put_global_key", lambda tx: tx.put_global_hpke_keypair(kp))
+    click.echo(f"generated global HPKE key {config_id}")
+
+
+@cli.command("set-global-hpke-key-state")
+@click.option("--id", "config_id", type=int, required=True)
+@click.option(
+    "--state", type=click.Choice(["Pending", "Active", "Expired"]), required=True
+)
+@click.option("--config-file", type=click.Path(exists=True), default=None)
+def set_global_hpke_key_state(config_id: int, state: str, config_file):
+    """reference: janus_cli.rs set-global-hpke-key-state"""
+    from ..core.time import RealClock
+    from ..datastore import Crypter, Datastore, HpkeKeyState
+    from .config import AggregatorConfig, datastore_keys_from_env, load_config
+
+    cfg = load_config(AggregatorConfig, config_file)
+    ds = Datastore(
+        cfg.common.database.path, Crypter(datastore_keys_from_env()), RealClock()
+    )
+    ds.run_tx(
+        "set_key_state",
+        lambda tx: tx.set_global_hpke_keypair_state(config_id, HpkeKeyState(state)),
+    )
+    click.echo("ok")
+
+
+@cli.command("dap-decode")
+@click.argument("message_file", type=click.Path(exists=True))
+@click.option(
+    "--media-type",
+    required=True,
+    help="DAP media type, e.g. application/dap-report",
+)
+@click.option(
+    "--query-type",
+    type=click.Choice(["TimeInterval", "FixedSize"]),
+    default="TimeInterval",
+)
+def dap_decode(message_file: str, media_type: str, query_type: str):
+    """Decode a DAP wire message to a readable repr
+    (reference: tools/src/bin/dap_decode.rs:15)."""
+    from .. import messages as m
+
+    by_media = {
+        "application/dap-hpke-config": m.HpkeConfig,
+        "application/dap-hpke-config-list": m.HpkeConfigList,
+        "application/dap-report": m.Report,
+        "application/dap-aggregation-job-init-req": m.AggregationJobInitializeReq,
+        "application/dap-aggregation-job-continue-req": m.AggregationJobContinueReq,
+        "application/dap-aggregation-job-resp": m.AggregationJobResp,
+        "application/dap-collect-req": m.CollectionReq,
+        "application/dap-collection": m.Collection,
+        "application/dap-aggregate-share-req": m.AggregateShareReq,
+        "application/dap-aggregate-share": m.AggregateShare,
+    }
+    cls = by_media.get(media_type)
+    if cls is None:
+        raise click.ClickException(f"unknown media type {media_type}")
+    with open(message_file, "rb") as f:
+        data = f.read()
+    qt = m.TimeInterval if query_type == "TimeInterval" else m.FixedSize
+    try:
+        msg = cls.get_decoded(data, qt)
+    except TypeError:
+        msg = cls.get_decoded(data)
+    click.echo(repr(msg))
+
+
+@cli.command("collect")
+@click.option("--task-id", required=True, help="b64url task id")
+@click.option("--leader", required=True, help="leader endpoint URL")
+@click.option("--vdaf", "vdaf_json", required=True, help="VDAF instance JSON")
+@click.option("--auth-token", required=True, help="collector bearer token")
+@click.option("--hpke-config", required=True, help="b64url collector HpkeConfig")
+@click.option("--hpke-private-key", required=True, help="b64url private key")
+@click.option("--batch-interval-start", type=int, default=None)
+@click.option("--batch-interval-duration", type=int, default=None)
+@click.option("--current-batch", is_flag=True, default=False)
+def collect(
+    task_id,
+    leader,
+    vdaf_json,
+    auth_token,
+    hpke_config,
+    hpke_private_key,
+    batch_interval_start,
+    batch_interval_duration,
+    current_batch,
+):
+    """Collector front-end (reference: tools collect CLI, 1,604 LoC)."""
+    import asyncio
+
+    from ..collector import Collector
+    from ..core.auth_tokens import AuthenticationToken
+    from ..core.hpke import HpkeKeypair
+    from ..messages import (
+        Duration,
+        FixedSizeQuery,
+        HpkeConfig,
+        Interval,
+        Query,
+        TaskId,
+        Time,
+    )
+    from ..vdaf.instances import vdaf_from_instance
+
+    vdaf = vdaf_from_instance(json.loads(vdaf_json))
+    collector = Collector(
+        task_id=TaskId(_unb64u(task_id)),
+        leader_endpoint=leader,
+        vdaf=vdaf,
+        auth_token=AuthenticationToken.new_bearer(auth_token),
+        hpke_keypair=HpkeKeypair(
+            HpkeConfig.get_decoded(_unb64u(hpke_config)), _unb64u(hpke_private_key)
+        ),
+    )
+    if current_batch:
+        query = Query.new_fixed_size(FixedSizeQuery.current_batch())
+    else:
+        if batch_interval_start is None or batch_interval_duration is None:
+            raise click.ClickException(
+                "either --current-batch or --batch-interval-start/duration required"
+            )
+        query = Query.new_time_interval(
+            Interval(Time(batch_interval_start), Duration(batch_interval_duration))
+        )
+    result = asyncio.run(collector.collect(query))
+    click.echo(
+        json.dumps(
+            {
+                "report_count": result.report_count,
+                "interval_start": result.interval.start.seconds,
+                "interval_duration": result.interval.duration.seconds,
+                "aggregate_result": result.aggregate_result,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    cli()

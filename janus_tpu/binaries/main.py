@@ -1,0 +1,285 @@
+"""Multi-call binary entry point.
+
+The analog of the reference's single multi-call binary (reference:
+aggregator/src/main.rs:93, binary_utils.rs:249 janus_main): one entry
+dispatches by subcommand to the four long-running daemons and the ops CLI:
+
+    python -m janus_tpu.binaries aggregator --config-file cfg.yaml
+    python -m janus_tpu.binaries aggregation_job_creator ...
+    python -m janus_tpu.binaries aggregation_job_driver ...
+    python -m janus_tpu.binaries collection_job_driver ...
+    python -m janus_tpu.binaries janus_cli <subcommand> ...
+
+Bootstrap per binary: config load → logging → datastore (keys from env) →
+SIGTERM-driven graceful stop → healthz server → main loop
+(reference: binary_utils.rs:249-518).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+from typing import Optional
+
+from ..core.time import RealClock
+from ..datastore import Crypter, Datastore
+from ..messages import Duration
+from .config import (
+    AggregatorConfig,
+    JobCreatorConfig,
+    JobDriverBinaryConfig,
+    datastore_keys_from_env,
+    load_config,
+    parse_listen_address,
+)
+
+logger = logging.getLogger("janus_tpu.binaries")
+
+
+def _bootstrap(config_common):
+    logging.basicConfig(
+        level=getattr(logging, config_common.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    clock = RealClock()
+    crypter = Crypter(datastore_keys_from_env())
+    datastore = Datastore(
+        config_common.database.path,
+        crypter,
+        clock,
+        max_transaction_retries=config_common.max_transaction_retries,
+    )
+    return clock, datastore
+
+
+def _stop_event_on_signals(loop) -> asyncio.Event:
+    """SIGTERM/SIGINT → graceful stop (reference: binary_utils.rs:458)."""
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    return stop
+
+
+async def _serve_health(listen_address: str):
+    from aiohttp import web
+
+    async def healthz(_):
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.add_routes([web.get("/healthz", healthz)])
+    runner = web.AppRunner(app)
+    await runner.setup()
+    host, port = parse_listen_address(listen_address)
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner
+
+
+# ---------------------------------------------------------------------------
+
+
+def run_aggregator(config_path: Optional[str]) -> None:
+    """DAP HTTP server + optional GC loop
+    (reference: binaries/aggregator.rs:31-150)."""
+    cfg = load_config(AggregatorConfig, config_path)
+    clock, datastore = _bootstrap(cfg.common)
+
+    from aiohttp import web
+
+    from ..aggregator import Aggregator, Config, GarbageCollector, aggregator_app
+
+    agg = Aggregator(
+        datastore,
+        clock,
+        Config(
+            max_upload_batch_size=cfg.max_upload_batch_size,
+            max_upload_batch_write_delay=cfg.max_upload_batch_write_delay_ms / 1000.0,
+            batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+            task_counter_shard_count=cfg.task_counter_shard_count,
+            vdaf_backend=cfg.vdaf_backend,
+        ),
+    )
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        stop = _stop_event_on_signals(loop)
+        health = await _serve_health(cfg.common.health_check_listen_address)
+        app = aggregator_app(agg)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        host, port = parse_listen_address(cfg.listen_address)
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        logger.info("aggregator serving on %s", cfg.listen_address)
+
+        async def gc_loop():
+            gc = GarbageCollector(datastore)
+            while not stop.is_set():
+                try:
+                    await gc.run_once()
+                except Exception:
+                    logger.exception("GC pass failed")
+                try:
+                    await asyncio.wait_for(
+                        stop.wait(), timeout=cfg.garbage_collection_interval_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+        tasks = []
+        if cfg.garbage_collection_interval_s:
+            tasks.append(asyncio.ensure_future(gc_loop()))
+        await stop.wait()
+        for t in tasks:
+            t.cancel()
+        await runner.cleanup()
+        await health.cleanup()
+
+    asyncio.run(main())
+
+
+def run_aggregation_job_creator(config_path: Optional[str]) -> None:
+    """reference: binaries/aggregation_job_creator.rs"""
+    cfg = load_config(JobCreatorConfig, config_path)
+    clock, datastore = _bootstrap(cfg.common)
+
+    from ..aggregator import AggregationJobCreator, CreatorConfig
+
+    creator = AggregationJobCreator(
+        datastore,
+        CreatorConfig(
+            min_aggregation_job_size=cfg.min_aggregation_job_size,
+            max_aggregation_job_size=cfg.max_aggregation_job_size,
+            batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+        ),
+    )
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        stop = _stop_event_on_signals(loop)
+        health = await _serve_health(cfg.common.health_check_listen_address)
+        while not stop.is_set():
+            try:
+                n = await creator.run_once()
+                if n:
+                    logger.info("created %d aggregation jobs", n)
+            except Exception:
+                logger.exception("creation pass failed")
+            try:
+                await asyncio.wait_for(
+                    stop.wait(), timeout=cfg.aggregation_job_creation_interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+        await health.cleanup()
+
+    asyncio.run(main())
+
+
+def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
+    """Shared wiring for the two lease-driven drivers
+    (reference: binaries/aggregation_job_driver.rs:12-66)."""
+    cfg = load_config(JobDriverBinaryConfig, config_path)
+    clock, datastore = _bootstrap(cfg.common)
+
+    import aiohttp
+
+    from ..aggregator import (
+        AggregationJobDriver,
+        CollectionJobDriver,
+        DriverConfig,
+        JobDriver,
+    )
+
+    if kind == "aggregation":
+        stepper_impl = AggregationJobDriver(
+            datastore,
+            aiohttp.ClientSession,
+            DriverConfig(
+                batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+                maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
+                vdaf_backend=cfg.vdaf_backend,
+            ),
+        )
+
+        async def acquirer(duration, limit):
+            return await datastore.run_tx_async(
+                "acquire_agg",
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(duration, limit),
+            )
+
+        stepper = stepper_impl.step_aggregation_job
+    else:
+        stepper_impl = CollectionJobDriver(datastore, aiohttp.ClientSession)
+
+        async def acquirer(duration, limit):
+            return await datastore.run_tx_async(
+                "acquire_coll",
+                lambda tx: tx.acquire_incomplete_collection_jobs(duration, limit),
+            )
+
+        stepper = stepper_impl.step_collection_job
+
+    driver = JobDriver(
+        clock,
+        acquirer,
+        stepper,
+        job_discovery_interval=cfg.job_driver.job_discovery_interval_s,
+        max_concurrent_job_workers=cfg.job_driver.max_concurrent_job_workers,
+        worker_lease_duration=Duration(cfg.job_driver.worker_lease_duration_s),
+        worker_lease_clock_skew_allowance=Duration(
+            cfg.job_driver.worker_lease_clock_skew_allowance_s
+        ),
+    )
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        stop = _stop_event_on_signals(loop)
+        health = await _serve_health(cfg.common.health_check_listen_address)
+        await driver.run(stop)
+        await health.cleanup()
+
+    asyncio.run(main())
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(
+            "usage: python -m janus_tpu.binaries "
+            "{aggregator|aggregation_job_creator|aggregation_job_driver|"
+            "collection_job_driver|janus_cli} [--config-file F] ...",
+            file=sys.stderr,
+        )
+        return 2
+    binary = argv.pop(0)
+    config_path = None
+    if argv[:1] == ["--config-file"]:
+        config_path = argv[1]
+        argv = argv[2:]
+    if binary == "aggregator":
+        run_aggregator(config_path)
+    elif binary == "aggregation_job_creator":
+        run_aggregation_job_creator(config_path)
+    elif binary == "aggregation_job_driver":
+        _run_job_driver_binary(config_path, "aggregation")
+    elif binary == "collection_job_driver":
+        _run_job_driver_binary(config_path, "collection")
+    elif binary == "janus_cli":
+        from .janus_cli import cli
+
+        cli.main(args=argv, standalone_mode=True)
+    else:
+        print(f"unknown binary {binary!r}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

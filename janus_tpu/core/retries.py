@@ -1,0 +1,75 @@
+"""HTTP retry policy: exponential backoff over retryable failures.
+
+The analog of ``retry_http_request`` (reference: core/src/retries.rs:102-205):
+network errors and retryable status codes (server overload / transient
+upstream failures) are retried with capped exponential backoff + jitter;
+everything else returns immediately.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+
+def is_retryable_http_status(status: int) -> bool:
+    """reference: core/src/retries.rs:205"""
+    return status in (408, 429, 500, 502, 503, 504)
+
+
+@dataclass
+class HttpRetryPolicy:
+    """reference: core/src/retries.rs:33 backoff parameters"""
+
+    initial_interval: float = 0.1
+    max_interval: float = 5.0
+    multiplier: float = 2.0
+    max_elapsed: float = 30.0
+    max_attempts: int = 10
+
+    def for_tests(self) -> "HttpRetryPolicy":
+        return HttpRetryPolicy(0.001, 0.01, 2.0, 0.5, 3)
+
+
+async def retry_http_request(
+    session,
+    method: str,
+    url: str,
+    *,
+    data: Optional[bytes] = None,
+    headers: Optional[dict] = None,
+    policy: Optional[HttpRetryPolicy] = None,
+) -> Tuple[int, bytes, dict]:
+    """Issue a request, retrying retryable outcomes.  Returns
+    (status, body, headers); raises the last connection error if every
+    attempt failed at the transport layer."""
+    import aiohttp
+
+    policy = policy or HttpRetryPolicy()
+    interval = policy.initial_interval
+    elapsed = 0.0
+    last_exc: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        try:
+            async with session.request(
+                method, url, data=data, headers=headers
+            ) as resp:
+                body = await resp.read()
+                if not is_retryable_http_status(resp.status):
+                    return resp.status, body, dict(resp.headers)
+                last_exc = None
+                last = (resp.status, body, dict(resp.headers))
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            last_exc = e
+            last = None
+        if elapsed >= policy.max_elapsed or attempt == policy.max_attempts - 1:
+            break
+        sleep = interval * (0.5 + random.random())
+        await asyncio.sleep(sleep)
+        elapsed += sleep
+        interval = min(interval * policy.multiplier, policy.max_interval)
+    if last_exc is not None:
+        raise last_exc
+    return last

@@ -15,8 +15,10 @@ Bit-exact against the oracle in janus_tpu.xof (tests/test_ops_keccak.py).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -140,6 +142,7 @@ def _pad_message(msg: jnp.ndarray, domain: int) -> jnp.ndarray:
     return jnp.concatenate([msg, pad_arr], axis=-1)
 
 
+@partial(jax.jit, static_argnums=(1, 2))
 def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndarray:
     """One-shot TurboSHAKE128 over a batch: msg (..., L) u8 -> (..., out_len) u8.
 
@@ -174,6 +177,7 @@ def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndar
     return out_bytes[..., :out_len]
 
 
+@partial(jax.jit, static_argnums=(1, 3))
 def xof_turboshake128_batch(
     seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, out_len: int
 ) -> jnp.ndarray:

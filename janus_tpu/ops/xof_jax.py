@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +37,10 @@ def _is_canonical(jf: JField, limbs: jnp.ndarray) -> jnp.ndarray:
     return borrow == 1
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnums=(0, 2, 4))
 def xof_next_vec_batch(
     jf: JField, seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, length: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:

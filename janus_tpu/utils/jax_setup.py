@@ -2,21 +2,38 @@
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def enable_compile_cache(cache_dir: str = None) -> None:
-    """Point XLA's persistent compilation cache at <repo>/.jax_cache.
+    """Point XLA's persistent compilation cache at <repo>/.jax_cache/<config>.
 
     The limb-arithmetic graphs are large; caching makes every re-run of the
     same (circuit, batch) shape start in milliseconds instead of minutes.
+
+    The cache is scoped per (JAX_PLATFORMS, XLA_FLAGS) configuration:
+    executables AOT-compiled under one configuration (e.g. the real TPU
+    platform, or a different host-feature set) must never be loaded under
+    another — XLA logs machine-feature mismatches and can hang or SIGILL
+    executing them.  XLA-internal AOT kernel caches are disabled for the
+    same reason; only the JAX-level executable cache is persisted.
     """
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir", cache_dir or os.path.join(_REPO_ROOT, ".jax_cache")
+    config_key = (
+        os.environ.get("JAX_PLATFORMS", "default")
+        + "|"
+        + os.environ.get("XLA_FLAGS", "")
     )
+    sub = hashlib.sha256(config_key.encode()).hexdigest()[:12]
+    path = cache_dir or os.path.join(_REPO_ROOT, ".jax_cache", sub)
+    jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except AttributeError:
+        pass

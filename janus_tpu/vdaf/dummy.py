@@ -42,6 +42,27 @@ class DummyPrepState:
     round: int
 
 
+class DummyField:
+    """Minimal field surface for out-share accumulation (u64 counters)."""
+
+    ENCODED_SIZE = 8
+    MODULUS = 1 << 64
+
+    @classmethod
+    def vec_add(cls, a, b):
+        return [(x + y) % cls.MODULUS for x, y in zip(a, b)]
+
+    @classmethod
+    def encode_vec(cls, vec) -> bytes:
+        return b"".join(int(x).to_bytes(8, "little") for x in vec)
+
+    @classmethod
+    def decode_vec(cls, data: bytes):
+        if len(data) % 8:
+            raise VdafError("bad dummy vector length")
+        return [int.from_bytes(data[i : i + 8], "little") for i in range(0, len(data), 8)]
+
+
 class DummyVdaf:
     """Test VDAF with ``rounds`` ping-pong prepare rounds (>= 1)."""
 
@@ -49,6 +70,7 @@ class DummyVdaf:
     VERIFY_KEY_SIZE = 0
     RAND_SIZE = 0
     ROUNDS: int
+    field = DummyField
 
     def __init__(self, rounds: int = 1):
         if rounds < 1:
@@ -73,6 +95,20 @@ class DummyVdaf:
         if data:
             raise VdafError("unexpected public share")
         return None
+
+    # Uniform VDAF surface consumed by role logic.
+    def decode_input_share(self, agg_id: int, data: bytes) -> DummyInputShare:
+        return DummyInputShare.decode(self, agg_id, data)
+
+    def encode_agg_param(self, agg_param) -> bytes:
+        return b"" if agg_param is None else struct.pack(">I", int(agg_param))
+
+    def decode_agg_param(self, data: bytes):
+        if not data:
+            return None
+        if len(data) != 4:
+            raise VdafError("bad dummy aggregation parameter")
+        return struct.unpack(">I", data)[0]
 
     # -- ping-pong adapter surface --------------------------------------
     def ping_pong_prep_init(self, verify_key, agg_id, agg_param, nonce, public_share, input_share):

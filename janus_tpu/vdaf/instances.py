@@ -67,14 +67,38 @@ def prio3_sum_vec_field64_multiproof_hmacsha256_aes128(
     )
 
 
+def _fake(rounds: int = 1):
+    from .dummy import DummyVdaf
+
+    return DummyVdaf(rounds)
+
+
+def _fake_fails_prep_init(rounds: int = 1):
+    from .dummy import FakeFailsPrepInit
+
+    return FakeFailsPrepInit(rounds)
+
+
+def _fake_fails_prep_step(rounds: int = 1):
+    from .dummy import FakeFailsPrepStep
+
+    return FakeFailsPrepStep(rounds)
+
+
 # Serializable registry keyed the way the reference names instances
-# (core/src/vdaf.rs:65-108).  Values: constructor taking the instance's params.
+# (core/src/vdaf.rs:65-108).  Values: constructor taking the instance's
+# params.  The Fake* test VDAFs mirror the reference's test-util instances
+# (core/src/vdaf.rs:96-108): no real crypto, configurable round count,
+# fault injection.
 VDAF_INSTANCES: Dict[str, Callable[..., Prio3]] = {
     "Prio3Count": prio3_count,
     "Prio3Sum": prio3_sum,
     "Prio3SumVec": prio3_sum_vec,
     "Prio3Histogram": prio3_histogram,
     "Prio3SumVecField64MultiproofHmacSha256Aes128": prio3_sum_vec_field64_multiproof_hmacsha256_aes128,
+    "Fake": _fake,
+    "FakeFailsPrepInit": _fake_fails_prep_init,
+    "FakeFailsPrepStep": _fake_fails_prep_step,
 }
 
 

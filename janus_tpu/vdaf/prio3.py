@@ -381,3 +381,22 @@ class Prio3:
         if len(data) != self.num_shares * l:
             raise VdafError("bad public share length")
         return [data[i : i + l] for i in range(0, len(data), l)]
+
+    # Uniform VDAF surface consumed by role logic (the analog of the
+    # prio::vdaf::Aggregator assoc-type codecs, SURVEY.md §2.2).
+    @property
+    def field(self):
+        return self.flp.field
+
+    def decode_input_share(self, agg_id: int, data: bytes) -> Prio3InputShare:
+        return Prio3InputShare.decode(self, agg_id, data)
+
+    def encode_agg_param(self, agg_param) -> bytes:
+        if agg_param is not None:
+            raise VdafError("Prio3 takes no aggregation parameter")
+        return b""
+
+    def decode_agg_param(self, data: bytes):
+        if data:
+            raise VdafError("Prio3 takes no aggregation parameter")
+        return None

@@ -1,0 +1,580 @@
+"""Role-logic handler tests: upload, aggregate init/continue, aggregate share.
+
+The analog of the reference's handler/component test layer (SURVEY.md §4.3;
+reference: aggregator/src/aggregator/http_handlers/tests/) — drives the
+Aggregator façade directly against an ephemeral datastore, no HTTP.
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.error import (
+    AggregatorError,
+    ForbiddenMutation,
+    InvalidMessage,
+    ReportTooEarly,
+    UnauthorizedRequest,
+)
+from janus_tpu.client import prepare_report
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import (
+    HpkeApplicationInfo,
+    HpkeKeypair,
+    Label,
+    open_,
+)
+from janus_tpu.core.report_id import checksum_updated_with
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import (
+    AggregatorTask,
+    BatchAggregationState,
+    ReportAggregationState,
+    TaskQueryType,
+)
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import (
+    AggregateShareReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    BatchSelector,
+    Duration,
+    Interval,
+    PartialBatchSelector,
+    PrepareError,
+    PrepareInit,
+    PrepareResp,
+    PrepareStepResult,
+    ReportIdChecksum,
+    ReportShare,
+    Role,
+    TaskId,
+    Time,
+)
+from janus_tpu.vdaf import pingpong as pp
+from janus_tpu.vdaf.dummy import DummyVdaf
+from janus_tpu.vdaf.instances import vdaf_from_instance
+
+TIME_PRECISION = Duration(3600)
+NOW = Time(1_600_002_000)  # aligned to TIME_PRECISION
+
+AGG_TOKEN = AuthenticationToken.new_bearer("agg-token")
+COL_TOKEN = AuthenticationToken.new_bearer("col-token")
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def make_pair_tasks(vdaf_desc, query_type=None):
+    """Leader + helper views of one task, sharing keys."""
+    task_id = TaskId.random()
+    leader_keys = [HpkeKeypair.generate(1)]
+    helper_keys = [HpkeKeypair.generate(2)]
+    collector_keys = HpkeKeypair.generate(3)
+    vk = b"\x2a" * (32 if "Multiproof" in vdaf_desc["type"] else 16)
+    common = dict(
+        task_id=task_id,
+        query_type=query_type or TaskQueryType.time_interval(),
+        vdaf=vdaf_desc,
+        vdaf_verify_key=vk,
+        min_batch_size=1,
+        time_precision=TIME_PRECISION,
+        collector_hpke_config=collector_keys.config,
+    )
+    leader = AggregatorTask(
+        peer_aggregator_endpoint="https://helper.example.com/",
+        role=Role.LEADER,
+        aggregator_auth_token=AGG_TOKEN,
+        collector_auth_token_hash=COL_TOKEN.hash(),
+        hpke_keys=leader_keys,
+        **common,
+    )
+    helper = AggregatorTask(
+        peer_aggregator_endpoint="https://leader.example.com/",
+        role=Role.HELPER,
+        aggregator_auth_token_hash=AGG_TOKEN.hash(),
+        hpke_keys=helper_keys,
+        **common,
+    )
+    return leader, helper, collector_keys
+
+
+@pytest.fixture()
+def env():
+    eds = EphemeralDatastore(MockClock(NOW))
+    agg = Aggregator(eds.datastore, eds.clock, Config(vdaf_backend="oracle"))
+    yield eds.datastore, agg
+    eds.cleanup()
+
+
+def leader_prep_inits(vdaf, leader_task, helper_task, measurements):
+    """Leader-side init: shard reports (client), leader prep (oracle), build
+    PrepareInits for the helper — what the AggregationJobDriver does."""
+    inits, states, reports = [], [], []
+    for m in measurements:
+        report = prepare_report(
+            vdaf,
+            leader_task.task_id,
+            leader_task.hpke_keys[0].config,
+            helper_task.hpke_keys[0].config,
+            TIME_PRECISION,
+            m,
+            time=NOW,
+        )
+        # leader opens its own share (as the upload handler would)
+        from janus_tpu.messages import InputShareAad, PlaintextInputShare
+
+        aad = InputShareAad(
+            leader_task.task_id, report.metadata, report.public_share
+        ).get_encoded()
+        plaintext = open_(
+            leader_task.hpke_keys[0],
+            HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+            report.leader_encrypted_input_share,
+            aad,
+        )
+        leader_share = vdaf.decode_input_share(
+            0, PlaintextInputShare.get_decoded(plaintext).payload
+        )
+        public = vdaf.decode_public_share(report.public_share)
+        state, msg = pp.leader_initialized(
+            vdaf,
+            leader_task.vdaf_verify_key,
+            None,
+            report.metadata.report_id.data,
+            public,
+            leader_share,
+        )
+        inits.append(
+            PrepareInit(
+                ReportShare(
+                    report.metadata,
+                    report.public_share,
+                    report.helper_encrypted_input_share,
+                ),
+                msg,
+            )
+        )
+        states.append(state)
+        reports.append(report)
+    return inits, states, reports
+
+
+class TestUpload:
+    def test_happy_path(self, env):
+        ds, agg = env
+        leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        vdaf = vdaf_from_instance({"type": "Prio3Count"})
+        report = prepare_report(
+            vdaf,
+            leader.task_id,
+            leader.hpke_keys[0].config,
+            helper.hpke_keys[0].config,
+            TIME_PRECISION,
+            1,
+            time=NOW,
+        )
+        run(agg.handle_upload(leader.task_id, report))
+        stored = ds.run_tx(
+            "get",
+            lambda tx: tx.get_client_report(leader.task_id, report.metadata.report_id),
+        )
+        assert stored is not None
+        assert stored.helper_encrypted_input_share == report.helper_encrypted_input_share
+        counter = ds.run_tx(
+            "cnt", lambda tx: tx.get_task_upload_counter(leader.task_id)
+        )
+        assert counter.report_success == 1
+
+    def test_too_early_rejected(self, env):
+        ds, agg = env
+        leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        vdaf = vdaf_from_instance({"type": "Prio3Count"})
+        report = prepare_report(
+            vdaf,
+            leader.task_id,
+            leader.hpke_keys[0].config,
+            helper.hpke_keys[0].config,
+            TIME_PRECISION,
+            1,
+            time=Time(NOW.seconds + 7200),
+        )
+        with pytest.raises(ReportTooEarly):
+            run(agg.handle_upload(leader.task_id, report))
+        counter = ds.run_tx(
+            "cnt", lambda tx: tx.get_task_upload_counter(leader.task_id)
+        )
+        assert counter.report_too_early == 1
+
+
+class TestAggregateInit:
+    def _init_job(self, ds, agg, vdaf_desc={"type": "Prio3Count"}, measurements=(1, 0, 1)):
+        leader, helper, collector = make_pair_tasks(vdaf_desc)
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, states, reports = leader_prep_inits(vdaf, leader, helper, measurements)
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        job_id = AggregationJobId.random()
+        body = req.get_encoded()
+        resp = run(
+            agg.handle_aggregate_init(helper.task_id, job_id, body, AGG_TOKEN)
+        )
+        return leader, helper, vdaf, inits, states, reports, job_id, body, resp
+
+    def test_happy_path_accumulates(self, env):
+        ds, agg = env
+        measurements = (1, 0, 1, 1)
+        (
+            leader,
+            helper,
+            vdaf,
+            inits,
+            states,
+            reports,
+            job_id,
+            body,
+            resp,
+        ) = self._init_job(ds, agg, measurements=measurements)
+
+        assert len(resp.prepare_resps) == len(measurements)
+        leader_out_shares = []
+        for pr, state in zip(resp.prepare_resps, states):
+            assert pr.result.variant == PrepareStepResult.CONTINUE
+            finished = pp.leader_continued(vdaf, state, pr.result.message)
+            leader_out_shares.append(finished.out_share)
+
+        # helper accumulated its out shares into batch aggregations
+        ident = Interval(NOW, TIME_PRECISION).get_encoded()
+        bas = ds.run_tx(
+            "get",
+            lambda tx: tx.get_batch_aggregations_for_batch(helper.task_id, ident, b""),
+        )
+        assert sum(ba.report_count for ba in bas) == len(measurements)
+        helper_agg = None
+        f = vdaf.field
+        for ba in bas:
+            if ba.aggregate_share:
+                vec = f.decode_vec(ba.aggregate_share)
+                helper_agg = vec if helper_agg is None else f.vec_add(helper_agg, vec)
+        leader_agg = vdaf.aggregate(leader_out_shares)
+        assert vdaf.unshard([leader_agg, helper_agg], len(measurements)) == sum(
+            measurements
+        )
+
+    def test_idempotent_replay(self, env):
+        ds, agg = env
+        leader, helper, vdaf, inits, states, reports, job_id, body, resp = self._init_job(
+            ds, agg
+        )
+        resp2 = run(
+            agg.handle_aggregate_init(helper.task_id, job_id, body, AGG_TOKEN)
+        )
+        assert resp2 == resp
+        # mutated request with the same job id → 409
+        other = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits[:1],
+        )
+        with pytest.raises(ForbiddenMutation):
+            run(
+                agg.handle_aggregate_init(
+                    helper.task_id, job_id, other.get_encoded(), AGG_TOKEN
+                )
+            )
+
+    def test_replayed_report_rejected(self, env):
+        ds, agg = env
+        leader, helper, vdaf, inits, states, reports, job_id, body, resp = self._init_job(
+            ds, agg
+        )
+        # same report in a NEW job → REPORT_REPLAYED
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits[:1],
+        )
+        resp2 = run(
+            agg.handle_aggregate_init(
+                helper.task_id, AggregationJobId.random(), req.get_encoded(), AGG_TOKEN
+            )
+        )
+        assert resp2.prepare_resps[0].result.variant == PrepareStepResult.REJECT
+        assert resp2.prepare_resps[0].result.error == PrepareError.REPORT_REPLAYED
+
+    def test_duplicate_report_in_request(self, env):
+        ds, agg = env
+        leader, helper, collector = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=[inits[0], inits[0]],
+        )
+        with pytest.raises(InvalidMessage):
+            run(
+                agg.handle_aggregate_init(
+                    helper.task_id,
+                    AggregationJobId.random(),
+                    req.get_encoded(),
+                    AGG_TOKEN,
+                )
+            )
+
+    def test_bad_auth(self, env):
+        ds, agg = env
+        leader, helper, collector = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        with pytest.raises(UnauthorizedRequest):
+            run(
+                agg.handle_aggregate_init(
+                    helper.task_id,
+                    AggregationJobId.random(),
+                    b"",
+                    AuthenticationToken.new_bearer("wrong"),
+                )
+            )
+
+    def test_tampered_share_rejected(self, env):
+        ds, agg = env
+        leader, helper, collector = make_pair_tasks(
+            {"type": "Prio3Histogram", "length": 4, "chunk_length": 2}
+        )
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, states, reports = leader_prep_inits(vdaf, leader, helper, [2, 3])
+        # corrupt report 1's helper ciphertext payload
+        from dataclasses import replace
+
+        from janus_tpu.messages import HpkeCiphertext
+
+        rs = inits[1].report_share
+        bad_ct = HpkeCiphertext(
+            rs.encrypted_input_share.config_id,
+            rs.encrypted_input_share.encapsulated_key,
+            rs.encrypted_input_share.payload[:-1]
+            + bytes([rs.encrypted_input_share.payload[-1] ^ 1]),
+        )
+        inits = [
+            inits[0],
+            PrepareInit(ReportShare(rs.metadata, rs.public_share, bad_ct), inits[1].message),
+        ]
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        resp = run(
+            agg.handle_aggregate_init(
+                helper.task_id, AggregationJobId.random(), req.get_encoded(), AGG_TOKEN
+            )
+        )
+        assert resp.prepare_resps[0].result.variant == PrepareStepResult.CONTINUE
+        assert resp.prepare_resps[1].result.variant == PrepareStepResult.REJECT
+        assert resp.prepare_resps[1].result.error == PrepareError.HPKE_DECRYPT_ERROR
+
+
+class TestAggregateShare:
+    def test_share_flow(self, env):
+        ds, agg = env
+        measurements = (1, 1, 0)
+        leader, helper, collector = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, states, reports = leader_prep_inits(vdaf, leader, helper, measurements)
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        resp = run(
+            agg.handle_aggregate_init(
+                helper.task_id, AggregationJobId.random(), req.get_encoded(), AGG_TOKEN
+            )
+        )
+        leader_out = []
+        checksum = ReportIdChecksum.zero()
+        for pr, state, report in zip(resp.prepare_resps, states, reports):
+            leader_out.append(pp.leader_continued(vdaf, state, pr.result.message).out_share)
+            checksum = checksum_updated_with(checksum, report.metadata.report_id)
+
+        share_req = AggregateShareReq(
+            batch_selector=BatchSelector.new_time_interval(
+                Interval(NOW, TIME_PRECISION)
+            ),
+            aggregation_parameter=b"",
+            report_count=len(measurements),
+            checksum=checksum,
+        )
+        out = run(
+            agg.handle_aggregate_share(
+                helper.task_id, share_req.get_encoded(), AGG_TOKEN
+            )
+        )
+        # collector decrypts the helper share and unshards with the leader's
+        from janus_tpu.messages import AggregateShareAad
+
+        aad = AggregateShareAad(
+            helper.task_id, b"", share_req.batch_selector
+        ).get_encoded()
+        helper_share_bytes = open_(
+            collector,
+            HpkeApplicationInfo.new(Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR),
+            out.encrypted_aggregate_share,
+            aad,
+        )
+        f = vdaf.field
+        total = vdaf.unshard(
+            [vdaf.aggregate(leader_out), f.decode_vec(helper_share_bytes)],
+            len(measurements),
+        )
+        assert total == sum(measurements)
+
+        # count mismatch → BatchMismatch (cached path)
+        bad_req = AggregateShareReq(
+            batch_selector=share_req.batch_selector,
+            aggregation_parameter=b"",
+            report_count=len(measurements) + 1,
+            checksum=checksum,
+        )
+        from janus_tpu.aggregator.error import BatchMismatch
+
+        with pytest.raises(BatchMismatch):
+            run(
+                agg.handle_aggregate_share(
+                    helper.task_id, bad_req.get_encoded(), AGG_TOKEN
+                )
+            )
+
+
+class TestMultiRoundDummy:
+    def test_init_then_continue(self, env):
+        """2-round dummy VDAF: init leaves WaitingHelper, continue finishes
+        (exercises the stored-transition model through the handlers)."""
+        ds, agg = env
+        from janus_tpu.messages import (
+            AggregationJobContinueReq,
+            PrepareContinue,
+        )
+
+        leader, helper, collector = make_pair_tasks({"type": "Prio3Count"})
+        # swap in a dummy task: same ids, dummy vdaf desc is not in the
+        # registry, so build the TaskAggregator path via instances? We
+        # instead register the dummy under its test name.
+        from janus_tpu.vdaf import instances as inst
+
+        inst.VDAF_INSTANCES.setdefault("Fake", lambda rounds=2: DummyVdaf(rounds))
+        import dataclasses
+
+        helper = dataclasses.replace(
+            helper, vdaf={"type": "Fake", "rounds": 2}, vdaf_verify_key=b"\x00" * 16
+        )
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+
+        vdaf = DummyVdaf(2)
+        measurements = [3, 4]
+        inits, states, reports = [], [], []
+        for m in measurements:
+            report = prepare_report(
+                vdaf,
+                helper.task_id,
+                leader.hpke_keys[0].config,
+                helper.hpke_keys[0].config,
+                TIME_PRECISION,
+                m,
+                time=NOW,
+            )
+            public = None
+            state, msg = pp.leader_initialized(
+                vdaf,
+                helper.vdaf_verify_key,
+                None,
+                report.metadata.report_id.data,
+                public,
+                vdaf.shard(m, report.metadata.report_id.data, b"")[1][0],
+            )
+            inits.append(
+                PrepareInit(
+                    ReportShare(
+                        report.metadata,
+                        report.public_share,
+                        report.helper_encrypted_input_share,
+                    ),
+                    msg,
+                )
+            )
+            states.append(state)
+            reports.append(report)
+
+        job_id = AggregationJobId.random()
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        resp = run(
+            agg.handle_aggregate_init(
+                helper.task_id, job_id, req.get_encoded(), AGG_TOKEN
+            )
+        )
+        # helper is waiting (2-round vdaf): responses are CONTINUE with a
+        # continue-variant ping-pong message
+        conts = []
+        leader_states = []
+        for pr, state in zip(resp.prepare_resps, states):
+            assert pr.result.variant == PrepareStepResult.CONTINUE
+            assert pr.result.message.variant == pp.PingPongMessage.CONTINUE
+            value = pp.continued(vdaf, True, state, pr.result.message, None)
+            assert value.transition is not None
+            l_state, l_msg = value.transition.evaluate(vdaf)
+            leader_states.append(l_state)
+            conts.append(PrepareContinue(pr.report_id, l_msg))
+
+        ras = ds.run_tx(
+            "ras",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                helper.task_id, job_id
+            ),
+        )
+        assert all(ra.state == ReportAggregationState.WAITING_HELPER for ra in ras)
+
+        cont_req = AggregationJobContinueReq(1, conts)
+        resp2 = run(
+            agg.handle_aggregate_continue(
+                helper.task_id, job_id, cont_req.get_encoded(), AGG_TOKEN
+            )
+        )
+        for pr in resp2.prepare_resps:
+            assert pr.result.variant in (
+                PrepareStepResult.FINISHED,
+                PrepareStepResult.CONTINUE,
+            )
+        ras = ds.run_tx(
+            "ras2",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                helper.task_id, job_id
+            ),
+        )
+        assert all(ra.state == ReportAggregationState.FINISHED for ra in ras)
+        # helper accumulated dummy out shares
+        ident = Interval(NOW, TIME_PRECISION).get_encoded()
+        bas = ds.run_tx(
+            "bas",
+            lambda tx: tx.get_batch_aggregations_for_batch(helper.task_id, ident, b""),
+        )
+        total = 0
+        for ba in bas:
+            if ba.aggregate_share:
+                total += vdaf.field.decode_vec(ba.aggregate_share)[0]
+        assert total == sum(measurements)

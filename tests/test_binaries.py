@@ -1,0 +1,153 @@
+"""Config parsing + CLI tests (reference test style: config parsing incl.
+doc-sample validation, SURVEY.md §4.1)."""
+
+import base64
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+from click.testing import CliRunner
+
+from janus_tpu.binaries.config import (
+    AggregatorConfig,
+    CommonConfig,
+    ConfigError,
+    JobDriverBinaryConfig,
+    datastore_keys_from_env,
+    load_config,
+)
+from janus_tpu.binaries.janus_cli import cli
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = load_config(AggregatorConfig)
+        assert cfg.listen_address == "0.0.0.0:8080"
+        assert cfg.common.database.path == "janus_tpu.sqlite3"
+        assert cfg.vdaf_backend == "tpu"
+
+    def test_yaml_overrides(self):
+        cfg = load_config(
+            AggregatorConfig,
+            text="""
+common:
+  database:
+    path: /tmp/x.sqlite3
+  log_level: DEBUG
+listen_address: "127.0.0.1:9999"
+vdaf_backend: oracle
+""",
+        )
+        assert cfg.common.database.path == "/tmp/x.sqlite3"
+        assert cfg.listen_address == "127.0.0.1:9999"
+        assert cfg.vdaf_backend == "oracle"
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ConfigError):
+            load_config(AggregatorConfig, text="nonsense_key: 1")
+
+    def test_job_driver_nested(self):
+        cfg = load_config(
+            JobDriverBinaryConfig,
+            text="""
+job_driver:
+  max_concurrent_job_workers: 3
+  worker_lease_duration_s: 120
+""",
+        )
+        assert cfg.job_driver.max_concurrent_job_workers == 3
+        assert cfg.job_driver.worker_lease_duration_s == 120
+
+    def test_datastore_keys_env(self, monkeypatch):
+        key = base64.urlsafe_b64encode(b"\x01" * 16).rstrip(b"=").decode()
+        monkeypatch.setenv("DATASTORE_KEYS", key)
+        assert datastore_keys_from_env() == [b"\x01" * 16]
+        monkeypatch.delenv("DATASTORE_KEYS")
+        with pytest.raises(ConfigError):
+            datastore_keys_from_env()
+
+
+class TestCli:
+    def test_create_datastore_key(self):
+        result = CliRunner().invoke(cli, ["create-datastore-key"])
+        assert result.exit_code == 0
+        key = base64.urlsafe_b64decode(result.output.strip() + "==")
+        assert len(key) == 16
+
+    def test_generate_hpke_key(self):
+        result = CliRunner().invoke(cli, ["generate-hpke-key", "--id", "5"])
+        assert result.exit_code == 0
+        doc = json.loads(result.output)
+        from janus_tpu.messages import HpkeConfig
+
+        config = HpkeConfig.get_decoded(
+            base64.urlsafe_b64decode(doc["config"] + "==")
+        )
+        assert config.id == 5
+
+    def test_provision_tasks_and_decode(self, tmp_path, monkeypatch):
+        key = base64.urlsafe_b64encode(b"\x02" * 16).rstrip(b"=").decode()
+        monkeypatch.setenv("DATASTORE_KEYS", key)
+        db = tmp_path / "cli.sqlite3"
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text(f"common:\n  database:\n    path: {db}\n")
+
+        hpke = json.loads(
+            CliRunner().invoke(cli, ["generate-hpke-key", "--id", "1"]).output
+        )
+        vk = base64.urlsafe_b64encode(b"\x03" * 16).rstrip(b"=").decode()
+        tasks = tmp_path / "tasks.yaml"
+        tasks.write_text(
+            f"""
+- peer_aggregator_endpoint: https://peer.example.com/
+  query_type: {{kind: TimeInterval}}
+  vdaf: {{type: Prio3Count}}
+  role: Leader
+  vdaf_verify_key: {vk}
+  min_batch_size: 10
+  time_precision_s: 3600
+  aggregator_auth_token: tok-123
+  collector_auth_token_for_hash: col-456
+  hpke_keys:
+    - config: {hpke["config"]}
+      private_key: {hpke["private_key"]}
+"""
+        )
+        result = CliRunner().invoke(
+            cli, ["provision-tasks", str(tasks), "--config-file", str(cfg)]
+        )
+        assert result.exit_code == 0, result.output
+        assert "provisioned task" in result.output
+
+        # the task is actually in the datastore
+        from janus_tpu.core.time import RealClock
+        from janus_tpu.datastore import Crypter, Datastore
+
+        ds = Datastore(str(db), Crypter([b"\x02" * 16]), RealClock())
+        tasks_in_db = ds.run_tx("get", lambda tx: tx.get_aggregator_tasks())
+        assert len(tasks_in_db) == 1
+        assert tasks_in_db[0].vdaf == {"type": "Prio3Count"}
+        ds.close()
+
+    def test_dap_decode(self, tmp_path):
+        from janus_tpu.messages import Duration, Interval, Time
+        from janus_tpu.messages import CollectionReq, Query
+
+        req = CollectionReq(
+            Query.new_time_interval(Interval(Time(3600), Duration(3600))), b""
+        )
+        f = tmp_path / "msg.bin"
+        f.write_bytes(req.get_encoded())
+        result = CliRunner().invoke(
+            cli,
+            [
+                "dap-decode",
+                str(f),
+                "--media-type",
+                "application/dap-collect-req",
+            ],
+        )
+        assert result.exit_code == 0, result.output
+        assert "CollectionReq" in result.output

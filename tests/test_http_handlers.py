@@ -1,0 +1,155 @@
+"""HTTP-layer tests: full DAP requests against an in-process aiohttp server.
+
+The analog of the reference's trillium in-memory handler tests (SURVEY.md
+§4.3; reference: aggregator/src/aggregator/http_handlers/tests/).
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.http_handlers import aggregator_app
+from janus_tpu.client import prepare_report
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    HpkeConfigList,
+    PartialBatchSelector,
+    PrepareStepResult,
+    Report,
+    Time,
+)
+from janus_tpu.vdaf import pingpong as pp
+
+from test_aggregator_handlers import (
+    AGG_TOKEN,
+    NOW,
+    TIME_PRECISION,
+    leader_prep_inits,
+    make_pair_tasks,
+)
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def make_env(role_task):
+    eds = EphemeralDatastore(MockClock(NOW))
+    eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(role_task))
+    agg = Aggregator(eds.datastore, eds.clock, Config(vdaf_backend="oracle"))
+    return eds, aggregator_app(agg)
+
+
+async def _client(app):
+    server = TestServer(app)
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+def test_hpke_config_and_upload(loop):
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    eds, app = make_env(leader)
+
+    async def flow():
+        client = await _client(app)
+        try:
+            # hpke_config
+            resp = await client.get("/hpke_config", params={"task_id": str(leader.task_id)})
+            assert resp.status == 200
+            configs = HpkeConfigList.get_decoded(await resp.read())
+            assert configs.hpke_configs[0] == leader.hpke_keys[0].config
+
+            # healthz
+            resp = await client.get("/healthz")
+            assert resp.status == 200
+
+            # upload
+            vdaf = leader.vdaf_instance()
+            report = prepare_report(
+                vdaf,
+                leader.task_id,
+                leader.hpke_keys[0].config,
+                helper.hpke_keys[0].config,
+                TIME_PRECISION,
+                1,
+                time=NOW,
+            )
+            resp = await client.put(
+                f"/tasks/{leader.task_id}/reports", data=report.get_encoded()
+            )
+            assert resp.status == 201, await resp.text()
+
+            # malformed upload → problem document
+            resp = await client.put(
+                f"/tasks/{leader.task_id}/reports", data=b"\x00garbage"
+            )
+            assert resp.status == 400
+            doc = json.loads(await resp.text())
+            assert doc["type"].endswith("invalidMessage")
+
+            # unknown task → unrecognizedTask problem
+            from janus_tpu.messages import TaskId
+
+            resp = await client.put(
+                f"/tasks/{TaskId.random()}/reports", data=report.get_encoded()
+            )
+            assert resp.status == 404
+            doc = json.loads(await resp.text())
+            assert doc["type"].endswith("unrecognizedTask")
+        finally:
+            await client.close()
+
+    loop.run_until_complete(flow())
+    eds.cleanup()
+
+
+def test_aggregation_job_http_flow(loop):
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Histogram", "length": 4, "chunk_length": 2})
+    eds, app = make_env(helper)
+    vdaf = helper.vdaf_instance()
+    measurements = [0, 1, 2, 3, 1]
+    inits, states, reports = leader_prep_inits(vdaf, leader, helper, measurements)
+
+    async def flow():
+        client = await _client(app)
+        try:
+            req = AggregationJobInitializeReq(
+                aggregation_parameter=b"",
+                partial_batch_selector=PartialBatchSelector.new_time_interval(),
+                prepare_inits=inits,
+            )
+            job_id = AggregationJobId.random()
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{job_id}"
+            # no auth → 403 problem
+            resp = await client.put(url, data=req.get_encoded())
+            assert resp.status == 403
+
+            headers = {"Authorization": "Bearer " + AGG_TOKEN.token}
+            resp = await client.put(url, data=req.get_encoded(), headers=headers)
+            assert resp.status == 200, await resp.text()
+            job_resp = AggregationJobResp.get_decoded(await resp.read())
+            total = None
+            outs = []
+            for pr, state in zip(job_resp.prepare_resps, states):
+                assert pr.result.variant == PrepareStepResult.CONTINUE
+                outs.append(pp.leader_continued(vdaf, state, pr.result.message).out_share)
+
+            # delete the job
+            resp = await client.delete(url, headers=headers)
+            assert resp.status == 204
+        finally:
+            await client.close()
+
+    loop.run_until_complete(flow())
+    eds.cleanup()

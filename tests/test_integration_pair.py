@@ -1,0 +1,290 @@
+"""End-to-end integration: in-process leader+helper pair over real HTTP.
+
+The analog of ``JanusInProcessPair`` (SURVEY.md §4.6; reference:
+integration_tests/src/janus.rs:83): boot both aggregators as in-process
+aiohttp servers with ephemeral datastores, submit real client reports over
+HTTP, run the creator/driver loops, collect, and verify the aggregate.
+"""
+
+import asyncio
+import dataclasses
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator import (
+    Aggregator,
+    AggregationJobCreator,
+    AggregationJobDriver,
+    CollectionJobDriver,
+    Config,
+    CreatorConfig,
+    DriverConfig,
+    aggregator_app,
+)
+from janus_tpu.client import prepare_report
+from janus_tpu.collector import Collector
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.retries import HttpRetryPolicy
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import AggregatorTask, TaskQueryType
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import (
+    Duration,
+    FixedSizeQuery,
+    Interval,
+    Query,
+    Role,
+    TaskId,
+    Time,
+)
+
+TIME_PRECISION = Duration(3600)
+NOW = Time(1_600_002_000)
+
+AGG_TOKEN = AuthenticationToken.new_bearer("agg-token-e2e")
+COL_TOKEN = AuthenticationToken.new_bearer("col-token-e2e")
+
+
+class InProcessPair:
+    """Leader + helper aggregators on ephemeral ports sharing a MockClock."""
+
+    def __init__(self, vdaf_desc, query_type=None, backend="oracle"):
+        self.vdaf_desc = vdaf_desc
+        self.query_type = query_type or TaskQueryType.time_interval()
+        self.clock = MockClock(NOW)
+        self.leader_ds = EphemeralDatastore(self.clock)
+        self.helper_ds = EphemeralDatastore(self.clock)
+        cfg = Config(vdaf_backend=backend, max_upload_batch_write_delay=0.02)
+        self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, cfg)
+        self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, cfg)
+        self.leader_client = None
+        self.helper_client = None
+        self.task_id = TaskId.random()
+        self.collector_keys = HpkeKeypair.generate(9)
+
+    async def start(self):
+        self.leader_client = TestClient(TestServer(aggregator_app(self.leader_agg)))
+        self.helper_client = TestClient(TestServer(aggregator_app(self.helper_agg)))
+        await self.leader_client.start_server()
+        await self.helper_client.start_server()
+        leader_url = str(self.leader_client.make_url("/"))
+        helper_url = str(self.helper_client.make_url("/"))
+
+        leader_keys = [HpkeKeypair.generate(1)]
+        helper_keys = [HpkeKeypair.generate(2)]
+        common = dict(
+            task_id=self.task_id,
+            query_type=self.query_type,
+            vdaf=self.vdaf_desc,
+            vdaf_verify_key=b"\x2a" * 16,
+            min_batch_size=3,
+            time_precision=TIME_PRECISION,
+            collector_hpke_config=self.collector_keys.config,
+        )
+        self.leader_task = AggregatorTask(
+            peer_aggregator_endpoint=helper_url,
+            role=Role.LEADER,
+            aggregator_auth_token=AGG_TOKEN,
+            collector_auth_token_hash=COL_TOKEN.hash(),
+            hpke_keys=leader_keys,
+            **common,
+        )
+        self.helper_task = AggregatorTask(
+            peer_aggregator_endpoint=leader_url,
+            role=Role.HELPER,
+            aggregator_auth_token_hash=AGG_TOKEN.hash(),
+            hpke_keys=helper_keys,
+            **common,
+        )
+        self.leader_ds.datastore.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(self.leader_task)
+        )
+        self.helper_ds.datastore.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(self.helper_task)
+        )
+        self.leader_url = leader_url
+
+    async def stop(self):
+        await self.leader_client.close()
+        await self.helper_client.close()
+        self.leader_ds.cleanup()
+        self.helper_ds.cleanup()
+
+    async def upload(self, measurement, t=NOW):
+        vdaf = self.leader_task.vdaf_instance()
+        report = prepare_report(
+            vdaf,
+            self.task_id,
+            self.leader_task.hpke_keys[0].config,
+            self.helper_task.hpke_keys[0].config,
+            TIME_PRECISION,
+            measurement,
+            time=t,
+        )
+        resp = await self.leader_client.put(
+            f"/tasks/{self.task_id}/reports", data=report.get_encoded()
+        )
+        assert resp.status == 201, await resp.text()
+
+    async def run_aggregation(self):
+        """Creator pass + aggregation-driver passes until quiescent."""
+        creator = AggregationJobCreator(
+            self.leader_ds.datastore,
+            CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=100),
+        )
+        await creator.run_once()
+        driver = AggregationJobDriver(
+            self.leader_ds.datastore,
+            aiohttp.ClientSession,
+            DriverConfig(http_retry=HttpRetryPolicy(0.01, 0.1, 2.0, 1.0, 3)),
+        )
+        for _ in range(10):
+            leases = await self.leader_ds.datastore.run_tx_async(
+                "acquire",
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+            )
+            if not leases:
+                break
+            for lease in leases:
+                await driver.step_aggregation_job(lease)
+
+    async def run_collection(self):
+        driver = CollectionJobDriver(
+            self.leader_ds.datastore,
+            aiohttp.ClientSession,
+        )
+        for _ in range(10):
+            leases = await self.leader_ds.datastore.run_tx_async(
+                "acquire",
+                lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 10),
+            )
+            if not leases:
+                break
+            for lease in leases:
+                await driver.step_collection_job(lease)
+
+    async def collect(self, query, expected_count):
+        vdaf = self.leader_task.vdaf_instance()
+        collector = Collector(
+            task_id=self.task_id,
+            leader_endpoint=self.leader_url,
+            vdaf=vdaf,
+            auth_token=COL_TOKEN,
+            hpke_keypair=self.collector_keys,
+            poll_interval=0.05,
+            max_poll_time=10.0,
+        )
+
+        async def poll():
+            # run the collection driver concurrently with polling
+            await asyncio.sleep(0.1)
+            await self.run_collection()
+
+        result, _ = await asyncio.gather(
+            collector.collect(query, session=None), poll()
+        )
+        assert result.report_count == expected_count
+        return result
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_count_time_interval_e2e():
+    pair = InProcessPair({"type": "Prio3Count"})
+    measurements = [1, 0, 1, 1, 0, 1]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)  # let the report batcher flush
+            await pair.run_aggregation()
+            result = await pair.collect(
+                Query.new_time_interval(Interval(NOW, TIME_PRECISION)),
+                len(measurements),
+            )
+            assert result.aggregate_result == sum(measurements)
+        finally:
+            await pair.stop()
+
+    run(flow())
+
+
+def test_multiround_fake_vdaf_e2e():
+    """2-round Fake VDAF through the full driver loop: init leaves the
+    leader WaitingLeader with a stored transition, a continue round
+    completes it (locks in the wire-step and round-reconstruction
+    conventions between driver and helper)."""
+    pair = InProcessPair({"type": "Fake", "rounds": 2})
+    measurements = [3, 4, 5]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            await pair.run_aggregation()
+            # every leader report aggregation must have Finished (not failed)
+            ds = pair.leader_ds.datastore
+            jobs = ds.run_tx(
+                "j", lambda tx: tx.get_aggregation_jobs_for_task(pair.task_id)
+            )
+            assert jobs and all(j.state.value == "Finished" for j in jobs)
+            for j in jobs:
+                ras = ds.run_tx(
+                    "r",
+                    lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                        pair.task_id, j.aggregation_job_id
+                    ),
+                )
+                assert all(ra.state.value == "Finished" for ra in ras), [
+                    (ra.state, ra.error) for ra in ras
+                ]
+            result = await pair.collect(
+                Query.new_time_interval(Interval(NOW, TIME_PRECISION)),
+                len(measurements),
+            )
+            assert result.aggregate_result == sum(measurements)
+        finally:
+            await pair.stop()
+
+    run(flow())
+
+
+def test_histogram_fixed_size_e2e():
+    pair = InProcessPair(
+        {"type": "Prio3Histogram", "length": 4, "chunk_length": 2},
+        query_type=TaskQueryType.fixed_size(max_batch_size=10),
+    )
+    measurements = [0, 1, 2, 3, 1, 1]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            await pair.run_aggregation()
+            result = await pair.collect(
+                Query.new_fixed_size(FixedSizeQuery.current_batch()),
+                len(measurements),
+            )
+            expect = [0] * 4
+            for m in measurements:
+                expect[m] += 1
+            assert result.aggregate_result == expect
+        finally:
+            await pair.stop()
+
+    run(flow())

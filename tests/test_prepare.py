@@ -26,12 +26,31 @@ from janus_tpu.vdaf.prio3 import VdafError
 from janus_tpu.utils.test_util import det_rng
 
 
+# Default suite keeps one no-joint-rand case (count, Field64) and one
+# joint-rand case (hist, Field128); the rest are compile-heavy permutations
+# of the same code paths and run under RUN_SLOW=1.
 CASES = [
-    ("count", prio3_count(), [0, 1, 1, 0]),
-    ("sum8", prio3_sum(8), [0, 1, 77, 255]),
-    ("sumvec", prio3_sum_vec(length=7, bits=3, chunk_length=4), [[1, 2, 3, 4, 5, 6, 7], [0] * 7, [7] * 7, [3, 0, 1, 2, 0, 7, 5]]),
-    ("hist", prio3_histogram(length=10, chunk_length=3), [0, 3, 9, 5]),
-    ("hist3sh", prio3_histogram(length=5, chunk_length=2, num_shares=3), [0, 4, 2, 1]),
+    pytest.param("count", prio3_count(), [0, 1, 1, 0], id="count"),
+    pytest.param(
+        "sum8", prio3_sum(8), [0, 1, 77, 255], id="sum8", marks=pytest.mark.slow
+    ),
+    pytest.param(
+        "sumvec",
+        prio3_sum_vec(length=7, bits=3, chunk_length=4),
+        [[1, 2, 3, 4, 5, 6, 7], [0] * 7, [7] * 7, [3, 0, 1, 2, 0, 7, 5]],
+        id="sumvec",
+        marks=pytest.mark.slow,
+    ),
+    pytest.param(
+        "hist", prio3_histogram(length=10, chunk_length=3), [0, 3, 9, 5], id="hist"
+    ),
+    pytest.param(
+        "hist3sh",
+        prio3_histogram(length=5, chunk_length=2, num_shares=3),
+        [0, 4, 2, 1],
+        id="hist3sh",
+        marks=pytest.mark.slow,
+    ),
 ]
 
 
@@ -61,7 +80,7 @@ def jit_prep_combine(bp, has_jr):
     return jax.jit(lambda vs, parts: bp.prep_shares_to_prep(vs))
 
 
-@pytest.mark.parametrize("name,vdaf,measurements", CASES, ids=[c[0] for c in CASES])
+@pytest.mark.parametrize("name,vdaf,measurements", CASES)
 def test_device_prepare_matches_oracle(name, vdaf, measurements):
     rng = det_rng(name)
     B = len(measurements)
