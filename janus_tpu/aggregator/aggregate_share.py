@@ -30,7 +30,7 @@ def compute_aggregate_share(
     identifier.  Returns (aggregate_share_vec | None, report_count,
     checksum, client_timestamp_interval)."""
     strategy = strategy_for(task)
-    field = vdaf.field
+    field = vdaf.field_for_agg_param(vdaf.decode_agg_param(aggregation_parameter))
     share: Optional[List[int]] = None
     count = 0
     checksum = ReportIdChecksum.zero()

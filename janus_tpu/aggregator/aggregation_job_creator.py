@@ -75,6 +75,16 @@ class AggregationJobCreator:
 
     # -- per-task creation (one transaction) ----------------------------
     def create_jobs_for_task(self, tx: Transaction, task: AggregatorTask) -> int:
+        vdaf = task.vdaf_instance()
+        try:
+            vdaf.decode_agg_param(b"")
+        except Exception:
+            # VDAFs with a real aggregation parameter (Poplar1) get their
+            # jobs from collection requests, not from this periodic creator
+            # (the reference gates this path behind test-util:
+            # aggregation_job_creator.rs:741).
+            logger.debug("skipping agg-param task %s", task.task_id)
+            return 0
         metas = tx.get_unaggregated_client_reports_for_task(
             task.task_id, self.config.reports_per_round
         )
@@ -90,7 +100,6 @@ class AggregationJobCreator:
         if leftover:
             tx.mark_reports_unaggregated(task.task_id, [m.report_id for m in leftover])
 
-        vdaf = task.vdaf_instance()
         writer = AggregationJobWriter(
             task,
             vdaf,

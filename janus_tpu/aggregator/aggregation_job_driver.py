@@ -171,7 +171,12 @@ class AggregationJobDriver:
     def _leader_prep_init(self, task, vdaf, job, start_ras):
         """Batched leader prepare (device launch for Prio3;
         reference mirror: aggregation_job_driver.rs:397-428 on rayon)."""
-        agg_param = vdaf.decode_agg_param(job.aggregation_parameter)
+        try:
+            agg_param = vdaf.decode_agg_param(job.aggregation_parameter)
+        except VdafError:
+            return {
+                ra.report_id.data: PrepareError.INVALID_MESSAGE for ra in start_ras
+            }
         outcomes: Dict[bytes, object] = {}  # report_id -> (state, msg) | PrepareError
         rows = []
         for ra in start_ras:

@@ -142,7 +142,9 @@ class AggregationJobWriter:
                 ).get_encoded()
             )
 
-        field = self.vdaf.field
+        field = self.vdaf.field_for_agg_param(
+            self.vdaf.decode_agg_param(job.aggregation_parameter)
+        )
         terminal = job.state in (
             AggregationJobState.FINISHED,
             AggregationJobState.ABANDONED,

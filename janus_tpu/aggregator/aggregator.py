@@ -311,6 +311,7 @@ class Aggregator:
                 if tx.check_report_aggregation_exists(
                     task_id,
                     pi.report_share.metadata.report_id,
+                    aggregation_parameter=req.aggregation_parameter,
                     exclude_aggregation_job_id=aggregation_job_id,
                 )
             ],
@@ -711,7 +712,7 @@ class Aggregator:
                     new_ras.append(
                         ra.with_state(ReportAggregationState.FINISHED).with_last_prep_resp(resp)
                     )
-                    out_shares[pc.report_id.data] = next_state.output_share
+                    out_shares[pc.report_id.data] = next_state.out_share
                 else:
                     new_ras.append(
                         ra.with_state(
@@ -938,7 +939,9 @@ class Aggregator:
                 raise InvalidBatchSize(f"batch too small: {count}")
             if share is None:
                 raise InvalidBatchSize("empty batch")
-            encoded = ta.vdaf.field.encode_vec(share)
+            encoded = ta.vdaf.field_for_agg_param(
+                ta.vdaf.decode_agg_param(req.aggregation_parameter)
+            ).encode_vec(share)
             tx.put_aggregate_share_job(
                 AggregateShareJob(
                     task_id=task_id,

@@ -209,7 +209,9 @@ class CollectionJobDriver:
         finished = job.finished(
             report_count=count,
             client_timestamp_interval=interval,
-            leader_aggregate_share=vdaf.field.encode_vec(share),
+            leader_aggregate_share=vdaf.field_for_agg_param(
+                vdaf.decode_agg_param(job.aggregation_parameter)
+            ).encode_vec(share),
             helper_aggregate_share=helper_share.encrypted_aggregate_share,
         )
 

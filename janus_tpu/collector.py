@@ -128,6 +128,8 @@ class Collector:
         ).get_encoded()
         from .messages import Role
 
+        agg_param = self.vdaf.decode_agg_param(aggregation_parameter)
+        field = self.vdaf.field_for_agg_param(agg_param)
         shares = []
         for role, ct in (
             (Role.LEADER, collection.leader_encrypted_agg_share),
@@ -135,8 +137,10 @@ class Collector:
         ):
             info = HpkeApplicationInfo.new(Label.AGGREGATE_SHARE, role, Role.COLLECTOR)
             plaintext = open_(self.hpke_keypair, info, ct, aad)
-            shares.append(self.vdaf.field.decode_vec(plaintext))
-        result = self.vdaf.unshard(shares, collection.report_count)
+            shares.append(field.decode_vec(plaintext))
+        result = self.vdaf.unshard_with_param(
+            agg_param, shares, collection.report_count
+        )
         return CollectionResult(
             partial_batch_selector=collection.partial_batch_selector,
             report_count=collection.report_count,

@@ -887,25 +887,23 @@ class Transaction:
         self,
         task_id: TaskId,
         report_id: ReportId,
+        aggregation_parameter: bytes = b"",
         exclude_aggregation_job_id: Optional[AggregationJobId] = None,
     ) -> bool:
         """Helper replay check: has this report been aggregated in another
-        job? (reference: aggregator.rs:1765 dup-report-ID check)"""
+        job WITH THE SAME aggregation parameter?  Scoping by parameter is
+        what lets Poplar1 re-aggregate the same reports level by level
+        (reference: aggregator.rs:1765 dup-report-ID check)."""
         pk = self._task_pk(task_id)
+        sql = """SELECT 1 FROM report_aggregations ra
+                 JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+                 WHERE ra.task_id = ? AND ra.report_id = ?
+                   AND aj.aggregation_param = ?"""
+        args = [pk, report_id.data, aggregation_parameter]
         if exclude_aggregation_job_id is not None:
-            row = self.conn.execute(
-                """SELECT 1 FROM report_aggregations ra
-                   JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
-                   WHERE ra.task_id = ? AND ra.report_id = ?
-                     AND aj.aggregation_job_id != ? LIMIT 1""",
-                (pk, report_id.data, exclude_aggregation_job_id.data),
-            ).fetchone()
-        else:
-            row = self.conn.execute(
-                "SELECT 1 FROM report_aggregations WHERE task_id = ? AND report_id = ?"
-                " LIMIT 1",
-                (pk, report_id.data),
-            ).fetchone()
+            sql += " AND aj.aggregation_job_id != ?"
+            args.append(exclude_aggregation_job_id.data)
+        row = self.conn.execute(sql + " LIMIT 1", args).fetchone()
         return row is not None
 
     # ------------------------------------------------------------------

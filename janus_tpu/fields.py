@@ -131,6 +131,17 @@ class Field128(Field):
     NUM_ROOTS = 66
 
 
+class Field255(Field):
+    """GF(2^255 - 19): the Poplar1 leaf field (VDAF spec field table).
+
+    No NTT support (NUM_ROOTS unset): Poplar1 does no polynomial work, only
+    additive sharing and sketch algebra.
+    """
+
+    MODULUS = 2**255 - 19
+    ENCODED_SIZE = 32
+
+
 def _init_field(cls: type) -> None:
     p = cls.MODULUS
     assert (p - 1) % (1 << cls.NUM_ROOTS) == 0

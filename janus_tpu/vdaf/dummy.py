@@ -97,6 +97,12 @@ class DummyVdaf:
         return None
 
     # Uniform VDAF surface consumed by role logic.
+    def field_for_agg_param(self, agg_param):
+        return self.field
+
+    def unshard_with_param(self, agg_param, agg_shares, num_measurements: int):
+        return self.unshard(agg_shares, num_measurements)
+
     def decode_input_share(self, agg_id: int, data: bytes) -> DummyInputShare:
         return DummyInputShare.decode(self, agg_id, data)
 

@@ -67,6 +67,12 @@ def prio3_sum_vec_field64_multiproof_hmacsha256_aes128(
     )
 
 
+def _poplar1(bits: int):
+    from .poplar1 import Poplar1
+
+    return Poplar1(bits)
+
+
 def _fake(rounds: int = 1):
     from .dummy import DummyVdaf
 
@@ -96,6 +102,7 @@ VDAF_INSTANCES: Dict[str, Callable[..., Prio3]] = {
     "Prio3SumVec": prio3_sum_vec,
     "Prio3Histogram": prio3_histogram,
     "Prio3SumVecField64MultiproofHmacSha256Aes128": prio3_sum_vec_field64_multiproof_hmacsha256_aes128,
+    "Poplar1": _poplar1,
     "Fake": _fake,
     "FakeFailsPrepInit": _fake_fails_prep_init,
     "FakeFailsPrepStep": _fake_fails_prep_step,

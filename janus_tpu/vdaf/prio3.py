@@ -388,6 +388,12 @@ class Prio3:
     def field(self):
         return self.flp.field
 
+    def field_for_agg_param(self, agg_param):
+        return self.flp.field
+
+    def unshard_with_param(self, agg_param, agg_shares, num_measurements: int):
+        return self.unshard(agg_shares, num_measurements)
+
     def decode_input_share(self, agg_id: int, data: bytes) -> Prio3InputShare:
         return Prio3InputShare.decode(self, agg_id, data)
 

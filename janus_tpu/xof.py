@@ -176,6 +176,58 @@ class XofTurboShake128(Xof):
         return self._sponge.squeeze(length)
 
 
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=4096)
+def _fixed_key_aes128(dst: bytes, binder: bytes) -> bytes:
+    return turboshake128(bytes([len(dst)]) + dst + binder, 0x02, 16)
+
+
+class XofFixedKeyAes128(Xof):
+    """Fixed-key AES-128 XOF for the IDPF tree walk (draft-irtf-cfrg-vdaf-08
+    §6.2.2): one TurboSHAKE-derived AES key per (dst, binder) context, then
+    stream block i = hash_block(seed XOR le128(i)) with the Davies-Meyer-style
+    hash_block(x) = AES128(k, sigma(x)) XOR sigma(x),
+    sigma(x_L || x_R) = x_R || (x_L XOR x_R).
+
+    Circular-correlation-robust by construction — safe for the DPF extend
+    step where seeds are XOR-related across parties.
+    """
+
+    SEED_SIZE = 16
+
+    def __init__(self, seed: bytes, dst: bytes, binder: bytes):
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        if len(seed) != self.SEED_SIZE:
+            raise ValueError("bad seed size")
+        if len(dst) > 255:
+            raise ValueError("dst too long")
+        # The fixed key depends only on (dst, binder) — for an IDPF tree walk
+        # that is two values per report, so cache the TurboSHAKE derivation.
+        fixed_key = _fixed_key_aes128(dst, binder)
+        self._enc = Cipher(algorithms.AES(fixed_key), modes.ECB()).encryptor()
+        self._seed = seed
+        self._index = 0
+        self._buf = b""
+
+    def _hash_block(self, x: bytes) -> bytes:
+        sigma = x[8:] + bytes(a ^ b for a, b in zip(x[:8], x[8:]))
+        return bytes(a ^ b for a, b in zip(self._enc.update(sigma), sigma))
+
+    def next(self, length: int) -> bytes:
+        while len(self._buf) < length:
+            block = bytes(
+                a ^ b
+                for a, b in zip(self._seed, self._index.to_bytes(16, "little"))
+            )
+            self._buf += self._hash_block(block)
+            self._index += 1
+        out, self._buf = self._buf[:length], self._buf[length:]
+        return out
+
+
 class XofHmacSha256Aes128(Xof):
     """libprio-rs XofHmacSha256Aes128 (non-standard; Daphne interop)."""
 

@@ -1,0 +1,222 @@
+"""IDPF + Poplar1 protocol tests.
+
+Checks the defining properties (SURVEY.md §4 test strategy; reference
+consumes these through the prio crate): IDPF shares sum to beta on the
+prefix path and zero elsewhere; Poplar1 transcripts complete at every level
+through the ping-pong topology; forged/tampered shares fail the sketch; and
+a full heavy-hitters traversal recovers the clients' strings.
+"""
+
+import pytest
+
+from janus_tpu.fields import Field64, Field255
+from janus_tpu.utils.test_util import det_rng
+from janus_tpu.vdaf import pingpong as pp
+from janus_tpu.vdaf.idpf import IdpfPoplar
+from janus_tpu.vdaf.instances import vdaf_from_instance
+from janus_tpu.vdaf.poplar1 import (
+    Poplar1,
+    Poplar1AggregationParam,
+    Poplar1InputShare,
+)
+from janus_tpu.vdaf.prio3 import VdafError
+
+BITS = 6
+
+
+class TestIdpf:
+    def test_point_function_property(self):
+        """Shares sum to beta exactly on the alpha path, zero off it."""
+        rng = det_rng("idpf-point")
+        idpf = IdpfPoplar(BITS, value_len=1)
+        alpha = 0b101101
+        beta_inner = [[lvl + 1] for lvl in range(BITS - 1)]
+        beta_leaf = [99]
+        nonce = rng(16)
+        public, keys = idpf.gen(alpha, beta_inner, beta_leaf, nonce, rng(idpf.RAND_SIZE))
+
+        for level in range(BITS):
+            field = idpf.field_at(level)
+            prefixes = list(range(1 << (level + 1)))
+            y0 = idpf.eval(0, public, keys[0], level, prefixes, nonce)
+            y1 = idpf.eval(1, public, keys[1], level, prefixes, nonce)
+            on_path = alpha >> (BITS - 1 - level)
+            expect_beta = beta_leaf if level == BITS - 1 else beta_inner[level]
+            for p in prefixes:
+                total = [field.add(a, b) for a, b in zip(y0[p], y1[p])]
+                if p == on_path:
+                    assert total == expect_beta, (level, p)
+                else:
+                    assert total == [0], (level, p)
+
+    def test_public_share_codec(self):
+        rng = det_rng("idpf-codec")
+        idpf = IdpfPoplar(4, value_len=1)
+        public, _ = idpf.gen(0b1010, [[1]] * 3, [1], rng(16), rng(idpf.RAND_SIZE))
+        encoded = idpf.encode_public_share(public)
+        decoded = idpf.decode_public_share(encoded)
+        assert decoded == public
+        with pytest.raises(VdafError):
+            idpf.decode_public_share(encoded[:-1])
+        with pytest.raises(VdafError):
+            idpf.decode_public_share(encoded + b"\x00")
+
+
+def run_poplar1_transcript(vdaf, verify_key, agg_param, reports):
+    """Full two-party multi-round transcript via the ping-pong topology;
+    returns the unsharded prefix counts."""
+    agg_shares = [None, None]
+    for nonce, public, shares in reports:
+        l_state, msg = pp.leader_initialized(
+            vdaf, verify_key, agg_param, nonce, public, shares[0]
+        )
+        trans = pp.helper_initialized(
+            vdaf, verify_key, agg_param, nonce, public, shares[1], msg
+        )
+        # round trip the storable transition (driver persistence model)
+        trans = pp.PingPongTransition.decode(vdaf, trans.encode(vdaf))
+        h_state, h_msg = trans.evaluate(vdaf)
+        out = {0: None, 1: None}
+        current = {"leader": l_state, "helper": h_state}
+        msg_in_flight = h_msg
+        # alternate until both finish
+        for _ in range(8):
+            value = pp.continued(
+                vdaf, True, current["leader"], msg_in_flight, agg_param
+            )
+            if value.out_share is not None:
+                out[0] = value.out_share
+                break
+            l2_state, l_msg = value.transition.evaluate(vdaf)
+            if isinstance(l2_state, pp.PingPongFinished):
+                out[0] = l2_state.out_share
+            else:
+                current["leader"] = l2_state
+            hv = pp.continued(
+                vdaf, False, current["helper"], l_msg, agg_param
+            )
+            if hv.out_share is not None:
+                out[1] = hv.out_share
+                break
+            h2_state, msg_in_flight = hv.transition.evaluate(vdaf)
+            if isinstance(h2_state, pp.PingPongFinished):
+                out[1] = h2_state.out_share
+                if out[0] is not None:
+                    break
+            else:
+                current["helper"] = h2_state
+        if isinstance(current["helper"], pp.PingPongFinished) and out[1] is None:
+            out[1] = current["helper"].out_share
+        assert out[0] is not None and out[1] is not None, "transcript incomplete"
+        field = vdaf.field_for_agg_param(agg_param)
+        for b in (0, 1):
+            agg_shares[b] = (
+                list(out[b])
+                if agg_shares[b] is None
+                else field.vec_add(agg_shares[b], out[b])
+            )
+    return vdaf.unshard_with_param(agg_param, agg_shares, len(reports))
+
+
+class TestPoplar1:
+    def _shard(self, vdaf, rng, measurement):
+        nonce = rng(vdaf.NONCE_SIZE)
+        public, shares = vdaf.shard(measurement, nonce, rng(vdaf.RAND_SIZE))
+        # wire round trips
+        enc_pub = vdaf.encode_public_share(public)
+        public = vdaf.decode_public_share(enc_pub)
+        shares = [
+            Poplar1InputShare.decode(vdaf, i, s.encode(vdaf))
+            for i, s in enumerate(shares)
+        ]
+        return nonce, public, shares
+
+    @pytest.mark.parametrize("level", [0, 2, BITS - 1])
+    def test_transcript_at_level(self, level):
+        vdaf = Poplar1(BITS)
+        rng = det_rng(f"poplar-l{level}")
+        verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+        measurements = [0b101101, 0b101101, 0b010011]
+        reports = [self._shard(vdaf, rng, m) for m in measurements]
+        prefixes = tuple(range(1 << (level + 1)))
+        agg_param = Poplar1AggregationParam(level, prefixes)
+        counts = run_poplar1_transcript(vdaf, verify_key, agg_param, reports)
+        expect = [0] * len(prefixes)
+        for m in measurements:
+            expect[m >> (BITS - 1 - level)] += 1
+        assert counts == expect
+
+    def test_agg_param_codec(self):
+        vdaf = Poplar1(BITS)
+        param = Poplar1AggregationParam(2, (0, 3, 7))
+        data = vdaf.encode_agg_param(param)
+        assert vdaf.decode_agg_param(data) == param
+        with pytest.raises(VdafError):
+            vdaf.decode_agg_param(data[:-1])
+        with pytest.raises(VdafError):
+            Poplar1AggregationParam(1, (3, 0))  # unsorted
+
+    def test_tampered_share_fails_sketch(self):
+        """Corrupting the leader's correlated randomness breaks C = A² and
+        the sketch rejects."""
+        vdaf = Poplar1(BITS)
+        rng = det_rng("poplar-tamper")
+        verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+        nonce, public, shares = self._shard(vdaf, rng, 0b111000)
+        bad_inner = list(shares[0].corr_inner)
+        a, b, c = bad_inner[1]
+        bad_inner[1] = (a, b, Field64.add(c, 1))
+        shares[0].corr_inner = bad_inner
+        agg_param = Poplar1AggregationParam(1, (0, 1, 2, 3))
+        with pytest.raises(VdafError, match="sketch"):
+            run_poplar1_transcript(
+                vdaf, verify_key, agg_param, [(nonce, public, shares)]
+            )
+
+    def test_forged_two_hot_fails_sketch(self):
+        """A client can't claim two strings: summing two valid reports'
+        IDPF keys into one (simulated by doubling beta via tampered eval)
+        must be caught.  We simulate by tampering a y-share at sketch time
+        via a corrupted IDPF key — decide must reject."""
+        vdaf = Poplar1(BITS)
+        rng = det_rng("poplar-forge")
+        verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+        nonce, public, shares = self._shard(vdaf, rng, 0b000111)
+        # corrupt helper idpf key: evaluations no longer one-hot consistent
+        shares[1].idpf_key = bytes(
+            b ^ 0x40 for b in shares[1].idpf_key
+        )
+        agg_param = Poplar1AggregationParam(2, tuple(range(8)))
+        with pytest.raises(VdafError):
+            run_poplar1_transcript(
+                vdaf, verify_key, agg_param, [(nonce, public, shares)]
+            )
+
+    def test_heavy_hitters_traversal(self):
+        """Level-by-level prefix tree walk — the Poplar use case."""
+        vdaf = Poplar1(BITS)
+        rng = det_rng("poplar-hh")
+        verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+        measurements = [0b110011] * 4 + [0b110000] * 2 + [0b001100]
+        reports = [self._shard(vdaf, rng, m) for m in measurements]
+        threshold = 2
+
+        candidates = (0, 1)
+        for level in range(BITS):
+            agg_param = Poplar1AggregationParam(level, tuple(sorted(candidates)))
+            counts = run_poplar1_transcript(vdaf, verify_key, agg_param, reports)
+            hot = [
+                p
+                for p, c in zip(sorted(candidates), counts)
+                if c >= threshold
+            ]
+            if level < BITS - 1:
+                candidates = tuple(
+                    (p << 1) | bit for p in hot for bit in (0, 1)
+                )
+        assert sorted(hot) == [0b110000, 0b110011]
+
+    def test_instance_registry(self):
+        vdaf = vdaf_from_instance({"type": "Poplar1", "bits": 8})
+        assert isinstance(vdaf, Poplar1)
+        assert vdaf.bits == 8
