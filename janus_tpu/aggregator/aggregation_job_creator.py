@@ -76,9 +76,7 @@ class AggregationJobCreator:
     # -- per-task creation (one transaction) ----------------------------
     def create_jobs_for_task(self, tx: Transaction, task: AggregatorTask) -> int:
         vdaf = task.vdaf_instance()
-        try:
-            vdaf.decode_agg_param(b"")
-        except Exception:
+        if getattr(vdaf, "REQUIRES_AGG_PARAM", False):
             # VDAFs with a real aggregation parameter (Poplar1) get their
             # jobs from collection requests, not from this periodic creator
             # (the reference gates this path behind test-util:

@@ -95,20 +95,33 @@ class AggregationJobDriver:
     async def step_aggregation_job(self, lease: Lease) -> None:
         """Stepper callback for the JobDriver
         (reference: aggregation_job_driver.rs:126 step_aggregation_job)."""
+        from ..core.metrics import GLOBAL_METRICS, Timer
+
         if lease.lease_attempts > self.config.maximum_attempts_before_failure:
             await self.abandon_aggregation_job(lease)
             return
-        try:
-            await self._step(lease)
-        except JobStepError as e:
-            if e.retryable:
-                logger.warning("retryable step failure: %s", e)
-                await self.datastore.run_tx_async(
-                    "release_agg_job", lambda tx: tx.release_aggregation_job(lease)
-                )
-            else:
-                logger.error("fatal step failure: %s", e)
-                await self.abandon_aggregation_job(lease)
+        outcome = "success"
+        with Timer() as timer:
+            try:
+                await self._step(lease)
+            except JobStepError as e:
+                if e.retryable:
+                    outcome = "retried"
+                    logger.warning("retryable step failure: %s", e)
+                    await self.datastore.run_tx_async(
+                        "release_agg_job",
+                        lambda tx: tx.release_aggregation_job(lease),
+                    )
+                else:
+                    outcome = "abandoned"
+                    logger.error("fatal step failure: %s", e)
+                    await self.abandon_aggregation_job(lease)
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.job_steps.labels(
+                job_type="aggregation", outcome=outcome
+            ).observe(timer.seconds)
+            if outcome != "success":
+                GLOBAL_METRICS.step_failures.labels(type=outcome).inc()
 
     async def _step(self, lease: Lease) -> None:
         acq = lease.leased

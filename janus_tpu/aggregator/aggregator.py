@@ -182,6 +182,84 @@ class Aggregator:
         return ta
 
     # ------------------------------------------------------------------
+    # taskprov opt-in (reference: aggregator.rs:722)
+
+    async def ensure_taskprov_task(
+        self,
+        task_id: TaskId,
+        encoded_task_config: Optional[bytes],
+        auth_token: Optional[AuthenticationToken],
+        require_peer_auth: bool = True,
+    ) -> None:
+        """Provision a task advertised in-band, if the advertising peer is
+        configured, AUTHENTICATED, and the id matches SHA-256 of the config
+        (reference: aggregator.rs:722 opt-in + :813 taskprov request
+        authorization — the peer must present its pre-shared token before
+        anything is written)."""
+        if encoded_task_config is None:
+            return
+        if task_id.data in self._task_cache or await self.datastore.run_tx_async(
+            "taskprov_exists",
+            lambda tx: tx.get_aggregator_task(task_id) is not None,
+        ):
+            return
+        from .taskprov import taskprov_task, taskprov_task_id
+
+        if taskprov_task_id(encoded_task_config) != task_id:
+            raise InvalidMessage("taskprov task id mismatch")
+        from ..messages.taskprov import TaskConfig
+
+        config = TaskConfig.get_decoded(encoded_task_config)
+        if config.task_expiration.seconds <= self.clock.now().seconds:
+            raise InvalidMessage("taskprov advertisement already expired")
+
+        def tx_fn(tx):
+            peers = tx.get_taskprov_peer_aggregators()
+            own_role = peer = None
+            for p in peers:
+                if (
+                    p.role == Role.LEADER
+                    and p.endpoint == str(config.leader_aggregator_endpoint)
+                ):
+                    own_role, peer = Role.HELPER, p
+                    break
+                if (
+                    p.role == Role.HELPER
+                    and p.endpoint == str(config.helper_aggregator_endpoint)
+                ):
+                    own_role, peer = Role.LEADER, p
+                    break
+            if peer is None:
+                raise UnrecognizedTask("no taskprov peer for advertised task")
+            # authenticate the advertising peer before any write; the upload
+            # route is exempt (clients cannot hold the peer token — the
+            # reference separates upload opt-in from peer request auth)
+            if require_peer_auth:
+                h = peer.aggregator_auth_token_hash
+                if h is None and peer.aggregator_auth_token is not None:
+                    h = peer.aggregator_auth_token.hash()
+                if h is None or auth_token is None or not h.validate(auth_token):
+                    raise UnauthorizedRequest(
+                        "taskprov advertisement not authenticated"
+                    )
+            keys = [
+                HpkeKeypair(kp.config, kp.private_key)
+                for kp in tx.get_global_hpke_keypairs()
+                if kp.state.value == "Active"
+            ]
+            if not keys:
+                raise UnrecognizedTask("no active global HPKE key for taskprov")
+            task = taskprov_task(
+                encoded_task_config, peer, own_role, keys, config=config
+            )
+            try:
+                tx.put_aggregator_task(task)
+            except TxConflict:
+                pass  # concurrent provisioning of the same advertisement
+
+        await self.datastore.run_tx_async("taskprov_opt_in", tx_fn)
+
+    # ------------------------------------------------------------------
     # GET hpke_config (reference: http_handlers.rs "hpke_config" route)
 
     async def handle_hpke_config(self, task_id: Optional[TaskId]) -> HpkeConfigList:
@@ -303,18 +381,22 @@ class Aggregator:
 
         # Per-report validation + HPKE open (host side, async-friendly).
         failed: Dict[int, PrepareError] = {}
+        conflict_key = ta.vdaf.agg_param_conflict_key(req.aggregation_parameter)
+
+        def find_replays(tx):
+            out = []
+            for pi in req.prepare_inits:
+                rid = pi.report_share.metadata.report_id
+                for param in tx.get_aggregation_params_for_report(
+                    task_id, rid, exclude_aggregation_job_id=aggregation_job_id
+                ):
+                    if ta.vdaf.agg_param_conflict_key(param) == conflict_key:
+                        out.append(rid.data)
+                        break
+            return out
+
         replay_ids = await self.datastore.run_tx_async(
-            "agg_init_conflicts",
-            lambda tx: [
-                pi.report_share.metadata.report_id.data
-                for pi in req.prepare_inits
-                if tx.check_report_aggregation_exists(
-                    task_id,
-                    pi.report_share.metadata.report_id,
-                    aggregation_parameter=req.aggregation_parameter,
-                    exclude_aggregation_job_id=aggregation_job_id,
-                )
-            ],
+            "agg_init_conflicts", find_replays
         )
         replay_set = set(replay_ids)
         now = self.clock.now()
@@ -818,8 +900,81 @@ class Aggregator:
                     state=CollectionJobState.START,
                 )
             )
+            if getattr(ta.vdaf, "REQUIRES_AGG_PARAM", False):
+                # Aggregation-parameter VDAFs (Poplar1): the collection
+                # request IS what names the parameter, so aggregation jobs
+                # are created here, re-reading the (never scrubbed) client
+                # reports for each level (the reference gates the analogous
+                # path behind test-util, aggregation_job_creator.rs:741).
+                self._create_agg_param_jobs(tx, ta, ident, req.aggregation_parameter)
 
         await self.datastore.run_tx_async("create_collection_job", tx_fn)
+
+    def _create_agg_param_jobs(
+        self, tx, ta: TaskAggregator, collection_identifier: bytes, agg_param: bytes
+    ) -> None:
+        """Create aggregation jobs for one (batch, aggregation parameter)."""
+        from .aggregation_job_writer import AggregationJobWriter
+
+        task = ta.task
+        if task.query_type.kind != "TimeInterval":
+            raise BatchInvalid(
+                "aggregation-parameter VDAFs support TimeInterval tasks"
+            )
+        interval = Interval.get_decoded(collection_identifier)
+        reports = tx.get_client_reports_for_interval(task.task_id, interval, 50000)
+        if not reports:
+            return
+        conflict_key = ta.vdaf.agg_param_conflict_key(agg_param)
+        writer = AggregationJobWriter(
+            task,
+            ta.vdaf,
+            batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+            initial_write=True,
+        )
+        fresh = []
+        for report in reports:
+            params = tx.get_aggregation_params_for_report(
+                task.task_id, report.report_id
+            )
+            if any(
+                ta.vdaf.agg_param_conflict_key(p) == conflict_key for p in params
+            ):
+                continue  # already aggregated at this level
+            fresh.append(report)
+        for i in range(0, len(fresh), 256):
+            chunk = fresh[i : i + 256]
+            job_id = AggregationJobId.random()
+            start = min(r.time.seconds for r in chunk)
+            end = max(r.time.seconds for r in chunk) + 1
+            job = AggregationJob(
+                task_id=task.task_id,
+                aggregation_job_id=job_id,
+                aggregation_parameter=agg_param,
+                partial_batch_identifier=None,
+                client_timestamp_interval=Interval(
+                    Time(start), Duration(end - start)
+                ),
+                state=AggregationJobState.IN_PROGRESS,
+                step=AggregationJobStep(0),
+            )
+            ras = [
+                ReportAggregation(
+                    task_id=task.task_id,
+                    aggregation_job_id=job_id,
+                    report_id=r.report_id,
+                    time=r.time,
+                    ord=ord_,
+                    state=ReportAggregationState.START_LEADER,
+                    public_share=r.public_share,
+                    leader_extensions=r.leader_extensions,
+                    leader_input_share=r.leader_input_share,
+                    helper_encrypted_input_share=r.helper_encrypted_input_share,
+                )
+                for ord_, r in enumerate(chunk)
+            ]
+            writer.put(job, ras)
+        writer.write(tx)
 
     async def handle_get_collection_job(
         self,

@@ -235,7 +235,13 @@ class CollectionJobDriver:
     def _ready(self, tx, task: AggregatorTask, job) -> bool:
         """Readiness gate (reference: :124-262): no unaggregated reports in
         scope and all created aggregation jobs terminated."""
-        if task.query_type.kind == "TimeInterval":
+        vdaf = task.vdaf_instance()
+        if task.query_type.kind == "TimeInterval" and not getattr(
+            vdaf, "REQUIRES_AGG_PARAM", False
+        ):
+            # agg-param VDAFs never mark reports aggregated (they are reused
+            # across levels); their jobs are all created with the collection
+            # request, so created==terminated alone gates readiness
             interval = Interval.get_decoded(job.batch_identifier)
             if tx.count_unaggregated_client_reports_for_interval(
                 task.task_id, interval

@@ -78,10 +78,25 @@ def _wire(body: bytes, media_type: str, status: int = 200) -> web.Response:
 
 
 def _route(handler):
-    """Wrap a handler: task-id parsing + error → problem-document mapping
-    (reference: http_handlers.rs error mapping + instrumented spans)."""
+    """Wrap a handler: task-id parsing, error → problem-document mapping,
+    and per-route request metrics (reference: http_handlers.rs error mapping
+    + instrumented spans + :225-281 route counters)."""
+    import time as _time
+
+    from ..core.metrics import GLOBAL_METRICS
 
     async def wrapped(request: web.Request) -> web.Response:
+        t0 = _time.monotonic()
+        resp = await _wrapped_inner(request)
+        route = request.match_info.route.resource
+        GLOBAL_METRICS.observe_http(
+            route.canonical if route else request.path,
+            resp.status,
+            _time.monotonic() - t0,
+        )
+        return resp
+
+    async def _wrapped_inner(request: web.Request) -> web.Response:
         task_id = None
         try:
             if "task_id" in request.match_info:
@@ -91,6 +106,26 @@ def _route(handler):
                     from .error import InvalidMessage
 
                     raise InvalidMessage("malformed task id")
+                # in-band task provisioning (reference: aggregator.rs:722)
+                taskprov_header = request.headers.get("dap-taskprov")
+                if taskprov_header:
+                    import base64
+
+                    aggregator = request.app["aggregator"]
+                    try:
+                        encoded = base64.urlsafe_b64decode(
+                            taskprov_header + "=" * (-len(taskprov_header) % 4)
+                        )
+                    except Exception:
+                        from .error import InvalidMessage
+
+                        raise InvalidMessage("malformed dap-taskprov header")
+                    await aggregator.ensure_taskprov_task(
+                        task_id,
+                        encoded,
+                        _extract_auth(request),
+                        require_peer_auth=not request.path.endswith("/reports"),
+                    )
             return await handler(request, task_id)
         except DeletedCollectionJob:
             return web.Response(status=204)
@@ -193,6 +228,13 @@ def aggregator_app(aggregator: Aggregator) -> web.Application:
     async def healthz(_request: web.Request) -> web.Response:
         return web.Response(text="ok")
 
+    async def metrics(_request: web.Request) -> web.Response:
+        from ..core.metrics import GLOBAL_METRICS
+
+        return web.Response(
+            body=GLOBAL_METRICS.export(), content_type="text/plain"
+        )
+
     async def cors_preflight(_request: web.Request) -> web.Response:
         # reference: http_handlers.rs CORS preflight for upload from browsers
         return web.Response(
@@ -205,10 +247,12 @@ def aggregator_app(aggregator: Aggregator) -> web.Application:
         )
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["aggregator"] = aggregator
     app.add_routes(
         [
             web.get("/hpke_config", hpke_config),
             web.get("/healthz", healthz),
+            web.get("/metrics", metrics),
             web.put("/tasks/{task_id}/reports", upload),
             web.options("/tasks/{task_id}/reports", cors_preflight),
             web.put(

@@ -56,6 +56,10 @@ class ReportWriteBatcher:
 
     async def write_rejection(self, task_id: TaskId, rejection: ReportRejection) -> None:
         """Record a rejected upload in the task's sharded counters."""
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.upload_outcomes.labels(decision=rejection.category).inc()
         shard = random.randrange(self.counter_shard_count)
         counter = TaskUploadCounter(task_id, **{rejection.category: 1})
 
@@ -104,6 +108,8 @@ class ReportWriteBatcher:
                     outcomes.append(None)
             return outcomes
 
+        from ..core.metrics import GLOBAL_METRICS
+
         try:
             outcomes = await self.datastore.run_tx_async("upload_batch", tx_fn)
         except Exception as e:  # commit failed: fan the error to every waiter
@@ -112,6 +118,10 @@ class ReportWriteBatcher:
                     if not fut.done():
                         fut.set_exception(e)
             return
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.upload_outcomes.labels(decision="accepted").inc(
+                sum(1 for o in outcomes if o is None)
+            )
         for (report, futs), outcome in zip(unique, outcomes):
             for fut in futs:
                 if fut.done():

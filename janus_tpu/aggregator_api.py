@@ -1,0 +1,298 @@
+"""Management REST API.
+
+The analog of the reference's ``aggregator_api`` crate (reference:
+aggregator_api/src/lib.rs:71, routes.rs:32-420): task CRUD, per-task upload
+metrics, global HPKE config management, and taskprov peer management, under
+the versioned content type and bearer-token auth.
+
+Routes (all JSON, content type ``application/vnd.janus.aggregator+json;
+version=0.1``):
+
+    GET    /                          — API root/version probe
+    GET    /task_ids
+    POST   /tasks
+    GET    /tasks/:task_id
+    DELETE /tasks/:task_id
+    PATCH  /tasks/:task_id            — mutable fields (task_expiration)
+    GET    /tasks/:task_id/metrics/uploads
+    GET    /hpke_configs              — global HPKE keys
+    PUT    /hpke_configs              — generate a new key
+    PATCH  /hpke_configs/:config_id   — set state
+    DELETE /hpke_configs/:config_id
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import secrets
+from typing import Optional
+
+from aiohttp import web
+
+from .core.auth_tokens import AuthenticationToken
+from .core.hpke import HpkeKeypair
+from .datastore import (
+    AggregatorTask,
+    Datastore,
+    HpkeKeyState,
+    TaskNotFound,
+    TaskQueryType,
+    generate_vdaf_verify_key,
+    validate_vdaf_instance,
+)
+from .messages import Duration, HpkeConfig, Role, TaskId, Time
+
+CONTENT_TYPE = "application/vnd.janus.aggregator+json;version=0.1"
+
+
+def _b64u(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64u(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _task_to_json(task: AggregatorTask) -> dict:
+    return {
+        "task_id": _b64u(task.task_id.data),
+        "peer_aggregator_endpoint": task.peer_aggregator_endpoint,
+        "query_type": json.loads(task.query_type.to_json()),
+        "vdaf": task.vdaf,
+        "role": task.role.name.capitalize(),
+        "vdaf_verify_key": _b64u(task.vdaf_verify_key),
+        "task_expiration": task.task_expiration.seconds
+        if task.task_expiration
+        else None,
+        "report_expiry_age": task.report_expiry_age.seconds
+        if task.report_expiry_age
+        else None,
+        "min_batch_size": task.min_batch_size,
+        "time_precision": task.time_precision.seconds,
+        "tolerable_clock_skew": task.tolerable_clock_skew.seconds,
+        "collector_hpke_config": _b64u(task.collector_hpke_config.get_encoded())
+        if task.collector_hpke_config
+        else None,
+        "aggregator_auth_token": task.aggregator_auth_token.token
+        if task.aggregator_auth_token
+        else None,
+        "hpke_configs": [_b64u(kp.config.get_encoded()) for kp in task.hpke_keys],
+    }
+
+
+def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Application:
+    """Build the management API (reference: aggregator_api/src/lib.rs:71
+    aggregator_api_handler).  ``auth_tokens``: accepted bearer tokens."""
+    hashes = [AuthenticationToken.new_bearer(t).hash() for t in auth_tokens]
+
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        auth = request.headers.get("Authorization", "")
+        ok = False
+        if auth.startswith("Bearer "):
+            try:
+                presented = AuthenticationToken.new_bearer(auth[len("Bearer ") :])
+                ok = any(h.validate(presented) for h in hashes)
+            except ValueError:
+                ok = False
+        if not ok:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        try:
+            return await handler(request)
+        except TaskNotFound:
+            return web.json_response({"error": "task not found"}, status=404)
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+
+    def ok_json(payload, status=200):
+        return web.Response(
+            status=status, content_type="application/json", text=json.dumps(payload),
+            headers={"X-Content-Type-Version": CONTENT_TYPE},
+        )
+
+    async def get_root(_request):
+        return ok_json({"version": "0.1"})
+
+    async def get_task_ids(_request):
+        ids = await datastore.run_tx_async("api_task_ids", lambda tx: tx.get_task_ids())
+        return ok_json({"task_ids": [_b64u(t.data) for t in ids]})
+
+    async def post_task(request: web.Request):
+        body = await request.json()
+        validate_vdaf_instance(body["vdaf"])
+        qt = body.get("query_type", {"kind": "TimeInterval"})
+        btws = qt.get("batch_time_window_size")
+        role = Role[body["role"].upper()]
+        vk = (
+            _unb64u(body["vdaf_verify_key"])
+            if body.get("vdaf_verify_key")
+            else generate_vdaf_verify_key(body["vdaf"])
+        )
+        agg_token = None
+        agg_token_hash = None
+        if role == Role.LEADER:
+            agg_token = AuthenticationToken.new_bearer(
+                body.get("aggregator_auth_token") or secrets.token_urlsafe(32)
+            )
+        else:
+            if not body.get("aggregator_auth_token"):
+                raise ValueError("helper task requires aggregator_auth_token")
+            agg_token_hash = AuthenticationToken.new_bearer(
+                body["aggregator_auth_token"]
+            ).hash()
+        task = AggregatorTask(
+            task_id=TaskId(_unb64u(body["task_id"]))
+            if body.get("task_id")
+            else TaskId.random(),
+            peer_aggregator_endpoint=body["peer_aggregator_endpoint"],
+            query_type=TaskQueryType(
+                qt["kind"],
+                qt.get("max_batch_size"),
+                Duration(btws) if btws is not None else None,
+            ),
+            vdaf=body["vdaf"],
+            role=role,
+            vdaf_verify_key=vk,
+            min_batch_size=body["min_batch_size"],
+            time_precision=Duration(body["time_precision"]),
+            task_expiration=Time(body["task_expiration"])
+            if body.get("task_expiration")
+            else None,
+            report_expiry_age=Duration(body["report_expiry_age"])
+            if body.get("report_expiry_age")
+            else None,
+            aggregator_auth_token=agg_token,
+            aggregator_auth_token_hash=agg_token_hash,
+            collector_auth_token_hash=AuthenticationToken.new_bearer(
+                body["collector_auth_token"]
+            ).hash()
+            if body.get("collector_auth_token")
+            else None,
+            collector_hpke_config=HpkeConfig.get_decoded(
+                _unb64u(body["collector_hpke_config"])
+            )
+            if body.get("collector_hpke_config")
+            else None,
+            hpke_keys=[HpkeKeypair.generate(1)],
+        )
+        await datastore.run_tx_async(
+            "api_post_task", lambda tx: tx.put_aggregator_task(task)
+        )
+        return ok_json(_task_to_json(task), status=201)
+
+    async def get_task(request: web.Request):
+        task_id = TaskId(_unb64u(request.match_info["task_id"]))
+        task = await datastore.run_tx_async(
+            "api_get_task", lambda tx: tx.get_aggregator_task(task_id)
+        )
+        if task is None:
+            return web.json_response({"error": "task not found"}, status=404)
+        return ok_json(_task_to_json(task))
+
+    async def delete_task(request: web.Request):
+        task_id = TaskId(_unb64u(request.match_info["task_id"]))
+        await datastore.run_tx_async(
+            "api_delete_task", lambda tx: tx.delete_task(task_id)
+        )
+        return web.Response(status=204)
+
+    async def patch_task(request: web.Request):
+        task_id = TaskId(_unb64u(request.match_info["task_id"]))
+        body = await request.json()
+        existing = await datastore.run_tx_async(
+            "api_get_task", lambda tx: tx.get_aggregator_task(task_id)
+        )
+        if existing is None:
+            return web.json_response({"error": "task not found"}, status=404)
+        if "task_expiration" in body:
+            exp = body["task_expiration"]
+            await datastore.run_tx_async(
+                "api_patch_task",
+                lambda tx: tx.update_task_expiration(
+                    task_id, Time(exp) if exp is not None else None
+                ),
+            )
+        task = await datastore.run_tx_async(
+            "api_get_task", lambda tx: tx.get_aggregator_task(task_id)
+        )
+        return ok_json(_task_to_json(task))
+
+    async def get_upload_metrics(request: web.Request):
+        task_id = TaskId(_unb64u(request.match_info["task_id"]))
+        counter = await datastore.run_tx_async(
+            "api_metrics", lambda tx: tx.get_task_upload_counter(task_id)
+        )
+        return ok_json(
+            {c: getattr(counter, c) for c in counter.COLUMNS}
+        )
+
+    async def get_hpke_configs(_request):
+        keypairs = await datastore.run_tx_async(
+            "api_hpke", lambda tx: tx.get_global_hpke_keypairs()
+        )
+        return ok_json(
+            [
+                {
+                    "config": _b64u(kp.config.get_encoded()),
+                    "id": kp.config.id,
+                    "state": kp.state.value,
+                }
+                for kp in keypairs
+            ]
+        )
+
+    async def put_hpke_config(request: web.Request):
+        body = await request.json() if request.can_read_body else {}
+        existing = await datastore.run_tx_async(
+            "api_hpke_list", lambda tx: tx.get_global_hpke_keypairs()
+        )
+        used = {kp.config.id for kp in existing}
+        config_id = body.get("id")
+        if config_id is None:
+            free = [i for i in range(256) if i not in used]
+            if not free:
+                raise ValueError("all 256 HPKE config ids are in use")
+            config_id = free[0]
+        kp = HpkeKeypair.generate(config_id)
+        await datastore.run_tx_async(
+            "api_hpke_put", lambda tx: tx.put_global_hpke_keypair(kp)
+        )
+        return ok_json(
+            {"config": _b64u(kp.config.get_encoded()), "id": config_id}, status=201
+        )
+
+    async def patch_hpke_config(request: web.Request):
+        config_id = int(request.match_info["config_id"])
+        body = await request.json()
+        state = HpkeKeyState(body["state"])
+        await datastore.run_tx_async(
+            "api_hpke_patch",
+            lambda tx: tx.set_global_hpke_keypair_state(config_id, state),
+        )
+        return web.Response(status=200)
+
+    async def delete_hpke_config(request: web.Request):
+        config_id = int(request.match_info["config_id"])
+        await datastore.run_tx_async(
+            "api_hpke_delete", lambda tx: tx.delete_global_hpke_keypair(config_id)
+        )
+        return web.Response(status=204)
+
+    app = web.Application(middlewares=[auth_middleware])
+    app.add_routes(
+        [
+            web.get("/", get_root),
+            web.get("/task_ids", get_task_ids),
+            web.post("/tasks", post_task),
+            web.get("/tasks/{task_id}", get_task),
+            web.delete("/tasks/{task_id}", delete_task),
+            web.patch("/tasks/{task_id}", patch_task),
+            web.get("/tasks/{task_id}/metrics/uploads", get_upload_metrics),
+            web.get("/hpke_configs", get_hpke_configs),
+            web.put("/hpke_configs", put_hpke_config),
+            web.patch("/hpke_configs/{config_id}", patch_hpke_config),
+            web.delete("/hpke_configs/{config_id}", delete_hpke_config),
+        ]
+    )
+    return app

@@ -187,6 +187,53 @@ def set_global_hpke_key_state(config_id: int, state: str, config_file):
     click.echo("ok")
 
 
+@cli.command("add-taskprov-peer-aggregator")
+@click.option("--endpoint", required=True)
+@click.option("--role", type=click.Choice(["Leader", "Helper"]), required=True)
+@click.option("--verify-key-init", required=True, help="b64url 32 bytes")
+@click.option("--collector-hpke-config", required=True, help="b64url HpkeConfig")
+@click.option("--aggregator-auth-token", default=None)
+@click.option("--aggregator-auth-token-for-hash", default=None)
+@click.option("--config-file", type=click.Path(exists=True), default=None)
+def add_taskprov_peer_aggregator(
+    endpoint,
+    role,
+    verify_key_init,
+    collector_hpke_config,
+    aggregator_auth_token,
+    aggregator_auth_token_for_hash,
+    config_file,
+):
+    """reference: janus_cli.rs add-taskprov-peer-aggregator"""
+    from ..aggregator.taskprov import PeerAggregator
+    from ..core.auth_tokens import AuthenticationToken
+    from ..core.time import RealClock
+    from ..datastore import Crypter, Datastore
+    from ..messages import HpkeConfig, Role
+    from .config import AggregatorConfig, datastore_keys_from_env, load_config
+
+    cfg = load_config(AggregatorConfig, config_file)
+    ds = Datastore(
+        cfg.common.database.path, Crypter(datastore_keys_from_env()), RealClock()
+    )
+    peer = PeerAggregator(
+        endpoint=endpoint,
+        role=Role[role.upper()],
+        verify_key_init=_unb64u(verify_key_init),
+        collector_hpke_config=HpkeConfig.get_decoded(_unb64u(collector_hpke_config)),
+        aggregator_auth_token=AuthenticationToken.new_bearer(aggregator_auth_token)
+        if aggregator_auth_token
+        else None,
+        aggregator_auth_token_hash=AuthenticationToken.new_bearer(
+            aggregator_auth_token_for_hash
+        ).hash()
+        if aggregator_auth_token_for_hash
+        else None,
+    )
+    ds.run_tx("add_peer", lambda tx: tx.put_taskprov_peer_aggregator(peer))
+    click.echo("ok")
+
+
 @cli.command("dap-decode")
 @click.argument("message_file", type=click.Path(exists=True))
 @click.option(

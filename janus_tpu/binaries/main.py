@@ -39,10 +39,9 @@ logger = logging.getLogger("janus_tpu.binaries")
 
 
 def _bootstrap(config_common):
-    logging.basicConfig(
-        level=getattr(logging, config_common.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    from ..core.trace import TraceConfiguration, install_trace_subscriber
+
+    install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
     clock = RealClock()
     crypter = Crypter(datastore_keys_from_env())
     datastore = Datastore(
@@ -66,13 +65,32 @@ def _stop_event_on_signals(loop) -> asyncio.Event:
 
 
 async def _serve_health(listen_address: str):
+    """Health + zpages server: /healthz, /metrics, PUT /traceconfigz
+    (reference: binary_utils.rs:398-456)."""
     from aiohttp import web
+
+    from ..core.metrics import GLOBAL_METRICS
+    from ..core.trace import reload_trace_filter
 
     async def healthz(_):
         return web.Response(text="ok")
 
+    async def metrics(_):
+        return web.Response(body=GLOBAL_METRICS.export(), content_type="text/plain")
+
+    async def traceconfigz(request):
+        level = (await request.text()).strip()
+        reload_trace_filter(level)
+        return web.Response(text=f"log level set to {level}\n")
+
     app = web.Application()
-    app.add_routes([web.get("/healthz", healthz)])
+    app.add_routes(
+        [
+            web.get("/healthz", healthz),
+            web.get("/metrics", metrics),
+            web.put("/traceconfigz", traceconfigz),
+        ]
+    )
     runner = web.AppRunner(app)
     await runner.setup()
     host, port = parse_listen_address(listen_address)
@@ -275,6 +293,17 @@ def main(argv=None) -> int:
         from .janus_cli import cli
 
         cli.main(args=argv, standalone_mode=True)
+    elif binary.startswith("janus_interop_"):
+        from ..interop import run_interop_binary
+
+        port = 8080
+        for i, arg in enumerate(argv):
+            if arg == "--port":
+                if i + 1 >= len(argv):
+                    print("--port requires a value", file=sys.stderr)
+                    return 2
+                port = int(argv[i + 1])
+        run_interop_binary(binary[len("janus_interop_") :], port)
     else:
         print(f"unknown binary {binary!r}", file=sys.stderr)
         return 2

@@ -1,0 +1,122 @@
+"""Metrics: Prometheus registry + the reference's domain metrics.
+
+The analog of the reference's OTel metrics stack (reference:
+aggregator/src/metrics.rs:222-323): per-route HTTP request counts/latency,
+upload outcome counters by rejection reason, aggregate step failures by
+type, job acquire/step timing, and per-transaction status/duration.
+Exported via a Prometheus scrape endpoint on the health server
+(``/metrics``), matching the reference's prometheus exporter mode.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover - baked into the image
+    HAVE_PROMETHEUS = False
+
+#: Latency buckets tuned like the reference's custom histogram views
+#: (reference: metrics.rs:103-174).
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Metrics:
+    """Domain metrics bundle; one per process."""
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        self.http_requests = Counter(
+            "janus_http_requests_total",
+            "DAP HTTP requests by route and status",
+            ["route", "status"],
+            registry=self.registry,
+        )
+        self.http_latency = Histogram(
+            "janus_http_request_duration_seconds",
+            "DAP HTTP request latency by route",
+            ["route"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        # reference: report_writer.rs:324 upload counters by reason
+        self.upload_outcomes = Counter(
+            "janus_upload_decision_total",
+            "Upload outcomes by decision",
+            ["decision"],
+            registry=self.registry,
+        )
+        # reference: metrics.rs:313 janus_aggregate_step_failure
+        self.step_failures = Counter(
+            "janus_aggregate_step_failure_total",
+            "Aggregation step failures by type",
+            ["type"],
+            registry=self.registry,
+        )
+        # reference: job_driver.rs:102-113 acquire/step timing
+        self.job_steps = Histogram(
+            "janus_job_step_duration_seconds",
+            "Job step wall time by job type and outcome",
+            ["job_type", "outcome"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        # reference: datastore.rs:186-224 per-tx status
+        self.tx_total = Counter(
+            "janus_database_transactions_total",
+            "Datastore transactions by name and status",
+            ["name", "status"],
+            registry=self.registry,
+        )
+        # batched device launches through the backend seam
+        self.device_launches = Counter(
+            "janus_device_prepare_launches_total",
+            "Batched VDAF prepare launches by backend",
+            ["backend"],
+            registry=self.registry,
+        )
+        self.device_reports = Counter(
+            "janus_device_prepare_reports_total",
+            "Reports prepared through batched launches by backend",
+            ["backend"],
+            registry=self.registry,
+        )
+
+    # -- helpers --------------------------------------------------------
+    def observe_http(self, route: str, status: int, seconds: float) -> None:
+        if self.registry is None:
+            return
+        self.http_requests.labels(route=route, status=str(status)).inc()
+        self.http_latency.labels(route=route).observe(seconds)
+
+    def export(self) -> bytes:
+        if self.registry is None:
+            return b""
+        return generate_latest(self.registry)
+
+
+#: Process-wide default bundle (the analog of the reference's global meters).
+GLOBAL_METRICS = Metrics()
+
+
+class Timer:
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.monotonic() - self.start

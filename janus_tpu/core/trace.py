@@ -1,0 +1,65 @@
+"""Tracing/logging configuration.
+
+The analog of the reference's ``TraceConfiguration`` (reference:
+aggregator/src/trace.rs:36-236): pretty or JSON structured stdout logging
+with a runtime-reloadable level filter (the reference exposes this as PUT
+``/traceconfigz`` on the health port; our health server does the same).
+On-device profiling is the separate ``jax.profiler`` session the bench
+harness can enable — host tracing stays here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class TraceConfiguration:
+    """reference: trace.rs:36"""
+
+    use_json: bool = False
+    level: str = "INFO"
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line (reference: trace.rs json/stackdriver
+    stdout modes)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exception"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def install_trace_subscriber(config: Optional[TraceConfiguration] = None) -> None:
+    """reference: trace.rs:119 install_trace_subscriber"""
+    config = config or TraceConfiguration()
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stdout)
+    if config.use_json:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, config.level.upper(), logging.INFO))
+
+
+def reload_trace_filter(level: str) -> None:
+    """Runtime log-level reload (reference: binary_utils.rs:422-456
+    /traceconfigz)."""
+    logging.getLogger().setLevel(getattr(logging, level.upper(), logging.INFO))

@@ -114,6 +114,14 @@ def _decode_extensions(data: bytes) -> List[Extension]:
     return out
 
 
+def _metrics_tx(name: str, status: str) -> None:
+    """reference: datastore.rs:186-224 per-tx status metrics."""
+    from ..core.metrics import GLOBAL_METRICS
+
+    if GLOBAL_METRICS.registry is not None:
+        GLOBAL_METRICS.tx_total.labels(name=name, status=status).inc()
+
+
 class Datastore:
     """Thread-safe handle; one SQLite connection per thread."""
 
@@ -179,6 +187,7 @@ class Datastore:
             try:
                 result = fn(tx)
                 conn.execute("COMMIT")
+                _metrics_tx(name, "committed")
                 return result
             except sqlite3.OperationalError as e:
                 conn.execute("ROLLBACK")
@@ -190,6 +199,7 @@ class Datastore:
             except BaseException:
                 conn.execute("ROLLBACK")
                 raise
+        _metrics_tx(name, "exhausted")
         raise DatastoreError(f"transaction {name!r} exhausted retries: {last_err}")
 
     async def run_tx_async(self, name: str, fn: Callable[["Transaction"], T]) -> T:
@@ -501,6 +511,27 @@ class Transaction:
                WHERE task_id = ? AND report_id = ?""",
             (pk, report_id.data),
         )
+
+    def get_client_reports_for_interval(
+        self, task_id: TaskId, interval: Interval, limit: int
+    ) -> List[LeaderStoredReport]:
+        """Full (unscrubbed) reports in an interval — the collection-driven
+        creation path for aggregation-parameter VDAFs, whose reports are
+        re-aggregated at every level and therefore never scrubbed."""
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT report_id FROM client_reports
+               WHERE task_id = ? AND client_timestamp >= ? AND client_timestamp < ?
+                 AND leader_input_share IS NOT NULL
+               ORDER BY client_timestamp LIMIT ?""",
+            (pk, interval.start.seconds, interval.end().seconds, limit),
+        ).fetchall()
+        out = []
+        for (rid,) in rows:
+            report = self.get_client_report(task_id, ReportId(rid))
+            if report is not None:
+                out.append(report)
+        return out
 
     def count_client_reports_for_interval(
         self, task_id: TaskId, interval: Interval
@@ -882,6 +913,24 @@ class Transaction:
             )
         except sqlite3.IntegrityError as e:
             raise TxConflict(f"report aggregation ord {meta.ord} already exists") from e
+
+    def get_aggregation_params_for_report(
+        self,
+        task_id: TaskId,
+        report_id: ReportId,
+        exclude_aggregation_job_id: Optional[AggregationJobId] = None,
+    ) -> List[bytes]:
+        """Distinct aggregation parameters of jobs this report is already in
+        (the VDAF decides which of them CONFLICT with a new one)."""
+        pk = self._task_pk(task_id)
+        sql = """SELECT DISTINCT aj.aggregation_param FROM report_aggregations ra
+                 JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+                 WHERE ra.task_id = ? AND ra.report_id = ?"""
+        args: List[Any] = [pk, report_id.data]
+        if exclude_aggregation_job_id is not None:
+            sql += " AND aj.aggregation_job_id != ?"
+            args.append(exclude_aggregation_job_id.data)
+        return [r[0] for r in self.conn.execute(sql, args)]
 
     def check_report_aggregation_exists(
         self,
@@ -1548,6 +1597,124 @@ class Transaction:
         )
         if cur.rowcount == 0:
             raise DatastoreError(f"no global HPKE key {config_id}")
+
+    # ------------------------------------------------------------------
+    # taskprov peer aggregators (reference: datastore.rs:4983-5326)
+
+    def put_taskprov_peer_aggregator(self, peer) -> None:
+        from ..aggregator.taskprov import PeerAggregator  # noqa: F401 (type)
+
+        row_ident = peer.endpoint.encode() + bytes([peer.role.value])
+        enc_init = self.crypter.encrypt(
+            "taskprov_peer_aggregators", row_ident, "verify_key_init",
+            peer.verify_key_init,
+        )
+        tok_type = tok_enc = None
+        if peer.aggregator_auth_token is not None:
+            tok_type = peer.aggregator_auth_token.kind
+            tok_enc = self.crypter.encrypt(
+                "taskprov_peer_aggregators", row_ident, "aggregator_auth_token",
+                peer.aggregator_auth_token.as_bytes(),
+            )
+        try:
+            self.conn.execute(
+                """INSERT INTO taskprov_peer_aggregators (endpoint, role,
+                    verify_key_init, collector_hpke_config, report_expiry_age,
+                    tolerable_clock_skew, aggregator_auth_token_type,
+                    aggregator_auth_token, aggregator_auth_token_hash,
+                    collector_auth_token_hash)
+                   VALUES (?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    peer.endpoint,
+                    peer.role.name.capitalize(),
+                    enc_init,
+                    peer.collector_hpke_config.get_encoded(),
+                    peer.report_expiry_age.seconds if peer.report_expiry_age else None,
+                    peer.tolerable_clock_skew.seconds,
+                    tok_type,
+                    tok_enc,
+                    json.dumps(peer.aggregator_auth_token_hash.to_dict())
+                    if peer.aggregator_auth_token_hash
+                    else None,
+                    json.dumps(peer.collector_auth_token_hash.to_dict())
+                    if peer.collector_auth_token_hash
+                    else None,
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            raise TxConflict("taskprov peer already exists") from e
+
+    def _peer_from_row(self, row):
+        from ..aggregator.taskprov import PeerAggregator
+
+        (
+            endpoint,
+            role_s,
+            enc_init,
+            cfg_b,
+            expiry_age,
+            skew,
+            tok_type,
+            tok_enc,
+            agg_hash_s,
+            col_hash_s,
+        ) = row
+        role = Role[role_s.upper()]
+        row_ident = endpoint.encode() + bytes([role.value])
+        token = None
+        if tok_enc is not None:
+            raw = self.crypter.decrypt(
+                "taskprov_peer_aggregators", row_ident, "aggregator_auth_token", tok_enc
+            )
+            token = AuthenticationToken(tok_type, raw.decode())
+        return PeerAggregator(
+            endpoint=endpoint,
+            role=role,
+            verify_key_init=self.crypter.decrypt(
+                "taskprov_peer_aggregators", row_ident, "verify_key_init", enc_init
+            ),
+            collector_hpke_config=HpkeConfig.get_decoded(cfg_b),
+            report_expiry_age=Duration(expiry_age) if expiry_age is not None else None,
+            tolerable_clock_skew=Duration(skew),
+            aggregator_auth_token=token,
+            aggregator_auth_token_hash=AuthenticationTokenHash.from_dict(
+                json.loads(agg_hash_s)
+            )
+            if agg_hash_s
+            else None,
+            collector_auth_token_hash=AuthenticationTokenHash.from_dict(
+                json.loads(col_hash_s)
+            )
+            if col_hash_s
+            else None,
+        )
+
+    _PEER_COLS = """endpoint, role, verify_key_init, collector_hpke_config,
+        report_expiry_age, tolerable_clock_skew, aggregator_auth_token_type,
+        aggregator_auth_token, aggregator_auth_token_hash,
+        collector_auth_token_hash"""
+
+    def get_taskprov_peer_aggregator(self, endpoint: str, role: Role):
+        row = self.conn.execute(
+            f"SELECT {self._PEER_COLS} FROM taskprov_peer_aggregators"
+            " WHERE endpoint = ? AND role = ?",
+            (endpoint, role.name.capitalize()),
+        ).fetchone()
+        return self._peer_from_row(row) if row else None
+
+    def get_taskprov_peer_aggregators(self):
+        rows = self.conn.execute(
+            f"SELECT {self._PEER_COLS} FROM taskprov_peer_aggregators ORDER BY id"
+        ).fetchall()
+        return [self._peer_from_row(r) for r in rows]
+
+    def delete_taskprov_peer_aggregator(self, endpoint: str, role: Role) -> None:
+        cur = self.conn.execute(
+            "DELETE FROM taskprov_peer_aggregators WHERE endpoint = ? AND role = ?",
+            (endpoint, role.name.capitalize()),
+        )
+        if cur.rowcount == 0:
+            raise DatastoreError("no such taskprov peer")
 
     # ------------------------------------------------------------------
     # upload counters (reference: datastore.rs:5326-5429)

@@ -168,6 +168,11 @@ class TpuBackend:
         pad_to = next_power_of_2(B)
         kw = self._marshal(agg_id, reports, pad_to)
         kw["verify_key_u8"] = np.frombuffer(verify_key, dtype=np.uint8)
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
+            GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
         out = self._prep_fn(agg_id)(kw)
 
         ok = np.asarray(out["ok"])[:B]

@@ -70,6 +70,7 @@ class DummyVdaf:
     VERIFY_KEY_SIZE = 0
     RAND_SIZE = 0
     ROUNDS: int
+    REQUIRES_AGG_PARAM = False
     field = DummyField
 
     def __init__(self, rounds: int = 1):
@@ -115,6 +116,9 @@ class DummyVdaf:
         if len(data) != 4:
             raise VdafError("bad dummy aggregation parameter")
         return struct.unpack(">I", data)[0]
+
+    def agg_param_conflict_key(self, data: bytes) -> bytes:
+        return data
 
     # -- ping-pong adapter surface --------------------------------------
     def ping_pong_prep_init(self, verify_key, agg_id, agg_param, nonce, public_share, input_share):

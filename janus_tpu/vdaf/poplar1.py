@@ -183,6 +183,7 @@ class Poplar1:
     NONCE_SIZE = 16
     VERIFY_KEY_SIZE = 16
     ROUNDS = 2
+    REQUIRES_AGG_PARAM = True
     num_shares = 2
 
     def __init__(self, bits: int):
@@ -209,6 +210,13 @@ class Poplar1:
 
     def decode_input_share(self, agg_id: int, data: bytes) -> Poplar1InputShare:
         return Poplar1InputShare.decode(self, agg_id, data)
+
+    def agg_param_conflict_key(self, data: bytes) -> bytes:
+        """A report may be aggregated at most ONCE PER LEVEL: the sketch's
+        correlated randomness is keyed by (nonce, level), so two different
+        prefix sets at one level would reuse one-time randomness and leak
+        relations among the helper's shares."""
+        return data[:2]  # the big-endian level prefix of the encoded param
 
     def encode_public_share(self, public_share) -> bytes:
         return self.idpf.encode_public_share(public_share)

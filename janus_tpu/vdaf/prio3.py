@@ -122,6 +122,7 @@ class Prio3:
 
     ROUNDS = 1
     NONCE_SIZE = 16
+    REQUIRES_AGG_PARAM = False
 
     def __init__(
         self,
@@ -406,3 +407,7 @@ class Prio3:
         if data:
             raise VdafError("Prio3 takes no aggregation parameter")
         return None
+
+    def agg_param_conflict_key(self, data: bytes) -> bytes:
+        """Reports may be aggregated once, period (no aggregation parameter)."""
+        return b""

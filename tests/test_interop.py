@@ -1,0 +1,199 @@
+"""Interop-API end-to-end: client/leader/helper/collector containers'
+HTTP control surface, in-process (reference:
+interop_binaries/tests/end_to_end.rs over a Docker network)."""
+
+import asyncio
+import base64
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator import (
+    Aggregator,
+    AggregationJobCreator,
+    AggregationJobDriver,
+    CollectionJobDriver,
+    Config,
+    CreatorConfig,
+    aggregator_app,
+)
+from janus_tpu.core.time import MockClock, RealClock
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.interop import (
+    interop_aggregator_app,
+    interop_client_app,
+    interop_collector_app,
+)
+from janus_tpu.messages import Duration, TaskId, Time
+
+
+def _b64u(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def test_interop_end_to_end():
+    """Drive the whole protocol exclusively through /internal/test/*."""
+    clock = RealClock()
+    leader_eds = EphemeralDatastore(clock)
+    helper_eds = EphemeralDatastore(clock)
+    cfg = Config(vdaf_backend="oracle", max_upload_batch_write_delay=0.02)
+    leader_agg = Aggregator(leader_eds.datastore, clock, cfg)
+    helper_agg = Aggregator(helper_eds.datastore, clock, cfg)
+
+    task_id = TaskId.random()
+    vdaf = {"type": "Prio3Count"}
+    now = clock.now().seconds
+    start = now - now % 3600
+
+    async def flow():
+        leader = TestClient(
+            TestServer(
+                interop_aggregator_app(
+                    leader_eds.datastore, leader_agg, aggregator_app(leader_agg)
+                )
+            )
+        )
+        helper = TestClient(
+            TestServer(
+                interop_aggregator_app(
+                    helper_eds.datastore, helper_agg, aggregator_app(helper_agg)
+                )
+            )
+        )
+        client_api = TestClient(TestServer(interop_client_app()))
+        collector_api = TestClient(TestServer(interop_collector_app()))
+        for c in (leader, helper, client_api, collector_api):
+            await c.start_server()
+        try:
+            for c in (leader, helper, client_api, collector_api):
+                assert (await c.post("/internal/test/ready")).status == 200
+
+            leader_url = str(leader.make_url("/dap/"))
+            helper_url = str(helper.make_url("/dap/"))
+
+            # collector add_task first (we need its HPKE config)
+            resp = await collector_api.post(
+                "/internal/test/add_task",
+                json={
+                    "task_id": _b64u(task_id.data),
+                    "leader": leader_url,
+                    "vdaf": vdaf,
+                    "collector_authentication_token": "col-tok",
+                    "query_type": 1,
+                },
+            )
+            doc = await resp.json()
+            assert doc["status"] == "success", doc
+            collector_hpke = doc["collector_hpke_config"]
+
+            # add_task on both aggregators
+            common = {
+                "task_id": _b64u(task_id.data),
+                "leader": leader_url,
+                "helper": helper_url,
+                "vdaf": vdaf,
+                "leader_authentication_token": "agg-tok",
+                "vdaf_verify_key": _b64u(b"\x2a" * 16),
+                "min_batch_size": 1,
+                "time_precision": 3600,
+                "query_type": 1,
+                "collector_hpke_config": collector_hpke,
+            }
+            resp = await leader.post(
+                "/internal/test/add_task",
+                json={
+                    **common,
+                    "role": "Leader",
+                    "collector_authentication_token": "col-tok",
+                },
+            )
+            assert (await resp.json())["status"] == "success", await resp.text()
+            resp = await helper.post(
+                "/internal/test/add_task", json={**common, "role": "Helper"}
+            )
+            assert (await resp.json())["status"] == "success", await resp.text()
+
+            # uploads through the interop client
+            measurements = [1, 1, 0, 1]
+            for m in measurements:
+                resp = await client_api.post(
+                    "/internal/test/upload",
+                    json={
+                        "task_id": _b64u(task_id.data),
+                        "leader": leader_url,
+                        "helper": helper_url,
+                        "vdaf": vdaf,
+                        "measurement": str(m),
+                        "time_precision": 3600,
+                    },
+                )
+                doc = await resp.json()
+                assert doc["status"] == "success", doc
+            await asyncio.sleep(0.1)
+
+            # drive aggregation on the leader
+            creator = AggregationJobCreator(
+                leader_eds.datastore, CreatorConfig(min_aggregation_job_size=1)
+            )
+            await creator.run_once()
+            driver = AggregationJobDriver(leader_eds.datastore, aiohttp.ClientSession)
+            while True:
+                leases = await leader_eds.datastore.run_tx_async(
+                    "a",
+                    lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+                )
+                if not leases:
+                    break
+                for lease in leases:
+                    await driver.step_aggregation_job(lease)
+            await driver.close()
+
+            # collection through the interop collector
+            resp = await collector_api.post(
+                "/internal/test/collection_start",
+                json={
+                    "task_id": _b64u(task_id.data),
+                    "agg_param": "",
+                    "query": {
+                        "type": 1,
+                        "batch_interval_start": start,
+                        "batch_interval_duration": 7200,
+                    },
+                },
+            )
+            doc = await resp.json()
+            assert doc["status"] == "success", doc
+            handle = doc["handle"]
+
+            coll_driver = CollectionJobDriver(
+                leader_eds.datastore, aiohttp.ClientSession
+            )
+            result = None
+            for _ in range(50):
+                leases = await leader_eds.datastore.run_tx_async(
+                    "c",
+                    lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 10),
+                )
+                for lease in leases:
+                    await coll_driver.step_collection_job(lease)
+                resp = await collector_api.post(
+                    "/internal/test/collection_poll", json={"handle": handle}
+                )
+                doc = await resp.json()
+                if doc["status"] == "success":
+                    result = doc
+                    break
+                assert doc["status"] == "in progress", doc
+                await asyncio.sleep(0.1)
+            await coll_driver.close()
+            assert result is not None, "collection never completed"
+            assert result["report_count"] == len(measurements)
+            assert result["result"] == str(sum(measurements))
+        finally:
+            for c in (leader, helper, client_api, collector_api):
+                await c.close()
+
+    asyncio.new_event_loop().run_until_complete(flow())
+    leader_eds.cleanup()
+    helper_eds.cleanup()

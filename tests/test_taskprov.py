@@ -1,0 +1,219 @@
+"""Taskprov tests: peer storage, task derivation, and in-band opt-in over
+HTTP (reference: aggregator/src/aggregator/taskprov_tests.rs style)."""
+
+import asyncio
+import base64
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.http_handlers import aggregator_app
+from janus_tpu.aggregator.taskprov import (
+    PeerAggregator,
+    derive_vdaf_verify_key,
+    taskprov_task,
+    taskprov_task_id,
+)
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import HpkeKeyState
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Role, Time, Url
+from janus_tpu.messages.taskprov import (
+    DpConfig,
+    DpMechanism,
+    QueryConfig,
+    TaskConfig,
+    TaskprovQuery,
+    VdafConfig,
+    VdafType,
+)
+
+NOW = Time(1_600_002_000)
+AGG_TOKEN = AuthenticationToken.new_bearer("taskprov-agg-tok")
+
+
+def make_task_config():
+    return TaskConfig(
+        task_info=b"test task",
+        leader_aggregator_endpoint=Url("https://leader.example.com/"),
+        helper_aggregator_endpoint=Url("https://helper.example.com/"),
+        query_config=QueryConfig(
+            time_precision=Duration(3600),
+            max_batch_query_count=1,
+            min_batch_size=10,
+            query=TaskprovQuery.time_interval(),
+        ),
+        task_expiration=Time(NOW.seconds + 86400),
+        vdaf_config=VdafConfig(DpConfig(DpMechanism.none()), VdafType(VdafType.PRIO3COUNT)),
+    )
+
+
+class TestDerivation:
+    def test_task_id_and_key_deterministic(self):
+        encoded = make_task_config().get_encoded()
+        tid = taskprov_task_id(encoded)
+        assert tid == taskprov_task_id(encoded)
+        vk = derive_vdaf_verify_key(b"\x05" * 32, tid, 16)
+        assert len(vk) == 16
+        assert vk == derive_vdaf_verify_key(b"\x05" * 32, tid, 16)
+        assert vk != derive_vdaf_verify_key(b"\x06" * 32, tid, 16)
+
+    def test_taskprov_task_builds(self):
+        encoded = make_task_config().get_encoded()
+        collector = HpkeKeypair.generate(9)
+        peer = PeerAggregator(
+            endpoint="https://leader.example.com/",
+            role=Role.LEADER,
+            verify_key_init=b"\x05" * 32,
+            collector_hpke_config=collector.config,
+            aggregator_auth_token_hash=AGG_TOKEN.hash(),
+        )
+        task = taskprov_task(encoded, peer, Role.HELPER, [HpkeKeypair.generate(1)])
+        assert task.role == Role.HELPER
+        assert task.vdaf == {"type": "Prio3Count"}
+        assert task.min_batch_size == 10
+        assert task.task_id == taskprov_task_id(encoded)
+
+
+class TestPeerStorage:
+    def test_round_trip(self):
+        eds = EphemeralDatastore(MockClock(NOW))
+        collector = HpkeKeypair.generate(9)
+        peer = PeerAggregator(
+            endpoint="https://leader.example.com/",
+            role=Role.LEADER,
+            verify_key_init=b"\x07" * 32,
+            collector_hpke_config=collector.config,
+            aggregator_auth_token=AuthenticationToken.new_bearer("peer-tok"),
+        )
+        ds = eds.datastore
+        ds.run_tx("put", lambda tx: tx.put_taskprov_peer_aggregator(peer))
+        got = ds.run_tx(
+            "get",
+            lambda tx: tx.get_taskprov_peer_aggregator(
+                "https://leader.example.com/", Role.LEADER
+            ),
+        )
+        assert got == peer
+        assert ds.run_tx("list", lambda tx: tx.get_taskprov_peer_aggregators()) == [
+            peer
+        ]
+        ds.run_tx(
+            "del",
+            lambda tx: tx.delete_taskprov_peer_aggregator(
+                "https://leader.example.com/", Role.LEADER
+            ),
+        )
+        assert ds.run_tx("list2", lambda tx: tx.get_taskprov_peer_aggregators()) == []
+        eds.cleanup()
+
+
+def test_opt_in_over_http():
+    """An aggregate-init with a dap-taskprov header auto-provisions the task
+    on the helper, then processes the job against it."""
+    from test_aggregator_handlers import leader_prep_inits, make_pair_tasks
+    from janus_tpu.datastore import AggregatorTask, TaskQueryType
+    from janus_tpu.messages import (
+        AggregationJobId,
+        AggregationJobInitializeReq,
+        PartialBatchSelector,
+        PrepareStepResult,
+    )
+
+    eds = EphemeralDatastore(MockClock(NOW))
+    ds = eds.datastore
+    agg = Aggregator(ds, eds.clock, Config(vdaf_backend="oracle"))
+    app = aggregator_app(agg)
+
+    encoded = make_task_config().get_encoded()
+    task_id = taskprov_task_id(encoded)
+    collector = HpkeKeypair.generate(9)
+    peer = PeerAggregator(
+        endpoint="https://leader.example.com/",
+        role=Role.LEADER,
+        verify_key_init=b"\x05" * 32,
+        collector_hpke_config=collector.config,
+        aggregator_auth_token_hash=AGG_TOKEN.hash(),
+    )
+    ds.run_tx("peer", lambda tx: tx.put_taskprov_peer_aggregator(peer))
+    global_key = HpkeKeypair.generate(33)
+    ds.run_tx("key", lambda tx: tx.put_global_hpke_keypair(global_key))
+    ds.run_tx(
+        "key2",
+        lambda tx: tx.set_global_hpke_keypair_state(33, HpkeKeyState.ACTIVE),
+    )
+
+    # build the leader-side view of the same task to produce real reports
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+
+    vdaf = vdaf_from_instance({"type": "Prio3Count"})
+    vk = derive_vdaf_verify_key(b"\x05" * 32, task_id, 16)
+    leader_task = AggregatorTask(
+        task_id=task_id,
+        peer_aggregator_endpoint="https://helper.example.com/",
+        query_type=TaskQueryType.time_interval(),
+        vdaf={"type": "Prio3Count"},
+        role=Role.LEADER,
+        vdaf_verify_key=vk,
+        min_batch_size=10,
+        time_precision=Duration(3600),
+        aggregator_auth_token=AGG_TOKEN,
+        hpke_keys=[HpkeKeypair.generate(1)],
+    )
+    helper_view_for_keys = AggregatorTask(
+        task_id=task_id,
+        peer_aggregator_endpoint="https://leader.example.com/",
+        query_type=TaskQueryType.time_interval(),
+        vdaf={"type": "Prio3Count"},
+        role=Role.HELPER,
+        vdaf_verify_key=vk,
+        min_batch_size=10,
+        time_precision=Duration(3600),
+        aggregator_auth_token_hash=AGG_TOKEN.hash(),
+        hpke_keys=[global_key],
+    )
+    inits, states, reports = leader_prep_inits(
+        vdaf, leader_task, helper_view_for_keys, [1, 0, 1]
+    )
+    req = AggregationJobInitializeReq(
+        aggregation_parameter=b"",
+        partial_batch_selector=PartialBatchSelector.new_time_interval(),
+        prepare_inits=inits,
+    )
+    header = base64.urlsafe_b64encode(encoded).rstrip(b"=").decode()
+
+    async def flow():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            url = f"/tasks/{task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.put(
+                url,
+                data=req.get_encoded(),
+                headers={
+                    "Authorization": "Bearer " + AGG_TOKEN.token,
+                    "dap-taskprov": header,
+                },
+            )
+            assert resp.status == 200, await resp.text()
+            from janus_tpu.messages import AggregationJobResp
+
+            job_resp = AggregationJobResp.get_decoded(await resp.read())
+            assert all(
+                pr.result.variant == PrepareStepResult.CONTINUE
+                for pr in job_resp.prepare_resps
+            )
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(flow())
+
+    # the task was provisioned
+    task = ds.run_tx("get", lambda tx: tx.get_aggregator_task(task_id))
+    assert task is not None
+    assert task.role == Role.HELPER
+    assert task.vdaf_verify_key == vk
+    eds.cleanup()
