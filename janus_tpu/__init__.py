@@ -1,7 +1,7 @@
 """janus_tpu — a TPU-native DAP aggregation framework.
 
 A ground-up re-design of the capabilities of divviup/janus (v0.7.4) for TPU:
-the Prio3 VDAF prepare step (FLP proof verification over Field64/Field128 plus
+the VDAF prepare step (FLP proof verification over Field64/Field128 plus
 TurboSHAKE128 XOF expansion) runs as jax.vmap'd modular-arithmetic tensor ops
 batched across whole aggregation jobs, with output-share accumulation reduced
 over a device mesh.  A bit-exact CPU oracle (fields/xof/flp/vdaf modules)
@@ -10,13 +10,21 @@ mirrors the pure-Rust ``prio`` path.
 Layout (see SURVEY.md for the reference layer map this re-expresses):
   fields, xof     — bit-exact scalar oracle for the crypto kernel
   flp/            — FLP proof system: gadgets, circuits, prove/query/decide
-  vdaf/           — Prio3 composition, ping-pong topology, instance registry,
-                    execution backends (oracle | tpu), dummy test VDAFs
-  ops/            — JAX/TPU kernels: u32-limb field ops, scanned Keccak,
+  vdaf/           — Prio3 + Poplar1 (IDPF, sketch), ping-pong topology,
+                    instance registry, execution backends (oracle | tpu),
+                    fake test VDAFs with fault injection
+  ops/            — JAX/TPU kernels: u32-limb field ops, lane-major Keccak,
                     batched XOF sampling, the batched prepare pipeline
   messages/       — DAP wire messages + TLS-syntax codec, taskprov, problems
-  core/           — HPKE (RFC 9180), auth tokens, checksums, clock/time math
+  core/           — HPKE (RFC 9180), auth tokens, checksums, clock/time math,
+                    HTTP retries, metrics, tracing
+  native/         — C++ TurboSHAKE host kernel (ctypes)
+  datastore/      — the database-is-the-checkpoint persistence layer: run_tx,
+                    leases, column crypto, models, task model, query types
+  aggregator/     — role logic, DAP HTTP API, job drivers, writers, taskprov
+  binaries/       — multi-call entry: daemons, janus_cli, interop servers
+  client, collector, aggregator_api, interop — SDKs and auxiliary APIs
   utils/          — transcript/test helpers, shared JAX setup
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"

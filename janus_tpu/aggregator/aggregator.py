@@ -932,11 +932,12 @@ class Aggregator:
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=True,
         )
+        params_by_report = tx.get_aggregation_params_by_report_for_interval(
+            task.task_id, interval
+        )
         fresh = []
         for report in reports:
-            params = tx.get_aggregation_params_for_report(
-                task.task_id, report.report_id
-            )
+            params = params_by_report.get(report.report_id.data, [])
             if any(
                 ta.vdaf.agg_param_conflict_key(p) == conflict_key for p in params
             ):

@@ -77,6 +77,33 @@ def _wire(body: bytes, media_type: str, status: int = 200) -> web.Response:
     return web.Response(status=status, body=body, content_type=media_type)
 
 
+async def _maybe_taskprov(request: web.Request, task_id: TaskId) -> None:
+    """In-band task provisioning (reference: aggregator.rs:722).  Upload and
+    hpke_config requests are client-originated and cannot carry the peer
+    token; everything else must."""
+    taskprov_header = request.headers.get("dap-taskprov")
+    if not taskprov_header:
+        return
+    from ..messages.dap import _unb64url
+
+    aggregator = request.app["aggregator"]
+    try:
+        encoded = _unb64url(taskprov_header)
+    except Exception:
+        from .error import InvalidMessage
+
+        raise InvalidMessage("malformed dap-taskprov header")
+    client_route = request.path.endswith("/reports") or request.path.endswith(
+        "/hpke_config"
+    )
+    await aggregator.ensure_taskprov_task(
+        task_id,
+        encoded,
+        _extract_auth(request),
+        require_peer_auth=not client_route,
+    )
+
+
 def _route(handler):
     """Wrap a handler: task-id parsing, error → problem-document mapping,
     and per-route request metrics (reference: http_handlers.rs error mapping
@@ -107,25 +134,7 @@ def _route(handler):
 
                     raise InvalidMessage("malformed task id")
                 # in-band task provisioning (reference: aggregator.rs:722)
-                taskprov_header = request.headers.get("dap-taskprov")
-                if taskprov_header:
-                    import base64
-
-                    aggregator = request.app["aggregator"]
-                    try:
-                        encoded = base64.urlsafe_b64decode(
-                            taskprov_header + "=" * (-len(taskprov_header) % 4)
-                        )
-                    except Exception:
-                        from .error import InvalidMessage
-
-                        raise InvalidMessage("malformed dap-taskprov header")
-                    await aggregator.ensure_taskprov_task(
-                        task_id,
-                        encoded,
-                        _extract_auth(request),
-                        require_peer_auth=not request.path.endswith("/reports"),
-                    )
+                await _maybe_taskprov(request, task_id)
             return await handler(request, task_id)
         except DeletedCollectionJob:
             return web.Response(status=204)
@@ -151,6 +160,7 @@ def aggregator_app(aggregator: Aggregator) -> web.Application:
         task_id = None
         if "task_id" in request.query:
             task_id = TaskId.from_str(request.query["task_id"])
+            await _maybe_taskprov(request, task_id)
         config_list = await aggregator.handle_hpke_config(task_id)
         return _wire(config_list.get_encoded(), HpkeConfigList.MEDIA_TYPE)
 

@@ -23,7 +23,6 @@ version=0.1``):
 
 from __future__ import annotations
 
-import base64
 import json
 import secrets
 from typing import Optional
@@ -38,6 +37,7 @@ from .datastore import (
     HpkeKeyState,
     TaskNotFound,
     TaskQueryType,
+    TxConflict,
     generate_vdaf_verify_key,
     validate_vdaf_instance,
 )
@@ -46,12 +46,7 @@ from .messages import Duration, HpkeConfig, Role, TaskId, Time
 CONTENT_TYPE = "application/vnd.janus.aggregator+json;version=0.1"
 
 
-def _b64u(data: bytes) -> str:
-    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
-
-
-def _unb64u(s: str) -> bytes:
-    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+from .messages.dap import _b64url as _b64u, _unb64url as _unb64u
 
 
 def _task_to_json(task: AggregatorTask) -> dict:
@@ -121,6 +116,9 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
     async def post_task(request: web.Request):
         body = await request.json()
         validate_vdaf_instance(body["vdaf"])
+        if not body.get("collector_hpke_config"):
+            # without it, collection responses can never be sealed
+            raise ValueError("collector_hpke_config is required")
         qt = body.get("query_type", {"kind": "TimeInterval"})
         btws = qt.get("batch_time_window_size")
         role = Role[body["role"].upper()]
@@ -244,22 +242,33 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
 
     async def put_hpke_config(request: web.Request):
         body = await request.json() if request.can_read_body else {}
-        existing = await datastore.run_tx_async(
-            "api_hpke_list", lambda tx: tx.get_global_hpke_keypairs()
-        )
-        used = {kp.config.id for kp in existing}
         config_id = body.get("id")
-        if config_id is None:
-            free = [i for i in range(256) if i not in used]
-            if not free:
-                raise ValueError("all 256 HPKE config ids are in use")
-            config_id = free[0]
-        kp = HpkeKeypair.generate(config_id)
-        await datastore.run_tx_async(
-            "api_hpke_put", lambda tx: tx.put_global_hpke_keypair(kp)
-        )
+        if config_id is not None and (
+            not isinstance(config_id, int) or not 0 <= config_id <= 255
+        ):
+            raise ValueError("id must be an integer in [0, 255]")
+
+        # pick-and-insert in ONE transaction so concurrent PUTs cannot race
+        def tx_fn(tx):
+            used = {kp.config.id for kp in tx.get_global_hpke_keypairs()}
+            cid = config_id
+            if cid is None:
+                free = [i for i in range(256) if i not in used]
+                if not free:
+                    raise ValueError("all 256 HPKE config ids are in use")
+                cid = free[0]
+            elif cid in used:
+                raise TxConflict(f"HPKE config id {cid} already exists")
+            kp = HpkeKeypair.generate(cid)
+            tx.put_global_hpke_keypair(kp)
+            return kp, cid
+
+        try:
+            kp, cid = await datastore.run_tx_async("api_hpke_put", tx_fn)
+        except TxConflict as e:
+            return web.json_response({"error": str(e)}, status=409)
         return ok_json(
-            {"config": _b64u(kp.config.get_encoded()), "id": config_id}, status=201
+            {"config": _b64u(kp.config.get_encoded()), "id": cid}, status=201
         )
 
     async def patch_hpke_config(request: web.Request):

@@ -17,12 +17,7 @@ import sys
 import click
 
 
-def _b64u(data: bytes) -> str:
-    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
-
-
-def _unb64u(s: str) -> bytes:
-    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+from ..messages.dap import _b64url as _b64u, _unb64url as _unb64u
 
 
 @click.group()

@@ -29,7 +29,7 @@ import secrets
 import sqlite3
 import threading
 import time as _time
-from typing import Any, Callable, List, Optional, Sequence, Tuple, TypeVar
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
 
 from ..core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
 from ..core.hpke import HpkeKeypair
@@ -517,20 +517,53 @@ class Transaction:
     ) -> List[LeaderStoredReport]:
         """Full (unscrubbed) reports in an interval — the collection-driven
         creation path for aggregation-parameter VDAFs, whose reports are
-        re-aggregated at every level and therefore never scrubbed."""
+        re-aggregated at every level and therefore never scrubbed.  One
+        query; per-row work is only the column decrypt."""
         pk = self._task_pk(task_id)
         rows = self.conn.execute(
-            """SELECT report_id FROM client_reports
+            """SELECT report_id, client_timestamp, extensions, public_share,
+                      leader_input_share, helper_encrypted_input_share
+               FROM client_reports
                WHERE task_id = ? AND client_timestamp >= ? AND client_timestamp < ?
                  AND leader_input_share IS NOT NULL
                ORDER BY client_timestamp LIMIT ?""",
             (pk, interval.start.seconds, interval.end().seconds, limit),
         ).fetchall()
         out = []
-        for (rid,) in rows:
-            report = self.get_client_report(task_id, ReportId(rid))
-            if report is not None:
-                out.append(report)
+        for rid, ts, ext_b, public_share, enc_share, helper_b in rows:
+            share = self.crypter.decrypt(
+                "client_reports", task_id.data + rid, "leader_input_share", enc_share
+            )
+            out.append(
+                LeaderStoredReport(
+                    task_id=task_id,
+                    metadata=ReportMetadata(ReportId(rid), Time(ts)),
+                    public_share=public_share,
+                    leader_extensions=_decode_extensions(ext_b) if ext_b else [],
+                    leader_input_share=share,
+                    helper_encrypted_input_share=HpkeCiphertext.get_decoded(helper_b),
+                )
+            )
+        return out
+
+    def get_aggregation_params_by_report_for_interval(
+        self, task_id: TaskId, interval: Interval
+    ) -> Dict[bytes, List[bytes]]:
+        """report_id -> distinct aggregation params, for every report in the
+        interval, in one query (the batch form of
+        get_aggregation_params_for_report)."""
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT DISTINCT ra.report_id, aj.aggregation_param
+               FROM report_aggregations ra
+               JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+               WHERE ra.task_id = ? AND ra.client_timestamp >= ?
+                 AND ra.client_timestamp < ?""",
+            (pk, interval.start.seconds, interval.end().seconds),
+        ).fetchall()
+        out: Dict[bytes, List[bytes]] = {}
+        for rid, param in rows:
+            out.setdefault(rid, []).append(param)
         return out
 
     def count_client_reports_for_interval(
@@ -939,21 +972,13 @@ class Transaction:
         aggregation_parameter: bytes = b"",
         exclude_aggregation_job_id: Optional[AggregationJobId] = None,
     ) -> bool:
-        """Helper replay check: has this report been aggregated in another
-        job WITH THE SAME aggregation parameter?  Scoping by parameter is
-        what lets Poplar1 re-aggregate the same reports level by level
+        """Exact-parameter replay check, expressed over
+        get_aggregation_params_for_report so the two can't diverge.  Role
+        logic uses the VDAF's conflict key on the params list instead
         (reference: aggregator.rs:1765 dup-report-ID check)."""
-        pk = self._task_pk(task_id)
-        sql = """SELECT 1 FROM report_aggregations ra
-                 JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
-                 WHERE ra.task_id = ? AND ra.report_id = ?
-                   AND aj.aggregation_param = ?"""
-        args = [pk, report_id.data, aggregation_parameter]
-        if exclude_aggregation_job_id is not None:
-            sql += " AND aj.aggregation_job_id != ?"
-            args.append(exclude_aggregation_job_id.data)
-        row = self.conn.execute(sql + " LIMIT 1", args).fetchone()
-        return row is not None
+        return aggregation_parameter in self.get_aggregation_params_for_report(
+            task_id, report_id, exclude_aggregation_job_id
+        )
 
     # ------------------------------------------------------------------
     # batch aggregations (reference: datastore.rs:3626-4008)

@@ -17,7 +17,6 @@ collector}`` — or build the apps in-process for tests.
 from __future__ import annotations
 
 import asyncio
-import base64
 import secrets
 from typing import Dict, Optional
 
@@ -48,12 +47,7 @@ from .messages import (
 )
 
 
-def _unb64u(s: str) -> bytes:
-    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
-
-
-def _b64u(b: bytes) -> str:
-    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+from .messages.dap import _b64url as _b64u, _unb64url as _unb64u
 
 
 def _vdaf_to_instance(vdaf: dict) -> dict:

@@ -175,6 +175,25 @@ class XofTurboShake128(Xof):
     def next(self, length: int) -> bytes:
         return self._sponge.squeeze(length)
 
+    @classmethod
+    def expand_into_vec(
+        cls, field: type, seed: bytes, dst: bytes, binder: bytes, length: int
+    ) -> List[int]:
+        # Hot path: the native C++ sponge (bit-exact, tests/test_native.py).
+        # The C++ kernel hardcodes the two rejection moduli, so gate on the
+        # EXACT modulus — a different 8/16-byte field must take the Python
+        # path or it would silently sample against the wrong bound.
+        if field.MODULUS in (
+            2**64 - 2**32 + 1,
+            2**128 - 7 * 2**66 + 1,
+        ):
+            from .native import next_vec as native_next_vec
+
+            out = native_next_vec(seed, dst, binder, field.ENCODED_SIZE, length)
+            if out is not None:
+                return out
+        return cls(seed, dst, binder).next_vec(field, length)
+
 
 from functools import lru_cache as _lru_cache
 

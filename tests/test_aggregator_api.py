@@ -45,7 +45,13 @@ def test_management_api_lifecycle():
             resp = await client.get("/task_ids", headers=headers)
             assert (await resp.json())["task_ids"] == []
 
-            # create a task
+            # create a task (collector_hpke_config is mandatory: without it
+            # collection responses could never be sealed)
+            from janus_tpu.core.hpke import HpkeKeypair
+
+            collector_cfg = base64.urlsafe_b64encode(
+                HpkeKeypair.generate(9).config.get_encoded()
+            ).rstrip(b"=").decode()
             resp = await client.post(
                 "/tasks",
                 headers=headers,
@@ -56,6 +62,7 @@ def test_management_api_lifecycle():
                     "min_batch_size": 10,
                     "time_precision": 3600,
                     "collector_auth_token": "col-tok",
+                    "collector_hpke_config": collector_cfg,
                 },
             )
             assert resp.status == 201, await resp.text()
