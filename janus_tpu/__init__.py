@@ -13,7 +13,7 @@ Layout (see SURVEY.md for the reference layer map this re-expresses):
   vdaf/           — Prio3 + Poplar1 (IDPF, sketch), ping-pong topology,
                     instance registry, execution backends (oracle | tpu),
                     fake test VDAFs with fault injection
-  ops/            — JAX/TPU kernels: u32-limb field ops, lane-major Keccak,
+  ops/            — JAX/TPU kernels: u32-limb field ops, scanned Keccak,
                     batched XOF sampling, the batched prepare pipeline
   messages/       — DAP wire messages + TLS-syntax codec, taskprov, problems
   core/           — HPKE (RFC 9180), auth tokens, checksums, clock/time math,

@@ -159,7 +159,12 @@ def aggregator_app(aggregator: Aggregator) -> web.Application:
     async def hpke_config(request: web.Request, _tid) -> web.Response:
         task_id = None
         if "task_id" in request.query:
-            task_id = TaskId.from_str(request.query["task_id"])
+            try:
+                task_id = TaskId.from_str(request.query["task_id"])
+            except Exception:
+                from .error import InvalidMessage
+
+                raise InvalidMessage("malformed task id")
             await _maybe_taskprov(request, task_id)
         config_list = await aggregator.handle_hpke_config(task_id)
         return _wire(config_list.get_encoded(), HpkeConfigList.MEDIA_TYPE)

@@ -61,13 +61,15 @@ class Field:
     # --- vector ops -------------------------------------------------------
     @classmethod
     def vec_add(cls, a: Sequence[int], b: Sequence[int]) -> List[int]:
-        assert len(a) == len(b)
+        if len(a) != len(b):
+            raise ValueError("vector length mismatch")
         p = cls.MODULUS
         return [(x + y) % p for x, y in zip(a, b)]
 
     @classmethod
     def vec_sub(cls, a: Sequence[int], b: Sequence[int]) -> List[int]:
-        assert len(a) == len(b)
+        if len(a) != len(b):
+            raise ValueError("vector length mismatch")
         p = cls.MODULUS
         return [(x - y) % p for x, y in zip(a, b)]
 
@@ -144,11 +146,13 @@ class Field255(Field):
 
 def _init_field(cls: type) -> None:
     p = cls.MODULUS
-    assert (p - 1) % (1 << cls.NUM_ROOTS) == 0
+    # explicit raises: these import-time invariants must hold even under -O
+    if (p - 1) % (1 << cls.NUM_ROOTS) != 0:
+        raise AssertionError(f"{cls.__name__}: 2-adicity does not divide p-1")
     g = pow(cls.GEN_BASE, (p - 1) >> cls.NUM_ROOTS, p)
     # g must have order exactly 2^NUM_ROOTS.
-    assert pow(g, 1 << cls.NUM_ROOTS, p) == 1
-    assert pow(g, 1 << (cls.NUM_ROOTS - 1), p) != 1
+    if pow(g, 1 << cls.NUM_ROOTS, p) != 1 or pow(g, 1 << (cls.NUM_ROOTS - 1), p) == 1:
+        raise AssertionError(f"{cls.__name__}: generator order check failed")
     cls._GEN = g
 
 

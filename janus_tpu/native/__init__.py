@@ -28,16 +28,24 @@ _tried = False
 
 
 def _build() -> bool:
+    # build to a temp path and rename: concurrent cold processes must never
+    # CDLL a partially written library
+    tmp = _LIB + f".tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB)
         return True
     except Exception as e:
         logger.debug("native build failed: %s", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -76,6 +84,15 @@ def load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def _validate(seed: Optional[bytes], dst: bytes) -> None:
+    """Mirror the Python XOF's input contract — the C ABI reads exactly 16
+    seed bytes and truncates the dst length prefix to one byte."""
+    if seed is not None and len(seed) != 16:
+        raise ValueError("bad seed size")
+    if len(dst) > 255:
+        raise ValueError("dst too long")
+
+
 def turboshake128(message: bytes, domain: int, length: int) -> Optional[bytes]:
     lib = load()
     if lib is None:
@@ -90,6 +107,7 @@ def xof_stream(seed: bytes, dst: bytes, binder: bytes, length: int) -> Optional[
     lib = load()
     if lib is None:
         return None
+    _validate(seed, dst)
     out = ctypes.create_string_buffer(length)
     lib.ts128_expand_vdaf(seed, dst, len(dst), binder, len(binder), out, length)
     return out.raw
@@ -102,6 +120,7 @@ def next_vec(
     lib = load()
     if lib is None or field_encoded_size not in (8, 16):
         return None
+    _validate(seed, dst)
     out = (ctypes.c_uint64 * (2 * length))()
     rc = lib.ts128_next_vec(
         seed, dst, len(dst), binder, len(binder),

@@ -14,6 +14,7 @@ values.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import List, Sequence, Tuple
 
@@ -24,6 +25,32 @@ from jax import lax
 
 _U32 = jnp.uint32
 _MASK16 = np.uint32(0xFFFF)
+
+
+def _eager_jit(static_argnums=(0,)):
+    """Jit for EAGER callers only; inline when already under a trace.
+
+    Wrapping these methods in plain jax.jit made eager tests fast but
+    embedded hundreds of nested pjit calls into every prepare trace, which
+    blew XLA CPU compile times from tens of seconds to tens of minutes.
+    Tracing callers get the original inlined body; eager callers (tests,
+    oracle fallbacks) get a cached compiled version.
+    """
+
+    def deco(fn):
+        jitted = partial(jax.jit, static_argnums=static_argnums)(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if any(isinstance(a, jax.core.Tracer) for a in args) or any(
+                isinstance(v, jax.core.Tracer) for v in kwargs.values()
+            ):
+                return fn(*args, **kwargs)
+            return jitted(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 def _u32(x: int):
@@ -161,7 +188,7 @@ class JField:
         take = (extra_bit | (1 - borrow)).astype(jnp.bool_)
         return [jnp.where(take, d[i], limbs[i]) for i in range(self.n)]
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def add(self, a, b):
         """Canonical modular addition."""
         aa, bb = self._split(a), self._split(b)
@@ -172,7 +199,7 @@ class JField:
             s.append(si)
         return self._join(self._cond_sub_p(s, carry))
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def sub(self, a, b):
         """Canonical modular subtraction."""
         aa, bb = self._split(a), self._split(b)
@@ -194,7 +221,7 @@ class JField:
     def neg(self, a):
         return self.sub(self.zeros(a.shape[:-1]), a)
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def mont_mul(self, a, b):
         """CIOS Montgomery multiplication: returns a*b*R^-1 mod p, canonical."""
         n = self.n
@@ -225,12 +252,12 @@ class JField:
             t[n + 1] = zero
         return self._join(self._cond_sub_p(t[:n], t[n]))
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def to_mont(self, a):
         r2 = jnp.asarray(self.r2_np)
         return self.mont_mul(a, jnp.broadcast_to(r2, a.shape))
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def from_mont(self, a):
         one = jnp.asarray(self.one_np)
         return self.mont_mul(a, jnp.broadcast_to(one, a.shape))
@@ -239,7 +266,7 @@ class JField:
         bits = 32 * self.n
         return jnp.asarray(self._int_to_limbs_np((1 << bits) % self.p))
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def inv_mont(self, a):
         """Fermat inversion in Montgomery domain: a^(p-2).  inv(0) = 0."""
         bits = jnp.asarray(self._inv_exp_bits)
@@ -255,16 +282,16 @@ class JField:
         acc, _ = lax.scan(body, one, bits)
         return acc
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def eq(self, a, b):
         """Elementwise equality of canonical limb vectors -> bool (...)."""
         return jnp.all(a == b, axis=-1)
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def is_zero(self, a):
         return jnp.all(a == 0, axis=-1)
 
-    @partial(jax.jit, static_argnums=(0, 2))
+    @_eager_jit(static_argnums=(0, 2))
     def sum(self, a, axis: int):
         """Exact modular reduction (tree) along an element axis."""
         axis = axis % (a.ndim - 1)  # never the limb axis
@@ -278,13 +305,13 @@ class JField:
             length = half + (length - 2 * half)
         return jnp.squeeze(a, axis=axis)
 
-    @partial(jax.jit, static_argnums=(0, 2))
+    @_eager_jit(static_argnums=(0, 2))
     def cumprod_mont(self, a, axis: int):
         """Inclusive cumulative product (Montgomery domain) along an axis."""
         axis = axis % (a.ndim - 1)
         return lax.associative_scan(self.mont_mul, a, axis=axis)
 
-    @partial(jax.jit, static_argnums=(0,))
+    @_eager_jit(static_argnums=(0,))
     def horner_mont(self, coeffs, x):
         """Evaluate poly with coeff tensor (..., n_coeffs, n_limbs) at x (..., n_limbs).
 
@@ -301,7 +328,7 @@ class JField:
         acc, _ = lax.scan(body, acc0, cs)
         return acc
 
-    @partial(jax.jit, static_argnums=(0, 2))
+    @_eager_jit(static_argnums=(0, 2))
     def batch_inv_mont(self, a, axis: int):
         """Montgomery-trick batched inversion along an axis (all nonzero)."""
         axis = axis % (a.ndim - 1)

@@ -20,6 +20,8 @@ from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .field_jax import _eager_jit
 import numpy as np
 from jax import lax
 
@@ -57,14 +59,8 @@ def _rotl_pair(lo, hi, r: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _keccak_round(state: jnp.ndarray, rc_pair: jnp.ndarray) -> jnp.ndarray:
-    """One Keccak round, LANE-MAJOR: state (50, ...) u32, lane i = rows
-    (2i, 2i+1).  The batch lives on the minor axes, so every lane op is a
-    well-tiled elementwise op over the batch — the lane axis is sliced on
-    the major dimension instead of gathering a strided, heavily-padded
-    minor axis (the TPU tiles the two minor dims to (8, 128); a trailing
-    axis of 50 wastes ~60% of every vector register and every HBM burst).
-    """
-    lanes = [(state[2 * i], state[2 * i + 1]) for i in range(25)]
+    """One Keccak round on state (..., 50) u32 (lane i = pairs 2i, 2i+1)."""
+    lanes = [(state[..., 2 * i], state[..., 2 * i + 1]) for i in range(25)]
     # theta
     c = []
     for x in range(5):
@@ -96,12 +92,11 @@ def _keccak_round(state: jnp.ndarray, rc_pair: jnp.ndarray) -> jnp.ndarray:
     for i in range(25):
         flat.append(lanes[i][0])
         flat.append(lanes[i][1])
-    return jnp.stack(flat, axis=0)
+    return jnp.stack(flat, axis=-1)
 
 
 def keccak_p_batch(state: jnp.ndarray) -> jnp.ndarray:
-    """Keccak-p[1600,12] on LANE-MAJOR state (50, ...) u32: lane i = rows
-    (2i, 2i+1); the batch occupies the trailing axes.
+    """Keccak-p[1600,12] on state (..., 50) u32: lane i = (state[2i], state[2i+1]).
 
     Rounds run under lax.scan (they are sequential by construction) so each
     XOF site contributes one round body to the graph, not twelve — an order
@@ -149,28 +144,24 @@ def _pad_message(msg: jnp.ndarray, domain: int) -> jnp.ndarray:
     return jnp.concatenate([msg, pad_arr], axis=-1)
 
 
-@partial(jax.jit, static_argnums=(1, 2))
+@_eager_jit(static_argnums=(1, 2))
 def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndarray:
     """One-shot TurboSHAKE128 over a batch: msg (..., L) u8 -> (..., out_len) u8.
 
     L and out_len are static.  Matches janus_tpu.xof.turboshake128 exactly.
-    The sponge itself runs lane-major (word axis leading, batch trailing) so
-    the per-round lane ops vectorize over the batch; the transposes sit only
-    at the byte boundaries.
     """
     padded = _pad_message(msg, domain)
     batch_shape = padded.shape[:-1]
     nblocks = padded.shape[-1] // RATE
     words = bytes_to_words(padded).reshape(batch_shape + (nblocks, RATE_WORDS))
-    # (nblocks, RATE_WORDS, ...batch) — lane-major blocks
-    blocks = jnp.moveaxis(
-        jnp.moveaxis(words, -2, 0), -1, 1
-    )
-    state0 = jnp.zeros((50,) + batch_shape, dtype=_U32)
+    state0 = jnp.zeros(batch_shape + (50,), dtype=_U32)
+
+    # absorb: xor each block into the rate words, permute
+    blocks = jnp.moveaxis(words, -2, 0)  # (nblocks, ..., 42)
 
     def absorb(state, block):
-        rate_part = state[:RATE_WORDS] ^ block
-        state = jnp.concatenate([rate_part, state[RATE_WORDS:]], axis=0)
+        rate_part = state[..., :RATE_WORDS] ^ block
+        state = jnp.concatenate([rate_part, state[..., RATE_WORDS:]], axis=-1)
         return keccak_p_batch(state), None
 
     state, _ = lax.scan(absorb, state0, blocks)
@@ -179,17 +170,16 @@ def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndar
     out_blocks = (out_len + RATE - 1) // RATE
 
     def squeeze(state, _):
-        out = state[:RATE_WORDS]
+        out = state[..., :RATE_WORDS]
         return keccak_p_batch(state), out
 
     state, outs = lax.scan(squeeze, state, None, length=out_blocks)
-    # outs (out_blocks, 42, ...batch) -> (...batch, out_blocks*42)
-    outs = jnp.moveaxis(outs.reshape((out_blocks * RATE_WORDS,) + batch_shape), 0, -1)
-    out_bytes = words_to_bytes(outs)
+    outs = jnp.moveaxis(outs, 0, -2)  # (..., out_blocks, 42)
+    out_bytes = words_to_bytes(outs.reshape(batch_shape + (out_blocks * RATE_WORDS,)))
     return out_bytes[..., :out_len]
 
 
-@partial(jax.jit, static_argnums=(1, 3))
+@_eager_jit(static_argnums=(1, 3))
 def xof_turboshake128_batch(
     seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, out_len: int
 ) -> jnp.ndarray:

@@ -37,10 +37,10 @@ def _is_canonical(jf: JField, limbs: jnp.ndarray) -> jnp.ndarray:
     return borrow == 1
 
 
-from functools import partial as _partial
+from .field_jax import _eager_jit as __eager_jit
 
 
-@_partial(jax.jit, static_argnums=(0, 2, 4))
+@__eager_jit(static_argnums=(0, 2, 4))
 def xof_next_vec_batch(
     jf: JField, seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, length: int
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -15,6 +15,7 @@ from janus_tpu.utils.test_util import det_rng
 
 
 def test_backend_dispatch_gate():
+    """Fast: touches only constructors, no device compile."""
     vdaf = vdaf_from_instance({"type": "Prio3Count"}, backend="oracle")
     assert isinstance(vdaf.backend, OracleBackend)
     vdaf = vdaf_from_instance({"type": "Prio3Count"}, backend="tpu")
@@ -35,9 +36,11 @@ def test_backend_dispatch_gate():
         make_backend(hm, "tpu")
 
 
+@pytest.mark.slow
 def test_backends_agree_on_job():
     """Oracle and TPU backends step the same job to identical artifacts,
-    including a tampered report both must reject."""
+    including a tampered report both must reject.  slow: the Field128
+    joint-rand prepare graph cold-compiles for 10+ minutes on CPU."""
     vdaf = vdaf_from_instance({"type": "Prio3Histogram", "length": 6, "chunk_length": 2})
     rng = det_rng("backend-agree")
     verify_key = rng(vdaf.VERIFY_KEY_SIZE)

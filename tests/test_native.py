@@ -68,18 +68,20 @@ def test_native_disable_env(monkeypatch):
     importlib.reload(n)
 
 
-def test_oracle_speedup_sanity():
-    """Sharding a wide histogram through the oracle must not be slower with
-    the native XOF (smoke perf check, not a benchmark)."""
-    import time
+def test_native_path_actually_engaged(monkeypatch):
+    """expand_into_vec must take the native path for the supported fields:
+    poison the Python fallback so any silent de-engagement fails loudly."""
+    from janus_tpu.xof import Xof
 
-    from janus_tpu.vdaf.instances import prio3_histogram
+    def boom(self, field, length):
+        raise AssertionError("python fallback used where native expected")
 
-    vdaf = prio3_histogram(length=256, chunk_length=16)
-    nonce = b"\x00" * 16
-    rand = b"\x01" * vdaf.RAND_SIZE
-    t0 = time.monotonic()
-    for _ in range(3):
-        vdaf.shard(7, nonce, rand)
-    native_time = time.monotonic() - t0
-    assert native_time < 10.0  # sanity bound; python-only path is ~this slow
+    monkeypatch.setattr(Xof, "next_vec", boom)
+    out = XofTurboShake128.expand_into_vec(
+        Field128, b"\x07" * 16, b"\x08" + b"\x00" * 7, b"x", 5
+    )
+    assert len(out) == 5
+
+    # short seeds must raise exactly like the Python path
+    with pytest.raises(ValueError):
+        XofTurboShake128.expand_into_vec(Field64, b"", b"d", b"x", 1)
