@@ -134,7 +134,12 @@ def main() -> int:
         sys.stderr.write("no batch size succeeded\n")
         return 1
 
-    # Timed iterations over pre-staged inputs.
+    # Timed iterations over pre-staged inputs.  Each iteration ends with a
+    # small host readback (np.asarray of the decide mask, which depends on the
+    # whole pipeline) so the number cannot be flattered by block_until_ready
+    # returning early on this tunnel transport.
+    import numpy as np
+
     lat = []
     staged = [make_inputs(i + 1) for i in range(min(args.iters, 4))]
     for i in range(args.iters):
@@ -142,6 +147,7 @@ def main() -> int:
         t0 = time.monotonic()
         out = fn(inp)
         jax.block_until_ready(out)
+        np.asarray(out[1])  # decide mask readback: forces real completion
         lat.append(time.monotonic() - t0)
 
     p50 = statistics.median(lat)
