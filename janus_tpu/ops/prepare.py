@@ -264,7 +264,13 @@ class BatchedPrio3:
 
     def _xof_seed(self, seed_u8, dst, binder_u8) -> jnp.ndarray:
         """XOF -> one seed-sized output (B, SEED)."""
-        return xof_turboshake128_batch(seed_u8, dst, binder_u8, self.prio3.xof.SEED_SIZE)
+        from .keccak_pallas import pallas_enabled, xof_words_pallas
+
+        seed_size = self.prio3.xof.SEED_SIZE
+        if seed_u8.ndim == 2 and pallas_enabled(seed_u8.shape[0]) and seed_size % 4 == 0:
+            words = xof_words_pallas(seed_u8, dst, binder_u8, seed_size // 4)
+            return words_to_bytes(words)
+        return xof_turboshake128_batch(seed_u8, dst, binder_u8, seed_size)
 
     # -- share expansion (helper side) ----------------------------------
     def helper_shares(self, agg_id: int, share_seeds_u8: jnp.ndarray):

@@ -3,11 +3,18 @@
 Mirrors janus_tpu.xof.Xof.next_vec (draft-irtf-cfrg-vdaf-08 §6.2.1): the XOF
 stream is consumed in ENCODED_SIZE-byte candidates, little-endian; candidates
 >= MODULUS are skipped.  Rejections are vanishingly rare (~2^-32 per candidate
-for Field64, ~2^-62 for Field128) but must be handled exactly for
-byte-compatibility with the oracle, so the kernel over-samples a margin and
-compacts valid candidates with a stable sort; an ``ok`` mask flags the
-(astronomically unlikely) case that the margin was insufficient, for host
-fallback.
+for Field64, ~2^-62 for Field128), so the kernel samples exactly ``length``
+candidates and takes them verbatim; when every candidate is canonical — the
+overwhelmingly common case — that is byte-identical to the oracle (no
+candidate was skipped, so the oracle takes the same bytes).  Any rejection
+clears the row's ``ok`` flag and the caller recomputes that row on the host
+oracle (janus_tpu/vdaf/backend.py prep_init_batch).
+
+An earlier version over-sampled a margin and compacted valid candidates with
+a stable argsort; on TPU the batched sort cost ~2x the TurboSHAKE expansion
+it post-processed (bitonic sort is O(n log^2 n) compares), for an event that
+happens less than once per ~10^9 batches per Field64 job and essentially
+never for Field128.
 """
 
 from __future__ import annotations
@@ -47,17 +54,20 @@ def xof_next_vec_batch(
     """Batched XofTurboShake128(...).next_vec(field, length).
 
     seed (..., 16) u8, binder (..., B) u8 -> (canonical limbs (..., length, n),
-    ok (...) bool).  ``ok`` False means rejections exceeded the margin and the
-    affected batch row must be recomputed on the host oracle.
+    ok (...) bool).  ``ok`` False means the stream contained a rejected
+    candidate and the affected batch row must be recomputed on the host
+    oracle.
     """
+    from .keccak_pallas import pallas_enabled, xof_words_pallas
+
     elem_size = 4 * jf.n
-    margin = max(2, RATE // elem_size)
-    total = length + margin
-    stream = xof_turboshake128_batch(seed, dst, binder, total * elem_size)
-    cand = limbs_from_stream(jf, stream, total)  # (..., total, n)
-    valid = _is_canonical(jf, cand)  # (..., total)
-    # Stable-compact valid candidates to the front, preserving stream order.
-    order = jnp.argsort(~valid, axis=-1, stable=True)  # valid-first
-    taken = jnp.take_along_axis(cand, order[..., :length, None], axis=-2)
-    ok = jnp.sum(valid.astype(jnp.int32), axis=-1) >= length
-    return taken, ok
+    msg_len = 1 + len(dst) + seed.shape[-1] + binder.shape[-1]
+    if seed.ndim == 2 and pallas_enabled(seed.shape[0]) and msg_len < RATE:
+        words = xof_words_pallas(seed, dst, binder, length * jf.n)
+        cand = words.reshape(words.shape[:-1] + (length, jf.n))
+    else:
+        stream = xof_turboshake128_batch(seed, dst, binder, length * elem_size)
+        cand = limbs_from_stream(jf, stream, length)  # (..., length, n)
+    valid = _is_canonical(jf, cand)  # (..., length)
+    ok = jnp.all(valid, axis=-1)
+    return cand, ok
