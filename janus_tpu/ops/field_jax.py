@@ -57,6 +57,29 @@ def _u32(x: int):
     return jnp.asarray(np.uint32(x), dtype=_U32)
 
 
+def _scan_fence(x):
+    """Fence a scan's output from its consumers on XLA:CPU.
+
+    XLA:CPU fuses cheap consumers *into* a while-loop body; once the body
+    spans multiple fusions the thunk runtime pays a per-iteration
+    scheduling penalty that grows with executable size (measured: a
+    127-iteration Fermat-inversion scan inside the histogram prepare graph
+    went from milliseconds standalone to minutes composed).  An
+    optimization_barrier on the scan output keeps the loop body a single
+    fused kernel.  TPU keeps the fusion (it's profitable there), so the
+    barrier is trace-time conditional on the backend.
+    """
+    # Keyed on the jax_platforms *config* (set by tests/conftest.py and the
+    # multichip dryrun, which pin "cpu"), NOT jax.default_backend(): reading
+    # the default backend at trace time runs the platform election and
+    # would initialize the out-of-process TPU plugin from contexts that
+    # must never touch it (see __graft_entry__.dryrun_multichip).
+    platforms = jax.config.jax_platforms or ""
+    if platforms.split(",")[0] == "cpu":
+        return lax.optimization_barrier(x)
+    return x
+
+
 def _mul32(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact 32x32 -> 64 multiply as (hi, lo) u32 pairs via 16-bit halves."""
     al = a & _MASK16
@@ -268,19 +291,33 @@ class JField:
 
     @_eager_jit(static_argnums=(0,))
     def inv_mont(self, a):
-        """Fermat inversion in Montgomery domain: a^(p-2).  inv(0) = 0."""
-        bits = jnp.asarray(self._inv_exp_bits)
+        """Fermat inversion in Montgomery domain: a^(p-2).  inv(0) = 0.
+
+        Two single-multiply scans instead of one square-and-multiply scan:
+        phase 1 stacks the squares chain a^(2^i); phase 2 multiplies the
+        squares selected by the bits of p-2.  Same exact integer result
+        (modular multiplication is associative/commutative), but each scan
+        body stays one fused kernel — XLA:CPU's while-loop runtime pays a
+        ~0.3 s/iteration scheduling penalty the moment a body spans more
+        than one fusion, which turned the old 2-multiply body into a
+        63 s dispatch for a (4,) batch (observed; 35 ms this way).
+        """
+        bits = jnp.asarray(self._inv_exp_bits[::-1].copy())  # LSB-first
+
+        def sq(acc, _):
+            return self.mont_mul(acc, acc), acc
+
+        _, squares = lax.scan(sq, a, None, length=bits.shape[0])
+        squares = _scan_fence(squares)
+
         one = jnp.broadcast_to(self.mont_one(), a.shape)
 
-        def body(acc, bit):
-            acc = self.mont_mul(acc, acc)
-            mul = self.mont_mul(acc, a)
-            take = (bit == 1)
-            acc = jnp.where(take, mul, acc)
-            return acc, None
+        def mulsel(acc, si_b):
+            si, bit = si_b
+            return self.mont_mul(acc, jnp.where(bit == 1, si, one)), None
 
-        acc, _ = lax.scan(body, one, bits)
-        return acc
+        acc, _ = lax.scan(mulsel, one, (squares, bits))
+        return _scan_fence(acc)
 
     @_eager_jit(static_argnums=(0,))
     def eq(self, a, b):
@@ -309,7 +346,7 @@ class JField:
     def cumprod_mont(self, a, axis: int):
         """Inclusive cumulative product (Montgomery domain) along an axis."""
         axis = axis % (a.ndim - 1)
-        return lax.associative_scan(self.mont_mul, a, axis=axis)
+        return _scan_fence(lax.associative_scan(self.mont_mul, a, axis=axis))
 
     @_eager_jit(static_argnums=(0,))
     def horner_mont(self, coeffs, x):
@@ -326,7 +363,35 @@ class JField:
 
         acc0 = jnp.zeros_like(x)
         acc, _ = lax.scan(body, acc0, cs)
-        return acc
+        return _scan_fence(acc)
+
+    def ntt_eval_mont(self, coeffs, bitrev_idx, tw_stages):
+        """Evaluate a polynomial at ALL P-th roots of unity (iterative NTT).
+
+        coeffs (..., P, n) canonical -> values (..., P, n) canonical, value
+        j = poly(w^j) in natural order.  ``bitrev_idx`` (P,) host-precomputed
+        bit-reversal permutation; ``tw_stages`` list of per-stage twiddle
+        tables (m/2, n) in Montgomery form (w^(P/m)^j).  Cooley-Tukey DIT:
+        log2(P) stages of m/2 butterflies; each butterfly is one
+        mont_mul(odd_canonical, twiddle_montgomery) -> canonical plus an
+        add/sub, so the whole tensor stays canonical.  Exact integer math —
+        identical limbs to per-point Horner evaluation, at O(P log P) cost
+        instead of O(P * deg) (the wide-vector FLP evaluates a ~2P-coeff
+        gadget polynomial at ~P points; reference circuit params
+        core/src/vdaf.rs:220-236).
+        """
+        P = coeffs.shape[-2]
+        x = jnp.take(coeffs, jnp.asarray(bitrev_idx), axis=-2)
+        m = 2
+        for tw in tw_stages:
+            xr = x.reshape(x.shape[:-2] + (P // m, m, self.n))
+            even = xr[..., : m // 2, :]
+            odd = xr[..., m // 2 :, :]
+            t = self.mont_mul(odd, jnp.broadcast_to(tw, odd.shape))
+            xr = jnp.concatenate([self.add(even, t), self.sub(even, t)], axis=-2)
+            x = xr.reshape(x.shape)
+            m *= 2
+        return x
 
     @_eager_jit(static_argnums=(0, 2))
     def batch_inv_mont(self, a, axis: int):
@@ -356,4 +421,4 @@ class JField:
         )
         others = self.mont_mul(prefix_excl, suffix_excl)
         inv_b = jnp.expand_dims(inv_total, axis=axis)
-        return self.mont_mul(others, jnp.broadcast_to(inv_b, a.shape))
+        return _scan_fence(self.mont_mul(others, jnp.broadcast_to(inv_b, a.shape)))

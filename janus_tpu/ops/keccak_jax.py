@@ -21,7 +21,7 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-from .field_jax import _eager_jit
+from .field_jax import _eager_jit, _scan_fence
 import numpy as np
 from jax import lax
 
@@ -107,7 +107,7 @@ def keccak_p_batch(state: jnp.ndarray) -> jnp.ndarray:
         return _keccak_round(s, rc_pair), None
 
     out, _ = lax.scan(body, state, jnp.asarray(_RC_PAIRS))
-    return out
+    return _scan_fence(out)
 
 
 def bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
@@ -164,16 +164,35 @@ def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndar
         state = jnp.concatenate([rate_part, state[..., RATE_WORDS:]], axis=-1)
         return keccak_p_batch(state), None
 
-    state, _ = lax.scan(absorb, state0, blocks)
-
-    # squeeze
     out_blocks = (out_len + RATE - 1) // RATE
 
     def squeeze(state, _):
         out = state[..., :RATE_WORDS]
         return keccak_p_batch(state), out
 
-    state, outs = lax.scan(squeeze, state, None, length=out_blocks)
+    # Small static block counts are unrolled as Python loops: a lax.scan
+    # here would nest the 12-round permutation scan inside another while
+    # loop, and XLA:CPU's thunk runtime charges a large per-iteration
+    # penalty to any loop whose body is not a single fusion (an inner loop
+    # never is).  Unrolling keeps the rounds scan the only loop at each XOF
+    # site.  Long squeezes (wide-vector share expansion) keep the scan so
+    # the graph stays one permutation body regardless of stream length.
+    _UNROLL = 8
+    if nblocks <= _UNROLL:
+        state = state0
+        for i in range(nblocks):
+            state, _ = absorb(state, blocks[i])
+    else:
+        state, _ = lax.scan(absorb, state0, blocks)
+
+    if out_blocks <= _UNROLL:
+        outs_list = []
+        for _ in range(out_blocks):
+            state, out = squeeze(state, None)
+            outs_list.append(out)
+        outs = jnp.stack(outs_list, axis=0)
+    else:
+        state, outs = lax.scan(squeeze, state, None, length=out_blocks)
     outs = jnp.moveaxis(outs, 0, -2)  # (..., out_blocks, 42)
     out_bytes = words_to_bytes(outs.reshape(batch_shape + (out_blocks * RATE_WORDS,)))
     return out_bytes[..., :out_len]

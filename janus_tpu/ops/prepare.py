@@ -15,10 +15,18 @@ aggregator/src/aggregator.rs:2101 helper).  Here one XLA launch handles the
 whole batch; every output is byte-identical to the CPU oracle
 (janus_tpu.vdaf.prio3) — asserted in tests/test_prepare.py.
 
-Montgomery domain convention: XOF output limbs are canonical; multiplication-
-heavy circuit code runs in Montgomery form (``to_mont`` at entry, ``from_mont``
-at the wire edges).  All arithmetic is exact integer math mod p, so there is
-no reassociation hazard.
+Montgomery domain convention: the BULK tensors (meas, proofs, wires, gadget
+outputs, verifiers, out shares) stay CANONICAL end to end; only the handful
+of per-report scalars that multiply them — joint-rand r, query point t, the
+precomputed alpha powers / barycentric weights — are held in Montgomery
+form.  ``mont_mul(x_canonical, y_montgomery) = x*y canonical`` makes every
+product land back in canonical form for free, which eliminates the
+full-width to_mont/from_mont passes over meas (MEAS_LEN muls), proofs
+(PROOF_LEN), and the verifier (VERIFIER_LEN) that an all-Montgomery circuit
+needs — ~26% of the field multiplies in the histogram1024 pipeline.  The
+gadget check in prep_shares_to_prep compares g*R^-1 against y*R^-1 (R is
+invertible, so equality is unchanged).  All arithmetic is exact integer
+math mod p, so there is no reassociation hazard.
 
 Wire-polynomial evaluation avoids a device NTT: the verifier needs each wire
 polynomial only *evaluated at t*, and the wire values live on the P-th roots
@@ -50,7 +58,7 @@ from ..vdaf.prio3 import (
     Prio3,
 )
 from ..xof import XofTurboShake128
-from .field_jax import JField
+from .field_jax import JField, _scan_fence
 from .keccak_jax import bytes_to_words, words_to_bytes, xof_turboshake128_batch
 from .xof_jax import xof_next_vec_batch
 
@@ -81,7 +89,8 @@ class _DeviceCircuit:
         self.P = next_power_of_2(1 + self.calls)
         self.glen = self.degree * (self.P - 1) + 1
 
-    # subclasses: inputs(), v(), truncate(), gadget_eval()
+    # subclasses: inputs(), v(), truncate(), gadget_eval_scaled().
+    # Convention: meas/gk/wires canonical; jr_m Montgomery; consts as noted.
 
 
 class _DCount(_DeviceCircuit):
@@ -96,8 +105,9 @@ class _DCount(_DeviceCircuit):
     def truncate(self, jf, meas_m, consts):
         return meas_m
 
-    def gadget_eval(self, jf, x_m):
-        return jf.mont_mul(x_m[:, 0], x_m[:, 1])
+    def gadget_eval_scaled(self, jf, x):
+        """Gadget output scaled by R^-1, from canonical wire inputs."""
+        return jf.mont_mul(x[:, 0], x[:, 1])
 
 
 class _DSum(_DeviceCircuit):
@@ -105,18 +115,19 @@ class _DSum(_DeviceCircuit):
         return meas_m[:, :, None, :]  # (B, bits, 1, n)
 
     def v(self, jf, gk, meas_m, jr_m, consts):
-        r = jr_m[:, 0]  # (B, n)
+        r = jr_m[:, 0]  # (B, n) Montgomery
         r_b = jnp.broadcast_to(r[:, None, :], gk.shape)
-        r_pows = jf.cumprod_mont(r_b, axis=1)  # r^(k+1) at call k
-        return jf.sum(jf.mont_mul(r_pows, gk), axis=1)
+        r_pows = jf.cumprod_mont(r_b, axis=1)  # r^(k+1)*R at call k
+        return jf.sum(jf.mont_mul(r_pows, gk), axis=1)  # canonical
 
     def truncate(self, jf, meas_m, consts):
-        w = consts["pow2_m"]  # (bits, n) mont constants 2^b
+        w = consts["pow2_m"]  # (bits, n) Montgomery constants 2^b*R
         return jf.sum(jf.mont_mul(meas_m, w[None]), axis=1)[:, None, :]
 
-    def gadget_eval(self, jf, x_m):
-        x0 = x_m[:, 0]
-        return jf.sub(jf.mont_mul(x0, x0), x0)
+    def gadget_eval_scaled(self, jf, x):
+        x0 = x[:, 0]
+        # (x^2 - x)*R^-1 from canonical x: x*x*R^-1 - x*1*R^-1.
+        return jf.sub(jf.mont_mul(x0, x0), jf.from_mont(x0))
 
 
 class _DChunked(_DeviceCircuit):
@@ -139,10 +150,10 @@ class _DChunked(_DeviceCircuit):
         B, calls, chunk, n = a.shape
         return jnp.stack([a, b], axis=3).reshape(B, calls, 2 * chunk, n)
 
-    def gadget_eval(self, jf, x_m):
-        B, arity, n = x_m.shape
-        pairs = x_m.reshape(B, arity // 2, 2, n)
-        prod = jf.mont_mul(pairs[:, :, 0], pairs[:, :, 1])
+    def gadget_eval_scaled(self, jf, x):
+        B, arity, n = x.shape
+        pairs = x.reshape(B, arity // 2, 2, n)
+        prod = jf.mont_mul(pairs[:, :, 0], pairs[:, :, 1])  # (a*b)*R^-1
         return jf.sum(prod, axis=1)
 
 
@@ -154,13 +165,17 @@ class _DSumVec(_DChunked):
         jr_b = jnp.broadcast_to(jr_m[:, :, None, :], m.shape)
         r_pows = jf.cumprod_mont(jr_b, axis=2)
         a = jf.mont_mul(m, r_pows)
-        b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_m"], m.shape))
+        b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_c"], m.shape))
         return self._interleave(a, b)
 
     def v(self, jf, gk, meas_m, jr_m, consts):
         return jf.sum(gk, axis=1)
 
     def truncate(self, jf, meas_m, consts):
+        if self.valid.bits == 1:
+            # sum over a single bit weighted 2^0 is the identity; skip the
+            # MEAS_LEN-wide multiply (len=100k circuits pay for it).
+            return meas_m
         B = meas_m.shape[0]
         w = consts["pow2_m"]  # (bits, n)
         m = meas_m.reshape(B, self.valid.length, self.valid.bits, jf.n)
@@ -176,14 +191,14 @@ class _DHistogram(_DChunked):
         r_flat = jnp.broadcast_to(r[:, None, :], (B, self.calls * self.chunk, jf.n))
         r_pows = jf.cumprod_mont(r_flat, axis=1).reshape(m.shape)
         a = jf.mont_mul(m, r_pows)
-        b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_m"], m.shape))
+        b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_c"], m.shape))
         return self._interleave(a, b)
 
     def v(self, jf, gk, meas_m, jr_m, consts):
         range_check = jf.sum(gk, axis=1)
         meas_sum = jf.sum(meas_m, axis=1)  # (B, n)
         sum_check = jf.sub(
-            meas_sum, jnp.broadcast_to(consts["shares_inv_m"], meas_sum.shape)
+            meas_sum, jnp.broadcast_to(consts["shares_inv_c"], meas_sum.shape)
         )
         jr1 = jr_m[:, 1]
         out = jf.add(
@@ -216,7 +231,7 @@ class BatchedPrio3:
     byte-identical to the CPU oracle.
     """
 
-    def __init__(self, prio3: Prio3):
+    def __init__(self, prio3: Prio3, ntt_min_p: int = 64):
         if prio3.xof is not XofTurboShake128:
             raise NotImplementedError("device path requires XofTurboShake128")
         self.prio3 = prio3
@@ -233,8 +248,9 @@ class BatchedPrio3:
         w = field.root(circ.P)
         p_inv = pow(circ.P, p - 2, p)
         self.consts: Dict[str, jnp.ndarray] = {}
-        self.consts["shares_inv_m"] = jnp.asarray(
-            mont_np(pow(prio3.num_shares, p - 2, p))
+        # Canonical: subtracted from / compared with canonical tensors.
+        self.consts["shares_inv_c"] = jnp.asarray(
+            jf._int_to_limbs_np(pow(prio3.num_shares, p - 2, p))
         )
         # alpha^k for k=1..calls (gadget poly eval points).
         self.alpha_pows_m = jnp.asarray(
@@ -253,6 +269,34 @@ class BatchedPrio3:
                 np.stack([mont_np(1 << b) for b in range(bits)])
             )
         self._log2_P = circ.P.bit_length() - 1
+
+        # Gadget-poly evaluation strategy: the verifier needs gpoly(alpha^k)
+        # for k=1..calls, alpha a P-th root of unity.  For small P a Horner
+        # scan over the glen coefficients is cheapest; for the wide-vector
+        # circuits (P >= 64, e.g. SumVec len=100k chunk=316 -> P=512,
+        # glen=1023) Horner costs calls*glen multiplies per report while a
+        # fold to P coefficients + P-point NTT costs P*log2(P)/2 — ~70x
+        # fewer.  Both produce identical limbs (exact integer math).
+        # ``ntt_min_p`` exists so parity tests can force this branch at tiny
+        # P and check it byte-for-byte against the oracle.
+        self._ntt = None
+        if circ.P >= ntt_min_p:
+            P = circ.P
+            logp = P.bit_length() - 1
+            bitrev = np.zeros(P, dtype=np.int32)
+            for i in range(P):
+                bitrev[i] = int(format(i, f"0{logp}b")[::-1], 2)
+            tw_stages = []
+            m = 2
+            while m <= P:
+                w_m = pow(w, P // m, p)
+                tw_stages.append(
+                    jnp.asarray(
+                        np.stack([mont_np(pow(w_m, j, p)) for j in range(m // 2)])
+                    )
+                )
+                m *= 2
+            self._ntt = (bitrev, tw_stages)
 
     # -- XOF helpers ----------------------------------------------------
     def _dst(self, usage: int) -> bytes:
@@ -296,10 +340,13 @@ class BatchedPrio3:
 
     # -- FLP query (one proof) ------------------------------------------
     def _query_one(self, meas_m, proof_m, jr_m, t_m):
-        """Device FLP query for one proof. All inputs Montgomery.
+        """Device FLP query for one proof.
 
-        meas_m (B,MEAS_LEN,n), proof_m (B,PROOF_LEN,n), jr_m (B,JR_LEN,n),
-        t_m (B,n) -> (verifier_m (B,VERIFIER_LEN,n), t_ok (B,)).
+        meas_m (B,MEAS_LEN,n) CANONICAL, proof_m (B,PROOF_LEN,n) CANONICAL,
+        jr_m (B,JR_LEN,n) Montgomery, t_m (B,n) Montgomery ->
+        (verifier (B,VERIFIER_LEN,n) CANONICAL, t_ok (B,)).
+        Every mont_mul pairs one canonical bulk tensor with one Montgomery
+        scalar/constant, so products stay canonical (see module docstring).
         Oracle twin: FlpGeneric.query.
         """
         jf, circ = self.jf, self.circ
@@ -309,13 +356,29 @@ class BatchedPrio3:
 
         inp = circ.inputs(jf, meas_m, jr_m, self.consts)  # (B, calls, arity, n)
 
-        # Gadget outputs at alpha^k via Horner over the gadget polynomial.
-        def horner_step(acc, c):
-            return jf.add(jf.mont_mul(acc, self.alpha_pows_m[None]), c[:, None, :]), None
+        if self._ntt is not None:
+            # Fold gpoly mod (x^P - 1) — alpha^P == 1 at the evaluation
+            # points — then evaluate at all P roots in one NTT.
+            P = circ.P
+            hi = gpoly[:, P:]
+            hi = jnp.concatenate(
+                [hi, jnp.zeros((B, P - hi.shape[1], jf.n), dtype=_U32)], axis=1
+            )
+            folded = jf.add(gpoly[:, :P], hi)
+            evals = jf.ntt_eval_mont(folded, *self._ntt)  # (B, P, n)
+            gk = evals[:, 1 : circ.calls + 1]
+        else:
+            # Gadget outputs at alpha^k via Horner over the gadget polynomial.
+            def horner_step(acc, c):
+                return (
+                    jf.add(jf.mont_mul(acc, self.alpha_pows_m[None]), c[:, None, :]),
+                    None,
+                )
 
-        coeffs_rev = jnp.moveaxis(jnp.flip(gpoly, axis=1), 1, 0)  # (glen, B, n)
-        acc0 = jnp.zeros((B, circ.calls, jf.n), dtype=_U32)
-        gk, _ = lax.scan(horner_step, acc0, coeffs_rev)  # (B, calls, n)
+            coeffs_rev = jnp.moveaxis(jnp.flip(gpoly, axis=1), 1, 0)  # (glen, B, n)
+            acc0 = jnp.zeros((B, circ.calls, jf.n), dtype=_U32)
+            gk, _ = lax.scan(horner_step, acc0, coeffs_rev)  # (B, calls, n)
+            gk = _scan_fence(gk)
 
         v = circ.v(jf, gk, meas_m, jr_m, self.consts)  # (B, n)
 
@@ -418,28 +481,27 @@ class BatchedPrio3:
             out["joint_rand_part"] = part
             out["corrected_seed"] = corrected
 
-        # Montgomery domain for the circuit.
-        meas_m = jf.to_mont(meas)
-        proofs_m = jf.to_mont(proofs)
-        qr_m = jf.to_mont(qr)
+        # Bulk tensors stay canonical; only the per-report multipliers (joint
+        # rand, query point t) go to Montgomery form — a handful of elements
+        # vs MEAS_LEN + PROOF_LEN full-width conversion passes.
         jr_m = jf.to_mont(jr) if jr is not None else None
 
         verifiers = []
         for i in range(prio3.num_proofs):
-            pm = proofs_m[:, i * flp.PROOF_LEN : (i + 1) * flp.PROOF_LEN]
-            ti = qr_m[:, i * flp.QUERY_RAND_LEN]  # QUERY_RAND_LEN == 1 per gadget
+            pm = proofs[:, i * flp.PROOF_LEN : (i + 1) * flp.PROOF_LEN]
+            # QUERY_RAND_LEN == 1 per gadget
+            ti = jf.to_mont(qr[:, i * flp.QUERY_RAND_LEN])
             ji = (
                 jr_m[:, i * flp.JOINT_RAND_LEN : (i + 1) * flp.JOINT_RAND_LEN]
                 if jr_m is not None
                 else jnp.zeros((B, 0, jf.n), dtype=_U32)
             )
-            ver_m, t_ok = self._query_one(meas_m, pm, ji, ti)
+            ver, t_ok = self._query_one(meas, pm, ji, ti)
             ok = ok & t_ok
-            verifiers.append(ver_m)
-        verifier_m = jnp.concatenate(verifiers, axis=1)
+            verifiers.append(ver)
 
-        out["verifiers"] = jf.from_mont(verifier_m)
-        out["out_share"] = jf.from_mont(self.circ.truncate(jf, meas_m, self.consts))
+        out["verifiers"] = jnp.concatenate(verifiers, axis=1)
+        out["out_share"] = self.circ.truncate(jf, meas, self.consts)
         out["ok"] = ok
         return out
 
@@ -464,10 +526,12 @@ class BatchedPrio3:
         for i in range(prio3.num_proofs):
             ver = combined[:, i * flp.VERIFIER_LEN : (i + 1) * flp.VERIFIER_LEN]
             v = ver[:, 0]
-            x = jf.to_mont(ver[:, 1 : 1 + circ.arity])
-            y = jf.to_mont(ver[:, 1 + circ.arity])
-            g = circ.gadget_eval(jf, x)
-            decide = decide & jf.is_zero(v) & jf.eq(g, y)
+            x = ver[:, 1 : 1 + circ.arity]  # canonical wire evaluations
+            # Compare g*R^-1 == y*R^-1 (R invertible => same predicate as
+            # g == y) to skip the to_mont pass over the arity wires.
+            y_scaled = jf.from_mont(ver[:, 1 + circ.arity])
+            g = circ.gadget_eval_scaled(jf, x)
+            decide = decide & jf.is_zero(v) & jf.eq(g, y_scaled)
         out: Dict[str, jnp.ndarray] = {"decide": decide}
         if flp.JOINT_RAND_LEN > 0:
             binder = jnp.concatenate(list(joint_rand_parts_u8), axis=-1)

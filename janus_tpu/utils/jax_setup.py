@@ -8,6 +8,28 @@ import os
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _host_fingerprint() -> str:
+    """Identify the host microarchitecture for the cache key.
+
+    Persisted executables embed AOT-compiled machine code; an entry built on
+    a host with a different CPU feature set can hang or SIGILL when loaded
+    (observed: a cache populated on an avx512fp16 host made a 12-second
+    Field128 graph hang its *execution* for 9+ minutes on this one).  Keying
+    the cache directory by the CPU flags makes foreign entries invisible
+    instead of trusting XLA's partial feature check.
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def enable_compile_cache(cache_dir: str = None) -> None:
     """Point XLA's persistent compilation cache at <repo>/.jax_cache/<config>.
 
@@ -23,10 +45,25 @@ def enable_compile_cache(cache_dir: str = None) -> None:
     """
     import jax
 
+    platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    if platforms.split(",")[0] == "cpu" or platforms == "":
+        # XLA:CPU persists executables as AOT objects whose recorded target
+        # machine includes compile-time pseudo-features (+prefer-no-scatter,
+        # +prefer-no-gather) that never appear in the loader's host-feature
+        # probe.  Every cross-process load then fails the feature check
+        # (cpu_aot_loader: "Machine type used for XLA:CPU compilation
+        # doesn't match...") and falls into a pathological slow path —
+        # observed turning a 68 s cold-compile test into a 26+ minute hang.
+        # Cold compiles are cheaper than poisoned loads: no persistent
+        # cache on CPU.
+        return
+
     config_key = (
         os.environ.get("JAX_PLATFORMS", "default")
         + "|"
         + os.environ.get("XLA_FLAGS", "")
+        + "|"
+        + _host_fingerprint()
     )
     sub = hashlib.sha256(config_key.encode()).hexdigest()[:12]
     path = cache_dir or os.path.join(_REPO_ROOT, ".jax_cache", sub)

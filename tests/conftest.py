@@ -15,7 +15,15 @@ fast (first run pays the compile; later runs replay it).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set, not setdefault: the ambient environment carries
+# JAX_PLATFORMS=axon (the out-of-process TPU plugin), and its site hook
+# force-updates jax.config to "axon,cpu" during import regardless of the
+# env var.  If axon stays first, jax.default_backend() reports tpu while a
+# default-device pin silently routes execution to CPU — a split brain that
+# disables the CPU-only graph shaping in ops/ (_scan_fence) and hangs the
+# Field128 graphs.  Making "cpu" the only platform keeps backend election,
+# execution, and trace-time platform checks consistent.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -25,11 +33,8 @@ import pytest
 
 from janus_tpu.utils.jax_setup import enable_compile_cache
 
+jax.config.update("jax_platforms", "cpu")  # beat the site hook's "axon,cpu"
 enable_compile_cache()
-try:
-    jax.config.update("jax_default_device", jax.devices("cpu")[0])
-except RuntimeError:
-    pass
 
 
 def pytest_configure(config):

@@ -134,6 +134,45 @@ def test_horner(field):
 
 
 @pytest.mark.parametrize("field", FIELDS)
+def test_ntt_eval_matches_per_point(field):
+    """ntt_eval_mont at all P-th roots == oracle per-point evaluation.
+
+    Exercises the full bit-reversal + per-stage twiddle construction used by
+    BatchedPrio3 for wide-vector gadget evaluation (prepare.py), at P large
+    enough for multiple butterfly stages.
+    """
+    from janus_tpu.fields import poly_eval
+
+    import jax.numpy as jnp
+
+    P = 16
+    p = field.MODULUS
+    w = field.root(P)
+    jf = JField(field)
+    rng = random.Random(11)
+    B = 3
+    coeffs = [[rng.randrange(p) for _ in range(P)] for _ in range(B)]
+    logp = P.bit_length() - 1
+    bitrev = np.array([int(format(i, f"0{logp}b")[::-1], 2) for i in range(P)], dtype=np.int32)
+
+    def mont_np(x):
+        return jf._int_to_limbs_np((x % p) * (1 << (32 * jf.n)) % p)
+
+    tw_stages = []
+    m = 2
+    while m <= P:
+        w_m = pow(w, P // m, p)
+        tw_stages.append(jnp.asarray(np.stack([mont_np(pow(w_m, j, p)) for j in range(m // 2)])))
+        m *= 2
+    carr = jnp.asarray(jf.to_limbs([x for row in coeffs for x in row]).reshape(B, P, jf.n))
+    got = jf.from_limbs(np.asarray(jf.ntt_eval_mont(carr, bitrev, tw_stages)).reshape(B * P, jf.n))
+    for b in range(B):
+        for j in range(P):
+            expect = poly_eval(field, coeffs[b], pow(w, j, p))
+            assert got[b * P + j] == expect, (b, j)
+
+
+@pytest.mark.parametrize("field", FIELDS)
 def test_batched_shapes(field):
     """Ops broadcast over leading axes (the report axis)."""
     jf = JField(field)
