@@ -412,6 +412,7 @@ class AggregationJobDriver:
             vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=False,
+            backend=self._backend_for(task, vdaf),
         )
         writer.put(job, new_ras, out_shares)
 

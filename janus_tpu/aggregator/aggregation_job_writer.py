@@ -51,11 +51,15 @@ class AggregationJobWriter:
         vdaf,
         batch_aggregation_shard_count: int = 8,
         initial_write: bool = True,
+        backend=None,
     ):
         self.task = task
         self.vdaf = vdaf
         self.shard_count = batch_aggregation_shard_count
         self.initial_write = initial_write
+        #: Device backend (TpuBackend/MeshBackend) for on-device out-share
+        #: accumulation; None falls back to host field adds.
+        self.backend = backend
         self._jobs: List[
             Tuple[AggregationJob, List[ReportAggregation], Dict[bytes, Sequence[int]]]
         ] = []
@@ -118,6 +122,26 @@ class AggregationJobWriter:
         return failures
 
     # ------------------------------------------------------------------
+    def _sum_shares(self, field, shares: List[Sequence[int]]) -> List[int]:
+        """Sum out-share vectors: on-device (cross-shard all-reduce on a
+        MeshBackend — the collective replacing the reference's DB shard
+        merge) when a device backend is attached and the batch is worth a
+        launch; host field adds otherwise."""
+        backend = self.backend
+        if backend is not None and hasattr(backend, "aggregate_batch") and len(shares) > 1:
+            import numpy as np
+
+            jf = backend.bp.jf
+            limbs = jf.to_limbs([x for sh in shares for x in sh]).reshape(
+                len(shares), -1, jf.n
+            )
+            return backend.aggregate_batch(limbs, np.ones(len(shares), dtype=bool))
+        acc: Optional[List[int]] = None
+        for sh in shares:
+            acc = list(sh) if acc is None else field.vec_add(acc, sh)
+        return acc
+
+    # ------------------------------------------------------------------
     def _accumulate(self, tx, job, ras, out_shares, ident_for) -> None:
         """Merge finished out-shares into per-batch shard accumulators and
         update the created/terminated job counters the collection readiness
@@ -157,17 +181,15 @@ class AggregationJobWriter:
             checksum = ReportIdChecksum.zero()
             interval = Interval.EMPTY
             for ra in finished:
-                share = out_shares[ra.report_id.data]
-                agg_share = (
-                    list(share)
-                    if agg_share is None
-                    else field.vec_add(agg_share, share)
-                )
                 count += 1
                 checksum = checksum_updated_with(checksum, ra.report_id)
                 interval = interval_merge(
                     interval,
                     time_to_batch_interval(ra.time, self.task.time_precision),
+                )
+            if finished:
+                agg_share = self._sum_shares(
+                    field, [out_shares[ra.report_id.data] for ra in finished]
                 )
             delta = BatchAggregation(
                 task_id=self.task.task_id,

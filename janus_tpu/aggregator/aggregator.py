@@ -506,6 +506,7 @@ class Aggregator:
             ta.vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=True,
+            backend=ta.backend,
         )
         writer.put(job, ras, out_shares)
 
@@ -731,6 +732,7 @@ class Aggregator:
             ta.vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=False,
+            backend=ta.backend,
         )
         writer.put(job, new_ras, out_shares)
         failures = await self.datastore.run_tx_async(
@@ -931,6 +933,7 @@ class Aggregator:
             ta.vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=True,
+            backend=ta.backend,
         )
         params_by_report = tx.get_aggregation_params_by_report_for_interval(
             task.task_id, interval

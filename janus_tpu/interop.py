@@ -17,6 +17,7 @@ collector}`` — or build the apps in-process for tests.
 from __future__ import annotations
 
 import asyncio
+import os
 import secrets
 from typing import Dict, Optional
 
@@ -320,7 +321,14 @@ def run_interop_binary(role: str, port: int = 8080) -> None:
     clock = RealClock()
     path = tempfile.mkstemp(suffix=".sqlite3", prefix="janus-interop-")[1]
     datastore = Datastore(path, Crypter([generate_key()]), clock)
-    aggregator = Aggregator(datastore, clock, Config(max_upload_batch_write_delay=0.05))
+    # Backend selectable from the environment so the containerized harness
+    # can exercise the device paths (oracle | tpu | mesh).
+    backend = os.environ.get("JANUS_TPU_VDAF_BACKEND", "oracle")
+    aggregator = Aggregator(
+        datastore,
+        clock,
+        Config(max_upload_batch_write_delay=0.05, vdaf_backend=backend),
+    )
     dap_app = aggregator_app(aggregator)
 
     async def main():

@@ -89,6 +89,7 @@ class TpuBackend:
         self._jax = jax
         self._prep_fns: Dict[int, object] = {}
         self._combine_fn = None
+        self._agg_fn = None
 
     # -- jit caches ------------------------------------------------------
     def _prep_fn(self, agg_id: int):
@@ -154,6 +155,19 @@ class TpuBackend:
             kw["share_seeds_u8"] = stack_bytes([r[2].share_seed for r in reports], seed_size)
         return kw
 
+    # -- placement hooks (MeshBackend shards these over the device mesh) --
+    def _pad_to(self, B: int) -> int:
+        """Power-of-two bucketing bounds recompiles to log2 distinct shapes."""
+        return next_power_of_2(B)
+
+    def _place(self, kw: Dict[str, np.ndarray]) -> Dict[str, object]:
+        """Commit marshaled inputs to device(s); identity on a single chip."""
+        return kw
+
+    def _place_batch(self, arr: np.ndarray):
+        """Commit one batch-axis array to device(s)."""
+        return arr
+
     # -- batch APIs ------------------------------------------------------
     def prep_init_batch(
         self,
@@ -163,18 +177,20 @@ class TpuBackend:
     ) -> List[PrepOutcome]:
         if not reports:
             return []
-        vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
         B = len(reports)
-        pad_to = next_power_of_2(B)
-        kw = self._marshal(agg_id, reports, pad_to)
+        kw = self._marshal(agg_id, reports, self._pad_to(B))
         kw["verify_key_u8"] = np.frombuffer(verify_key, dtype=np.uint8)
         from ..core.metrics import GLOBAL_METRICS
 
         if GLOBAL_METRICS.registry is not None:
             GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
             GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
-        out = self._prep_fn(agg_id)(kw)
+        out = self._prep_fn(agg_id)(self._place(kw))
+        return self._unmarshal_prep(verify_key, agg_id, reports, out)
 
+    def _unmarshal_prep(self, verify_key, agg_id, reports, out) -> List[PrepOutcome]:
+        flp, jf = self.vdaf.flp, self.bp.jf
+        B = len(reports)
         ok = np.asarray(out["ok"])[:B]
         verifiers = np.asarray(out["verifiers"])[:B]
         out_shares = np.asarray(out["out_share"])[:B]
@@ -223,7 +239,7 @@ class TpuBackend:
                     results.append(next(good_iter))
             return results
         B = len(prep_shares)
-        pad_to = next_power_of_2(B)
+        pad_to = self._pad_to(B)
         has_jr = flp.JOINT_RAND_LEN > 0
 
         ver_len = flp.VERIFIER_LEN * vdaf.num_proofs
@@ -233,13 +249,19 @@ class TpuBackend:
             limbs = jf.to_limbs(
                 [x for row in prep_shares for x in row[a].verifiers_share]
             ).reshape(B, ver_len, jf.n)
-            vs.append(np.concatenate([limbs, np.repeat(limbs[-1:], pad_to - B, axis=0)]))
+            vs.append(
+                self._place_batch(
+                    np.concatenate([limbs, np.repeat(limbs[-1:], pad_to - B, axis=0)])
+                )
+            )
             if has_jr:
                 arr = np.frombuffer(
                     b"".join(row[a].joint_rand_part for row in prep_shares), dtype=np.uint8
                 ).reshape(B, vdaf.xof.SEED_SIZE)
                 parts.append(
-                    np.concatenate([arr, np.repeat(arr[-1:], pad_to - B, axis=0)])
+                    self._place_batch(
+                        np.concatenate([arr, np.repeat(arr[-1:], pad_to - B, axis=0)])
+                    )
                 )
 
         out = self._combine()(vs, parts)
@@ -256,8 +278,80 @@ class TpuBackend:
                 results.append(None)
         return results
 
+    def aggregate_batch(self, out_shares_limbs, mask) -> List[int]:
+        """Masked out-share aggregation on-device.
 
-BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
+        out_shares_limbs (B, OUT, n) canonical, mask (B,) bool -> aggregate
+        share as field integers.  On MeshBackend the inputs are sharded over
+        the batch axis and the reduction crosses shard boundaries, so XLA
+        lowers it to per-device partial sums + an all-reduce over the mesh —
+        the collective replacing the reference's DB shard merge
+        (reference: aggregator/src/aggregator/aggregation_job_writer.rs:591-698).
+        """
+        if self._agg_fn is None:
+            self._agg_fn = self._jax.jit(self.bp.aggregate)
+        shares = np.asarray(out_shares_limbs)
+        m = np.asarray(mask)
+        B = shares.shape[0]
+        pad_to = self._pad_to(B)
+        if pad_to != B:  # zero rows masked False: no effect on the sum
+            shares = np.concatenate(
+                [shares, np.zeros((pad_to - B,) + shares.shape[1:], shares.dtype)]
+            )
+            m = np.concatenate([m, np.zeros(pad_to - B, dtype=bool)])
+        return self.bp.jf.from_limbs(
+            np.asarray(self._agg_fn(self._place_batch(shares), self._place_batch(m)))
+        )
+
+
+class MeshBackend(TpuBackend):
+    """SPMD batched prepare over a ``jax.sharding.Mesh``.
+
+    The product form of the multi-chip path (not just the dryrun): every
+    prepare / combine launch is sharded over the mesh's ``batch`` axis, so
+    on a v5e-8 slice each chip prepares 1/8 of the job's reports, and
+    ``aggregate_batch`` reduces out shares ACROSS chips on-device — XLA
+    inserts the all-reduce over ICI for the sum along the sharded axis.
+    This replaces the reference's write-contention DB shard merge
+    (reference: aggregator/src/aggregator/aggregation_job_writer.rs:591-698)
+    with a collective, exactly the psum re-design named in SURVEY §2.3 P4.
+
+    Selected via the service config ``vdaf_backend: mesh``.  On a single
+    device it degrades to TpuBackend behavior (mesh of 1).
+    """
+
+    name = "mesh"
+
+    def __init__(self, vdaf: Prio3, devices=None):
+        super().__init__(vdaf)
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        devs = list(devices) if devices is not None else jax.devices()
+        self.mesh = Mesh(np.array(devs), ("batch",))
+        self._batch_sharding = NamedSharding(self.mesh, PartitionSpec("batch"))
+        self._replicated = NamedSharding(self.mesh, PartitionSpec())
+
+    # The batch APIs are inherited: only padding and placement differ.
+    def _pad_to(self, B: int) -> int:
+        # Power-of-two bucketing (bounds recompiles) rounded up so the mesh
+        # axis divides the batch evenly.
+        n = len(self.mesh.devices)
+        return max(next_power_of_2(B), n)
+
+    def _place(self, kw: Dict[str, np.ndarray]) -> Dict[str, object]:
+        """Commit per-report arrays shard-per-device; replicate scalars."""
+        placed: Dict[str, object] = {}
+        for k, v in kw.items():
+            sharding = self._replicated if k == "verify_key_u8" else self._batch_sharding
+            placed[k] = self._jax.device_put(v, sharding)
+        return placed
+
+    def _place_batch(self, arr: np.ndarray):
+        return self._jax.device_put(arr, self._batch_sharding)
+
+
+BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend, "mesh": MeshBackend}
 
 
 def make_backend(vdaf: Prio3, backend: str = "oracle"):
