@@ -92,6 +92,19 @@ class _DeviceCircuit:
     # subclasses: inputs(), v(), truncate(), gadget_eval_scaled().
     # Convention: meas/gk/wires canonical; jr_m Montgomery; consts as noted.
 
+    def wire_evals(self, jf, meas_m, jr_m, lag, seeds, consts):
+        """Wire-polynomial evaluations at t: (B, arity, n) canonical.
+
+        lag (B, K, n) Montgomery barycentric coefficients, seeds (B, arity, n)
+        canonical.  Default path materializes the gadget-input tensor; the
+        chunked circuits override with a fused form (the input tensor is
+        (B, calls, arity, n) — ~165 MB/launch for histogram1024 at B=4096 —
+        and this device is HBM-bandwidth-bound, so never writing it is the
+        win)."""
+        inp = self.inputs(jf, meas_m, jr_m, consts)  # (B, calls, arity, n)
+        wires = jnp.concatenate([seeds[:, None], inp], axis=1)  # (B, K, arity, n)
+        return jf.sum(jf.mont_mul(wires, lag[:, :, None, :]), axis=1)
+
 
 class _DCount(_DeviceCircuit):
     def inputs(self, jf, meas_m, jr_m, consts):
@@ -156,6 +169,27 @@ class _DChunked(_DeviceCircuit):
         prod = jf.mont_mul(pairs[:, :, 0], pairs[:, :, 1])  # (a*b)*R^-1
         return jf.sum(prod, axis=1)
 
+    def _odds_and_seed(self, jf, m, lagk, lag0, seeds, consts):
+        """Shared pieces of the fused wire evaluation.
+
+        odds[u] = sum_k lag_{k+1}*(m[k,u] - 1/shares)
+                = sum_k mont_mul(m[k,u], lag_{k+1}) - mont_mul(1/shares, sum_k lag_{k+1})
+        (exact: mont_mul distributes over mod-p addition; canonical limbs are
+        unique, so the rearranged form is byte-identical to the oracle's).
+        """
+        s2 = jf.sum(jf.mont_mul(m, lagk[:, :, None, :]), axis=1)  # (B, chunk, n)
+        lag_sum = jf.sum(lagk, axis=1)  # (B, n) Montgomery
+        c = jnp.broadcast_to(consts["shares_inv_c"], lag_sum.shape)
+        ccorr = jf.mont_mul(c, lag_sum)  # (B, n) canonical
+        odds = jf.sub(s2, ccorr[:, None, :])
+        se = jf.mont_mul(seeds, lag0[:, None, :])  # (B, arity, n)
+        return odds, se
+
+    def _zip_wires(self, jf, evens, odds, se):
+        B = evens.shape[0]
+        pair = jnp.stack([evens, odds], axis=2).reshape(B, 2 * self.chunk, jf.n)
+        return jf.add(se, pair)
+
 
 class _DSumVec(_DChunked):
     def inputs(self, jf, meas_m, jr_m, consts):
@@ -167,6 +201,21 @@ class _DSumVec(_DChunked):
         a = jf.mont_mul(m, r_pows)
         b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_c"], m.shape))
         return self._interleave(a, b)
+
+    def wire_evals(self, jf, meas_m, jr_m, lag, seeds, consts):
+        """Fused: evens[u] = sum_k lag_{k+1} * m[k,u] * jr_k^(u+1).
+
+        jr differs per call, so lag folds into the per-(k,u) Montgomery
+        power table; no (B, calls, arity, n) tensor is ever written."""
+        B = meas_m.shape[0]
+        m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
+        lag0, lagk = lag[:, 0], lag[:, 1:]
+        jr_b = jnp.broadcast_to(jr_m[:, :, None, :], m.shape)
+        r_pows = jf.cumprod_mont(jr_b, axis=2)  # jr_k^(u+1) * R
+        rl = jf.mont_mul(r_pows, jnp.broadcast_to(lagk[:, :, None, :], m.shape))
+        evens = jf.sum(jf.mont_mul(m, rl), axis=1)  # (B, chunk, n)
+        odds, se = self._odds_and_seed(jf, m, lagk, lag0, seeds, consts)
+        return self._zip_wires(jf, evens, odds, se)
 
     def v(self, jf, gk, meas_m, jr_m, consts):
         return jf.sum(gk, axis=1)
@@ -193,6 +242,38 @@ class _DHistogram(_DChunked):
         a = jf.mont_mul(m, r_pows)
         b = jf.sub(m, jnp.broadcast_to(consts["shares_inv_c"], m.shape))
         return self._interleave(a, b)
+
+    def wire_evals(self, jf, meas_m, jr_m, lag, seeds, consts):
+        """Fused with the global r-power pulled apart as an outer product.
+
+        r^(k*chunk + u + 1) = r^(k*chunk) * r^(u+1), so
+        evens[u] = mont_mul( sum_k mont_mul(m[k,u], kl[k]),  r_ch[u] )
+        with kl[k] = mont_mul(r_call[k], lag_{k+1}) a TINY (B, calls, n)
+        table — the k-contraction happens before the chunk-wide multiply,
+        reading meas once and writing only (B, chunk, n).  Every
+        rearrangement is an exact mod-p identity, so the canonical output
+        limbs are byte-identical to the unfused form."""
+        B = meas_m.shape[0]
+        m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
+        lag0, lagk = lag[:, 0], lag[:, 1:]
+        r = jr_m[:, 0]  # (B, n) Montgomery
+        r_ch = jf.cumprod_mont(
+            jnp.broadcast_to(r[:, None, :], (B, self.chunk, jf.n)), axis=1
+        )  # r^(u+1) * R
+        rc = r_ch[:, -1]  # r^chunk * R
+        ones = jf.mont_one()[None, None, :]
+        if self.calls > 1:
+            tail = jf.cumprod_mont(
+                jnp.broadcast_to(rc[:, None, :], (B, self.calls - 1, jf.n)), axis=1
+            )
+            r_call = jnp.concatenate([jnp.broadcast_to(ones, (B, 1, jf.n)), tail], axis=1)
+        else:
+            r_call = jnp.broadcast_to(ones, (B, 1, jf.n))
+        kl = jf.mont_mul(r_call, lagk)  # (B, calls, n) Montgomery
+        s1 = jf.sum(jf.mont_mul(m, kl[:, :, None, :]), axis=1)  # (B, chunk, n)
+        evens = jf.mont_mul(s1, r_ch)
+        odds, se = self._odds_and_seed(jf, m, lagk, lag0, seeds, consts)
+        return self._zip_wires(jf, evens, odds, se)
 
     def v(self, jf, gk, meas_m, jr_m, consts):
         range_check = jf.sum(gk, axis=1)
@@ -354,8 +435,6 @@ class BatchedPrio3:
         seeds = proof_m[:, : circ.arity]  # (B, arity, n)
         gpoly = proof_m[:, circ.arity :]  # (B, glen, n)
 
-        inp = circ.inputs(jf, meas_m, jr_m, self.consts)  # (B, calls, arity, n)
-
         if self._ntt is not None:
             # Fold gpoly mod (x^P - 1) — alpha^P == 1 at the evaluation
             # points — then evaluate at all P roots in one NTT.
@@ -395,8 +474,7 @@ class BatchedPrio3:
             jf.mont_mul(jnp.broadcast_to(z[:, None, :], denom.shape), self.bary_c_m[None]),
             inv_denom,
         )  # (B, K, n)
-        wires = jnp.concatenate([seeds[:, None], inp], axis=1)  # (B, K, arity, n)
-        wire_evals = jf.sum(jf.mont_mul(wires, lag[:, :, None, :]), axis=1)  # (B, arity, n)
+        wire_evals = circ.wire_evals(jf, meas_m, jr_m, lag, seeds, self.consts)
 
         gp_t = jf.horner_mont(gpoly, t_m)  # (B, n)
 

@@ -283,3 +283,38 @@ def test_roundtrip_limb_bytes():
     data = limbs_to_bytes(limbs)
     back = bytes_to_limbs(jf, data, len(vals))
     assert jf.from_limbs(np.asarray(back)) == vals
+
+
+def test_fused_wire_evals_match_unfused():
+    """The chunked circuits' fused wire_evals overrides must be byte-
+    identical to the base-class path that materializes inputs() — the
+    rearrangements are exact mod-p identities, and this keeps the unfused
+    reference implementation honest (it is otherwise only reachable via
+    Count/Sum)."""
+    import jax.numpy as jnp
+
+    from janus_tpu.ops.prepare import BatchedPrio3, _DeviceCircuit
+    from janus_tpu.vdaf.instances import prio3_histogram, prio3_sum_vec
+
+    for vdaf in [
+        prio3_histogram(length=5, chunk_length=2),
+        prio3_sum_vec(length=4, bits=2, chunk_length=3),
+    ]:
+        bp = BatchedPrio3(vdaf)
+        jf, circ, flp = bp.jf, bp.circ, vdaf.flp
+        rng = np.random.RandomState(3)
+        B, K = 3, circ.calls + 1
+
+        def rl(shape):
+            vals = [int(rng.randint(0, 1 << 31)) for _ in range(int(np.prod(shape)))]
+            return jnp.asarray(jf.to_limbs(vals).reshape(*shape, jf.n))
+
+        meas = rl((B, flp.MEAS_LEN))
+        seeds = rl((B, circ.arity))
+        jr_m = jf.to_mont(rl((B, flp.JOINT_RAND_LEN)))
+        lag = jf.to_mont(rl((B, K)))
+        fused = np.asarray(circ.wire_evals(jf, meas, jr_m, lag, seeds, bp.consts))
+        unfused = np.asarray(
+            _DeviceCircuit.wire_evals(circ, jf, meas, jr_m, lag, seeds, bp.consts)
+        )
+        assert (fused == unfused).all(), type(circ).__name__

@@ -119,7 +119,10 @@ def main() -> int:
     }
     pick = [s for s in args.stages.split(",") if s] or list(stages)
 
+    import jax.numpy as jnp
+
     print(f"platform={jax.devices()[0].platform} batch={B}")
+    DEPTH = 8
     for name in pick:
         f = stages[name]
         jitted = jax.jit(f)
@@ -127,20 +130,21 @@ def main() -> int:
         out = jitted()
         jax.block_until_ready(out)
         compile_s = time.monotonic() - t0
-        import jax.numpy as jnp
 
-        lat = []
+        # Pipelined marginal cost: DEPTH launches in flight, one readback.
+        # The shared chip + ~200 ms tunnel round-trip make single-dispatch
+        # timings meaningless; best-of-N pipelined rounds is the metric
+        # bench.py reports and the regime the job driver runs in.
+        rounds = []
         for _ in range(args.iters):
             t0 = time.monotonic()
-            out = jitted()
-            jax.block_until_ready(out)
-            # Tiny slice readback (device-side slice, 16 bytes over the wire)
-            # to defeat any early return without paying full-output transfer.
-            np.asarray(jnp.ravel(out)[:4])
-            lat.append(time.monotonic() - t0)
-        best = min(lat) * 1e3
-        med = sorted(lat)[len(lat) // 2] * 1e3
-        print(f"{name:14s} p50={med:9.2f}ms best={best:9.2f}ms compile={compile_s:6.1f}s")
+            outs = [jitted() for _ in range(DEPTH)]
+            jax.block_until_ready(outs)
+            np.asarray(jnp.ravel(outs[-1])[:4])
+            rounds.append((time.monotonic() - t0) / DEPTH)
+        best = min(rounds) * 1e3
+        med = sorted(rounds)[len(rounds) // 2] * 1e3
+        print(f"{name:14s} pipelined p50={med:9.2f}ms best={best:9.2f}ms compile={compile_s:6.1f}s")
     return 0
 
 
