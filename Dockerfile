@@ -1,0 +1,24 @@
+# janus_tpu — container image for the aggregator binaries and the interop
+# harness (the reference ships per-binary images built via docker-bake;
+# here one image serves every multi-call entry point — reference:
+# Dockerfile, docker-bake.hcl).
+#
+# Build:   docker build -t janus-tpu .
+# Run:     docker run janus-tpu <binary> [args]
+#   where <binary> is one of: aggregator, aggregation_job_creator,
+#   aggregation_job_driver, collection_job_driver, janus_cli,
+#   janus_interop_client, janus_interop_aggregator, janus_interop_collector.
+#
+# The TPU runtime is provided by the host (mount the libtpu + device as
+# usual for TPU containers); CPU-only containers work out of the box with
+# JAX_PLATFORMS=cpu (the interop topology in docker-compose.yml does this).
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir "jax[cpu]" aiohttp cryptography prometheus-client pyyaml click
+
+WORKDIR /app
+COPY janus_tpu /app/janus_tpu
+COPY pyproject.toml /app/
+
+ENV PYTHONPATH=/app
+ENTRYPOINT ["python", "-m", "janus_tpu.binaries.main"]

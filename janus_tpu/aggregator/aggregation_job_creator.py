@@ -170,45 +170,16 @@ class AggregationJobCreator:
     def _group_fixed_size(
         self, tx: Transaction, task: AggregatorTask, metas: List[ReportMetadata]
     ) -> Tuple[List[Tuple[Optional[BatchId], List[ReportMetadata]]], List[ReportMetadata]]:
-        """Incremental batch filling (reference: batch_creator.rs:32-517):
-        route reports into unfilled outstanding batches (most-full first),
-        creating batches as needed; mark batches filled when they reach the
-        fill target."""
-        fill_target = task.query_type.max_batch_size or task.min_batch_size
-        btws = task.query_type.batch_time_window_size
+        """Incremental batch filling via the headroom-priority BatchCreator
+        (reference: batch_creator.rs:32-517 — see batch_creator.py)."""
+        from .batch_creator import BatchCreator
 
-        def bucket_of(m: ReportMetadata) -> Optional[int]:
-            if btws is None:
-                return None
-            return m.time.seconds - m.time.seconds % btws.seconds
-
-        by_bucket: Dict[Optional[int], List[ReportMetadata]] = {}
+        creator = BatchCreator(
+            tx,
+            task,
+            self.config.min_aggregation_job_size,
+            self.config.max_aggregation_job_size,
+        )
         for m in metas:
-            by_bucket.setdefault(bucket_of(m), []).append(m)
-
-        jobs: List[Tuple[Optional[BatchId], List[ReportMetadata]]] = []
-        for bucket, group in by_bucket.items():
-            bucket_time = Time(bucket) if bucket is not None else None
-            batches = tx.get_unfilled_outstanding_batches(task.task_id, bucket_time)
-            # most-full first (reference: priority queue by remaining headroom)
-            batches.sort(key=lambda b: fill_target - b.size_max)
-            idx = 0
-            while group:
-                if idx < len(batches):
-                    batch = batches[idx]
-                    headroom = max(0, fill_target - batch.size_max)
-                    batch_id = batch.batch_id
-                    idx += 1
-                else:
-                    batch_id = BatchId.random()
-                    tx.put_outstanding_batch(task.task_id, batch_id, bucket_time)
-                    headroom = fill_target
-                if headroom == 0:
-                    tx.mark_outstanding_batch_filled(task.task_id, batch_id)
-                    continue
-                take, group = group[:headroom], group[headroom:]
-                if headroom - len(take) == 0:
-                    tx.mark_outstanding_batch_filled(task.task_id, batch_id)
-                for i in range(0, len(take), self.config.max_aggregation_job_size):
-                    jobs.append((batch_id, take[i : i + self.config.max_aggregation_job_size]))
-        return jobs, []
+            creator.add_report(m)
+        return creator.finish()

@@ -104,7 +104,12 @@ class Config:
     batch_aggregation_shard_count: int = 8
     task_counter_shard_count: int = 8
     task_cache_ttl: float = 30.0
-    #: VDAF execution backend: "tpu" (batched device launch) or "oracle".
+    #: Refresh cadence for the global-HPKE / taskprov-peer config caches
+    #: (reference: cache.rs refresh tasks).
+    global_hpke_cache_refresh_interval: float = 60.0
+    peer_aggregator_cache_refresh_interval: float = 60.0
+    #: VDAF execution backend: "oracle", "tpu" (batched device launch), or
+    #: "mesh" (SPMD over a device mesh).
     vdaf_backend: str = "oracle"
     collection_job_retry_after: int = 10
 
@@ -155,12 +160,25 @@ class Aggregator:
         self.clock = clock
         self.config = config or Config()
         self._task_cache: Dict[bytes, Tuple[float, TaskAggregator]] = {}
+        from .cache import GlobalHpkeKeypairCache, PeerAggregatorCache
+
+        self.global_hpke_cache = GlobalHpkeKeypairCache(
+            datastore, self.config.global_hpke_cache_refresh_interval
+        )
+        self.peer_aggregator_cache = PeerAggregatorCache(
+            datastore, self.config.peer_aggregator_cache_refresh_interval
+        )
         self.report_writer = ReportWriteBatcher(
             datastore,
             max_batch_size=self.config.max_upload_batch_size,
             max_batch_write_delay=self.config.max_upload_batch_write_delay,
             counter_shard_count=self.config.task_counter_shard_count,
         )
+
+    async def shutdown(self) -> None:
+        """Cancel the config-cache refresh loops (call on service teardown)."""
+        await self.global_hpke_cache.stop()
+        await self.peer_aggregator_cache.stop()
 
     # ------------------------------------------------------------------
     # task cache (reference: aggregator.rs:675 task_aggregator_for)
@@ -213,42 +231,42 @@ class Aggregator:
         if config.task_expiration.seconds <= self.clock.now().seconds:
             raise InvalidMessage("taskprov advertisement already expired")
 
+        # Peer + global-key lookups come from the refreshed caches; only the
+        # task write needs a transaction (reference: cache.rs consumers).
+        peers = await self.peer_aggregator_cache.peers()
+        own_role = peer = None
+        for p in peers:
+            if (
+                p.role == Role.LEADER
+                and p.endpoint == str(config.leader_aggregator_endpoint)
+            ):
+                own_role, peer = Role.HELPER, p
+                break
+            if (
+                p.role == Role.HELPER
+                and p.endpoint == str(config.helper_aggregator_endpoint)
+            ):
+                own_role, peer = Role.LEADER, p
+                break
+        if peer is None:
+            raise UnrecognizedTask("no taskprov peer for advertised task")
+        # authenticate the advertising peer before any write; the upload
+        # route is exempt (clients cannot hold the peer token — the
+        # reference separates upload opt-in from peer request auth)
+        if require_peer_auth:
+            h = peer.aggregator_auth_token_hash
+            if h is None and peer.aggregator_auth_token is not None:
+                h = peer.aggregator_auth_token.hash()
+            if h is None or auth_token is None or not h.validate(auth_token):
+                raise UnauthorizedRequest("taskprov advertisement not authenticated")
+        keys = [
+            HpkeKeypair(kp.config, kp.private_key)
+            for kp in await self.global_hpke_cache.active_keypairs()
+        ]
+        if not keys:
+            raise UnrecognizedTask("no active global HPKE key for taskprov")
+
         def tx_fn(tx):
-            peers = tx.get_taskprov_peer_aggregators()
-            own_role = peer = None
-            for p in peers:
-                if (
-                    p.role == Role.LEADER
-                    and p.endpoint == str(config.leader_aggregator_endpoint)
-                ):
-                    own_role, peer = Role.HELPER, p
-                    break
-                if (
-                    p.role == Role.HELPER
-                    and p.endpoint == str(config.helper_aggregator_endpoint)
-                ):
-                    own_role, peer = Role.LEADER, p
-                    break
-            if peer is None:
-                raise UnrecognizedTask("no taskprov peer for advertised task")
-            # authenticate the advertising peer before any write; the upload
-            # route is exempt (clients cannot hold the peer token — the
-            # reference separates upload opt-in from peer request auth)
-            if require_peer_auth:
-                h = peer.aggregator_auth_token_hash
-                if h is None and peer.aggregator_auth_token is not None:
-                    h = peer.aggregator_auth_token.hash()
-                if h is None or auth_token is None or not h.validate(auth_token):
-                    raise UnauthorizedRequest(
-                        "taskprov advertisement not authenticated"
-                    )
-            keys = [
-                HpkeKeypair(kp.config, kp.private_key)
-                for kp in tx.get_global_hpke_keypairs()
-                if kp.state.value == "Active"
-            ]
-            if not keys:
-                raise UnrecognizedTask("no active global HPKE key for taskprov")
             task = taskprov_task(
                 encoded_task_config, peer, own_role, keys, config=config
             )
@@ -266,11 +284,9 @@ class Aggregator:
         if task_id is not None:
             ta = await self.task_aggregator_for(task_id)
             return ta.hpke_config_list()
-        # global keys
-        keypairs = await self.datastore.run_tx_async(
-            "get_global_hpke", lambda tx: tx.get_global_hpke_keypairs()
-        )
-        active = [kp.config for kp in keypairs if kp.state.value == "Active"]
+        # global keys, served from the refreshed cache (no DB hit in the
+        # steady state — reference: cache.rs GlobalHpkeKeypairCache)
+        active = await self.global_hpke_cache.active_configs()
         if not active:
             raise UnrecognizedTask("no HPKE configuration available")
         return HpkeConfigList(active)

@@ -156,6 +156,7 @@ def run_aggregator(config_path: Optional[str]) -> None:
         await stop.wait()
         for t in tasks:
             t.cancel()
+        await agg.shutdown()
         await runner.cleanup()
         await health.cleanup()
 
@@ -293,6 +294,10 @@ def main(argv=None) -> int:
         from .janus_cli import cli
 
         cli.main(args=argv, standalone_mode=True)
+    elif binary == "collect":
+        from .collect import collect
+
+        collect.main(args=argv, standalone_mode=True, obj={})
     elif binary.startswith("janus_interop_"):
         from ..interop import run_interop_binary
 

@@ -74,43 +74,82 @@ class Collector:
             session = aiohttp.ClientSession()
         try:
             collection_job_id = CollectionJobId.random()
-            url = (
-                self.leader_endpoint.rstrip("/")
-                + f"/tasks/{self.task_id}/collection_jobs/{collection_job_id}"
-            )
-            name, value = self.auth_token.request_authentication()
-            headers = {name: value, "Content-Type": CollectionReq.MEDIA_TYPE}
-            req = CollectionReq(query, aggregation_parameter)
-            async with session.put(url, data=req.get_encoded(), headers=headers) as resp:
-                if resp.status not in (200, 201):
-                    raise CollectorError(
-                        f"collection create failed: {resp.status} {await resp.text()}"
-                    )
+            await self.create_job(query, collection_job_id, aggregation_parameter, session=session)
 
             # poll (reference: :522 poll_once w/ Retry-After)
             deadline = asyncio.get_running_loop().time() + self.max_poll_time
             while True:
-                async with session.post(url, headers={name: value}) as resp:
-                    if resp.status == 200:
-                        body = await resp.read()
-                        return self._decrypt(
-                            Collection.get_decoded(body, self._query_class(query)),
-                            query,
-                            aggregation_parameter,
-                        )
-                    if resp.status != 202:
-                        raise CollectorError(
-                            f"collection poll failed: {resp.status} {await resp.text()}"
-                        )
-                    retry_after = float(
-                        resp.headers.get("Retry-After", self.poll_interval)
-                    )
+                out, retry_after = await self.poll_once(
+                    query, collection_job_id, aggregation_parameter, session=session
+                )
+                if out is not None:
+                    return out
                 if asyncio.get_running_loop().time() > deadline:
                     raise CollectorError("collection timed out")
-                await asyncio.sleep(min(retry_after, self.poll_interval))
+                await asyncio.sleep(
+                    min(retry_after or self.poll_interval, self.poll_interval)
+                )
         finally:
             if own_session:
                 await session.close()
+
+    def _job_url(self, collection_job_id: CollectionJobId) -> str:
+        return (
+            self.leader_endpoint.rstrip("/")
+            + f"/tasks/{self.task_id}/collection_jobs/{collection_job_id}"
+        )
+
+    async def create_job(
+        self,
+        query: Query,
+        collection_job_id: CollectionJobId,
+        aggregation_parameter: bytes = b"",
+        *,
+        session,
+    ) -> None:
+        """PUT the collection job (reference: collector/src/lib.rs:439)."""
+        name, value = self.auth_token.request_authentication()
+        headers = {name: value, "Content-Type": CollectionReq.MEDIA_TYPE}
+        req = CollectionReq(query, aggregation_parameter)
+        url = self._job_url(collection_job_id)
+        async with session.put(url, data=req.get_encoded(), headers=headers) as resp:
+            if resp.status not in (200, 201):
+                raise CollectorError(
+                    f"collection create failed: {resp.status} {await resp.text()}"
+                )
+
+    async def poll_once(
+        self,
+        query: Query,
+        collection_job_id: CollectionJobId,
+        aggregation_parameter: bytes = b"",
+        *,
+        session,
+    ) -> tuple:
+        """One POST poll -> (result | None, server Retry-After seconds | None)
+        (reference: collector/src/lib.rs:522 poll_once)."""
+        name, value = self.auth_token.request_authentication()
+        url = self._job_url(collection_job_id)
+        async with session.post(url, headers={name: value}) as resp:
+            if resp.status == 200:
+                body = await resp.read()
+                return (
+                    self._decrypt(
+                        Collection.get_decoded(body, self._query_class(query)),
+                        query,
+                        aggregation_parameter,
+                    ),
+                    None,
+                )
+            if resp.status != 202:
+                raise CollectorError(
+                    f"collection poll failed: {resp.status} {await resp.text()}"
+                )
+            retry_after = resp.headers.get("Retry-After")
+            try:
+                return None, float(retry_after) if retry_after is not None else None
+            except ValueError:
+                return None, None
 
     def _decrypt(
         self, collection: Collection, query: Query, aggregation_parameter: bytes

@@ -7,7 +7,7 @@ bit-for-bit.
 """
 
 from .gadgets import Mul, ParallelSum, PolyEval, Range2
-from .circuits import Count, Histogram, Sum, SumVec
+from .circuits import Count, FixedPointBoundedL2VecSum, Histogram, Sum, SumVec
 from .generic import FlpError, FlpGeneric
 
 __all__ = [
@@ -16,6 +16,7 @@ __all__ = [
     "PolyEval",
     "Range2",
     "Count",
+    "FixedPointBoundedL2VecSum",
     "Histogram",
     "Sum",
     "SumVec",

@@ -14,7 +14,7 @@ of the circuit output then sum to the true output).
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from ..fields import Field64, Field128
 from .gadgets import Gadget, Mul, ParallelSum, Range2
@@ -244,3 +244,151 @@ class Histogram(Valid):
 
     def decode(self, output, num_measurements):
         return list(output)
+
+
+class FixedPointBoundedL2VecSum(Valid):
+    """Fixed-point vector sum with an L2-norm bound (federated-learning
+    gradient aggregation).
+
+    The analog of the reference's ``fpvec_bounded_l2`` VDAF family
+    (reference: core/src/vdaf.rs:91 Prio3FixedPointBoundedL2VecSum; the
+    circuit lives in the external prio crate, flp/types/fixedpoint_l2.rs).
+    Each measurement is a vector of ``entries`` fixed-point values in
+    [-1, 1) with ``bits_per_entry`` bits (1 sign + n-1 fraction), encoded
+    via the unsigned offset representation X = x*2^(n-1) + 2^(n-1).  The
+    client additionally claims the squared L2 norm of the ORIGINAL vector
+    as a (2n-2)-bit decomposition, which bounds it below 1.
+
+    Validity checks, combined into one output by Schwartz-Zippel random
+    linear combination (the Histogram pattern above):
+    1. every entry bit and norm bit is 0/1 (chunked ParallelSum(Mul) with
+       per-chunk joint-rand weights, the SumVec pattern);
+    2. the claimed norm equals the recomputed norm
+       sum_i (X_i - 2^(n-1))^2 = sum_i X_i^2 - 2^n sum_i X_i + d*2^(2n-2),
+       where the squares come from a second ParallelSum(Mul) gadget over
+       entry pairs (X_i, X_i) and the rest is affine in the shares.
+    """
+
+    def __init__(
+        self,
+        bits_per_entry: int,
+        entries: int,
+        chunk_length: Optional[int] = None,
+        field: type = Field128,
+    ):
+        if bits_per_entry < 2 or entries <= 0:
+            raise ValueError("invalid FixedPointBoundedL2VecSum parameters")
+        n = bits_per_entry
+        self.field = field
+        self.bits_per_entry = n
+        self.entries = entries
+        self.bits_for_norm = 2 * (n - 1)
+        self.MEAS_LEN = entries * n + self.bits_for_norm
+        self.OUTPUT_LEN = entries
+        self.chunk_length = chunk_length or max(1, int(self.MEAS_LEN**0.5))
+        bit_calls = (self.MEAS_LEN + self.chunk_length - 1) // self.chunk_length
+        sq_calls = (entries + self.chunk_length - 1) // self.chunk_length
+        self.GADGET_CALLS = [bit_calls, sq_calls]
+        # one weight per bit chunk + one combiner for the norm equality
+        self.JOINT_RAND_LEN = bit_calls + 1
+
+    def new_gadgets(self):
+        return [
+            ParallelSum(Mul(), self.chunk_length),
+            ParallelSum(Mul(), self.chunk_length),
+        ]
+
+    def _entry(self, f, meas, i):
+        n = self.bits_per_entry
+        acc = 0
+        for b in range(n):
+            acc = f.add(acc, f.mul(pow(2, b, f.MODULUS), meas[i * n + b]))
+        return acc
+
+    def eval(self, meas, joint_rand, num_shares, gadgets):
+        self.check_valid(meas, joint_rand)
+        f = self.field
+        n = self.bits_per_entry
+        d = self.entries
+        shares_inv = f.inv(num_shares)
+        bit_calls, sq_calls = self.GADGET_CALLS
+
+        # 1. bit range checks over ALL MEAS_LEN positions (SumVec pattern).
+        bit_check = 0
+        for i in range(bit_calls):
+            r = joint_rand[i]
+            r_power = r
+            inputs = []
+            for j in range(self.chunk_length):
+                index = i * self.chunk_length + j
+                meas_elem = meas[index] if index < len(meas) else 0
+                inputs.append(f.mul(meas_elem, r_power))
+                inputs.append(f.sub(meas_elem, shares_inv))
+                r_power = f.mul(r_power, r)
+            bit_check = f.add(bit_check, gadgets[0].eval(f, inputs))
+
+        # 2. norm equality.
+        entries_f = [self._entry(f, meas, i) for i in range(d)]
+        sumsq = 0
+        for i in range(sq_calls):
+            inputs = []
+            for j in range(self.chunk_length):
+                index = i * self.chunk_length + j
+                x = entries_f[index] if index < d else 0
+                inputs.append(x)
+                inputs.append(x)
+            sumsq = f.add(sumsq, gadgets[1].eval(f, inputs))
+        sum_x = 0
+        for x in entries_f:
+            sum_x = f.add(sum_x, x)
+        offset_sq = f.mul(
+            shares_inv, f.mul(d % f.MODULUS, pow(2, 2 * n - 2, f.MODULUS))
+        )
+        computed = f.add(
+            f.sub(sumsq, f.mul(pow(2, n, f.MODULUS), sum_x)), offset_sq
+        )
+        claimed = 0
+        for b in range(self.bits_for_norm):
+            claimed = f.add(
+                claimed,
+                f.mul(pow(2, b, f.MODULUS), meas[d * n + b]),
+            )
+        norm_check = f.sub(computed, claimed)
+
+        rn = joint_rand[bit_calls]
+        return f.add(f.mul(rn, bit_check), f.mul(f.mul(rn, rn), norm_check))
+
+    def encode(self, measurement):
+        """measurement: sequence of floats in [-1, 1)."""
+        n = self.bits_per_entry
+        if len(measurement) != self.entries:
+            raise ValueError("measurement length mismatch")
+        xs = []
+        for v in measurement:
+            if not -1.0 <= float(v) < 1.0:
+                raise ValueError("fixed-point value out of [-1, 1)")
+            # Clamp the rounded magnitude to the largest representable
+            # value: floats in [1 - 2^-(n-1), 1) would otherwise round up
+            # to the unrepresentable 2^(n-1) (the reference takes
+            # fixed-point-typed inputs, where this cannot arise).
+            scaled = min(int(round(float(v) * (1 << (n - 1)))), (1 << (n - 1)) - 1)
+            xs.append(scaled + (1 << (n - 1)))
+        norm = sum((x - (1 << (n - 1))) ** 2 for x in xs)
+        if norm >= 1 << self.bits_for_norm:
+            raise ValueError("L2 norm out of bounds")
+        meas = []
+        for x in xs:
+            meas.extend((x >> b) & 1 for b in range(n))
+        meas.extend((norm >> b) & 1 for b in range(self.bits_for_norm))
+        return meas
+
+    def truncate(self, meas):
+        f = self.field
+        return [self._entry(f, meas, i) for i in range(self.entries)]
+
+    def decode(self, output, num_measurements):
+        n = self.bits_per_entry
+        offset = num_measurements << (n - 1)
+        return [
+            (int(o) - offset) / float(1 << (n - 1)) for o in output
+        ]

@@ -12,13 +12,14 @@ from __future__ import annotations
 from typing import Any, Callable, Dict
 
 from ..fields import Field64, Field128
-from ..flp import Count, FlpGeneric, Histogram, Sum, SumVec
+from ..flp import Count, FixedPointBoundedL2VecSum, FlpGeneric, Histogram, Sum, SumVec
 from ..xof import XofHmacSha256Aes128, XofTurboShake128
 from .prio3 import (
     ALG_PRIO3_COUNT,
     ALG_PRIO3_HISTOGRAM,
     ALG_PRIO3_SUM,
     ALG_PRIO3_SUMVEC,
+    ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM,
     ALG_PRIO3_SUMVEC_FIELD64_MULTIPROOF_HMACSHA256_AES128,
     Prio3,
 )
@@ -67,6 +68,37 @@ def prio3_sum_vec_field64_multiproof_hmacsha256_aes128(
     )
 
 
+def prio3_fixedpoint_bounded_l2_vec_sum(
+    bitsize, length: int, num_shares: int = 2, dp_strategy=None, chunk_length: int = None
+) -> Prio3:
+    """Fixed-point bounded-L2 vector sum (reference: core/src/vdaf.rs:88-91).
+
+    ``bitsize``: 16 | 32 | "BitSize16" | "BitSize32" (the reference's enum).
+    ``dp_strategy``: only NoDifferentialPrivacy is supported, matching the
+    DP stub at the reference's call site (collection_job_driver.py).
+    """
+    bits = {16: 16, 32: 32, "BitSize16": 16, "BitSize32": 32}.get(bitsize)
+    if bits is None:
+        raise ValueError(f"unsupported bitsize {bitsize!r}")
+    if dp_strategy is not None:
+        tag = (
+            dp_strategy.get("dp_strategy")
+            if isinstance(dp_strategy, dict)
+            else dp_strategy
+        )
+        if tag not in (None, "NoDifferentialPrivacy"):
+            raise ValueError("only NoDifferentialPrivacy is supported")
+    return Prio3(
+        FlpGeneric(
+            FixedPointBoundedL2VecSum(
+                bits_per_entry=bits, entries=length, chunk_length=chunk_length
+            )
+        ),
+        ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM,
+        num_shares=num_shares,
+    )
+
+
 def _poplar1(bits: int):
     from .poplar1 import Poplar1
 
@@ -102,6 +134,7 @@ VDAF_INSTANCES: Dict[str, Callable[..., Prio3]] = {
     "Prio3SumVec": prio3_sum_vec,
     "Prio3Histogram": prio3_histogram,
     "Prio3SumVecField64MultiproofHmacSha256Aes128": prio3_sum_vec_field64_multiproof_hmacsha256_aes128,
+    "Prio3FixedPointBoundedL2VecSum": prio3_fixedpoint_bounded_l2_vec_sum,
     "Poplar1": _poplar1,
     "Fake": _fake,
     "FakeFailsPrepInit": _fake_fails_prep_init,

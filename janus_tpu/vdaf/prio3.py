@@ -37,6 +37,8 @@ ALG_PRIO3_SUM = 0x00000001
 ALG_PRIO3_SUMVEC = 0x00000002
 ALG_PRIO3_HISTOGRAM = 0x00000003
 ALG_PRIO3_SUMVEC_FIELD64_MULTIPROOF_HMACSHA256_AES128 = 0xFFFF1003
+# libprio's private codepoint for the fpvec_bounded_l2 family.
+ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM = 0xFFFF1002
 
 
 class VdafError(Exception):

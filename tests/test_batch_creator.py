@@ -1,0 +1,137 @@
+"""BatchCreator headroom-priority semantics (reference: batch_creator.rs tests)."""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from janus_tpu.aggregator.batch_creator import BatchCreator
+from janus_tpu.datastore.task import TaskQueryType
+from janus_tpu.messages import BatchId, Duration, ReportId, ReportMetadata, TaskId, Time
+
+
+@dataclass
+class FakeOutstanding:
+    batch_id: BatchId
+    size_min: int
+    size_max: int
+
+
+class FakeTx:
+    def __init__(self, existing: Optional[List[FakeOutstanding]] = None):
+        self.existing = existing or []
+        self.created: List[BatchId] = []
+        self.filled: List[BatchId] = []
+
+    def get_unfilled_outstanding_batches(self, task_id, time_bucket_start):
+        return list(self.existing)
+
+    def mark_outstanding_batch_filled(self, task_id, batch_id):
+        self.filled.append(batch_id)
+
+    def put_outstanding_batch(self, task_id, batch_id, time_bucket_start):
+        self.created.append(batch_id)
+
+
+@dataclass
+class FakeTask:
+    task_id: TaskId
+    min_batch_size: int
+    query_type: TaskQueryType
+
+
+def _task(min_batch=8, max_batch=None, btws=None):
+    return FakeTask(
+        task_id=TaskId(b"\x01" * 32),
+        min_batch_size=min_batch,
+        query_type=TaskQueryType.fixed_size(
+            max_batch_size=max_batch, batch_time_window_size=btws
+        ),
+    )
+
+
+def _metas(n, t0=1000):
+    return [
+        ReportMetadata(ReportId(bytes([i]) * 16), Time(t0 + i)) for i in range(n)
+    ]
+
+
+def test_fills_most_full_batch_first():
+    nearly = FakeOutstanding(BatchId(b"\x02" * 32), 0, 6)
+    empty = FakeOutstanding(BatchId(b"\x03" * 32), 0, 1)
+    tx = FakeTx([empty, nearly])
+    c = BatchCreator(tx, _task(min_batch=8), min_aggregation_job_size=1, max_aggregation_job_size=4)
+    for m in _metas(2):
+        c.add_report(m)
+    jobs, leftover = c.finish()
+    # both reports top up the 6/8 batch (headroom 2), not the 1/8 one
+    assert [b.data for b, _ in jobs] == [nearly.batch_id.data]
+    assert len(jobs[0][1]) == 2
+    assert not leftover and not tx.created
+
+
+def test_non_greedy_waits_for_full_jobs_then_finish_flushes():
+    tx = FakeTx()
+    c = BatchCreator(tx, _task(min_batch=10, max_batch=20), 3, 5)
+    for m in _metas(7):
+        c.add_report(m)
+    # assignment pass cuts only full-size (5) jobs: one job so far
+    assert [len(g) for _, g in c.jobs] == [5]
+    jobs, leftover = c.finish()
+    # greedy finish cuts the remaining 2... but 2 < min_job 3 and doesn't
+    # complete min_batch (5+2 < 10): left unaggregated
+    assert [len(g) for _, g in jobs] == [5]
+    assert len(leftover) == 2
+
+
+def test_greedy_sub_min_job_when_it_completes_the_batch():
+    # Existing batch at 6/8 potential; two more reports complete min_batch
+    # even though 2 < min_aggregation_job_size.
+    nearly = FakeOutstanding(BatchId(b"\x04" * 32), 0, 6)
+    tx = FakeTx([nearly])
+    c = BatchCreator(tx, _task(min_batch=8), 4, 6)
+    for m in _metas(2):
+        c.add_report(m)
+    jobs, leftover = c.finish()
+    assert [len(g) for _, g in jobs] == [2]
+    assert jobs[0][0].data == nearly.batch_id.data
+    assert not leftover
+
+
+def test_saturated_batches_open_new_ones():
+    tx = FakeTx()
+    c = BatchCreator(tx, _task(min_batch=4, max_batch=4), 1, 4)
+    for m in _metas(10):
+        c.add_report(m)
+    jobs, leftover = c.finish()
+    # batches cap at 4: 4+4+2 across three new batches
+    sizes = {}
+    for b, g in jobs:
+        sizes[b.data] = sizes.get(b.data, 0) + len(g)
+    assert sorted(sizes.values()) == [2, 4, 4]
+    assert len(tx.created) == 3
+    assert not leftover
+
+
+def test_already_complete_batches_marked_filled_and_skipped():
+    done = FakeOutstanding(BatchId(b"\x05" * 32), 8, 9)
+    tx = FakeTx([done])
+    c = BatchCreator(tx, _task(min_batch=8), 1, 4)
+    for m in _metas(4):
+        c.add_report(m)
+    jobs, _ = c.finish()
+    assert done.batch_id in tx.filled
+    assert all(b.data != done.batch_id.data for b, _ in jobs)
+
+
+def test_time_bucketed_batches_do_not_mix():
+    btws = Duration(3600)
+    tx = FakeTx()
+    c = BatchCreator(tx, _task(min_batch=2, btws=btws), 1, 4)
+    early = [ReportMetadata(ReportId(bytes([i]) * 16), Time(100 + i)) for i in range(2)]
+    late = [ReportMetadata(ReportId(bytes([0x80 + i]) * 16), Time(7300 + i)) for i in range(2)]
+    for m in early + late:
+        c.add_report(m)
+    jobs, leftover = c.finish()
+    assert len(jobs) == 2 and not leftover
+    for _, group in jobs:
+        buckets = {m.time.seconds // 3600 for m in group}
+        assert len(buckets) == 1

@@ -1,0 +1,84 @@
+"""Refreshed config caches (reference: aggregator/src/cache.rs:24-208)."""
+
+import asyncio
+
+from janus_tpu.aggregator.cache import RefreshingCache
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_serves_snapshot_without_refetch():
+    calls = []
+
+    async def fetch():
+        calls.append(1)
+        return len(calls)
+
+    async def flow():
+        c = RefreshingCache(fetch, refresh_interval=60.0, name="t")
+        assert await c.get() == 1
+        assert await c.get() == 1  # snapshot, no second fetch
+        assert len(calls) == 1
+        await c.stop()
+
+    run(flow())
+
+
+def test_background_refresh_updates_snapshot():
+    calls = []
+
+    async def fetch():
+        calls.append(1)
+        return len(calls)
+
+    async def flow():
+        c = RefreshingCache(fetch, refresh_interval=0.05, name="t")
+        assert await c.get() == 1
+        await asyncio.sleep(0.2)
+        assert await c.get() > 1  # the loop refreshed behind our back
+        await c.stop()
+
+    run(flow())
+
+
+def test_refresh_failure_keeps_stale_snapshot():
+    state = {"fail": False, "calls": 0}
+
+    async def fetch():
+        state["calls"] += 1
+        if state["fail"]:
+            raise RuntimeError("db down")
+        return state["calls"]
+
+    async def flow():
+        c = RefreshingCache(fetch, refresh_interval=0.05, name="t")
+        first = await c.get()
+        state["fail"] = True
+        await asyncio.sleep(0.2)
+        assert await c.get() == first  # stale beats outage
+        await c.stop()
+
+    run(flow())
+
+
+def test_invalidate_forces_fetch():
+    calls = []
+
+    async def fetch():
+        calls.append(1)
+        return len(calls)
+
+    async def flow():
+        c = RefreshingCache(fetch, refresh_interval=60.0, name="t")
+        assert await c.get() == 1
+        c.invalidate()
+        assert await c.get() == 2
+        await c.stop()
+
+    run(flow())

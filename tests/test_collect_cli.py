@@ -1,0 +1,123 @@
+"""collect CLI (reference: tools/src/bin/collect.rs) — argument handling and
+end-to-end against an in-process leader."""
+
+import base64
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from janus_tpu.binaries.collect import _build_query, _build_vdaf, collect
+
+
+def b64u(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def test_build_vdaf_variants():
+    assert _build_vdaf("count", None, None, None).__class__.__name__ == "Prio3"
+    v = _build_vdaf("histogram", 4, None, 2)
+    assert v.flp.valid.length == 4
+    v = _build_vdaf("sumvec", 6, 2, 3)
+    assert v.flp.valid.bits == 2
+    with pytest.raises(Exception):
+        _build_vdaf("histogram", None, None, None)
+    with pytest.raises(Exception):
+        _build_vdaf("sum", None, None, None)
+
+
+def test_build_query_exclusivity():
+    q = _build_query(1000, 3600, None, False)
+    assert q.query_type.__name__ == "TimeInterval"
+    q = _build_query(None, None, b64u(b"\x07" * 32), False)
+    assert q.query_type.__name__ == "FixedSize"
+    q = _build_query(None, None, None, True)
+    assert q.query_type.__name__ == "FixedSize"
+    with pytest.raises(Exception):
+        _build_query(1000, 3600, b64u(b"\x07" * 32), False)
+    with pytest.raises(Exception):
+        _build_query(None, None, None, False)
+    with pytest.raises(Exception):
+        _build_query(1000, None, None, False)
+
+
+def test_cli_requires_exactly_one_auth():
+    runner = CliRunner()
+    res = runner.invoke(
+        collect,
+        [
+            "--task-id", b64u(b"\x01" * 32),
+            "--leader", "http://localhost:9/dap/",
+            "--vdaf", "count",
+            "--batch-interval-start", "0",
+            "--batch-interval-duration", "3600",
+            "--hpke-config", b64u(b"\x00" * 10),
+            "--hpke-private-key", b64u(b"\x00" * 32),
+        ],
+        obj={},
+    )
+    assert res.exit_code != 0
+    assert "dap-auth-token" in res.output or "authorization" in res.output.lower()
+
+
+def test_cli_collect_e2e_against_live_pair():
+    """Full CLI run against a real leader+helper pair over HTTP sockets."""
+    import asyncio
+    import threading
+
+    from tests.test_integration_pair import (
+        COL_TOKEN,
+        InProcessPair,
+        NOW,
+        TIME_PRECISION,
+    )
+
+    pair = InProcessPair({"type": "Prio3Count"})
+    measurements = [1, 0, 1, 1]
+    state = {"stop": False}
+    ready = threading.Event()
+
+    async def serve():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            await pair.run_aggregation()
+            state["leader_url"] = pair.leader_url
+            ready.set()
+            # keep stepping collection jobs so the CLI's poll completes
+            while not state["stop"]:
+                await pair.run_collection()
+                await asyncio.sleep(0.1)
+        finally:
+            await pair.stop()
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=lambda: loop.run_until_complete(serve()), daemon=True)
+    t.start()
+    assert ready.wait(timeout=60), "pair never became ready"
+
+    try:
+        runner = CliRunner()
+        res = runner.invoke(
+            collect,
+            [
+                "--task-id", b64u(pair.task_id.data),
+                "--leader", state["leader_url"],
+                "--vdaf", "count",
+                "--authorization-bearer-token", "col-token-e2e",
+                "--batch-interval-start", str(NOW.seconds - NOW.seconds % TIME_PRECISION.seconds),
+                "--batch-interval-duration", str(2 * TIME_PRECISION.seconds),
+                "--hpke-config", b64u(pair.collector_keys.config.get_encoded()),
+                "--hpke-private-key", b64u(pair.collector_keys.private_key),
+            ],
+            obj={},
+        )
+        assert res.exit_code == 0, res.output
+        payload = json.loads(res.output.strip().splitlines()[-1])
+        assert payload["aggregate_result"] == sum(measurements)
+        assert payload["report_count"] == len(measurements)
+    finally:
+        state["stop"] = True
+        t.join(timeout=30)

@@ -116,3 +116,77 @@ def test_truncate_decode():
     sv = SumVec(length=3, bits=4, chunk_length=2)
     fsv = FlpGeneric(sv)
     assert fsv.decode(fsv.truncate(fsv.encode([15, 0, 9])), 1) == [15, 0, 9]
+
+
+def test_fixedpoint_l2_roundtrip():
+    """Shard -> prepare -> aggregate -> unshard for the fixed-point
+    bounded-L2 vector sum (reference: core/src/vdaf.rs:88-91)."""
+    import secrets
+
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+
+    v = vdaf_from_instance(
+        {"type": "Prio3FixedPointBoundedL2VecSum", "bitsize": 16, "length": 3}
+    )
+    vk = secrets.token_bytes(v.VERIFY_KEY_SIZE)
+    vectors = [[0.5, -0.25, 0.125], [-0.5, 0.5, 0.0], [0.25, 0.25, -0.25]]
+    agg = [None, None]
+    for vec in vectors:
+        nonce = secrets.token_bytes(v.NONCE_SIZE)
+        ps, shares = v.shard(vec, nonce, secrets.token_bytes(v.RAND_SIZE))
+        outs = []
+        for agg_id in range(2):
+            st, sh = v.prep_init(vk, agg_id, nonce, ps, shares[agg_id])
+            outs.append((st, sh))
+        v.prep_shares_to_prep([sh for _, sh in outs])
+        for i, (st, _) in enumerate(outs):
+            agg[i] = (
+                st.out_share
+                if agg[i] is None
+                else [v.field.add(a, b) for a, b in zip(agg[i], st.out_share)]
+            )
+    got = v.unshard(agg, len(vectors))
+    expect = [sum(col) for col in zip(*vectors)]
+    for g, e in zip(got, expect):
+        assert abs(g - e) < 1e-3, (g, e)
+
+
+def test_fixedpoint_l2_norm_bound_rejected():
+    """A forged encoding whose claimed norm understates the real one must
+    fail the norm-equality check at prepare time."""
+    import secrets
+
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+    from janus_tpu.vdaf.prio3 import VdafError
+
+    v = vdaf_from_instance(
+        {"type": "Prio3FixedPointBoundedL2VecSum", "bitsize": 16, "length": 2}
+    )
+    flp = v.flp
+    # encode() itself refuses an out-of-bounds norm
+    try:
+        flp.valid.encode([0.9, 0.9])
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised, "norm >= 1 must be rejected at encode time"
+
+    # forge: legal bits but a lying norm claim
+    meas = flp.valid.encode([0.5, 0.5])
+    n = flp.valid.bits_per_entry
+    d = flp.valid.entries
+    forged = list(meas)
+    for b in range(flp.valid.bits_for_norm):
+        forged[d * n + b] = 0  # claim norm == 0
+    import secrets as s2
+
+    import random as _r
+
+    _rng = _r.Random(5)
+    jr = [_rng.randrange(flp.field.MODULUS) for _ in range(flp.JOINT_RAND_LEN)]
+    gadgets = flp.valid.new_gadgets()
+    out = flp.valid.eval(forged, jr, 1, gadgets)
+    assert out != 0, "lying norm claim must not validate"
+    # and the honest encoding does validate
+    out = flp.valid.eval(list(meas), jr, 1, flp.valid.new_gadgets())
+    assert out == 0

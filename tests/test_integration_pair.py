@@ -108,6 +108,8 @@ class InProcessPair:
         self.leader_url = leader_url
 
     async def stop(self):
+        await self.leader_agg.shutdown()
+        await self.helper_agg.shutdown()
         await self.leader_client.close()
         await self.helper_client.close()
         self.leader_ds.cleanup()
