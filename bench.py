@@ -41,7 +41,10 @@ import sys
 import time
 
 
-def build_pipeline(vdaf, batch: int):
+def build_pipeline(vdaf, batch: int, multi_task: int = 0):
+    """``multi_task`` > 0 benches the BASELINE configs[4] launch shape: the
+    batch carries reports from that many tasks, so the verify key becomes a
+    per-ROW traced input (exactly what TpuBackend.prep_init_multi passes)."""
     import jax
     import jax.numpy as jnp
 
@@ -54,8 +57,10 @@ def build_pipeline(vdaf, batch: int):
     def helper_step(kw):
         """One helper aggregate-init step over a whole job: prep + decide
         against the leader's verifier share + masked aggregate."""
-        out = bp.prep_init(1, verify_key=verify_key, **{
-            k: v for k, v in kw.items() if k != "leader_verifiers"
+        vk = kw.get("verify_keys_u8", verify_key)
+        out = bp.prep_init(1, verify_key=vk, **{
+            k: v for k, v in kw.items()
+            if k not in ("leader_verifiers", "verify_keys_u8")
         })
         comb = bp.prep_shares_to_prep(
             [kw["leader_verifiers"], out["verifiers"]],
@@ -85,6 +90,12 @@ def build_pipeline(vdaf, batch: int):
             kw["public_parts_u8"] = rng.integers(
                 0, 256, (batch, vdaf.num_shares, 16), dtype=np.uint8
             )
+        if multi_task:
+            # per-row verify keys: `multi_task` distinct tasks interleaved
+            task_keys = rng.integers(
+                0, 256, (multi_task, vdaf.VERIFY_KEY_SIZE), dtype=np.uint8
+            )
+            kw["verify_keys_u8"] = task_keys[np.arange(batch) % multi_task]
         return {k: jax.device_put(v) for k, v in kw.items()}
 
     return fn, make_inputs
@@ -124,7 +135,7 @@ def main() -> int:
     parser.add_argument(
         "--config",
         default="histogram1024",
-        choices=["histogram1024", "count", "sum32", "sumvec", "sumvec100k"],
+        choices=["histogram1024", "count", "sum32", "sumvec", "sumvec100k", "multitask16"],
     )
     args = parser.parse_args()
 
@@ -159,6 +170,12 @@ def main() -> int:
             "Prio3SumVec len=100000 bits=1 chunk=316",
             lambda: prio3_sum_vec(length=100000, bits=1, chunk_length=316),
         ),
+        "multitask16": (
+            # BASELINE.md configs[4], single-chip form: one launch carrying
+            # 16 concurrent histogram tasks (per-row verify keys).
+            "16x Prio3Histogram len=1024 chunk=316, one launch",
+            lambda: prio3_histogram(length=1024, chunk_length=316),
+        ),
     }
     desc, ctor = configs[args.config]
     vdaf = ctor()
@@ -170,7 +187,9 @@ def main() -> int:
     fn = make_inputs = None
     while batch >= 64:
         try:
-            fn, make_inputs = build_pipeline(vdaf, batch)
+            fn, make_inputs = build_pipeline(
+                vdaf, batch, multi_task=16 if args.config == "multitask16" else 0
+            )
             inputs = make_inputs(0)
             t0 = time.monotonic()
             out = fn(inputs)

@@ -65,6 +65,10 @@ class DriverConfig:
     maximum_attempts_before_failure: int = 10
     vdaf_backend: str = "oracle"
     http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
+    #: Gather window for coalescing same-shape jobs from DIFFERENT tasks
+    #: into one device launch (BASELINE configs[4]); 0 disables.  Only
+    #: meaningful for device backends — the oracle ignores it.
+    multi_task_launch_window_s: float = 0.005
 
 
 class AggregationJobDriver:
@@ -78,7 +82,9 @@ class AggregationJobDriver:
         self._session_factory = session_factory
         self._session = None
         self.config = config or DriverConfig()
-        self._backends: Dict[bytes, object] = {}
+        self._backends: Dict[tuple, object] = {}
+        # key -> [(verify_key, prep_rows, future)] awaiting a coalesced launch
+        self._pending_prep: Dict[int, list] = {}
 
     def _get_session(self):
         """One shared connection-pooled session per driver (the analog of the
@@ -170,8 +176,39 @@ class AggregationJobDriver:
             await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _vdaf_shape_key(vdaf) -> tuple:
+        """Key backends by the FULL VDAF parameterization: tasks sharing it
+        share one backend instance — and therefore one set of compiled
+        device graphs (verify_key is a traced input, so one compilation
+        serves every task; reference contrast: per-task rayon dispatch at
+        aggregator.rs:180-209 config knobs).  Every scalar circuit
+        parameter participates — derived lengths alone are ambiguous
+        (SumVec(length=100, bits=2) and SumVec(length=200, bits=1) share
+        MEAS_LEN but not truncate/OUTPUT_LEN)."""
+        flp = getattr(vdaf, "flp", None)
+        valid = getattr(flp, "valid", None)
+        circuit_params = None
+        if valid is not None:
+            circuit_params = tuple(
+                sorted(
+                    (k, v if isinstance(v, (int, str, bool)) else getattr(v, "__name__", str(v)))
+                    for k, v in vars(valid).items()
+                    if not k.startswith("_") and not isinstance(v, (list, dict))
+                )
+            )
+        return (
+            type(vdaf).__name__,
+            type(valid).__name__ if valid is not None else None,
+            circuit_params,
+            getattr(vdaf, "algorithm_id", None),
+            getattr(vdaf, "num_shares", None),
+            getattr(vdaf, "num_proofs", None),
+            getattr(getattr(vdaf, "xof", None), "__name__", None),
+        )
+
     def _backend_for(self, task: AggregatorTask, vdaf):
-        key = task.task_id.data
+        key = self._vdaf_shape_key(vdaf)
         b = self._backends.get(key)
         if b is None and isinstance(vdaf, Prio3):
             try:
@@ -181,7 +218,55 @@ class AggregationJobDriver:
             self._backends[key] = b
         return b
 
-    def _leader_prep_init(self, task, vdaf, job, start_ras):
+    async def _coalesced_prep_init(self, backend, verify_key: bytes, prep_in):
+        """Join concurrent same-shape jobs (across tasks) into ONE launch.
+
+        The first arrival opens a short gather window; jobs landing inside
+        it ride the same ``prep_init_multi`` launch with per-row verify
+        keys (BASELINE configs[4]'s 16-task shape).  Window 0 or a backend
+        without prep_init_multi degrades to a per-job launch.
+        """
+        loop = asyncio.get_running_loop()
+        window = self.config.multi_task_launch_window_s
+        if window <= 0 or not hasattr(backend, "prep_init_multi"):
+            return await loop.run_in_executor(
+                None, lambda: backend.prep_init_batch(verify_key, 0, prep_in)
+            )
+        key = id(backend)
+        fut = loop.create_future()
+        bucket = self._pending_prep.setdefault(key, [])
+        bucket.append((verify_key, prep_in, fut))
+        if len(bucket) == 1:
+            loop.call_later(
+                window,
+                lambda: asyncio.ensure_future(self._flush_prep(backend, key)),
+            )
+        return await fut
+
+    async def _flush_prep(self, backend, key: int) -> None:
+        bucket = self._pending_prep.pop(key, [])
+        if not bucket:
+            return
+        reqs = [(vk, rows) for vk, rows, _ in bucket]
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(
+                None, lambda: backend.prep_init_multi(0, reqs)
+            )
+            if len(results) != len(bucket):
+                raise RuntimeError(
+                    f"prep_init_multi returned {len(results)} results for "
+                    f"{len(bucket)} requests"
+                )
+            for (_, _, fut), res in zip(bucket, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:  # surface the launch failure to every job
+            for _, _, fut in bucket:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    async def _leader_prep_init(self, task, vdaf, job, start_ras):
         """Batched leader prepare (device launch for Prio3;
         reference mirror: aggregation_job_driver.rs:397-428 on rayon)."""
         try:
@@ -191,53 +276,78 @@ class AggregationJobDriver:
                 ra.report_id.data: PrepareError.INVALID_MESSAGE for ra in start_ras
             }
         outcomes: Dict[bytes, object] = {}  # report_id -> (state, msg) | PrepareError
-        rows = []
-        for ra in start_ras:
-            try:
-                public_parts = vdaf.decode_public_share(ra.public_share or b"")
-                input_share = vdaf.decode_input_share(0, ra.leader_input_share)
-            except (VdafError, Exception):
-                outcomes[ra.report_id.data] = PrepareError.INVALID_MESSAGE
-                continue
-            rows.append((ra, public_parts, input_share))
+        loop = asyncio.get_running_loop()
+
+        def decode_rows():
+            """Per-report wire decoding is pure-Python field parsing —
+            thousands of elements per report — so it stays off the event
+            loop (the loop must keep serving lease heartbeats and the
+            coalescing gather timers)."""
+            good, bad = [], []
+            for ra in start_ras:
+                try:
+                    public_parts = vdaf.decode_public_share(ra.public_share or b"")
+                    input_share = vdaf.decode_input_share(0, ra.leader_input_share)
+                except (VdafError, Exception):
+                    bad.append(ra.report_id.data)
+                    continue
+                good.append((ra, public_parts, input_share))
+            return good, bad
+
+        rows, bad_ids = await loop.run_in_executor(None, decode_rows)
+        for rid in bad_ids:
+            outcomes[rid] = PrepareError.INVALID_MESSAGE
 
         backend = self._backend_for(task, vdaf)
         if backend is not None:
             prep_in = [
                 (ra.report_id.data, public, share) for ra, public, share in rows
             ]
-            prep_out = backend.prep_init_batch(task.vdaf_verify_key, 0, prep_in)
-            for (ra, _pub, _sh), outcome in zip(rows, prep_out):
-                if isinstance(outcome, VdafError):
-                    outcomes[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
-                    continue
-                state, share = outcome
-                msg = pp.PingPongMessage(
-                    pp.PingPongMessage.INITIALIZE,
-                    prep_share=vdaf.ping_pong_encode_prep_share(share),
-                )
-                outcomes[ra.report_id.data] = (pp.PingPongContinued(state, 0), msg)
-        else:
-            for ra, public, share in rows:
-                try:
-                    state, msg = pp.leader_initialized(
-                        vdaf,
-                        task.vdaf_verify_key,
-                        agg_param,
-                        ra.report_id.data,
-                        public,
-                        share,
+            prep_out = await self._coalesced_prep_init(
+                backend, task.vdaf_verify_key, prep_in
+            )
+
+            def wrap_outcomes():
+                out = {}
+                for (ra, _pub, _sh), outcome in zip(rows, prep_out):
+                    if isinstance(outcome, VdafError):
+                        out[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
+                        continue
+                    state, share = outcome
+                    msg = pp.PingPongMessage(
+                        pp.PingPongMessage.INITIALIZE,
+                        prep_share=vdaf.ping_pong_encode_prep_share(share),
                     )
-                    outcomes[ra.report_id.data] = (state, msg)
-                except (VdafError, pp.PingPongError):
-                    outcomes[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
+                    out[ra.report_id.data] = (pp.PingPongContinued(state, 0), msg)
+                return out
+
+            outcomes.update(await loop.run_in_executor(None, wrap_outcomes))
+        else:
+
+            def oracle_prep():
+                out = {}
+                for ra, public, share in rows:
+                    try:
+                        state, msg = pp.leader_initialized(
+                            vdaf,
+                            task.vdaf_verify_key,
+                            agg_param,
+                            ra.report_id.data,
+                            public,
+                            share,
+                        )
+                        out[ra.report_id.data] = (state, msg)
+                    except (VdafError, pp.PingPongError):
+                        out[ra.report_id.data] = PrepareError.VDAF_PREP_ERROR
+                return out
+
+            outcomes.update(
+                await asyncio.get_running_loop().run_in_executor(None, oracle_prep)
+            )
         return outcomes
 
     async def _step_init(self, lease, task, vdaf, job, all_ras, start_ras):
-        loop = asyncio.get_running_loop()
-        outcomes = await loop.run_in_executor(
-            None, lambda: self._leader_prep_init(task, vdaf, job, start_ras)
-        )
+        outcomes = await self._leader_prep_init(task, vdaf, job, start_ras)
         prepare_inits = []
         states: Dict[bytes, pp.PingPongContinued] = {}
         failed: Dict[bytes, PrepareError] = {}

@@ -175,18 +175,12 @@ class TpuBackend:
         agg_id: int,
         reports: Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]],
     ) -> List[PrepOutcome]:
+        """Single-task launch: the one-request form of prep_init_multi
+        (same compiled graph — the verify key is a per-row traced input
+        either way)."""
         if not reports:
             return []
-        B = len(reports)
-        kw = self._marshal(agg_id, reports, self._pad_to(B))
-        kw["verify_key_u8"] = np.frombuffer(verify_key, dtype=np.uint8)
-        from ..core.metrics import GLOBAL_METRICS
-
-        if GLOBAL_METRICS.registry is not None:
-            GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
-            GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
-        out = self._prep_fn(agg_id)(self._place(kw))
-        return self._unmarshal_prep(verify_key, agg_id, reports, out)
+        return self.prep_init_multi(agg_id, [(verify_key, reports)])[0]
 
     def _unmarshal_prep(self, verify_key, agg_id, reports, out) -> List[PrepOutcome]:
         flp, jf = self.vdaf.flp, self.bp.jf
@@ -276,6 +270,56 @@ class TpuBackend:
                 results.append(seeds[b].tobytes())
             else:
                 results.append(None)
+        return results
+
+    def prep_init_multi(
+        self,
+        agg_id: int,
+        requests: Sequence[
+            Tuple[bytes, Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]]]
+        ],
+    ) -> List[List[PrepOutcome]]:
+        """ONE device launch preparing reports from MULTIPLE tasks.
+
+        ``requests``: (verify_key, reports) per task, all sharing this
+        backend's VDAF shape.  The verify key is a traced per-ROW input, so
+        the same compiled graph serves any task mix (BASELINE configs[4]'s
+        16-concurrent-task shape on a single chip; the mesh backend shards
+        the concatenated batch across chips).  Results are returned
+        per-request, byte-identical to separate launches.
+        """
+        if not requests:
+            return []
+        flat: List = []
+        vk_rows: List[np.ndarray] = []
+        for verify_key, reports in requests:
+            flat.extend(reports)
+            vk = np.frombuffer(verify_key, dtype=np.uint8)
+            vk_rows.extend([vk] * len(reports))
+        if not flat:
+            return [[] for _ in requests]
+        B = len(flat)
+        pad_to = self._pad_to(B)
+        kw = self._marshal(agg_id, flat, pad_to)
+        vk_mat = np.stack(vk_rows)
+        kw["verify_key_u8"] = np.concatenate(
+            [vk_mat, np.repeat(vk_mat[-1:], pad_to - B, axis=0)]
+        )
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
+            GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
+        out = self._prep_fn(agg_id)(self._place(kw))
+        # One readback for the whole launch, then slice per request.
+        outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
+        start = 0
+        results: List[List[PrepOutcome]] = []
+        for verify_key, reports in requests:
+            n = len(reports)
+            view = {k: v[start : start + n] for k, v in outputs.items()}
+            results.append(self._unmarshal_prep(verify_key, agg_id, reports, view))
+            start += n
         return results
 
     def aggregate_batch(self, out_shares_limbs, mask) -> List[int]:

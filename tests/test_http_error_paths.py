@@ -1,0 +1,570 @@
+"""HTTP error-path matrix: one test per DapProblemType mapping plus
+malformed-body, auth-failure, role, idempotency, and taskprov edges.
+
+Mirrors the reference's handler-test coverage of failure modes
+(reference: aggregator/src/aggregator/http_handlers/tests/*.rs), driven as
+full DAP requests against the in-process aiohttp app so the problem-details
+wire format (RFC 7807 type/title/status/taskid) is what's asserted.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.http_handlers import aggregator_app
+from janus_tpu.client import prepare_report
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import (
+    AggregateShareReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    BatchSelector,
+    CollectionJobId,
+    CollectionReq,
+    Duration,
+    Interval,
+    PartialBatchSelector,
+    Query,
+    ReportIdChecksum,
+    TaskId,
+    Time,
+)
+
+from test_aggregator_handlers import (
+    AGG_TOKEN,
+    COL_TOKEN,
+    NOW,
+    TIME_PRECISION,
+    leader_prep_inits,
+    make_pair_tasks,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class Env:
+    """One role's app over a real in-process HTTP server."""
+
+    def __init__(self, task=None, clock=None):
+        self.eds = EphemeralDatastore(clock or MockClock(NOW))
+        if task is not None:
+            self.eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        self.agg = Aggregator(self.eds.datastore, self.eds.clock, Config(vdaf_backend="oracle"))
+        self.client = None
+
+    async def __aenter__(self):
+        self.client = TestClient(TestServer(aggregator_app(self.agg)))
+        await self.client.start_server()
+        return self.client
+
+    async def __aexit__(self, *exc):
+        await self.agg.shutdown()
+        await self.client.close()
+        self.eds.cleanup()
+
+
+async def expect_problem(resp, status, suffix):
+    assert resp.status == status, await resp.text()
+    doc = json.loads(await resp.text())
+    assert doc["type"].endswith(suffix), doc
+    assert "title" in doc
+    return doc
+
+
+AUTH = {"Authorization": "Bearer " + AGG_TOKEN.token}
+COL_AUTH = {"Authorization": "Bearer " + COL_TOKEN.token}
+
+
+def _report(leader, helper, m=1, time=NOW, config=None):
+    vdaf = leader.vdaf_instance()
+    return prepare_report(
+        vdaf,
+        leader.task_id,
+        config or leader.hpke_keys[0].config,
+        helper.hpke_keys[0].config,
+        TIME_PRECISION,
+        m,
+        time=time,
+    )
+
+
+# ---------------------------------------------------------------- hpke_config
+
+
+def test_hpke_config_missing_task_id_without_global_keys():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.get("/hpke_config")
+            # no global keys provisioned: no config to serve
+            assert resp.status in (400, 404)
+
+    run(flow())
+
+
+def test_hpke_config_unknown_task():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.get("/hpke_config", params={"task_id": str(TaskId.random())})
+            await expect_problem(resp, 404, "unrecognizedTask")
+
+    run(flow())
+
+
+def test_hpke_config_malformed_task_id():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.get("/hpke_config", params={"task_id": "!!notb64!!"})
+            assert resp.status == 400
+
+    run(flow())
+
+
+# -------------------------------------------------------------------- upload
+
+
+def test_upload_garbage_body():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.put(f"/tasks/{leader.task_id}/reports", data=b"\xffgarbage")
+            await expect_problem(resp, 400, "invalidMessage")
+
+    run(flow())
+
+
+def test_upload_unknown_task():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    report = _report(leader, helper)
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.put(
+                f"/tasks/{TaskId.random()}/reports", data=report.get_encoded()
+            )
+            await expect_problem(resp, 404, "unrecognizedTask")
+
+    run(flow())
+
+
+def test_upload_to_helper_role_rejected():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    report = _report(leader, helper)
+
+    async def flow():
+        async with Env(helper) as client:
+            resp = await client.put(
+                f"/tasks/{leader.task_id}/reports", data=report.get_encoded()
+            )
+            await expect_problem(resp, 404, "unrecognizedTask")
+
+    run(flow())
+
+
+def test_upload_outdated_hpke_config():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    stale = HpkeKeypair.generate((int(leader.hpke_keys[0].config.id) + 1) % 256)
+    report = _report(leader, helper, config=stale.config)
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.put(
+                f"/tasks/{leader.task_id}/reports", data=report.get_encoded()
+            )
+            await expect_problem(resp, 400, "outdatedConfig")
+
+    run(flow())
+
+
+def test_upload_report_too_early():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    future = Time(NOW.seconds + 3 * 3600)
+    report = _report(leader, helper, time=future)
+
+    async def flow():
+        async with Env(leader) as client:
+            resp = await client.put(
+                f"/tasks/{leader.task_id}/reports", data=report.get_encoded()
+            )
+            await expect_problem(resp, 400, "reportTooEarly")
+
+    run(flow())
+
+
+# --------------------------------------------------- helper aggregation init
+
+
+def _init_req(inits):
+    return AggregationJobInitializeReq(
+        aggregation_parameter=b"",
+        partial_batch_selector=PartialBatchSelector.new_time_interval(),
+        prepare_inits=inits,
+    )
+
+
+def test_agg_init_no_auth():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = helper.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.put(url, data=_init_req(inits).get_encoded())
+            await expect_problem(resp, 403, "unauthorizedRequest")
+
+    run(flow())
+
+
+def test_agg_init_wrong_token():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = helper.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.put(
+                url,
+                data=_init_req(inits).get_encoded(),
+                headers={"Authorization": "Bearer wrong-token"},
+            )
+            await expect_problem(resp, 403, "unauthorizedRequest")
+
+    run(flow())
+
+
+def test_agg_init_garbage_body():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.put(url, data=b"\x01bad", headers=AUTH)
+            await expect_problem(resp, 400, "invalidMessage")
+
+    run(flow())
+
+
+def test_agg_init_unknown_task():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = helper.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{TaskId.random()}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.put(url, data=_init_req(inits).get_encoded(), headers=AUTH)
+            await expect_problem(resp, 404, "unrecognizedTask")
+
+    run(flow())
+
+
+def test_agg_init_on_leader_role_rejected():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = leader.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+
+    async def flow():
+        async with Env(leader) as client:
+            url = f"/tasks/{leader.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.put(url, data=_init_req(inits).get_encoded(), headers=AUTH)
+            assert resp.status in (400, 404), await resp.text()
+
+    run(flow())
+
+
+def test_agg_init_idempotent_replay_and_mutation_conflict():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = helper.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1, 0])
+    req = _init_req(inits)
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            r1 = await client.put(url, data=req.get_encoded(), headers=AUTH)
+            assert r1.status == 200, await r1.text()
+            body1 = await r1.read()
+            # byte-identical replay: same response, no re-processing
+            r2 = await client.put(url, data=req.get_encoded(), headers=AUTH)
+            assert r2.status == 200
+            assert await r2.read() == body1
+            # same job id, mutated body: forbidden mutation
+            mutated = _init_req(list(reversed(inits)))
+            r3 = await client.put(url, data=mutated.get_encoded(), headers=AUTH)
+            assert r3.status == 409, await r3.text()
+
+    run(flow())
+
+
+def test_agg_continue_unknown_job():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            from janus_tpu.messages import AggregationJobContinueReq, AggregationJobStep
+
+            req = AggregationJobContinueReq(AggregationJobStep(1), [])
+            resp = await client.post(url, data=req.get_encoded(), headers=AUTH)
+            await expect_problem(resp, 404, "unrecognizedAggregationJob")
+
+    run(flow())
+
+
+def test_agg_continue_step_mismatch():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = helper.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+
+    async def flow():
+        async with Env(helper) as client:
+            job_id = AggregationJobId.random()
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{job_id}"
+            r1 = await client.put(url, data=_init_req(inits).get_encoded(), headers=AUTH)
+            assert r1.status == 200
+            from janus_tpu.messages import AggregationJobContinueReq, AggregationJobStep
+
+            # Prio3 finishes in one round; step 0 on continue is always
+            # invalid, and a bogus step number mismatches the job state.
+            req = AggregationJobContinueReq(AggregationJobStep(0), [])
+            resp = await client.post(url, data=req.get_encoded(), headers=AUTH)
+            await expect_problem(resp, 400, "invalidMessage")
+            req = AggregationJobContinueReq(AggregationJobStep(5), [])
+            resp = await client.post(url, data=req.get_encoded(), headers=AUTH)
+            await expect_problem(resp, 400, "stepMismatch")
+
+    run(flow())
+
+
+def test_agg_delete_requires_auth():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/aggregation_jobs/{AggregationJobId.random()}"
+            resp = await client.delete(url)
+            await expect_problem(resp, 403, "unauthorizedRequest")
+
+    run(flow())
+
+
+# ------------------------------------------------------- helper agg share
+
+
+def _share_req(task, count=1, checksum=None, interval_start=None):
+    start = interval_start if interval_start is not None else NOW.seconds - NOW.seconds % 3600
+    return AggregateShareReq(
+        BatchSelector.new_time_interval(Interval(Time(start), TIME_PRECISION)),
+        b"",
+        count,
+        checksum or ReportIdChecksum.zero(),
+    )
+
+
+def test_agg_share_no_auth():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            resp = await client.post(
+                f"/tasks/{helper.task_id}/aggregate_shares",
+                data=_share_req(helper).get_encoded(),
+            )
+            await expect_problem(resp, 403, "unauthorizedRequest")
+
+    run(flow())
+
+
+def test_agg_share_unknown_task():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            resp = await client.post(
+                f"/tasks/{TaskId.random()}/aggregate_shares",
+                data=_share_req(helper).get_encoded(),
+                headers=AUTH,
+            )
+            await expect_problem(resp, 404, "unrecognizedTask")
+
+    run(flow())
+
+
+def test_agg_share_batch_mismatch_on_counts():
+    """Helper has aggregated nothing; a leader claiming 5 reports must get
+    batchMismatch (checksum/count cross-check)."""
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            resp = await client.post(
+                f"/tasks/{helper.task_id}/aggregate_shares",
+                data=_share_req(helper, count=5).get_encoded(),
+                headers=AUTH,
+            )
+            await expect_problem(resp, 400, "batchMismatch")
+
+    run(flow())
+
+
+def test_agg_share_garbage_body():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            resp = await client.post(
+                f"/tasks/{helper.task_id}/aggregate_shares", data=b"zz", headers=AUTH
+            )
+            await expect_problem(resp, 400, "invalidMessage")
+
+    run(flow())
+
+
+# --------------------------------------------------------- leader collection
+
+
+def _collection_req(start=None, duration=None):
+    s = start if start is not None else NOW.seconds - NOW.seconds % 3600
+    d = duration or 2 * TIME_PRECISION.seconds
+    return CollectionReq(
+        Query.new_time_interval(Interval(Time(s), Duration(d))), b""
+    )
+
+
+def test_collection_put_no_auth():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            url = f"/tasks/{leader.task_id}/collection_jobs/{CollectionJobId.random()}"
+            resp = await client.put(url, data=_collection_req().get_encoded())
+            await expect_problem(resp, 403, "unauthorizedRequest")
+
+    run(flow())
+
+
+def test_collection_put_garbage_body():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            url = f"/tasks/{leader.task_id}/collection_jobs/{CollectionJobId.random()}"
+            resp = await client.put(url, data=b"\x00", headers=COL_AUTH)
+            await expect_problem(resp, 400, "invalidMessage")
+
+    run(flow())
+
+
+def test_collection_unaligned_interval_batch_invalid():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            url = f"/tasks/{leader.task_id}/collection_jobs/{CollectionJobId.random()}"
+            req = _collection_req(start=NOW.seconds - NOW.seconds % 3600 + 17)
+            resp = await client.put(url, data=req.get_encoded(), headers=COL_AUTH)
+            await expect_problem(resp, 400, "batchInvalid")
+
+    run(flow())
+
+
+def test_collection_on_helper_role_rejected():
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{helper.task_id}/collection_jobs/{CollectionJobId.random()}"
+            resp = await client.put(url, data=_collection_req().get_encoded(), headers=COL_AUTH)
+            assert resp.status in (400, 403, 404), await resp.text()
+
+    run(flow())
+
+
+def test_collection_poll_unknown_job():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            url = f"/tasks/{leader.task_id}/collection_jobs/{CollectionJobId.random()}"
+            resp = await client.post(url, headers=COL_AUTH)
+            assert resp.status == 404
+
+    run(flow())
+
+
+def test_collection_delete_then_poll_gone():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            job_id = CollectionJobId.random()
+            url = f"/tasks/{leader.task_id}/collection_jobs/{job_id}"
+            resp = await client.put(url, data=_collection_req().get_encoded(), headers=COL_AUTH)
+            assert resp.status == 201, await resp.text()
+            resp = await client.delete(url, headers=COL_AUTH)
+            assert resp.status == 204
+            # deleted job: poll reports deletion, not results
+            resp = await client.post(url, headers=COL_AUTH)
+            assert resp.status == 204
+
+    run(flow())
+
+
+def test_collection_batch_queried_too_many_times():
+    leader, _, _ = make_pair_tasks({"type": "Prio3Count"})
+
+    async def flow():
+        async with Env(leader) as client:
+            req = _collection_req()
+            u1 = f"/tasks/{leader.task_id}/collection_jobs/{CollectionJobId.random()}"
+            resp = await client.put(u1, data=req.get_encoded(), headers=COL_AUTH)
+            assert resp.status == 201, await resp.text()
+            # same interval under a NEW job id: the batch has already been
+            # queried max_batch_query_count (=1) times
+            u2 = f"/tasks/{leader.task_id}/collection_jobs/{CollectionJobId.random()}"
+            resp = await client.put(u2, data=req.get_encoded(), headers=COL_AUTH)
+            await expect_problem(resp, 400, "batchQueriedTooManyTimes")
+
+    run(flow())
+
+
+# -------------------------------------------------------------- taskprov edge
+
+
+def test_taskprov_advertisement_unknown_peer_rejected():
+    """An advertised task config with no configured peer must not be
+    provisioned (invalid/unrecognized task), even with a valid auth token."""
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    vdaf = helper.vdaf_instance()
+    inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1])
+
+    async def flow():
+        async with Env(helper) as client:
+            url = f"/tasks/{TaskId.random()}/aggregation_jobs/{AggregationJobId.random()}"
+            headers = dict(AUTH)
+            headers["dap-taskprov"] = "AAAA"  # base64url, not a valid TaskConfig
+            resp = await client.put(
+                url, data=_init_req(inits).get_encoded(), headers=headers
+            )
+            assert resp.status in (400, 404), await resp.text()
+
+    run(flow())

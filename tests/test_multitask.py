@@ -1,0 +1,123 @@
+"""Multi-task batched launches (BASELINE configs[4] single-launch shape).
+
+One device launch prepares reports from MANY tasks: the verify key is a
+per-row traced input, so one compiled graph serves any task mix, and the
+mesh backend shards the concatenated batch across devices.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from janus_tpu.utils.test_util import det_rng
+from janus_tpu.vdaf.backend import MeshBackend, OracleBackend, TpuBackend
+from janus_tpu.vdaf.instances import prio3_count, prio3_histogram
+
+
+def _requests(vdaf, n_tasks, reports_per_task, seed="mt"):
+    rng = det_rng(seed)
+    reqs = []
+    for t in range(n_tasks):
+        vk = rng(vdaf.VERIFY_KEY_SIZE)
+        reports = []
+        for i in range(reports_per_task):
+            nonce = rng(vdaf.NONCE_SIZE)
+            rand = rng(vdaf.RAND_SIZE)
+            ps, shares = vdaf.shard((t + i) % 2, nonce, rand)
+            reports.append((nonce, ps, shares[0]))
+        reqs.append((vk, reports))
+    return reqs
+
+
+def test_16_histogram_tasks_one_launch_matches_oracle():
+    """16 histogram (joint-rand, Field128) tasks with distinct verify keys
+    prepared in ONE mesh launch — byte parity with per-task oracle runs."""
+    import jax
+
+    vdaf = prio3_histogram(length=2, chunk_length=1)
+    reqs = _requests(vdaf, n_tasks=16, reports_per_task=2)
+    mesh = MeshBackend(vdaf, devices=jax.devices()[:8])
+    oracle = OracleBackend(vdaf)
+
+    results = mesh.prep_init_multi(0, reqs)
+    assert len(results) == 16
+    for (vk, reports), got in zip(reqs, results):
+        want = oracle.prep_init_batch(vk, 0, reports)
+        for (gs, gsh), (ws, wsh) in zip(got, want):
+            assert gs.out_share == ws.out_share
+            assert gsh.verifiers_share == wsh.verifiers_share
+            assert gsh.joint_rand_part == wsh.joint_rand_part
+            assert gs.corrected_joint_rand_seed == ws.corrected_joint_rand_seed
+
+
+def test_multi_launch_empty_and_uneven_requests():
+    import jax
+
+    vdaf = prio3_count()
+    backend = TpuBackend(vdaf)
+    reqs = _requests(vdaf, n_tasks=3, reports_per_task=1, seed="uneven")
+    reqs.insert(1, (b"\x00" * vdaf.VERIFY_KEY_SIZE, []))  # empty task slot
+    results = backend.prep_init_multi(0, reqs)
+    assert [len(r) for r in results] == [1, 0, 1, 1]
+    oracle = OracleBackend(vdaf)
+    for (vk, reports), got in zip(reqs, results):
+        want = oracle.prep_init_batch(vk, 0, reports)
+        for (gs, _), (ws, _) in zip(got, want):
+            assert gs.out_share == ws.out_share
+
+
+def test_driver_coalesces_concurrent_jobs_into_one_launch():
+    """Two same-shape jobs from different tasks stepped concurrently must
+    share one device launch through the driver's gather window."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+
+    vdaf = prio3_count()
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(vdaf_backend="tpu", multi_task_launch_window_s=0.02),
+    )
+
+    backend = TpuBackend(vdaf)
+    launches = []
+    real_multi = backend.prep_init_multi
+
+    def counting_multi(agg_id, reqs):
+        launches.append(len(reqs))
+        return real_multi(agg_id, reqs)
+
+    backend.prep_init_multi = counting_multi
+
+    reqs = _requests(vdaf, n_tasks=2, reports_per_task=2, seed="coal")
+
+    async def flow():
+        outs = await asyncio.gather(
+            *[
+                driver._coalesced_prep_init(backend, vk, rows)
+                for vk, rows in reqs
+            ]
+        )
+        return outs
+
+    outs = asyncio.new_event_loop().run_until_complete(flow())
+    assert launches == [2], "both jobs must ride one launch"
+    oracle = OracleBackend(vdaf)
+    for (vk, rows), got in zip(reqs, outs):
+        want = oracle.prep_init_batch(vk, 0, rows)
+        for (gs, _), (ws, _) in zip(got, want):
+            assert gs.out_share == ws.out_share
+
+
+def test_shape_keyed_backend_shared_across_tasks():
+    """Tasks with the same VDAF shape share one backend instance (and its
+    compiled graphs); different shapes do not."""
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+
+    k1 = AggregationJobDriver._vdaf_shape_key(prio3_count())
+    k2 = AggregationJobDriver._vdaf_shape_key(prio3_count())
+    k3 = AggregationJobDriver._vdaf_shape_key(prio3_histogram(length=2, chunk_length=1))
+    assert k1 == k2 and k1 != k3
