@@ -15,8 +15,8 @@ Two numbers are reported:
   every rayon worker busy across jobs.  On this environment a single
   synchronous dispatch pays a ~200 ms tunnel round-trip that the pipelined
   regime amortizes away.
-* ``sync_p50_ms`` / ``sync_reports_per_sec``: per-batch latency when each
-  launch is dispatched and awaited alone (the round-2 methodology).
+* ``sync_p50_ms``: per-batch latency when each launch is dispatched and
+  awaited alone (the round-2 methodology).
 
 Each timed round ends with an np.asarray readback of the decide mask — an
 output that depends on the whole pipeline — so neither number can be
@@ -53,15 +53,26 @@ def build_pipeline(vdaf, batch: int, multi_task: int = 0):
     bp = BatchedPrio3(vdaf)
     has_jr = vdaf.flp.JOINT_RAND_LEN > 0
     verify_key = b"\x2a" * vdaf.VERIFY_KEY_SIZE
+    use_planar = bp.planar_eligible(1, batch)
 
     def helper_step(kw):
         """One helper aggregate-init step over a whole job: prep + decide
         against the leader's verifier share + masked aggregate."""
         vk = kw.get("verify_keys_u8", verify_key)
-        out = bp.prep_init(1, verify_key=vk, **{
-            k: v for k, v in kw.items()
-            if k not in ("leader_verifiers", "verify_keys_u8")
-        })
+        if use_planar:
+            out = bp.prep_init_planar(
+                1,
+                vk,
+                kw["nonces_u8"],
+                share_seeds_u8=kw["share_seeds_u8"],
+                blinds_u8=kw["blinds_u8"],
+                public_parts_u8=kw["public_parts_u8"],
+            )
+        else:
+            out = bp.prep_init(1, verify_key=vk, **{
+                k: v for k, v in kw.items()
+                if k not in ("leader_verifiers", "verify_keys_u8")
+            })
         comb = bp.prep_shares_to_prep(
             [kw["leader_verifiers"], out["verifiers"]],
             [out["joint_rand_part"], out["joint_rand_part"]] if has_jr else None,
@@ -127,15 +138,104 @@ def measure(fn, staged, iters: int, pipeline_depth: int):
     return sync, rounds
 
 
+CONFIGS = {
+    # BASELINE.md rows; histogram1024 is the north-star config.
+    "count": ("Prio3Count", "prio3_count", {}),
+    "sum32": ("Prio3Sum bits=32", "prio3_sum", {"bits": 32}),
+    "histogram1024": (
+        "Prio3Histogram len=1024 chunk=316",
+        "prio3_histogram",
+        {"length": 1024, "chunk_length": 316},
+    ),
+    "sumvec": (
+        "Prio3SumVec len=1024 bits=1 chunk=316",
+        "prio3_sum_vec",
+        {"length": 1024, "bits": 1, "chunk_length": 316},
+    ),
+    "sumvec100k": (
+        # BASELINE.md configs[3]: the wide-vector FLP
+        # (reference circuit params: core/src/vdaf.rs:220-236).
+        "Prio3SumVec len=100000 bits=1 chunk=316",
+        "prio3_sum_vec",
+        {"length": 100000, "bits": 1, "chunk_length": 316},
+    ),
+    "multitask16": (
+        # BASELINE.md configs[4], single-chip form: one launch carrying
+        # 16 concurrent histogram tasks (per-row verify keys).
+        "16x Prio3Histogram len=1024 chunk=316, one launch",
+        "prio3_histogram",
+        {"length": 1024, "chunk_length": 316},
+    ),
+}
+
+# All five BASELINE.md rows, benched on every default run so BENCH_r{N}.json
+# stays comparable round over round (VERDICT r3 weak #9).
+DEFAULT_SET = ["count", "sum32", "histogram1024", "sumvec100k", "multitask16"]
+
+
+def run_config(name: str, args) -> dict:
+    """Measure one config; returns the result dict (or an error record)."""
+    import jax
+
+    from janus_tpu.vdaf import instances
+
+    desc, ctor_name, ctor_kw = CONFIGS[name]
+    vdaf = getattr(instances, ctor_name)(**ctor_kw)
+
+    batch = args.batch
+    depth = args.pipeline_depth
+    if name == "sumvec100k":
+        # 100k Field128 elements/report: bound the batch and the number of
+        # in-flight launches (each holds a multi-GB XLA workspace).
+        batch = min(batch, 512)
+        depth = min(depth, 4)
+    fn = make_inputs = None
+    while batch >= 64:
+        try:
+            fn, make_inputs = build_pipeline(
+                vdaf, batch, multi_task=16 if name == "multitask16" else 0
+            )
+            inputs = make_inputs(0)
+            t0 = time.monotonic()
+            out = fn(inputs)
+            jax.block_until_ready(out)
+            compile_s = time.monotonic() - t0
+            break
+        except Exception as e:  # OOM etc: halve the batch and retry
+            sys.stderr.write(f"{name}: batch {batch} failed ({type(e).__name__}: {e}); halving\n")
+            batch //= 2
+            fn = None
+    if fn is None:
+        return {"config": desc, "error": "no batch size succeeded"}
+
+    staged = [make_inputs(i + 1) for i in range(min(args.iters, 4))]
+    sync, rounds = measure(fn, staged, args.iters, depth)
+
+    sync_p50 = statistics.median(sync)
+    pipelined = min(rounds)  # least-contended round: this chip is shared
+    reports_per_sec = batch / pipelined
+    return {
+        "config": desc,
+        "value": round(reports_per_sec, 1),
+        "unit": "reports/s",
+        "batch": batch,
+        "pipelined_ms_per_batch": round(pipelined * 1e3, 3),
+        "pipeline_depth": depth,
+        "sync_p50_ms": round(sync_p50 * 1e3, 3),
+        "compile_s": round(compile_s, 1),
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch", type=int, default=4096)
+    parser.add_argument("--batch", type=int, default=16384)
     parser.add_argument("--iters", type=int, default=8)
-    parser.add_argument("--pipeline-depth", type=int, default=8)
+    parser.add_argument("--pipeline-depth", type=int, default=48)
     parser.add_argument(
         "--config",
-        default="histogram1024",
-        choices=["histogram1024", "count", "sum32", "sumvec", "sumvec100k", "multitask16"],
+        default="all",
+        choices=["all"] + list(CONFIGS),
+        help="one config, or 'all' for every BASELINE.md row (default)",
     )
     args = parser.parse_args()
 
@@ -145,71 +245,19 @@ def main() -> int:
 
     enable_compile_cache()
 
-    from janus_tpu.vdaf.instances import (
-        prio3_count,
-        prio3_histogram,
-        prio3_sum,
-        prio3_sum_vec,
-    )
-
-    configs = {
-        # BASELINE.md rows; histogram1024 is the north-star config.
-        "count": ("Prio3Count", prio3_count),
-        "sum32": ("Prio3Sum bits=32", lambda: prio3_sum(32)),
-        "histogram1024": (
-            "Prio3Histogram len=1024 chunk=316",
-            lambda: prio3_histogram(1024, 316),
-        ),
-        "sumvec": (
-            "Prio3SumVec len=1024 bits=1 chunk=316",
-            lambda: prio3_sum_vec(length=1024, bits=1, chunk_length=316),
-        ),
-        "sumvec100k": (
-            # BASELINE.md configs[3]: the wide-vector FLP
-            # (reference circuit params: core/src/vdaf.rs:220-236).
-            "Prio3SumVec len=100000 bits=1 chunk=316",
-            lambda: prio3_sum_vec(length=100000, bits=1, chunk_length=316),
-        ),
-        "multitask16": (
-            # BASELINE.md configs[4], single-chip form: one launch carrying
-            # 16 concurrent histogram tasks (per-row verify keys).
-            "16x Prio3Histogram len=1024 chunk=316, one launch",
-            lambda: prio3_histogram(length=1024, chunk_length=316),
-        ),
-    }
-    desc, ctor = configs[args.config]
-    vdaf = ctor()
-
     platform = jax.devices()[0].platform
-    batch = args.batch
-    if args.config == "sumvec100k" and batch > 512:
-        batch = 512  # 100k Field128 elements/report: cap the default batch
-    fn = make_inputs = None
-    while batch >= 64:
+    names = DEFAULT_SET if args.config == "all" else [args.config]
+    results = {}
+    for name in names:
         try:
-            fn, make_inputs = build_pipeline(
-                vdaf, batch, multi_task=16 if args.config == "multitask16" else 0
-            )
-            inputs = make_inputs(0)
-            t0 = time.monotonic()
-            out = fn(inputs)
-            jax.block_until_ready(out)
-            compile_s = time.monotonic() - t0
-            break
-        except Exception as e:  # OOM etc: halve the batch and retry
-            sys.stderr.write(f"batch {batch} failed ({type(e).__name__}: {e}); halving\n")
-            batch //= 2
-            fn = None
-    if fn is None:
-        sys.stderr.write("no batch size succeeded\n")
-        return 1
+            results[name] = run_config(name, args)
+        except Exception as e:  # never lose completed configs to one failure
+            sys.stderr.write(f"{name} failed: {type(e).__name__}: {e}\n")
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
 
-    staged = [make_inputs(i + 1) for i in range(min(args.iters, 4))]
-    sync, rounds = measure(fn, staged, args.iters, args.pipeline_depth)
-
-    sync_p50 = statistics.median(sync)
-    pipelined = min(rounds)  # least-contended round: this chip is shared
-    reports_per_sec = batch / pipelined
+    headline = "histogram1024" if "histogram1024" in results else names[0]
+    head = results[headline]
+    reports_per_sec = head.get("value", 0.0)
 
     # Device calibration: effective HBM bandwidth via a pure elementwise
     # pass (read + write = 2 x 64 MB moved, negligible compute).  The
@@ -238,24 +286,26 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": f"prepare_throughput_{args.config}",
+                "metric": f"prepare_throughput_{headline}",
                 "value": round(reports_per_sec, 1),
                 "unit": "reports/s",
                 "vs_baseline": round(reports_per_sec / 1_000_000, 4),
-                "config": desc,
-                "batch": batch,
-                "pipelined_ms_per_batch": round(pipelined * 1e3, 3),
-                "pipeline_depth": args.pipeline_depth,
-                "sync_p50_ms": round(sync_p50 * 1e3, 3),
-                "sync_reports_per_sec": round(batch / sync_p50, 1),
-                "compile_s": round(compile_s, 1),
+                "config": head.get("config"),
+                "batch": head.get("batch"),
+                "pipelined_ms_per_batch": head.get("pipelined_ms_per_batch"),
+                "pipeline_depth": head.get("pipeline_depth"),
+                "sync_p50_ms": head.get("sync_p50_ms"),
+                "compile_s": head.get("compile_s"),
                 "platform": platform,
                 "device_eff_gbps": round(device_gbps, 2) if device_gbps else None,
                 "iters": args.iters,
+                "configs": results,
             }
         )
     )
-    return 0
+    # Nonzero exit when the headline config produced no measurement, so a
+    # harness gating on the exit code cannot publish an all-error run.
+    return 0 if "value" in head else 1
 
 
 if __name__ == "__main__":

@@ -211,21 +211,22 @@ class JField:
         take = (extra_bit | (1 - borrow)).astype(jnp.bool_)
         return [jnp.where(take, d[i], limbs[i]) for i in range(self.n)]
 
-    @_eager_jit(static_argnums=(0,))
-    def add(self, a, b):
-        """Canonical modular addition."""
-        aa, bb = self._split(a), self._split(b)
+    def add_limbs(self, aa: List, bb: List) -> List:
+        """Canonical modular addition on limb lists (shared XLA/Pallas core)."""
         s = []
         carry = _u32(0)
         for i in range(self.n):
             si, carry = _adc(aa[i], bb[i], carry)
             s.append(si)
-        return self._join(self._cond_sub_p(s, carry))
+        return self._cond_sub_p(s, carry)
 
     @_eager_jit(static_argnums=(0,))
-    def sub(self, a, b):
-        """Canonical modular subtraction."""
-        aa, bb = self._split(a), self._split(b)
+    def add(self, a, b):
+        """Canonical modular addition."""
+        return self._join(self.add_limbs(self._split(a), self._split(b)))
+
+    def sub_limbs(self, aa: List, bb: List) -> List:
+        """Canonical modular subtraction on limb lists (shared XLA/Pallas core)."""
         d = []
         borrow = _u32(0)
         for i in range(self.n):
@@ -239,19 +240,52 @@ class JField:
             si, carry = _adc(d[i], p[i], carry)
             s.append(si)
         use_add = borrow.astype(jnp.bool_)
-        return self._join([jnp.where(use_add, s[i], d[i]) for i in range(self.n)])
+        return [jnp.where(use_add, s[i], d[i]) for i in range(self.n)]
+
+    @_eager_jit(static_argnums=(0,))
+    def sub(self, a, b):
+        """Canonical modular subtraction."""
+        return self._join(self.sub_limbs(self._split(a), self._split(b)))
 
     def neg(self, a):
         return self.sub(self.zeros(a.shape[:-1]), a)
 
-    @_eager_jit(static_argnums=(0,))
-    def mont_mul(self, a, b):
-        """CIOS Montgomery multiplication: returns a*b*R^-1 mod p, canonical."""
+    def _mont_m(self, t0):
+        """m = t0 * n_prime mod 2^32; free negation when n_prime == -1.
+
+        Every field whose modulus is 1 mod 2^32 (Field64 = 2^64-2^32+1,
+        Field128 = 2^128-7*2^66+1) has n_prime = 0xFFFFFFFF.
+        """
+        if int(self.n_prime) == 0xFFFFFFFF:
+            return jnp.zeros_like(t0) - t0
+        return t0 * _u32(int(self.n_prime))
+
+    def _mac_p(self, j: int, m, acc, carry):
+        """(hi, lo) of m * p[j] + acc + carry, specialized on the host-known
+        limb value of the modulus.  The VDAF fields' moduli have limbs drawn
+        from {0, 1, 0xFFFFFFFF, <one odd limb>}, which turns most of the
+        CIOS reduction multiplies into adds/negations (~1.4x fewer VPU ops
+        per mont_mul; exact same integer result)."""
+        pj = int(self.p_np[j])
+        zero = jnp.zeros_like(m)
+        if pj == 0:
+            lo, c = _adc(acc, carry, zero)
+            return c, lo
+        if pj == 1:
+            lo, c1 = _adc(m, acc, zero)
+            lo, c2 = _adc(lo, carry, zero)
+            return c1 + c2, lo
+        if pj == 0xFFFFFFFF:
+            # m*(2^32-1) + acc + carry = m*2^32 + (acc + carry - m)
+            s1, c1 = _adc(acc, carry, zero)
+            d, borrow = _sbb(s1, m, zero)
+            return m + c1 - borrow, d
+        return _mac(m, _u32(pj), acc, carry)
+
+    def mont_mul_limbs(self, aa: List, bb: List) -> List:
+        """CIOS core on limb lists: a*b*R^-1 mod p (shared XLA/Pallas)."""
         n = self.n
-        aa, bb = self._split(a), self._split(b)
-        p = [ _u32(int(x)) for x in self.p_np ]
-        npr = _u32(int(self.n_prime))
-        zero = jnp.zeros_like(aa[0])
+        zero = jnp.zeros_like(aa[0] | bb[0])
         t = [zero] * (n + 2)
         for i in range(n):
             carry = zero
@@ -262,18 +296,23 @@ class JField:
             s, c = _adc(t[n], carry, zero)
             t[n] = s
             t[n + 1] = t[n + 1] + c
-            m = t[0] * npr  # wrapping u32 multiply
-            hi, _lo = _mac(m, p[0], t[0], zero)
+            m = self._mont_m(t[0])
+            hi, _lo = self._mac_p(0, m, t[0], zero)
             carry = hi
             for j in range(1, n):
-                hi, lo = _mac(m, p[j], t[j], carry)
+                hi, lo = self._mac_p(j, m, t[j], carry)
                 t[j - 1] = lo
                 carry = hi
             s, c = _adc(t[n], carry, zero)
             t[n - 1] = s
             t[n] = t[n + 1] + c
             t[n + 1] = zero
-        return self._join(self._cond_sub_p(t[:n], t[n]))
+        return self._cond_sub_p(t[:n], t[n])
+
+    @_eager_jit(static_argnums=(0,))
+    def mont_mul(self, a, b):
+        """CIOS Montgomery multiplication: returns a*b*R^-1 mod p, canonical."""
+        return self._join(self.mont_mul_limbs(self._split(a), self._split(b)))
 
     @_eager_jit(static_argnums=(0,))
     def to_mont(self, a):
@@ -330,9 +369,20 @@ class JField:
 
     @_eager_jit(static_argnums=(0, 2))
     def sum(self, a, axis: int):
-        """Exact modular reduction (tree) along an element axis."""
+        """Exact modular reduction along an element axis.
+
+        Long axes use a lazy 16-bit-half accumulation: limbs are split into
+        u16 halves, summed with plain (exact, < 2^32) integer reduces, and
+        reduced mod p ONCE at the end — replacing length-1 full modular adds
+        (carry chain + conditional subtract each) with plain adds.  Exact
+        integer math, so the result is limb-identical to the add tree, which
+        short axes still use (the lazy path's fixed cost: a digit
+        carry-propagation plus one tiny mont_mul).
+        """
         axis = axis % (a.ndim - 1)  # never the limb axis
         length = a.shape[axis]
+        if 16 <= length <= 65535:
+            return self._sum_lazy(a, axis)
         while length > 1:
             half = length // 2
             lo = lax.slice_in_dim(a, 0, half, axis=axis)
@@ -341,6 +391,74 @@ class JField:
             a = jnp.concatenate([self.add(lo, hi), rest], axis=axis)
             length = half + (length - 2 * half)
         return jnp.squeeze(a, axis=axis)
+
+    def _sum_lazy(self, a, axis: int):
+        """Lazy-reduction sum: u16-half accumulate, one mod-p fold at the end.
+
+        Requires a.shape[axis] <= 65535 so each half-column sum stays below
+        2^16 * 65535 < 2^32 (exact in u32).
+        """
+        slo = jnp.sum(a & _MASK16, axis=axis)  # (..., n) each < 2^32
+        shi = jnp.sum(a >> 16, axis=axis)
+        return self.lazy_fold(slo, shi)
+
+    def lazy_fold(self, slo, shi):
+        """(..., n) u16-half column sums -> canonical limbs (..., n).
+
+        Base-2^16 digit stream D[2i] = slo_i, D[2i+1] = shi_i is carry-
+        normalized; the overflow beyond 2^(32n) (carry < 2^17) folds back
+        via one tiny mont_mul with R^2 (= 2^(32n)*R mod p).  Exact integer
+        math — shared by the row-major and limb-planar lazy sums.
+        """
+        n = self.n
+        carry = jnp.zeros_like(slo[..., 0])
+        digits = []
+        for i in range(n):
+            t = slo[..., i] + carry
+            digits.append(t & _MASK16)
+            carry = t >> 16
+            t = shi[..., i] + carry
+            digits.append(t & _MASK16)
+            carry = t >> 16
+        limbs = self._join(
+            [digits[2 * j] | (digits[2 * j + 1] << 16) for j in range(n)]
+        )
+        r2 = jnp.asarray(self.r2_np)
+        hi_limbs = self._join([carry] + [jnp.zeros_like(carry)] * (n - 1))
+        corr = self.mont_mul(hi_limbs, jnp.broadcast_to(r2, hi_limbs.shape))
+        # limbs < 2^(32n) < 2p but may exceed p: add(x, 0) canonicalizes.
+        limbs = self.add(limbs, jnp.zeros_like(limbs))
+        return self.add(limbs, corr)
+
+    @_eager_jit(static_argnums=(0, 2))
+    def mutual_products_mont(self, a, axis: int):
+        """For each k along the axis: prod_{j != k} a_j (Montgomery domain).
+
+        Exclusive prefix x exclusive suffix products — the inversion-free
+        core of barycentric Lagrange on roots of unity, where
+        (t^P - 1)/(t - w^k) = prod_{j != k} (t - w^j) exactly.
+        """
+        axis = axis % (a.ndim - 1)
+        L = a.shape[axis]
+        prefix = self.cumprod_mont(a, axis)
+        ones = jnp.broadcast_to(
+            self.mont_one(), lax.slice_in_dim(a, 0, 1, axis=axis).shape
+        )
+        prefix_excl = jnp.concatenate(
+            [ones, lax.slice_in_dim(prefix, 0, L - 1, axis=axis)], axis=axis
+        )
+        rev = jnp.flip(a, axis=axis)
+        suffix_incl_rev = self.cumprod_mont(rev, axis)
+        suffix_excl = jnp.concatenate(
+            [
+                jnp.flip(
+                    lax.slice_in_dim(suffix_incl_rev, 0, L - 1, axis=axis), axis=axis
+                ),
+                ones,
+            ],
+            axis=axis,
+        )
+        return self.mont_mul(prefix_excl, suffix_excl)
 
     @_eager_jit(static_argnums=(0, 2))
     def cumprod_mont(self, a, axis: int):
@@ -395,30 +513,19 @@ class JField:
 
     @_eager_jit(static_argnums=(0, 2))
     def batch_inv_mont(self, a, axis: int):
-        """Montgomery-trick batched inversion along an axis (all nonzero)."""
+        """Montgomery-trick batched inversion along an axis (all nonzero).
+
+        inv(a_k) = inv(prod_j a_j) * prod_{j != k} a_j — one Fermat
+        inversion plus the exclusive mutual products.
+        """
         axis = axis % (a.ndim - 1)
-        prefix = self.cumprod_mont(a, axis)  # inclusive
-        total = lax.slice_in_dim(prefix, a.shape[axis] - 1, a.shape[axis], axis=axis)
-        inv_total = self.inv_mont(jnp.squeeze(total, axis=axis))
-        # inv(a_k) = prefix_{k-1} * inv_suffix_k where we walk backwards.
-        # Simpler: inv_k = inv_total * prod_{j != k} a_j = inv_total *
-        # prefix_{k-1} * suffix_{k+1}.
-        ones = jnp.broadcast_to(
-            self.mont_one(), lax.slice_in_dim(a, 0, 1, axis=axis).shape
-        )
-        prefix_excl = jnp.concatenate(
-            [ones, lax.slice_in_dim(prefix, 0, a.shape[axis] - 1, axis=axis)], axis=axis
-        )
-        rev = jnp.flip(a, axis=axis)
-        suffix_incl_rev = self.cumprod_mont(rev, axis)
-        # suffix_excl[k] = prod_{j>k} a_j = suffix_incl_rev[L-2-k]; last is empty.
-        suffix_excl = jnp.concatenate(
-            [
-                jnp.flip(lax.slice_in_dim(suffix_incl_rev, 0, a.shape[axis] - 1, axis=axis), axis=axis),
-                ones,
-            ],
+        total = jnp.squeeze(
+            lax.slice_in_dim(
+                self.cumprod_mont(a, axis), a.shape[axis] - 1, a.shape[axis], axis=axis
+            ),
             axis=axis,
         )
-        others = self.mont_mul(prefix_excl, suffix_excl)
+        inv_total = self.inv_mont(total)
+        others = self.mutual_products_mont(a, axis)
         inv_b = jnp.expand_dims(inv_total, axis=axis)
         return _scan_fence(self.mont_mul(others, jnp.broadcast_to(inv_b, a.shape)))

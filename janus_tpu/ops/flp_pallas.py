@@ -1,0 +1,132 @@
+"""Pallas TPU kernel for the chunked-circuit wire evaluations (limb-planar).
+
+The FLP query's dominant cost is the wire-polynomial evaluation over the
+measurement: for every chunk column u,
+
+    evens[u] = (sum_k m[k,u] * kl[k]) * r_ch[u]
+    odds[u]  =  sum_k m[k,u] * lagk[k]  -  ccorr
+    wire     =  seeds * lag0  +  zip(evens, odds)
+
+(~3.5 * MEAS_LEN Montgomery multiplies per report for histogram1024).  XLA
+emits this as dozens of partially-fused elementwise kernels at ~2x the raw
+op cost (profiled); this kernel hand-schedules the whole contraction with
+every tensor in the limb-planar layout — tensors are (R, n_limbs, elems,
+128) with the 128 lanes indexing reports (report b lives at (b // 128,
+..., b % 128)) — so each VPU op is full-width and the measurement block is
+read from HBM exactly once.
+
+The chunk axis is zero-padded to a multiple of 16 so block shapes satisfy
+the TPU (8, 128) tiling rule; pad columns compute garbage wires that the
+caller slices off (no cross-column dataflow exists).
+
+Field arithmetic is field_jax.JField's limb-list CIOS core (mont_mul_limbs),
+so device results are byte-identical to the row-major path and the oracle
+by construction (tests/test_prepare.py).
+
+Reference hot loop analog: aggregator/src/aggregator/aggregation_job_driver.rs:397-449.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .field_jax import JField
+
+
+def _pallas_interpret() -> bool:
+    from .keccak_pallas import _pallas_mode
+
+    return _pallas_mode() == "interpret"
+
+
+def pad_chunk(chunk: int) -> int:
+    """Chunk axis padded so both it and its half are sublane (8) multiples."""
+    return -(-chunk // 16) * 16
+
+
+def _uchunks(chunk_pad: int) -> int:
+    """Grid subdivision of the chunk axis keeping blocks comfortably in VMEM."""
+    return 2 if chunk_pad > 160 else 1
+
+
+def _wire_kernel(jf: JField, calls: int, m_ref, sw_ref, rch_ref, kl_ref,
+                 lagk_ref, lag0_ref, ccorr_ref, out_ref):
+    n = jf.n
+    UC = m_ref.shape[3]
+    shape = (UC, 128)
+
+    def scal(ref, *idx):
+        return jnp.broadcast_to(ref[idx].reshape(1, 128), shape)
+
+    s1: List = None
+    s2: List = None
+    for k in range(calls):
+        mk = [m_ref[0, l, k, :, :] for l in range(n)]
+        t1 = jf.mont_mul_limbs(mk, [scal(kl_ref, 0, l, k) for l in range(n)])
+        s1 = t1 if s1 is None else jf.add_limbs(s1, t1)
+        t2 = jf.mont_mul_limbs(mk, [scal(lagk_ref, 0, l, k) for l in range(n)])
+        s2 = t2 if s2 is None else jf.add_limbs(s2, t2)
+    rch = [rch_ref[0, l, :, :] for l in range(n)]
+    evens = jf.mont_mul_limbs(s1, rch)
+    odds = jf.sub_limbs(s2, [scal(ccorr_ref, 0, l) for l in range(n)])
+    sshape = (2 * UC, 128)
+    sw = [sw_ref[0, l, :, :] for l in range(n)]
+    lag0 = [
+        jnp.broadcast_to(lag0_ref[0, l].reshape(1, 128), sshape) for l in range(n)
+    ]
+    se = jf.mont_mul_limbs(sw, lag0)
+    eo = [jnp.stack([evens[l], odds[l]], axis=1).reshape(sshape) for l in range(n)]
+    wire = jf.add_limbs(se, eo)
+    for l in range(n):
+        out_ref[0, l, :, :] = wire[l]
+
+
+def wire_evals_planar(
+    jf: JField,
+    m_pl: jnp.ndarray,      # (R, n, calls, chunk_pad, 128) canonical
+    sw_pl: jnp.ndarray,     # (R, n, 2*chunk_pad, 128) canonical
+    rch_pl: jnp.ndarray,    # (R, n, chunk_pad, 128) Montgomery r^(u+1)
+    kl_pl: jnp.ndarray,     # (R, n, calls, 128) Montgomery
+    lagk_pl: jnp.ndarray,   # (R, n, calls, 128) Montgomery
+    lag0_pl: jnp.ndarray,   # (R, n, 128) Montgomery
+    ccorr_pl: jnp.ndarray,  # (R, n, 128) canonical
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Histogram-family wire evals -> (R, n, 2*chunk_pad, 128) canonical."""
+    R, n, calls, chunk_pad, _ = m_pl.shape
+    NJ = _uchunks(chunk_pad)
+    UC = chunk_pad // NJ
+    grid = (R, NJ)
+    kern = partial(_wire_kernel, jf, calls)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n, calls, UC, 128), lambda r, j: (r, 0, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 2 * UC, 128), lambda r, j: (r, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, UC, 128), lambda r, j: (r, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, calls, 128), lambda r, j: (r, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, calls, 128), lambda r, j: (r, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 128), lambda r, j: (r, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 128), lambda r, j: (r, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, n, 2 * UC, 128), lambda r, j: (r, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, n, 2 * chunk_pad, 128), jnp.uint32),
+        interpret=interpret,
+    )(m_pl, sw_pl, rch_pl, kl_pl, lagk_pl, lag0_pl, ccorr_pl)

@@ -232,6 +232,59 @@ def _pad_words(msg_u8: jnp.ndarray, domain: int) -> jnp.ndarray:
     return bytes_to_words(padded)
 
 
+def xof_planes_pallas(
+    seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, out_words: int
+) -> jnp.ndarray:
+    """Batched XofTurboShake128 -> PLANE-ordered stream words (W, B//128, 128).
+
+    Same computation as xof_words_pallas for a single-block message, but the
+    result stays in the kernels' native planar layout (plane w = stream word
+    w of every report) — the limb-planar FLP pipeline consumes this directly,
+    skipping the 100+ MB lane transpose that (B, W) row-major output costs.
+    """
+    interpret = _pallas_mode() == "interpret"
+    prefix = np.frombuffer(bytes([len(dst)]) + dst, dtype=np.uint8)
+    B = seed.shape[0]
+    parts = [jnp.broadcast_to(jnp.asarray(prefix), (B, len(prefix))), seed]
+    if binder.shape[-1]:
+        parts.append(binder)
+    msg = jnp.concatenate(parts, axis=-1)
+    words = _pad_words(msg, 0x01)
+    if words.shape[1] != RATE_WORDS:
+        raise NotImplementedError("xof_planes_pallas requires a single-block message")
+    nb = -(-out_words // RATE_WORDS)
+    planes = _squeeze_call(_to_planar(words), nb, interpret)  # (nb, 42, R, 128)
+    R = planes.shape[2]
+    return planes.reshape(nb * RATE_WORDS, R, 128)[:out_words]
+
+
+def absorb_planes_pallas(msg_planes: jnp.ndarray, out_words: int) -> jnp.ndarray:
+    """Absorb a pre-built planar padded message -> (out_words, R, 128).
+
+    msg_planes: (na*42, R, 128) plane-ordered padded message words (the
+    caller applies TurboSHAKE padding).  Used by the joint-rand-part XOF,
+    whose 16 KB-per-report binder is assembled by funnel-shifting the
+    measurement-share planes instead of a byte-level concat + transpose.
+    """
+    interpret = _pallas_mode() == "interpret"
+    if out_words > RATE_WORDS:
+        raise NotImplementedError("multi-block squeeze after absorb")
+    na = msg_planes.shape[0] // RATE_WORDS
+    planes = _absorb_call(msg_planes, na, interpret)  # (42, R, 128)
+    return planes[:out_words]
+
+
+def planes_to_rows(planes: jnp.ndarray) -> jnp.ndarray:
+    """(W, R, 128) planar words -> (B, W) row-major words (small W only)."""
+    W, R, _ = planes.shape
+    return planes.transpose(1, 2, 0).reshape(R * 128, W)
+
+
+def rows_to_planes(words: jnp.ndarray) -> jnp.ndarray:
+    """(B, W) row-major words -> (W, B//128, 128) planes (small W only)."""
+    return _to_planar(words)
+
+
 def xof_words_pallas(
     seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, out_words: int
 ) -> jnp.ndarray:

@@ -252,41 +252,66 @@ class _DHistogram(_DChunked):
         table — the k-contraction happens before the chunk-wide multiply,
         reading meas once and writing only (B, chunk, n).  Every
         rearrangement is an exact mod-p identity, so the canonical output
-        limbs are byte-identical to the unfused form."""
+        limbs are byte-identical to the unfused form.  The coefficient
+        tensors come from planar_coeffs — the SAME code that feeds the
+        limb-planar Pallas kernel, so the two paths cannot drift.
+        """
         B = meas_m.shape[0]
         m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
+        kl, lagk, lag0, ccorr, r_ch = self.planar_coeffs(jf, jr_m, lag, consts)
+        s1 = jf.sum(jf.mont_mul(m, kl[:, :, None, :]), axis=1)  # (B, chunk, n)
+        evens = jf.mont_mul(s1, r_ch)
+        s2 = jf.sum(jf.mont_mul(m, lagk[:, :, None, :]), axis=1)
+        odds = jf.sub(s2, ccorr[:, None, :])
+        se = jf.mont_mul(seeds, lag0[:, None, :])  # (B, arity, n)
+        return self._zip_wires(jf, evens, odds, se)
+
+    def v(self, jf, gk, meas_m, jr_m, consts):
+        meas_sum = jf.sum(meas_m, axis=1)  # (B, n)
+        return self.v_from_meas_sum(jf, gk, meas_sum, jr_m, consts)
+
+    def v_from_meas_sum(self, jf, gk, meas_sum, jr_m, consts):
+        """v given a precomputed meas sum (planar path computes it lazily)."""
+        range_check = jf.sum(gk, axis=1)
+        sum_check = jf.sub(
+            meas_sum, jnp.broadcast_to(consts["shares_inv_c"], meas_sum.shape)
+        )
+        jr1 = jr_m[:, 1]
+        return jf.add(
+            jf.mont_mul(jr1, range_check),
+            jf.mont_mul(jf.mont_mul(jr1, jr1), sum_check),
+        )
+
+    def planar_coeffs(self, jf, jr_m, lag, consts):
+        """Per-report coefficient tensors for the planar wire kernel.
+
+        Exactly the scalars wire_evals folds into its fused contraction:
+        (kl (B,calls,n), lagk (B,calls,n), lag0 (B,n), ccorr (B,n),
+        r_ch (B,chunk,n)) — same formulas, so kernel output limbs are
+        byte-identical to the row-major path.
+        """
+        B = jr_m.shape[0]
         lag0, lagk = lag[:, 0], lag[:, 1:]
-        r = jr_m[:, 0]  # (B, n) Montgomery
+        r = jr_m[:, 0]
         r_ch = jf.cumprod_mont(
             jnp.broadcast_to(r[:, None, :], (B, self.chunk, jf.n)), axis=1
-        )  # r^(u+1) * R
-        rc = r_ch[:, -1]  # r^chunk * R
+        )
+        rc = r_ch[:, -1]
         ones = jf.mont_one()[None, None, :]
         if self.calls > 1:
             tail = jf.cumprod_mont(
                 jnp.broadcast_to(rc[:, None, :], (B, self.calls - 1, jf.n)), axis=1
             )
-            r_call = jnp.concatenate([jnp.broadcast_to(ones, (B, 1, jf.n)), tail], axis=1)
+            r_call = jnp.concatenate(
+                [jnp.broadcast_to(ones, (B, 1, jf.n)), tail], axis=1
+            )
         else:
             r_call = jnp.broadcast_to(ones, (B, 1, jf.n))
-        kl = jf.mont_mul(r_call, lagk)  # (B, calls, n) Montgomery
-        s1 = jf.sum(jf.mont_mul(m, kl[:, :, None, :]), axis=1)  # (B, chunk, n)
-        evens = jf.mont_mul(s1, r_ch)
-        odds, se = self._odds_and_seed(jf, m, lagk, lag0, seeds, consts)
-        return self._zip_wires(jf, evens, odds, se)
-
-    def v(self, jf, gk, meas_m, jr_m, consts):
-        range_check = jf.sum(gk, axis=1)
-        meas_sum = jf.sum(meas_m, axis=1)  # (B, n)
-        sum_check = jf.sub(
-            meas_sum, jnp.broadcast_to(consts["shares_inv_c"], meas_sum.shape)
-        )
-        jr1 = jr_m[:, 1]
-        out = jf.add(
-            jf.mont_mul(jr1, range_check),
-            jf.mont_mul(jf.mont_mul(jr1, jr1), sum_check),
-        )
-        return out
+        kl = jf.mont_mul(r_call, lagk)
+        lag_sum = jf.sum(lagk, axis=1)
+        c = jnp.broadcast_to(consts["shares_inv_c"], lag_sum.shape)
+        ccorr = jf.mont_mul(c, lag_sum)
+        return kl, lagk, lag0, ccorr, r_ch
 
     def truncate(self, jf, meas_m, consts):
         return meas_m
@@ -343,6 +368,11 @@ class BatchedPrio3:
         )
         self.roots_m = jnp.asarray(
             np.stack([mont_np(pow(w, k, p)) for k in range(circ.calls + 1)])
+        )
+        # ALL P root differences feed the inversion-free barycentric weights
+        # (prod over j != k of (t - w^k) spans every P-th root, used or not).
+        self.roots_all_m = jnp.asarray(
+            np.stack([mont_np(pow(w, k, p)) for k in range(circ.P)])
         )
         if hasattr(self.flp.valid, "bits"):
             bits = self.flp.valid.bits
@@ -419,6 +449,53 @@ class BatchedPrio3:
         )
         return meas, proofs, ok1 & ok2
 
+    def _lagrange_coeffs(self, t_m):
+        """Barycentric Lagrange coefficients at t over the P-th roots.
+
+        Inversion-free form: z/(t - w^k) = prod_{j != k} (t - w^j) exactly
+        (t^P - 1 factors over ALL P roots), so the coefficients need only
+        exclusive prefix/suffix products — this removes a Fermat inversion
+        whose 2x(32n)-step sequential scan dominated the query's serial
+        sections.  Rows with t on a root have z == 0 and are flagged via
+        t_ok for host recompute, as before.
+        Returns (lag (B, calls+1, n) Montgomery, t_ok (B,)).
+        """
+        jf, circ = self.jf, self.circ
+        t_pow = t_m
+        for _ in range(self._log2_P):
+            t_pow = jf.mont_mul(t_pow, t_pow)
+        z = jf.sub(t_pow, jnp.broadcast_to(jf.mont_one(), t_pow.shape))  # t^P - 1
+        t_ok = ~jf.is_zero(z)
+        K = circ.calls + 1
+        denom_all = jf.sub(t_m[:, None, :], self.roots_all_m[None])  # (B, P, n)
+        others = jf.mutual_products_mont(denom_all, axis=1)
+        lag = jf.mont_mul(others[:, :K], self.bary_c_m[None])  # (B, K, n)
+        return lag, t_ok
+
+    def _gadget_outputs(self, gpoly, B):
+        """gk (B, calls, n): the gadget polynomial at alpha^1..alpha^calls."""
+        jf, circ = self.jf, self.circ
+        if self._ntt is not None:
+            P = circ.P
+            hi = gpoly[:, P:]
+            hi = jnp.concatenate(
+                [hi, jnp.zeros((B, P - hi.shape[1], jf.n), dtype=_U32)], axis=1
+            )
+            folded = jf.add(gpoly[:, :P], hi)
+            evals = jf.ntt_eval_mont(folded, *self._ntt)
+            return evals[:, 1 : circ.calls + 1]
+
+        def horner_step(acc, c):
+            return (
+                jf.add(jf.mont_mul(acc, self.alpha_pows_m[None]), c[:, None, :]),
+                None,
+            )
+
+        coeffs_rev = jnp.moveaxis(jnp.flip(gpoly, axis=1), 1, 0)
+        acc0 = jnp.zeros((B, circ.calls, jf.n), dtype=_U32)
+        gk, _ = lax.scan(horner_step, acc0, coeffs_rev)
+        return _scan_fence(gk)
+
     # -- FLP query (one proof) ------------------------------------------
     def _query_one(self, meas_m, proof_m, jr_m, t_m):
         """Device FLP query for one proof.
@@ -435,45 +512,11 @@ class BatchedPrio3:
         seeds = proof_m[:, : circ.arity]  # (B, arity, n)
         gpoly = proof_m[:, circ.arity :]  # (B, glen, n)
 
-        if self._ntt is not None:
-            # Fold gpoly mod (x^P - 1) — alpha^P == 1 at the evaluation
-            # points — then evaluate at all P roots in one NTT.
-            P = circ.P
-            hi = gpoly[:, P:]
-            hi = jnp.concatenate(
-                [hi, jnp.zeros((B, P - hi.shape[1], jf.n), dtype=_U32)], axis=1
-            )
-            folded = jf.add(gpoly[:, :P], hi)
-            evals = jf.ntt_eval_mont(folded, *self._ntt)  # (B, P, n)
-            gk = evals[:, 1 : circ.calls + 1]
-        else:
-            # Gadget outputs at alpha^k via Horner over the gadget polynomial.
-            def horner_step(acc, c):
-                return (
-                    jf.add(jf.mont_mul(acc, self.alpha_pows_m[None]), c[:, None, :]),
-                    None,
-                )
-
-            coeffs_rev = jnp.moveaxis(jnp.flip(gpoly, axis=1), 1, 0)  # (glen, B, n)
-            acc0 = jnp.zeros((B, circ.calls, jf.n), dtype=_U32)
-            gk, _ = lax.scan(horner_step, acc0, coeffs_rev)  # (B, calls, n)
-            gk = _scan_fence(gk)
-
+        gk = self._gadget_outputs(gpoly, B)  # (B, calls, n)
         v = circ.v(jf, gk, meas_m, jr_m, self.consts)  # (B, n)
 
         # Wire evaluations at t via barycentric Lagrange on the P-th roots.
-        t_pow = t_m
-        for _ in range(self._log2_P):
-            t_pow = jf.mont_mul(t_pow, t_pow)
-        z = jf.sub(t_pow, jnp.broadcast_to(jf.mont_one(), t_pow.shape))  # t^P - 1
-        t_ok = ~jf.is_zero(z)
-        K = circ.calls + 1
-        denom = jf.sub(t_m[:, None, :], self.roots_m[None])  # (B, K, n)
-        inv_denom = jf.batch_inv_mont(denom, axis=1)
-        lag = jf.mont_mul(
-            jf.mont_mul(jnp.broadcast_to(z[:, None, :], denom.shape), self.bary_c_m[None]),
-            inv_denom,
-        )  # (B, K, n)
+        lag, t_ok = self._lagrange_coeffs(t_m)
         wire_evals = circ.wire_evals(jf, meas_m, jr_m, lag, seeds, self.consts)
 
         gp_t = jf.horner_mont(gpoly, t_m)  # (B, n)
@@ -583,6 +626,248 @@ class BatchedPrio3:
         out["ok"] = ok
         return out
 
+    # -- planar (limb-plane) helper prep --------------------------------
+    def planar_eligible(self, agg_id: int, batch: int) -> bool:
+        """True when the limb-planar Pallas fast path serves this prep."""
+        from .keccak_pallas import pallas_enabled
+
+        return (
+            agg_id != 0
+            and isinstance(self.circ, _DHistogram)
+            and self.prio3.num_proofs == 1
+            and self.flp.JOINT_RAND_LEN > 0
+            # u16-half lazy sums (meas_sum, planar aggregate) are exact only
+            # while term counts stay <= 65535 (see JField._sum_lazy).
+            and self.flp.MEAS_LEN <= 65535
+            and batch <= 65535
+            and pallas_enabled(batch)
+        )
+
+    def _planar_ok(self, stream, num_elems):
+        """Canonicality of stream-ordered element words -> ok (B,) row-major."""
+        jf = self.jf
+        el = stream[: num_elems * jf.n].reshape(num_elems, jf.n, *stream.shape[1:])
+        borrow = jnp.zeros(el.shape[0:1] + el.shape[2:], dtype=_U32)
+        from .field_jax import _sbb
+
+        for i in range(jf.n):
+            _, borrow = _sbb(el[:, i], jnp.asarray(np.uint32(jf.p_np[i])), borrow)
+        valid = jnp.all(borrow == 1, axis=0)  # (R, 128)
+        return valid.reshape(-1)
+
+    def _rows_to_planes_small(self, rows3):
+        """(B, L, n) row-major limbs -> (R, n, L, 128) planes (narrow L)."""
+        B, L, n = rows3.shape
+        return rows3.reshape(B // 128, 128, L, n).transpose(0, 3, 2, 1)
+
+    def _jr_part_planes(self, agg_id, blinds_u8, nonces_u8, meas_stream):
+        """Joint-rand-part XOF with the 16 KB meas binder built in-plane.
+
+        The message is  len(dst) || dst || blind || agg_id || nonce ||
+        meas_bytes || padding.  meas_bytes already exist as the XOF squeeze
+        planes; a 16/8/24-bit funnel shift aligns them into message words,
+        replacing a byte-level concat plus a full-batch lane transpose.
+        Byte-identical to the row-major absorb (tests/test_prepare.py).
+        """
+        from .keccak_pallas import (
+            RATE,
+            RATE_WORDS,
+            absorb_planes_pallas,
+            rows_to_planes,
+        )
+        from .keccak_jax import bytes_to_words
+
+        jf = self.jf
+        B = nonces_u8.shape[0]
+        R = B // 128
+        dst = self._dst(USAGE_JOINT_RAND_PART)
+        W_m = meas_stream.shape[0]
+        hb_len = 1 + len(dst) + blinds_u8.shape[-1] + 1 + nonces_u8.shape[-1]
+        q, rm = divmod(hb_len, 4)
+        msg_len = hb_len + 4 * W_m
+        nblocks = msg_len // RATE + 1
+        msg_words = nblocks * RATE_WORDS
+
+        # Head: constant prefix + per-report blind/agg_id/nonce, padded to a
+        # word boundary, as (ceil(hb_len/4), R, 128) planes.
+        prefix = np.frombuffer(bytes([len(dst)]) + dst, dtype=np.uint8)
+        agg_b = jnp.broadcast_to(jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1))
+        head_pad = (-hb_len) % 4
+        head_parts = [
+            jnp.broadcast_to(jnp.asarray(prefix), (B, len(prefix))),
+            blinds_u8,
+            agg_b,
+            nonces_u8,
+        ]
+        if head_pad:
+            head_parts.append(jnp.zeros((B, head_pad), dtype=jnp.uint8))
+        head_words = bytes_to_words(jnp.concatenate(head_parts, axis=-1))
+        head_planes = rows_to_planes(head_words)  # (q or q+1, R, 128)
+
+        # Tail: TurboSHAKE padding bytes (constant), as extension words so
+        # the funnel below can treat meas+pad as one stream.  The funnel
+        # consumes msg_words - q extension words total; the meas stream
+        # provides W_m, so (rm + pad_len)/4 constant words complete it
+        # (exact: 4*msg_words = 4*q + rm + 4*W_m + pad_len).
+        pad_len = nblocks * RATE - msg_len
+        pad_words_needed = (rm + pad_len) // 4
+        pad = np.zeros(pad_words_needed * 4, dtype=np.uint8)
+        pad[0] = 0x01
+        pad[pad_len - 1] ^= 0x80
+        pad_words_np = pad.view("<u4").astype(np.uint32)
+        ext_const = jnp.broadcast_to(
+            jnp.asarray(pad_words_np)[:, None, None], (pad_words_needed, R, 128)
+        )
+        ext = jnp.concatenate([meas_stream, ext_const], axis=0)
+
+        if rm == 0:
+            body = ext[: msg_words - q]
+            msg = jnp.concatenate([head_planes[:q], body], axis=0)
+        else:
+            sh = 8 * rm
+            boundary = head_planes[q] | (ext[0] << sh)
+            nbody = msg_words - q - 1
+            body = (ext[:nbody] >> (32 - sh)) | (ext[1 : nbody + 1] << sh)
+            msg = jnp.concatenate([head_planes[:q], boundary[None], body], axis=0)
+
+        seed_words = self.prio3.xof.SEED_SIZE // 4
+        return absorb_planes_pallas(msg, seed_words)  # (seed_words, R, 128)
+
+    def prep_init_planar(
+        self,
+        agg_id: int,
+        verify_key,
+        nonces_u8: jnp.ndarray,
+        *,
+        share_seeds_u8: jnp.ndarray,
+        blinds_u8: jnp.ndarray,
+        public_parts_u8: jnp.ndarray,
+    ) -> Dict[str, jnp.ndarray]:
+        """Helper prep in the limb-planar layout (histogram family).
+
+        Same outputs as prep_init except ``out_share`` stays limb-planar
+        (n, OUTPUT_LEN, R, 128) — ``aggregate`` consumes either layout.  The
+        XOF squeeze planes feed the Pallas wire kernel directly; nothing
+        batch-wide is lane-transposed except the (small) verifier tensor.
+        """
+        from .keccak_jax import words_to_bytes
+        from .keccak_pallas import xof_planes_pallas
+        from .flp_pallas import pad_chunk, wire_evals_planar, _pallas_interpret
+
+        prio3, flp, jf, circ = self.prio3, self.flp, self.jf, self.circ
+        B = nonces_u8.shape[0]
+        R = B // 128
+        n = jf.n
+        binder = jnp.broadcast_to(
+            jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1)
+        )
+
+        meas_st = xof_planes_pallas(
+            share_seeds_u8, self._dst(USAGE_MEAS_SHARE), binder, flp.MEAS_LEN * n
+        )  # (MEAS_LEN*n, R, 128)
+        proofs_st = xof_planes_pallas(
+            share_seeds_u8, self._dst(USAGE_PROOF_SHARE), binder, flp.PROOF_LEN * n
+        )
+        ok = self._planar_ok(meas_st, flp.MEAS_LEN) & self._planar_ok(
+            proofs_st, flp.PROOF_LEN
+        )
+
+        # Limb-planar views: lanes stay report-indexed throughout; the
+        # chunk axis is zero-padded to the kernel's tiling multiple and the
+        # garbage wires of pad columns are sliced off after the kernel.
+        cp = pad_chunk(circ.chunk)
+        m_el = meas_st.reshape(flp.MEAS_LEN, n, R, 128)
+        m_lp = m_el.transpose(2, 1, 0, 3)  # (R, n, MEAS_LEN, 128)
+        if circ.pad_len:
+            m_pad = jnp.concatenate(
+                [m_lp, jnp.zeros((R, n, circ.pad_len, 128), dtype=_U32)], axis=2
+            )
+        else:
+            m_pad = m_lp
+        m_pl = m_pad.reshape(R, n, circ.calls, circ.chunk, 128)
+        if cp != circ.chunk:
+            m_pl = jnp.pad(m_pl, ((0, 0), (0, 0), (0, 0), (0, cp - circ.chunk), (0, 0)))
+        p_el = proofs_st.reshape(flp.PROOF_LEN, n, R, 128)
+        sw_pl = p_el[: circ.arity].transpose(2, 1, 0, 3)  # (R, n, arity, 128)
+        if cp != circ.chunk:
+            sw_pl = jnp.pad(
+                sw_pl, ((0, 0), (0, 0), (0, 2 * cp - circ.arity), (0, 0))
+            )
+        gpoly = (
+            p_el[circ.arity :].transpose(2, 3, 0, 1).reshape(B, circ.glen, n)
+        )  # small row-major
+
+        # Joint randomness: part from the in-plane absorb, the rest row-major.
+        part_planes = self._jr_part_planes(agg_id, blinds_u8, nonces_u8, meas_st)
+        from .keccak_pallas import planes_to_rows
+
+        part = words_to_bytes(planes_to_rows(part_planes))  # (B, SEED)
+        S = prio3.num_shares
+        pieces = []
+        if agg_id > 0:
+            pieces.append(public_parts_u8[:, :agg_id].reshape(B, -1))
+        pieces.append(part)
+        if agg_id < S - 1:
+            pieces.append(public_parts_u8[:, agg_id + 1 :].reshape(B, -1))
+        seed_binder = jnp.concatenate(pieces, axis=-1)
+        zero_seed = jnp.zeros((B, prio3.xof.SEED_SIZE), dtype=jnp.uint8)
+        corrected = self._xof_seed(zero_seed, self._dst(USAGE_JOINT_RAND_SEED), seed_binder)
+        jr_vec, ok_j = self._expand_vec(
+            corrected,
+            self._dst(USAGE_JOINT_RANDOMNESS),
+            jnp.zeros((B, 0), dtype=jnp.uint8),
+            flp.JOINT_RAND_LEN,
+        )
+        if isinstance(verify_key, (bytes, bytearray)):
+            verify_key = jnp.asarray(np.frombuffer(bytes(verify_key), dtype=np.uint8))
+        vk = jnp.broadcast_to(verify_key, (B, verify_key.shape[-1]))
+        qr, ok_q = self._expand_vec(
+            vk, self._dst(USAGE_QUERY_RANDOMNESS), nonces_u8, flp.QUERY_RAND_LEN
+        )
+        ok = ok & ok_j & ok_q
+
+        jr_m = jf.to_mont(jr_vec)
+        t_m = jf.to_mont(qr[:, 0])
+        lag, t_ok = self._lagrange_coeffs(t_m)
+        ok = ok & t_ok
+        kl, lagk, lag0, ccorr, r_ch = circ.planar_coeffs(jf, jr_m, lag, self.consts)
+        if cp != circ.chunk:
+            r_ch = jnp.pad(r_ch, ((0, 0), (0, cp - circ.chunk), (0, 0)))
+
+        wire_pl = wire_evals_planar(
+            jf,
+            m_pl,
+            sw_pl,
+            self._rows_to_planes_small(r_ch),
+            self._rows_to_planes_small(kl),
+            self._rows_to_planes_small(lagk),
+            self._rows_to_planes_small(lag0[:, None, :])[:, :, 0],
+            self._rows_to_planes_small(ccorr[:, None, :])[:, :, 0],
+            interpret=_pallas_interpret(),
+        )  # (R, n, 2*cp, 128)
+        wire = (
+            wire_pl.transpose(0, 3, 2, 1).reshape(B, 2 * cp, n)[:, : circ.arity]
+        )
+
+        # v from the lazily-summed measurement (exact; see JField._sum_lazy).
+        gk = self._gadget_outputs(gpoly, B)
+        slo = jnp.sum(m_lp & np.uint32(0xFFFF), axis=2)  # (R, n, 128)
+        shi = jnp.sum(m_lp >> 16, axis=2)
+        meas_sum = jf.lazy_fold(
+            slo.transpose(0, 2, 1).reshape(B, n), shi.transpose(0, 2, 1).reshape(B, n)
+        )
+        v = circ.v_from_meas_sum(jf, gk, meas_sum, jr_m, self.consts)
+        gp_t = jf.horner_mont(gpoly, t_m)
+        verifier = jnp.concatenate([v[:, None], wire, gp_t[:, None]], axis=1)
+
+        return {
+            "verifiers": verifier,
+            "out_share": m_lp,  # planar; aggregate() accepts this layout
+            "ok": ok,
+            "joint_rand_part": part,
+            "corrected_seed": corrected,
+        }
+
     # -- prep shares -> prep message ------------------------------------
     def prep_shares_to_prep(
         self,
@@ -623,9 +908,20 @@ class BatchedPrio3:
     def aggregate(self, out_shares: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
         """Masked modular sum of out shares over the batch axis.
 
-        out_shares (B, OUTPUT_LEN, n) canonical, mask (B,) bool ->
-        (OUTPUT_LEN, n).  TPU analog of sharded batch-aggregation accumulation
-        (reference: aggregator/src/aggregator/aggregation_job_writer.rs:591-698).
+        out_shares (B, OUTPUT_LEN, n) canonical — or limb-planar
+        (n, OUTPUT_LEN, R, 128) from prep_init_planar — with mask (B,) bool
+        -> (OUTPUT_LEN, n).  TPU analog of sharded batch-aggregation
+        accumulation (reference:
+        aggregator/src/aggregator/aggregation_job_writer.rs:591-698).
         """
+        if out_shares.ndim == 4:  # planar (R, n, L, 128): lazy u16 lane reduce
+            R, n, L, _ = out_shares.shape
+            maskp = mask.reshape(R, 128)
+            masked = jnp.where(
+                maskp[:, None, None], out_shares, jnp.zeros_like(out_shares)
+            )
+            slo = jnp.sum(masked & np.uint32(0xFFFF), axis=(0, 3))  # (n, L)
+            shi = jnp.sum(masked >> 16, axis=(0, 3))
+            return self.jf.lazy_fold(slo.T, shi.T)
         masked = jnp.where(mask[:, None, None], out_shares, jnp.zeros_like(out_shares))
         return self.jf.sum(masked, axis=0)

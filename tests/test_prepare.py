@@ -318,3 +318,50 @@ def test_fused_wire_evals_match_unfused():
             _DeviceCircuit.wire_evals(circ, jf, meas, jr_m, lag, seeds, bp.consts)
         )
         assert (fused == unfused).all(), type(circ).__name__
+
+
+@pytest.mark.slow
+def test_planar_prep_matches_row_path(monkeypatch):
+    """The limb-planar Pallas path (prep_init_planar) is byte-identical to
+    the row-major path — which the suite anchors to the oracle above — for
+    every output: verifiers, joint-rand part/seed, ok, out shares, and the
+    planar masked aggregation.  Runs the kernels in interpret mode at the
+    minimum planar batch (B = 1024; ~13 min on CPU, hence the slow tier —
+    the real chip revalidates this path on every bench/driver run)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("JANUS_TPU_PALLAS", "interpret")
+    vdaf = prio3_histogram(length=4, chunk_length=2)
+    bp = BatchedPrio3(vdaf)
+    B = 1024
+    rng = np.random.default_rng(7)
+    kw = dict(
+        nonces_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        share_seeds_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        blinds_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        public_parts_u8=jnp.asarray(rng.integers(0, 256, (B, 2, 16), dtype=np.uint8)),
+    )
+    vk = b"\x2a" * 16
+    assert bp.planar_eligible(1, B)
+    row = jax.jit(lambda kw: bp.prep_init(1, verify_key=vk, **kw))(kw)
+    pl = jax.jit(
+        lambda kw: bp.prep_init_planar(
+            1,
+            vk,
+            kw["nonces_u8"],
+            share_seeds_u8=kw["share_seeds_u8"],
+            blinds_u8=kw["blinds_u8"],
+            public_parts_u8=kw["public_parts_u8"],
+        )
+    )(kw)
+    for k in ("verifiers", "ok", "joint_rand_part", "corrected_seed"):
+        assert np.array_equal(np.asarray(row[k]), np.asarray(pl[k])), k
+    osp = np.asarray(pl["out_share"])  # planar (R, n, L, 128)
+    R, n, L, _ = osp.shape
+    assert np.array_equal(
+        np.asarray(row["out_share"]), osp.transpose(0, 3, 2, 1).reshape(B, L, n)
+    )
+    mask = jnp.asarray(rng.integers(0, 2, (B,), dtype=np.uint8).astype(bool))
+    agg_row = np.asarray(jax.jit(bp.aggregate)(row["out_share"], mask))
+    agg_pl = np.asarray(jax.jit(bp.aggregate)(pl["out_share"], mask))
+    assert np.array_equal(agg_row, agg_pl)
