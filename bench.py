@@ -186,9 +186,11 @@ def run_config(name: str, args) -> dict:
     depth = args.pipeline_depth
     if name == "sumvec100k":
         # 100k Field128 elements/report: bound the batch and the number of
-        # in-flight launches (each holds a multi-GB XLA workspace).
-        batch = min(batch, 512)
-        depth = min(depth, 4)
+        # in-flight launches (each holds a multi-GB XLA workspace).  1024 is
+        # the minimum batch that engages the planar Pallas XOF kernels
+        # (keccak_pallas.pallas_enabled) and fits HBM.
+        batch = min(batch, 1024)
+        depth = min(depth, 2)
     fn = make_inputs = None
     while batch >= 64:
         try:

@@ -1,0 +1,232 @@
+"""Pluggable SQL backends for the datastore: SQLite and PostgreSQL.
+
+The Transaction API (datastore.py) is written once against the DB-API-ish
+surface ``conn.execute(sql, params) -> cursor``; this module supplies the
+dialect underneath:
+
+- :class:`SqliteBackend` — the hermetic default (one file, WAL, busy-retry).
+  N replicas on one HOST share the file (proven by
+  tests/test_multi_replica.py); cross-host scale-out needs Postgres.
+- :class:`PostgresBackend` — the reference's deployment shape
+  (aggregator_core/src/datastore.rs:108: every component coordinates through
+  one shared Postgres): psycopg under a statement-translation adapter, with
+  real ``FOR UPDATE SKIP LOCKED`` lease acquisition and retry on
+  serialization failures (SQLSTATE 40001/40P01), matching the reference's
+  run_tx retry loop (datastore.rs:249-298).  Requires the ``psycopg2`` or
+  ``psycopg`` package at runtime; everything else (statement translation,
+  schema translation, retry classification) is importable and unit-tested
+  without a server.
+
+Statement translation is mechanical: ``?`` placeholders become ``%s``, and
+the ``/*skip-locked*/`` marker — placed inside the lease-acquisition
+subselects — expands to ``FOR UPDATE SKIP LOCKED`` so concurrent Postgres
+replicas never serialize on lease scans.  The blind placeholder rewrite is
+safe only while no Transaction SQL puts ``?`` or ``%`` inside a quoted
+string literal (state-name literals like ``'InProgress'`` are fine); keep
+new SQL within that rule.
+"""
+
+from __future__ import annotations
+
+import re
+import time as _time
+from typing import Any, Optional
+
+__all__ = [
+    "SqliteBackend",
+    "PostgresBackend",
+    "backend_for",
+    "translate_sql_to_postgres",
+    "translate_schema_to_postgres",
+]
+
+SKIP_LOCKED_MARKER = "/*skip-locked*/"
+
+
+class _NeverRaised(Exception):
+    """Placeholder exception type when no Postgres driver is importable."""
+
+
+def translate_sql_to_postgres(sql: str) -> str:
+    """SQLite-dialect statement -> Postgres dialect.
+
+    Only mechanical rewrites are needed: the Transaction SQL uses ``?``
+    placeholders, no string literals, and marks lease subselects with
+    ``/*skip-locked*/``.
+    """
+    out = sql.replace("?", "%s")
+    out = out.replace(SKIP_LOCKED_MARKER, " FOR UPDATE SKIP LOCKED")
+    return out
+
+
+def translate_schema_to_postgres(schema: str) -> str:
+    """The SQLite schema (schema.py) translated to Postgres DDL.
+
+    Mirrors the reference's initial migration
+    (db/00000000000001_initial_schema.up.sql) type for type: synthetic row
+    ids become BIGSERIAL, BLOB columns BYTEA, and INTEGER columns BIGINT
+    (times/durations are integral seconds in both dialects).
+    """
+    lines = []
+    for line in schema.splitlines():
+        if line.strip().startswith("PRAGMA"):
+            continue
+        line = re.sub(r"\bINTEGER PRIMARY KEY\b", "BIGSERIAL PRIMARY KEY", line)
+        line = re.sub(r"\bBLOB\b", "BYTEA", line)
+        line = re.sub(r"\bINTEGER\b", "BIGINT", line)
+        lines.append(line)
+    return "\n".join(lines)
+
+
+class SqliteBackend:
+    """File-backed SQLite with the semantics documented in datastore.py."""
+
+    dialect = "sqlite"
+    begin_sql = "BEGIN IMMEDIATE"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def connect(self):
+        import sqlite3
+
+        conn = sqlite3.connect(self.path, timeout=10.0, isolation_level=None)
+        conn.execute("PRAGMA journal_mode = WAL")
+        conn.execute("PRAGMA synchronous = NORMAL")
+        conn.execute("PRAGMA foreign_keys = ON")
+        conn.execute("PRAGMA busy_timeout = 10000")
+        return conn
+
+    # No statement translation: Transaction SQL is written in the SQLite
+    # dialect, and the /*skip-locked*/ marker is comment-shaped on purpose.
+
+    @property
+    def integrity_errors(self):
+        import sqlite3
+
+        return (sqlite3.IntegrityError,)
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        import sqlite3
+
+        return isinstance(exc, sqlite3.OperationalError) and (
+            "locked" in str(exc) or "busy" in str(exc)
+        )
+
+    def init_schema(self, conn, schema: str) -> None:
+        conn.executescript(schema)
+
+
+class _PgConnAdapter:
+    """psycopg connection behind the sqlite3-like execute() surface."""
+
+    def __init__(self, conn, backend: "PostgresBackend"):
+        self._conn = conn
+        self._backend = backend
+
+    def execute(self, sql: str, params: tuple = ()):
+        cur = self._conn.cursor()
+        cur.execute(self._backend.translate(sql), params)
+        return cur
+
+    def executemany(self, sql: str, seq_of_params) -> None:
+        cur = self._conn.cursor()
+        cur.executemany(self._backend.translate(sql), seq_of_params)
+
+    # The connection runs in driver-autocommit with explicit BEGIN/COMMIT
+    # statements (run_tx owns transaction boundaries); statement-level
+    # commit/rollback works identically on psycopg v2 and v3.
+    def commit(self) -> None:
+        self._conn.cursor().execute("COMMIT")
+
+    def rollback(self) -> None:
+        self._conn.cursor().execute("ROLLBACK")
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class PostgresBackend:
+    """Shared-Postgres backend (reference deployment shape)."""
+
+    dialect = "postgres"
+    # psycopg opens the transaction implicitly on the first statement; the
+    # BEGIN here just pins the isolation level per-transaction the way the
+    # reference uses REPEATABLE READ (datastore.rs:298).
+    begin_sql = "BEGIN ISOLATION LEVEL REPEATABLE READ"
+
+    def __init__(self, dsn: str):
+        self.dsn = dsn
+        self._translated: dict = {}
+
+    def _driver(self):
+        try:
+            import psycopg  # psycopg3
+
+            return psycopg
+        except ImportError:
+            pass
+        try:
+            import psycopg2
+
+            return psycopg2
+        except ImportError:
+            raise ImportError(
+                "PostgresBackend requires the psycopg (v3) or psycopg2 package; "
+                "install one, or use an SQLite database path instead"
+            )
+
+    def connect(self):
+        driver = self._driver()
+        conn = driver.connect(self.dsn)
+        conn.autocommit = True  # run_tx manages transactions explicitly
+        return _PgConnAdapter(conn, self)
+
+    def translate(self, sql: str) -> str:
+        out = self._translated.get(sql)
+        if out is None:
+            out = translate_sql_to_postgres(sql)
+            self._translated[sql] = out
+        return out
+
+    @property
+    def integrity_errors(self):
+        out = []
+        try:
+            import psycopg
+
+            out.append(psycopg.errors.IntegrityError)
+        except ImportError:
+            pass
+        try:
+            import psycopg2
+
+            out.append(psycopg2.IntegrityError)
+        except ImportError:
+            pass
+        return tuple(out) or (_NeverRaised,)
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        # SQLSTATE 40001 serialization_failure / 40P01 deadlock_detected,
+        # exactly the classes the reference retries (datastore.rs:273-289).
+        sqlstate = getattr(exc, "sqlstate", None) or getattr(exc, "pgcode", None)
+        return sqlstate in ("40001", "40P01")
+
+    def init_schema(self, conn, schema: str) -> None:
+        pg_schema = translate_schema_to_postgres(schema)
+        for stmt in pg_schema.split(";"):
+            if stmt.strip():
+                conn.execute(stmt)
+        conn.commit()
+
+
+def backend_for(path_or_url: str):
+    """Dispatch on the configured database location.
+
+    ``postgres://`` / ``postgresql://`` DSNs select the Postgres backend;
+    anything else is an SQLite file path (the reference's DbConfig url is a
+    Postgres DSN, config.rs:75; SQLite is this framework's hermetic mode).
+    """
+    if path_or_url.startswith(("postgres://", "postgresql://")):
+        return PostgresBackend(path_or_url)
+    return SqliteBackend(path_or_url)

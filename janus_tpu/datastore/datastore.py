@@ -17,8 +17,11 @@ Mapping of Postgres machinery onto SQLite:
 - Column crypto: AES-GCM via :class:`~janus_tpu.datastore.crypter.Crypter`
   with AAD = (table, row-ident, column) (reference datastore.rs:5622).
 
-The SQL dialect is confined to this module so a Postgres driver could be
-slotted in behind the same Transaction API.
+The SQL dialect is confined behind backend_sql.py: the default is this
+module's documented SQLite mapping, and a ``postgres://`` database path
+selects the shared-Postgres backend with real ``FOR UPDATE SKIP LOCKED``
+lease scans and serialization-failure retries — the reference's deployment
+shape — behind the same Transaction API.
 """
 
 from __future__ import annotations
@@ -123,7 +126,12 @@ def _metrics_tx(name: str, status: str) -> None:
 
 
 class Datastore:
-    """Thread-safe handle; one SQLite connection per thread."""
+    """Thread-safe handle; one backend connection per thread.
+
+    ``path`` is an SQLite file path (hermetic default) or a
+    ``postgres://`` DSN selecting the shared-Postgres backend
+    (backend_sql.py; reference DbConfig url, config.rs:75).
+    """
 
     def __init__(
         self,
@@ -132,7 +140,10 @@ class Datastore:
         clock: Clock,
         max_transaction_retries: int = 30,
     ):
+        from .backend_sql import backend_for
+
         self.path = path
+        self.backend = backend_for(path)
         self.crypter = crypter
         self.clock = clock
         self.max_transaction_retries = max_transaction_retries
@@ -140,23 +151,21 @@ class Datastore:
         self._init_schema()
 
     # -- connections ----------------------------------------------------
-    def _conn(self) -> sqlite3.Connection:
+    def _conn(self):
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self.path, timeout=10.0, isolation_level=None)
-            conn.execute("PRAGMA journal_mode = WAL")
-            conn.execute("PRAGMA synchronous = NORMAL")
-            conn.execute("PRAGMA foreign_keys = ON")
-            conn.execute("PRAGMA busy_timeout = 10000")
+            conn = self.backend.connect()
             self._local.conn = conn
         return conn
 
     def _init_schema(self) -> None:
         conn = self._conn()
-        conn.executescript(SCHEMA)
+        self.backend.init_schema(conn, SCHEMA)
         row = conn.execute("SELECT version FROM schema_version").fetchone()
         if row is None:
-            conn.execute("INSERT INTO schema_version (version) VALUES (?)", (SCHEMA_VERSION,))
+            conn.execute(
+                "INSERT INTO schema_version (version) VALUES (?)", (SCHEMA_VERSION,)
+            )
             conn.commit()
         elif row[0] != SCHEMA_VERSION:
             # reference: supported_schema_versions! (datastore.rs:77-104)
@@ -170,34 +179,53 @@ class Datastore:
             conn.close()
             self._local.conn = None
 
+    def _evict_conn(self) -> None:
+        """Drop this thread's cached connection (it may be dead — e.g. a
+        network backend's server restarted).  The next _conn() reconnects."""
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
     # -- transactions ---------------------------------------------------
     def run_tx(self, name: str, fn: Callable[["Transaction"], T]) -> T:
-        """Run ``fn`` in one transaction, retrying on lock contention
-        (reference: datastore.rs:249 run_tx / :298 run_tx_once)."""
+        """Run ``fn`` in one transaction, retrying on lock contention /
+        serialization failure (reference: datastore.rs:249 run_tx /
+        :298 run_tx_once; retry classification is per-backend)."""
         last_err: Optional[BaseException] = None
         for attempt in range(self.max_transaction_retries):
             conn = self._conn()
             try:
-                conn.execute("BEGIN IMMEDIATE")
-            except sqlite3.OperationalError as e:
+                conn.execute(self.backend.begin_sql)
+            except Exception as e:
+                # A failing BEGIN often means the cached connection is dead
+                # (server restart on a network backend): always reconnect.
+                self._evict_conn()
+                if not self.backend.is_retryable(e):
+                    raise
                 last_err = e
                 _time.sleep(min(0.05 * (attempt + 1), 0.5))
                 continue
             tx = Transaction(self, conn)
             try:
                 result = fn(tx)
-                conn.execute("COMMIT")
+                conn.commit()
                 _metrics_tx(name, "committed")
                 return result
-            except sqlite3.OperationalError as e:
-                conn.execute("ROLLBACK")
-                if "locked" in str(e) or "busy" in str(e):
+            except BaseException as e:
+                try:
+                    conn.rollback()
+                except Exception:
+                    # Never mask the original error with a rollback failure
+                    # on a broken connection; reconnect next attempt.
+                    self._evict_conn()
+                if self.backend.is_retryable(e):
                     last_err = e
                     _time.sleep(min(0.05 * (attempt + 1), 0.5))
                     continue
-                raise
-            except BaseException:
-                conn.execute("ROLLBACK")
                 raise
         _metrics_tx(name, "exhausted")
         raise DatastoreError(f"transaction {name!r} exhausted retries: {last_err}")
@@ -262,7 +290,8 @@ class Transaction:
                     aggregator_auth_token_type, aggregator_auth_token,
                     aggregator_auth_token_hash, collector_auth_token_hash,
                     created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)
+                   RETURNING id""",
                 (
                     task.task_id.data,
                     task.role.name.capitalize() if isinstance(task.role, Role) else str(task.role),
@@ -289,9 +318,11 @@ class Transaction:
                     self._now_s(),
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"task {task.task_id} already exists") from e
-        pk = cur.lastrowid
+        # RETURNING id works on both dialects; cursor.lastrowid does not
+        # (psycopg has no usable lastrowid for PG tables).
+        pk = cur.fetchone()[0]
         for kp in task.hpke_keys:
             enc_sk = self.crypter.encrypt(
                 "task_hpke_keys", task.task_id.data, "private_key", kp.private_key
@@ -429,7 +460,7 @@ class Transaction:
                     self._now_s(),
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"report {report.report_id} already exists") from e
 
     def get_client_report(
@@ -629,7 +660,7 @@ class Transaction:
                     now,
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"aggregation job {job.aggregation_job_id} exists") from e
 
     def get_aggregation_job(
@@ -715,7 +746,7 @@ class Transaction:
                WHERE id IN (
                    SELECT id FROM aggregation_jobs
                    WHERE state = 'InProgress' AND lease_expiry <= ?
-                   ORDER BY id LIMIT ?)
+                   ORDER BY id LIMIT ? /*skip-locked*/)
                RETURNING task_id, aggregation_job_id, lease_attempts""",
             (expiry, token, now, now, limit),
         ).fetchall()
@@ -787,7 +818,7 @@ class Transaction:
                     *cols,
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"report aggregation ord {ra.ord} already exists") from e
 
     def _ra_payload_cols(self, ra: ReportAggregation) -> Tuple:
@@ -944,7 +975,7 @@ class Transaction:
                     ReportAggregationState.START_LEADER.value,
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"report aggregation ord {meta.ord} already exists") from e
 
     def get_aggregation_params_for_report(
@@ -1009,7 +1040,7 @@ class Transaction:
                     self._now_s(),
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict("batch aggregation shard already exists") from e
 
     def update_batch_aggregation(self, ba: BatchAggregation) -> None:
@@ -1168,7 +1199,7 @@ class Transaction:
                     now,
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"collection job {job.collection_job_id} exists") from e
 
     def get_collection_job(
@@ -1307,7 +1338,7 @@ class Transaction:
                WHERE id IN (
                    SELECT id FROM collection_jobs
                    WHERE state = 'Start' AND lease_expiry <= ?
-                   ORDER BY id LIMIT ?)
+                   ORDER BY id LIMIT ? /*skip-locked*/)
                RETURNING task_id, collection_job_id, lease_attempts, step_attempts""",
             (expiry, token, now, now, limit),
         ).fetchall()
@@ -1375,7 +1406,7 @@ class Transaction:
                     self._now_s(),
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict("aggregate share job already exists") from e
 
     def get_aggregate_share_job(
@@ -1430,7 +1461,7 @@ class Transaction:
                     self._now_s(),
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict("outstanding batch already exists") from e
 
     def get_unfilled_outstanding_batches(
@@ -1586,7 +1617,7 @@ class Transaction:
                     self._now_s(),
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict("global HPKE key id already exists") from e
 
     def get_global_hpke_keypairs(self) -> List[GlobalHpkeKeypair]:
@@ -1666,7 +1697,7 @@ class Transaction:
                     else None,
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except self.ds.backend.integrity_errors as e:
             raise TxConflict("taskprov peer already exists") from e
 
     def _peer_from_row(self, row):

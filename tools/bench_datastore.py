@@ -1,0 +1,166 @@
+"""Datastore throughput benchmark: report ingest + lease churn.
+
+Measures the two datastore paths that bound end-to-end scale so the
+SQLite-vs-Postgres decision is numbers-driven (the reference exposes the
+matching contention knobs: batch_aggregation_shard_count,
+max_upload_batch_size, max_concurrent_job_workers —
+aggregator/src/aggregator.rs:180-209):
+
+1. ingest          — reports/s through ReportWriteBatcher-shaped batched
+                     upload transactions (put_client_report x batch per tx).
+2. lease-churn     — acquire+release cycles/s for aggregation-job leases,
+                     across N contending worker threads.
+
+Usage: python tools/bench_datastore.py [--db PATH_OR_POSTGRES_URL]
+       [--reports 20000] [--upload-batch 100] [--jobs 2000] [--workers 4]
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import secrets
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--db", default=None, help="SQLite path or postgres:// DSN (default: temp file)")
+    parser.add_argument("--reports", type=int, default=20000)
+    parser.add_argument("--upload-batch", type=int, default=100)
+    parser.add_argument("--jobs", type=int, default=2000)
+    parser.add_argument("--workers", type=int, default=4)
+    args = parser.parse_args()
+
+    sys.path.insert(0, ".")
+    import tempfile, os
+
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import (
+        AggregationJob,
+        AggregationJobState,
+        Crypter,
+        LeaderStoredReport,
+        generate_key,
+    )
+    from janus_tpu.datastore.datastore import Datastore
+    from janus_tpu.messages import (
+        AggregationJobId,
+        AggregationJobStep,
+        Duration,
+        HpkeCiphertext,
+        Interval,
+        ReportId,
+        ReportMetadata,
+        Time,
+    )
+
+    sys.path.insert(0, "tests")
+    from test_datastore import make_task
+
+    cleanup = None
+    db = args.db
+    if db is None:
+        fd, db = tempfile.mkstemp(suffix=".sqlite3", prefix="janus-dsbench-")
+        os.close(fd)
+        os.unlink(db)
+        cleanup = db
+
+    ds = Datastore(db, Crypter([generate_key()]), RealClock())
+    task = make_task()
+    ds.run_tx("put-task", lambda tx: tx.put_aggregator_task(task))
+    now = int(time.time())
+
+    # -- 1. ingest ------------------------------------------------------
+    def mk_report():
+        return LeaderStoredReport(
+            task_id=task.task_id,
+            metadata=ReportMetadata(ReportId(secrets.token_bytes(16)), Time(now)),
+            public_share=b"",
+            leader_extensions=[],
+            leader_input_share=b"\x01" * 32,
+            helper_encrypted_input_share=HpkeCiphertext(1, b"enc", b"payload" * 4),
+        )
+
+    n_batches = args.reports // args.upload_batch
+    batches = [[mk_report() for _ in range(args.upload_batch)] for _ in range(n_batches)]
+    t0 = time.monotonic()
+    for batch in batches:
+        def write(tx, batch=batch):
+            for r in batch:
+                tx.put_client_report(r)
+        ds.run_tx("upload", write)
+    ingest_s = time.monotonic() - t0
+    ingest_rps = n_batches * args.upload_batch / ingest_s
+
+    # -- 2. lease churn -------------------------------------------------
+    for _ in range(args.jobs):
+        job = AggregationJob(
+            task_id=task.task_id,
+            aggregation_job_id=AggregationJobId.random(),
+            aggregation_parameter=b"",
+            partial_batch_identifier=None,
+            client_timestamp_interval=Interval(Time(0), Duration(1)),
+            state=AggregationJobState.IN_PROGRESS,
+            step=AggregationJobStep(0),
+        )
+        ds.run_tx("put-job", lambda tx, j=job: tx.put_aggregation_job(j))
+
+    done = threading.Event()
+    counts = [0] * args.workers
+
+    def churn(i: int) -> None:
+        while not done.is_set():
+            leases = ds.run_tx(
+                "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)
+            )
+            if not leases:
+                break
+            for lease in leases:
+                ds.run_tx(
+                    "rel",
+                    lambda tx, l=lease: tx.release_aggregation_job(l, Duration(0)),
+                )
+                counts[i] += 1
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(args.workers)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(5.0)
+    done.set()
+    for t in threads:
+        t.join()
+    churn_s = time.monotonic() - t0
+    cycles = sum(counts)
+    lease_cps = cycles / churn_s
+
+    ds.close()
+    if cleanup:
+        for suffix in ("", "-wal", "-shm"):
+            try:
+                os.unlink(cleanup + suffix)
+            except FileNotFoundError:
+                pass
+
+    print(
+        json.dumps(
+            {
+                "backend": ds.backend.dialect,
+                "ingest_reports_per_sec": round(ingest_rps, 1),
+                "upload_batch": args.upload_batch,
+                "lease_cycles_per_sec": round(lease_cps, 1),
+                "lease_workers": args.workers,
+                "lease_cycles": cycles,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
