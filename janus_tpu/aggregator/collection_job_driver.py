@@ -45,12 +45,9 @@ from .error import InvalidBatchSize
 logger = logging.getLogger("janus_tpu.collection_job_driver")
 
 
-class NoDifferentialPrivacy:
-    """No-op DP strategy (reference: core/src/dp.rs:38; the noise hook is
-    collection_job_driver.rs:338 add_noise_to_agg_share)."""
-
-    def add_noise_to_agg_share(self, vdaf, agg_share: List[int], report_count: int):
-        return agg_share
+# Strategy types live in core.dp (ZCdpDiscreteGaussian discrete-Gaussian
+# noise + the no-op); re-exported here for compatibility with earlier API.
+from ..core.dp import NoDifferentialPrivacy, dp_strategy_from_dict  # noqa: E402
 
 
 @dataclass
@@ -73,7 +70,8 @@ class CollectionJobDriver:
         self._session_factory = session_factory
         self._session = None
         self.config = config or CollectionDriverConfig()
-        self.dp_strategy = dp_strategy or NoDifferentialPrivacy()
+        # None => per-task dispatch from the VDAF instance's dp_strategy.
+        self._dp_override = dp_strategy
 
     def _get_session(self):
         if self._session is None or self._session.closed:
@@ -157,8 +155,13 @@ class CollectionJobDriver:
             await self.abandon_collection_job(lease)
             return
 
-        # DP noise hook (reference: :338-344)
-        share = self.dp_strategy.add_noise_to_agg_share(vdaf, share, count)
+        # DP noise (reference: :338-344 add_noise_to_agg_share): the
+        # strategy comes from the task's VDAF instance description unless
+        # the driver was constructed with an explicit override.
+        strategy = self._dp_override or dp_strategy_from_dict(
+            task.vdaf.get("dp_strategy")
+        )
+        share = strategy.add_noise_to_agg_share(vdaf, share, count)
 
         # request the helper's encrypted aggregate share (reference: :347-377)
         if task.query_type.kind == "TimeInterval":

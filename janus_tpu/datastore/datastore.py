@@ -201,10 +201,14 @@ class Datastore:
             try:
                 conn.execute(self.backend.begin_sql)
             except Exception as e:
-                # A failing BEGIN often means the cached connection is dead
-                # (server restart on a network backend): always reconnect.
-                self._evict_conn()
                 if not self.backend.is_retryable(e):
+                    # Non-retryable BEGIN failure usually means the cached
+                    # connection is dead (server restart on a network
+                    # backend): reconnect before surfacing the error.
+                    # Retryable failures (SQLite lock contention) keep the
+                    # healthy connection — re-opening per retry would add
+                    # connection churn to the contended hot path.
+                    self._evict_conn()
                     raise
                 last_err = e
                 _time.sleep(min(0.05 * (attempt + 1), 0.5))

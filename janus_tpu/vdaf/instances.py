@@ -69,25 +69,19 @@ def prio3_sum_vec_field64_multiproof_hmacsha256_aes128(
 
 
 def prio3_fixedpoint_bounded_l2_vec_sum(
-    bitsize, length: int, num_shares: int = 2, dp_strategy=None, chunk_length: int = None
+    bitsize, length: int, num_shares: int = 2, chunk_length: int = None
 ) -> Prio3:
     """Fixed-point bounded-L2 vector sum (reference: core/src/vdaf.rs:88-91).
 
     ``bitsize``: 16 | 32 | "BitSize16" | "BitSize32" (the reference's enum).
-    ``dp_strategy``: only NoDifferentialPrivacy is supported, matching the
-    DP stub at the reference's call site (collection_job_driver.py).
+    A ``dp_strategy`` key in the instance description is handled by the DP
+    layer (janus_tpu/core/dp.py), not the circuit — vdaf_from_instance
+    strips it before construction, mirroring the reference's per-instance
+    dp_strategy dispatch.
     """
     bits = {16: 16, 32: 32, "BitSize16": 16, "BitSize32": 32}.get(bitsize)
     if bits is None:
         raise ValueError(f"unsupported bitsize {bitsize!r}")
-    if dp_strategy is not None:
-        tag = (
-            dp_strategy.get("dp_strategy")
-            if isinstance(dp_strategy, dict)
-            else dp_strategy
-        )
-        if tag not in (None, "NoDifferentialPrivacy"):
-            raise ValueError("only NoDifferentialPrivacy is supported")
     return Prio3(
         FlpGeneric(
             FixedPointBoundedL2VecSum(
@@ -153,8 +147,13 @@ def vdaf_from_instance(instance: Dict[str, Any], backend: str = None) -> Prio3:
     kind = instance["type"]
     if kind not in VDAF_INSTANCES:
         raise ValueError(f"unknown VDAF instance: {kind}")
-    params = {k: v for k, v in instance.items() if k != "type"}
+    # dp_strategy rides inside the instance description (the reference keeps
+    # it in the VdafInstance variants and dispatches it alongside the vdaf,
+    # aggregator/src/aggregator/collection_job_driver.rs:98); it is not a
+    # circuit parameter.
+    params = {k: v for k, v in instance.items() if k not in ("type", "dp_strategy")}
     vdaf = VDAF_INSTANCES[kind](**params)
+    vdaf.instance = dict(instance)
     if backend is not None:
         from .backend import make_backend
 

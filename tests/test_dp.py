@@ -1,0 +1,118 @@
+"""Differential privacy: exact discrete-Gaussian sampler + noise wiring.
+
+Statistical checks on the CKS sampler (janus_tpu/core/dp.py) and the
+per-task strategy dispatch matching the reference's noise hook
+(aggregator/src/aggregator/collection_job_driver.rs:338-344).
+"""
+
+from __future__ import annotations
+
+import statistics
+from fractions import Fraction
+
+import pytest
+
+from janus_tpu.core.dp import (
+    DpError,
+    NoDifferentialPrivacy,
+    ZCdpDiscreteGaussian,
+    _bernoulli_exp,
+    dp_strategy_from_dict,
+    l2_sensitivity,
+    sample_discrete_gaussian,
+    sample_discrete_laplace,
+)
+from janus_tpu.vdaf.instances import vdaf_from_instance
+
+
+def test_bernoulli_exp_frequency():
+    # P[True] = e^-1 ~ 0.36788; N=4000 -> s.e. ~ 0.0076.
+    n = 4000
+    hits = sum(_bernoulli_exp(Fraction(1)) for _ in range(n))
+    assert abs(hits / n - 0.36788) < 0.04
+
+
+def test_discrete_laplace_symmetry_and_scale():
+    n = 3000
+    xs = [sample_discrete_laplace(Fraction(5)) for _ in range(n)]
+    assert abs(statistics.mean(xs)) < 1.0
+    # Var of discrete Laplace(t) ~ 2 e^(1/t) / (e^(1/t)-1)^2 ~ 2 t^2 = 50.
+    assert 30 < statistics.pvariance(xs) < 80
+
+
+def test_discrete_gaussian_moments():
+    sigma = Fraction(10)
+    n = 1500
+    xs = [sample_discrete_gaussian(sigma) for _ in range(n)]
+    # mean 0 +- ~4 s.e. (s.e. = sigma/sqrt(n) ~ 0.26)
+    assert abs(statistics.mean(xs)) < 1.1
+    # variance ~ sigma^2 = 100 (the discrete Gaussian's variance is within
+    # a hair of the continuous one at sigma >= 1).
+    assert 75 < statistics.pvariance(xs) < 130
+    # integrality and reasonable tails
+    assert all(isinstance(x, int) for x in xs)
+    assert max(abs(x) for x in xs) < 10 * 10
+
+
+def test_invalid_params():
+    with pytest.raises(DpError):
+        sample_discrete_gaussian(Fraction(0))
+    with pytest.raises(DpError):
+        sample_discrete_laplace(Fraction(-1))
+    with pytest.raises(DpError):
+        ZCdpDiscreteGaussian(Fraction(0))
+
+
+def test_sensitivities():
+    assert l2_sensitivity({"type": "Prio3Count"}) == 1
+    assert l2_sensitivity({"type": "Prio3Sum", "bits": 8}) == 255
+    h = l2_sensitivity({"type": "Prio3Histogram", "length": 4, "chunk_length": 2})
+    assert Fraction(14142, 10000) < h < Fraction(14143, 10000)  # sqrt(2), rounded up
+    sv = l2_sensitivity({"type": "Prio3SumVec", "length": 16, "bits": 1, "chunk_length": 4})
+    assert sv >= 4  # sqrt(16), upper bound
+    with pytest.raises(DpError):
+        l2_sensitivity({"type": "Nope"})
+
+
+def test_add_noise_changes_share_mod_p():
+    inst = {
+        "type": "Prio3Histogram",
+        "length": 8,
+        "chunk_length": 3,
+        "dp_strategy": {"dp_mechanism": "ZCdpDiscreteGaussian", "epsilon": [1, 10]},
+    }
+    vdaf = vdaf_from_instance(inst)
+    p = vdaf.flp.field.MODULUS
+    share = [7] * 8
+    strategy = dp_strategy_from_dict(inst["dp_strategy"])
+    noised = strategy.add_noise_to_agg_share(vdaf, list(share), 100)
+    assert len(noised) == 8
+    assert all(0 <= x < p for x in noised)
+    # sigma = sqrt(2)/epsilon ~ 14.1: with 8 coordinates the chance all
+    # noise draws are zero is negligible.
+    assert noised != share
+    # The no-op strategy is the identity.
+    assert NoDifferentialPrivacy().add_noise_to_agg_share(vdaf, list(share), 100) == share
+
+
+def test_strategy_parse_and_instance_plumbing():
+    assert isinstance(dp_strategy_from_dict(None), NoDifferentialPrivacy)
+    assert isinstance(
+        dp_strategy_from_dict({"dp_mechanism": "NoDifferentialPrivacy"}),
+        NoDifferentialPrivacy,
+    )
+    s = dp_strategy_from_dict({"dp_mechanism": "ZCdpDiscreteGaussian", "epsilon": [1, 2]})
+    assert isinstance(s, ZCdpDiscreteGaussian) and s.epsilon == Fraction(1, 2)
+    assert s.to_dict()["epsilon"] == [1, 2]
+    with pytest.raises(DpError):
+        dp_strategy_from_dict({"dp_mechanism": "Quantum"})
+    # vdaf_from_instance strips dp_strategy before circuit construction and
+    # keeps the full description on vdaf.instance.
+    inst = {
+        "type": "Prio3Count",
+        "dp_strategy": {"dp_mechanism": "ZCdpDiscreteGaussian", "epsilon": [1, 1]},
+    }
+    vdaf = vdaf_from_instance(inst)
+    assert vdaf.instance["dp_strategy"]["dp_mechanism"] == "ZCdpDiscreteGaussian"
+    sigma = ZCdpDiscreteGaussian(Fraction(1)).sigma_for(vdaf)
+    assert sigma == 1

