@@ -54,13 +54,16 @@ from janus_tpu.messages import (
 
 
 def make_task(role=Role.LEADER, query_type=None, vdaf=None) -> AggregatorTask:
+    from janus_tpu.datastore.task import vdaf_verify_key_length
+
+    vdaf = vdaf or {"type": "Prio3Count"}
     return AggregatorTask(
         task_id=TaskId.random(),
         peer_aggregator_endpoint="https://peer.example.com/",
         query_type=query_type or TaskQueryType.time_interval(),
-        vdaf=vdaf or {"type": "Prio3Count"},
+        vdaf=vdaf,
         role=role,
-        vdaf_verify_key=b"\x01" * 16,
+        vdaf_verify_key=b"\x01" * vdaf_verify_key_length(vdaf),
         min_batch_size=10,
         time_precision=Duration(3600),
         aggregator_auth_token=AuthenticationToken.new_bearer("token-abc")
