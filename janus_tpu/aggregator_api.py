@@ -19,6 +19,9 @@ version=0.1``):
     PUT    /hpke_configs              — generate a new key
     PATCH  /hpke_configs/:config_id   — set state
     DELETE /hpke_configs/:config_id
+    GET    /taskprov/peer_aggregators — configured taskprov peers
+    POST   /taskprov/peer_aggregators — add a peer (insert-only)
+    DELETE /taskprov/peer_aggregators — remove a peer (endpoint+role body)
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ from .core.hpke import HpkeKeypair
 from .datastore import (
     AggregatorTask,
     Datastore,
+    DatastoreError,
     HpkeKeyState,
     TaskNotFound,
     TaskQueryType,
@@ -313,6 +317,89 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
         )
         return web.Response(status=204)
 
+    # -- taskprov peer aggregators (reference: routes.rs:401-467) --------
+    def _peer_to_json(peer) -> dict:
+        # Secrets (verify_key_init, auth tokens) never leave the API —
+        # matching the reference's PeerAggregator resource shape.
+        return {
+            "endpoint": peer.endpoint,
+            "role": peer.role.name.capitalize(),
+            "collector_hpke_config": _b64u(peer.collector_hpke_config.get_encoded()),
+            "report_expiry_age": peer.report_expiry_age.seconds
+            if peer.report_expiry_age
+            else None,
+            "tolerable_clock_skew": peer.tolerable_clock_skew.seconds,
+        }
+
+    async def get_taskprov_peers(_request):
+        peers = await datastore.run_tx_async(
+            "api_get_taskprov_peers", lambda tx: tx.get_taskprov_peer_aggregators()
+        )
+        return ok_json([_peer_to_json(p) for p in peers])
+
+    async def post_taskprov_peer(request: web.Request):
+        from .aggregator.taskprov import PeerAggregator
+
+        body = await request.json()
+        role = Role[body["peer_role"].upper()]
+        if role not in (Role.LEADER, Role.HELPER):
+            # Matching the reference routes: a peer AGGREGATOR is one of the
+            # two aggregator roles; anything else would store an unusable
+            # peer and silently drop its auth token.
+            raise ValueError("peer_role must be Leader or Helper")
+        vk_init = _unb64u(body["verify_key_init"])
+        peer = PeerAggregator(
+            endpoint=body["endpoint"],
+            role=role,
+            verify_key_init=vk_init,
+            collector_hpke_config=HpkeConfig.get_decoded(
+                _unb64u(body["collector_hpke_config"])
+            ),
+            report_expiry_age=Duration(body["report_expiry_age"])
+            if body.get("report_expiry_age")
+            else None,
+            tolerable_clock_skew=Duration(body.get("tolerable_clock_skew", 60)),
+            # If WE are the leader for this peer we hold the token; as the
+            # helper we hold its hash (reference: taskprov.rs:97).
+            aggregator_auth_token=AuthenticationToken.new_bearer(
+                body["aggregator_auth_token"]
+            )
+            if role == Role.HELPER and body.get("aggregator_auth_token")
+            else None,
+            aggregator_auth_token_hash=AuthenticationToken.new_bearer(
+                body["aggregator_auth_token"]
+            ).hash()
+            if role == Role.LEADER and body.get("aggregator_auth_token")
+            else None,
+            collector_auth_token_hash=AuthenticationToken.new_bearer(
+                body["collector_auth_token"]
+            ).hash()
+            if body.get("collector_auth_token")
+            else None,
+        )
+        try:
+            await datastore.run_tx_async(
+                "api_post_taskprov_peer", lambda tx: tx.put_taskprov_peer_aggregator(peer)
+            )
+        except TxConflict as e:
+            # insert-only, as in the reference (routes.rs:416-421): delete
+            # then re-create to change an existing peer.
+            return web.json_response({"error": str(e)}, status=409)
+        return ok_json(_peer_to_json(peer), status=201)
+
+    async def delete_taskprov_peer(request: web.Request):
+        body = await request.json()
+        role = Role[body["peer_role"].upper()]
+
+        def tx_fn(tx):
+            tx.delete_taskprov_peer_aggregator(body["endpoint"], role)
+
+        try:
+            await datastore.run_tx_async("api_delete_taskprov_peer", tx_fn)
+        except DatastoreError:
+            return web.Response(status=404)
+        return web.Response(status=204)
+
     app = web.Application(middlewares=[auth_middleware])
     app.add_routes(
         [
@@ -327,6 +414,9 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
             web.put("/hpke_configs", put_hpke_config),
             web.patch("/hpke_configs/{config_id}", patch_hpke_config),
             web.delete("/hpke_configs/{config_id}", delete_hpke_config),
+            web.get("/taskprov/peer_aggregators", get_taskprov_peers),
+            web.post("/taskprov/peer_aggregators", post_taskprov_peer),
+            web.delete("/taskprov/peer_aggregators", delete_taskprov_peer),
         ]
     )
     return app

@@ -60,6 +60,12 @@ class AggregatorConfig:
     #: "tpu" routes whole-job prepare through one batched device launch.
     vdaf_backend: str = "tpu"
     garbage_collection_interval_s: Optional[float] = None
+    #: Global-HPKE key rotation loop (reference: binaries/aggregator.rs:31-150
+    #: runs the maintenance loops beside the server); None disables.
+    key_rotator_interval_s: Optional[float] = None
+    key_rotator_pending_duration_s: int = 86400
+    key_rotator_active_duration_s: int = 7 * 86400
+    key_rotator_expired_duration_s: int = 86400
 
 
 @dataclass

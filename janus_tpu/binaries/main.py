@@ -136,23 +136,43 @@ def run_aggregator(config_path: Optional[str]) -> None:
         await site.start()
         logger.info("aggregator serving on %s", cfg.listen_address)
 
-        async def gc_loop():
-            gc = GarbageCollector(datastore)
+        async def periodic(name: str, fn, interval_s: float):
+            """Run ``fn`` every interval until stop; failures log, not kill
+            (the maintenance-loop shape of reference binaries/aggregator.rs)."""
             while not stop.is_set():
                 try:
-                    await gc.run_once()
+                    await fn()
                 except Exception:
-                    logger.exception("GC pass failed")
+                    logger.exception("%s pass failed", name)
                 try:
-                    await asyncio.wait_for(
-                        stop.wait(), timeout=cfg.garbage_collection_interval_s
-                    )
+                    await asyncio.wait_for(stop.wait(), timeout=interval_s)
                 except asyncio.TimeoutError:
                     pass
 
         tasks = []
         if cfg.garbage_collection_interval_s:
-            tasks.append(asyncio.ensure_future(gc_loop()))
+            gc = GarbageCollector(datastore)
+            tasks.append(
+                asyncio.ensure_future(
+                    periodic("GC", gc.run_once, cfg.garbage_collection_interval_s)
+                )
+            )
+        if cfg.key_rotator_interval_s:
+            from ..aggregator.key_rotator import HpkeKeyRotator, KeyRotatorConfig
+
+            rotator = HpkeKeyRotator(
+                datastore,
+                KeyRotatorConfig(
+                    pending_duration=Duration(cfg.key_rotator_pending_duration_s),
+                    active_duration=Duration(cfg.key_rotator_active_duration_s),
+                    expired_duration=Duration(cfg.key_rotator_expired_duration_s),
+                ),
+            )
+            tasks.append(
+                asyncio.ensure_future(
+                    periodic("key rotator", rotator.run, cfg.key_rotator_interval_s)
+                )
+            )
         await stop.wait()
         for t in tasks:
             t.cancel()

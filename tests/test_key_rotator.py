@@ -1,0 +1,165 @@
+"""Clock-driven HPKE key rotator lifecycle + taskprov peer CRUD routes.
+
+Rotator analog of the reference's key lifecycle maintenance beside the
+aggregator server (binaries/aggregator.rs:31-150); peer routes match
+aggregator_api/src/routes.rs:401-467.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator.key_rotator import HpkeKeyRotator, KeyRotatorConfig
+from janus_tpu.aggregator_api import aggregator_api_app
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import HpkeKeyState
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Time
+
+TOKEN = "mgmt-token-123"
+
+
+def _states(ds):
+    return {
+        kp.config.id: kp.state
+        for kp in ds.run_tx("get", lambda tx: tx.get_global_hpke_keypairs())
+    }
+
+
+def test_key_rotator_lifecycle():
+    clock = MockClock(Time(1_000_000))
+    eds = EphemeralDatastore(clock)
+    ds = eds.datastore
+    rotator = HpkeKeyRotator(
+        ds,
+        KeyRotatorConfig(
+            pending_duration=Duration(100),
+            active_duration=Duration(1000),
+            expired_duration=Duration(50),
+        ),
+    )
+
+    # bootstrap: empty store -> one Active key.
+    rotator.run_sync()
+    s0 = _states(ds)
+    assert list(s0.values()) == [HpkeKeyState.ACTIVE]
+    (active_id,) = s0
+
+    # steady state: nothing to do well before rotation.
+    clock.advance(Duration(500))
+    rotator.run_sync()
+    assert _states(ds) == {active_id: HpkeKeyState.ACTIVE}
+
+    # pre-stage: inside the final pending_duration window of the active key.
+    clock.advance(Duration(450))  # age 950 >= 1000 - 100
+    rotator.run_sync()
+    s1 = _states(ds)
+    assert sorted(s1.values(), key=lambda s: s.value) == [
+        HpkeKeyState.ACTIVE,
+        HpkeKeyState.PENDING,
+    ]
+    (pending_id,) = [cid for cid, st in s1.items() if st == HpkeKeyState.PENDING]
+
+    # promote after the propagation delay; the old key stays ACTIVE for a
+    # pending_duration of overlap (clients fetching /hpke_config just
+    # before the promotion must not race the flip).
+    clock.advance(Duration(100))
+    rotator.run_sync()
+    s2 = _states(ds)
+    assert s2[pending_id] == HpkeKeyState.ACTIVE
+    assert s2[active_id] == HpkeKeyState.ACTIVE
+
+    # retire once past active_duration + pending_duration.
+    clock.advance(Duration(100))
+    rotator.run_sync()
+    s2b = _states(ds)
+    assert s2b[active_id] == HpkeKeyState.EXPIRED
+    assert s2b[pending_id] == HpkeKeyState.ACTIVE
+
+    # reap the expired key after the decrypt grace period.
+    clock.advance(Duration(50))
+    rotator.run_sync()
+    s3 = _states(ds)
+    assert active_id not in s3
+    assert s3 == {pending_id: HpkeKeyState.ACTIVE}
+
+    # idempotent: an immediate re-run changes nothing.
+    rotator.run_sync()
+    assert _states(ds) == s3
+    eds.cleanup()
+
+
+def test_taskprov_peer_crud_routes():
+    eds = EphemeralDatastore(MockClock(Time(1_600_002_000)))
+    app = aggregator_api_app(eds.datastore, [TOKEN])
+
+    async def flow():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        headers = {"Authorization": "Bearer " + TOKEN}
+        cfg_b64 = (
+            base64.urlsafe_b64encode(HpkeKeypair.generate(7).config.get_encoded())
+            .rstrip(b"=")
+            .decode()
+        )
+        vk_init = base64.urlsafe_b64encode(b"\x11" * 32).rstrip(b"=").decode()
+        peer = {
+            "endpoint": "https://peer.example.com/",
+            "peer_role": "Helper",
+            "verify_key_init": vk_init,
+            "collector_hpke_config": cfg_b64,
+            "aggregator_auth_token": "tok-123",
+            "tolerable_clock_skew": 120,
+        }
+        try:
+            resp = await client.get("/taskprov/peer_aggregators", headers=headers)
+            assert resp.status == 200 and await resp.json() == []
+
+            resp = await client.post(
+                "/taskprov/peer_aggregators", headers=headers, json=peer
+            )
+            assert resp.status == 201, await resp.text()
+            doc = await resp.json()
+            assert doc["endpoint"] == peer["endpoint"]
+            assert doc["role"] == "Helper"
+            assert doc["tolerable_clock_skew"] == 120
+            # secrets never come back
+            assert "verify_key_init" not in doc
+            assert "aggregator_auth_token" not in doc
+
+            # insert-only: re-posting the same (endpoint, role) conflicts.
+            resp = await client.post(
+                "/taskprov/peer_aggregators", headers=headers, json=peer
+            )
+            assert resp.status == 409
+
+            resp = await client.get("/taskprov/peer_aggregators", headers=headers)
+            assert len(await resp.json()) == 1
+
+            resp = await client.delete(
+                "/taskprov/peer_aggregators",
+                headers=headers,
+                json={"endpoint": peer["endpoint"], "peer_role": "Helper"},
+            )
+            assert resp.status == 204
+            resp = await client.delete(
+                "/taskprov/peer_aggregators",
+                headers=headers,
+                json={"endpoint": peer["endpoint"], "peer_role": "Helper"},
+            )
+            assert resp.status == 404
+            resp = await client.get("/taskprov/peer_aggregators", headers=headers)
+            assert await resp.json() == []
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(flow())
+    finally:
+        loop.close()
+        eds.cleanup()
