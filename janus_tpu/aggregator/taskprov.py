@@ -11,7 +11,7 @@ key from the peer's ``verify_key_init``, and provisions the task on the fly.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
@@ -29,7 +29,10 @@ class PeerAggregator:
 
     endpoint: str
     role: Role  # the PEER's role
-    verify_key_init: bytes  # 32 bytes
+    # Secret hygiene: VerifyKeyInit seeds every task's verify key — never in
+    # logs (reference: aggregator_core/src/taskprov.rs:17 wraps it in a
+    # Debug-redacting newtype).
+    verify_key_init: bytes = field(repr=False)  # 32 bytes
     collector_hpke_config: HpkeConfig
     report_expiry_age: Optional[Duration] = None
     tolerable_clock_skew: Duration = Duration(60)

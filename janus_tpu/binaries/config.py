@@ -21,11 +21,31 @@ class ConfigError(Exception):
     pass
 
 
+def redact_database_url(url: str) -> str:
+    """DB location safe for logs: the DSN password is dropped
+    (reference: config.rs:115-124 redacts the url in Debug output)."""
+    if "://" not in url:
+        return url  # SQLite file path: nothing secret
+    scheme, _, rest = url.partition("://")
+    authority, slash, tail = rest.partition("/")
+    # Userinfo lives only in the authority (an '@' in path/query is data),
+    # and only a userinfo WITH a password needs redacting.
+    if "@" in authority:
+        userinfo, _, host = authority.rpartition("@")
+        if ":" in userinfo:
+            user = userinfo.split(":", 1)[0]
+            return f"{scheme}://{user}:REDACTED@{host}{slash}{tail}"
+    return url
+
+
 @dataclass
 class DbConfig:
     """reference: config.rs:75 DbConfig"""
 
     path: str = "janus_tpu.sqlite3"
+
+    def __repr__(self) -> str:
+        return f"DbConfig(path={redact_database_url(self.path)!r})"
 
 
 @dataclass

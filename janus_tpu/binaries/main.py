@@ -33,6 +33,7 @@ from .config import (
     datastore_keys_from_env,
     load_config,
     parse_listen_address,
+    redact_database_url,
 )
 
 logger = logging.getLogger("janus_tpu.binaries")
@@ -44,6 +45,7 @@ def _bootstrap(config_common):
     install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
     clock = RealClock()
     crypter = Crypter(datastore_keys_from_env())
+    logger.info("datastore: %s", redact_database_url(config_common.database.path))
     datastore = Datastore(
         config_common.database.path,
         crypter,

@@ -9,7 +9,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from ..messages.dap import _b64url, _unb64url
 
@@ -31,7 +31,9 @@ class AuthenticationToken:
     DAP_AUTH = "DapAuth"
 
     kind: str
-    token: str
+    # Secret hygiene (reference: aggregator_core/src/lib.rs:28 SecretBytes
+    # redacts Debug output): the token never reaches logs through repr.
+    token: str = field(repr=False)
 
     def __post_init__(self):
         if self.kind == self.BEARER:

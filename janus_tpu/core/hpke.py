@@ -20,7 +20,7 @@ from __future__ import annotations
 import hashlib
 import hmac as _hmac
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
 from cryptography.hazmat.primitives.asymmetric import ec
@@ -252,7 +252,9 @@ class HpkeKeypair:
     """Public config + private key (reference: core/src/hpke.rs HpkeKeypair)."""
 
     config: HpkeConfig
-    private_key: bytes
+    # Secret hygiene: the private key never reaches logs through repr
+    # (reference: aggregator_core/src/lib.rs:28).
+    private_key: bytes = field(repr=False)
 
     @classmethod
     def generate(

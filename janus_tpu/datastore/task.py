@@ -80,7 +80,8 @@ class AggregatorTask:
     query_type: TaskQueryType
     vdaf: Dict[str, Any]  # serialized VdafInstance description
     role: Role
-    vdaf_verify_key: bytes
+    # Secret hygiene: never in logs (reference: aggregator_core/src/lib.rs:28).
+    vdaf_verify_key: bytes = field(repr=False)
     min_batch_size: int
     time_precision: Duration
     task_expiration: Optional[Time] = None
