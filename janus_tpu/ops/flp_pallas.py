@@ -88,6 +88,76 @@ def _wire_kernel(jf: JField, calls: int, m_ref, sw_ref, rch_ref, kl_ref,
         out_ref[0, l, :, :] = wire[l]
 
 
+def _sumvec_partial_kernel(jf: JField, kc: int, m_ref, klu_ref, lagk_ref,
+                           ev_ref, od_ref):
+    """Per-call-slab contraction for the SumVec circuit:
+
+        evens_part[u] = sum_k m[k,u] * klu[k,u]
+        odds_part[u]  = sum_k m[k,u] * lagk[k]
+
+    klu[k,u] = jr_k^(u+1) * lag_{k+1} varies over BOTH axes (the joint rand
+    is per-call and its power resets each call), so unlike the histogram
+    kernel the evens coefficient is a full tensor, computed slab-by-slab by
+    the caller so the 100k-element circuits never materialize it whole.
+    """
+    n = jf.n
+    UC = m_ref.shape[3]
+    shape = (UC, 128)
+    ev: List = None
+    od: List = None
+    for k in range(kc):
+        mk = [m_ref[0, l, k, :, :] for l in range(n)]
+        kluk = [klu_ref[0, l, k, :, :] for l in range(n)]
+        t1 = jf.mont_mul_limbs(mk, kluk)
+        ev = t1 if ev is None else jf.add_limbs(ev, t1)
+        lgk = [
+            jnp.broadcast_to(lagk_ref[0, l, k, :].reshape(1, 128), shape)
+            for l in range(n)
+        ]
+        t2 = jf.mont_mul_limbs(mk, lgk)
+        od = t2 if od is None else jf.add_limbs(od, t2)
+    for l in range(n):
+        ev_ref[0, l, :, :] = ev[l]
+        od_ref[0, l, :, :] = od[l]
+
+
+def sumvec_partial_planar(
+    jf: JField,
+    m_slab: jnp.ndarray,     # (R, n, KC, chunk_pad, 128) canonical
+    klu_slab: jnp.ndarray,   # (R, n, KC, chunk_pad, 128) Montgomery
+    lagk_slab: jnp.ndarray,  # (R, n, KC, 128) Montgomery
+    *,
+    interpret: bool = False,
+):
+    """One slab's (evens_part, odds_part), each (R, n, chunk_pad, 128)."""
+    R, n, kc, chunk_pad, _ = m_slab.shape
+    NJ = _uchunks(chunk_pad)
+    UC = chunk_pad // NJ
+    grid = (R, NJ)
+    kern = partial(_sumvec_partial_kernel, jf, kc)
+    out_shape = jax.ShapeDtypeStruct((R, n, chunk_pad, 128), jnp.uint32)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n, kc, UC, 128), lambda r, j: (r, 0, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, kc, UC, 128), lambda r, j: (r, 0, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, kc, 128), lambda r, j: (r, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, UC, 128), lambda r, j: (r, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, UC, 128), lambda r, j: (r, 0, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(m_slab, klu_slab, lagk_slab)
+
+
 def wire_evals_planar(
     jf: JField,
     m_pl: jnp.ndarray,      # (R, n, calls, chunk_pad, 128) canonical

@@ -472,6 +472,15 @@ class BatchedPrio3:
         lag = jf.mont_mul(others[:, :K], self.bary_c_m[None])  # (B, K, n)
         return lag, t_ok
 
+    def _gpoly_at(self, gpoly, t_m):
+        """Gadget polynomial at t.  Wide polynomials (the 100k-element
+        SumVec has glen=1023) use baby-step/giant-step evaluation —
+        Horner's glen-step serial chain is the launch's critical path."""
+        jf = self.jf
+        if gpoly.shape[1] >= 64:
+            return jf.poly_eval_mont(gpoly, t_m)
+        return jf.horner_mont(gpoly, t_m)
+
     def _gadget_outputs(self, gpoly, B):
         """gk (B, calls, n): the gadget polynomial at alpha^1..alpha^calls."""
         jf, circ = self.jf, self.circ
@@ -519,7 +528,7 @@ class BatchedPrio3:
         lag, t_ok = self._lagrange_coeffs(t_m)
         wire_evals = circ.wire_evals(jf, meas_m, jr_m, lag, seeds, self.consts)
 
-        gp_t = jf.horner_mont(gpoly, t_m)  # (B, n)
+        gp_t = self._gpoly_at(gpoly, t_m)  # (B, n)
 
         verifier = jnp.concatenate(
             [v[:, None], wire_evals, gp_t[:, None]], axis=1
@@ -631,14 +640,20 @@ class BatchedPrio3:
         """True when the limb-planar Pallas fast path serves this prep."""
         from .keccak_pallas import pallas_enabled
 
+        if isinstance(self.circ, _DHistogram):
+            # u16-half lazy meas_sum is exact only up to 65535 terms.
+            circuit_ok = self.flp.MEAS_LEN <= 65535
+        elif isinstance(self.circ, _DSumVec):
+            # bits > 1 would need a planar truncate (out_share != meas).
+            circuit_ok = self.flp.valid.bits == 1
+        else:
+            circuit_ok = False
         return (
             agg_id != 0
-            and isinstance(self.circ, _DHistogram)
+            and circuit_ok
             and self.prio3.num_proofs == 1
             and self.flp.JOINT_RAND_LEN > 0
-            # u16-half lazy sums (meas_sum, planar aggregate) are exact only
-            # while term counts stay <= 65535 (see JField._sum_lazy).
-            and self.flp.MEAS_LEN <= 65535
+            # planar aggregate's lazy batch sum is exact to 65535 terms.
             and batch <= 65535
             and pallas_enabled(batch)
         )
@@ -830,34 +845,39 @@ class BatchedPrio3:
         t_m = jf.to_mont(qr[:, 0])
         lag, t_ok = self._lagrange_coeffs(t_m)
         ok = ok & t_ok
-        kl, lagk, lag0, ccorr, r_ch = circ.planar_coeffs(jf, jr_m, lag, self.consts)
-        if cp != circ.chunk:
-            r_ch = jnp.pad(r_ch, ((0, 0), (0, cp - circ.chunk), (0, 0)))
-
-        wire_pl = wire_evals_planar(
-            jf,
-            m_pl,
-            sw_pl,
-            self._rows_to_planes_small(r_ch),
-            self._rows_to_planes_small(kl),
-            self._rows_to_planes_small(lagk),
-            self._rows_to_planes_small(lag0[:, None, :])[:, :, 0],
-            self._rows_to_planes_small(ccorr[:, None, :])[:, :, 0],
-            interpret=_pallas_interpret(),
-        )  # (R, n, 2*cp, 128)
-        wire = (
-            wire_pl.transpose(0, 3, 2, 1).reshape(B, 2 * cp, n)[:, : circ.arity]
-        )
-
-        # v from the lazily-summed measurement (exact; see JField._sum_lazy).
         gk = self._gadget_outputs(gpoly, B)
-        slo = jnp.sum(m_lp & np.uint32(0xFFFF), axis=2)  # (R, n, 128)
-        shi = jnp.sum(m_lp >> 16, axis=2)
-        meas_sum = jf.lazy_fold(
-            slo.transpose(0, 2, 1).reshape(B, n), shi.transpose(0, 2, 1).reshape(B, n)
-        )
-        v = circ.v_from_meas_sum(jf, gk, meas_sum, jr_m, self.consts)
-        gp_t = jf.horner_mont(gpoly, t_m)
+
+        if isinstance(circ, _DHistogram):
+            kl, lagk, lag0, ccorr, r_ch = circ.planar_coeffs(jf, jr_m, lag, self.consts)
+            if cp != circ.chunk:
+                r_ch = jnp.pad(r_ch, ((0, 0), (0, cp - circ.chunk), (0, 0)))
+            wire_pl = wire_evals_planar(
+                jf,
+                m_pl,
+                sw_pl,
+                self._rows_to_planes_small(r_ch),
+                self._rows_to_planes_small(kl),
+                self._rows_to_planes_small(lagk),
+                self._rows_to_planes_small(lag0[:, None, :])[:, :, 0],
+                self._rows_to_planes_small(ccorr[:, None, :])[:, :, 0],
+                interpret=_pallas_interpret(),
+            )  # (R, n, 2*cp, 128)
+            wire = (
+                wire_pl.transpose(0, 3, 2, 1).reshape(B, 2 * cp, n)[:, : circ.arity]
+            )
+            # v from the lazily-summed measurement (see JField._sum_lazy).
+            slo = jnp.sum(m_lp & np.uint32(0xFFFF), axis=2)  # (R, n, 128)
+            shi = jnp.sum(m_lp >> 16, axis=2)
+            meas_sum = jf.lazy_fold(
+                slo.transpose(0, 2, 1).reshape(B, n),
+                shi.transpose(0, 2, 1).reshape(B, n),
+            )
+            v = circ.v_from_meas_sum(jf, gk, meas_sum, jr_m, self.consts)
+        else:  # _DSumVec
+            wire = self._sumvec_wires_planar(m_pl, sw_pl, jr_m, lag, cp)
+            v = jf.sum(gk, axis=1)
+
+        gp_t = self._gpoly_at(gpoly, t_m)
         verifier = jnp.concatenate([v[:, None], wire, gp_t[:, None]], axis=1)
 
         return {
@@ -867,6 +887,88 @@ class BatchedPrio3:
             "joint_rand_part": part,
             "corrected_seed": corrected,
         }
+
+    def _planar_add(self, a, b):
+        """Modular add on (R, n, ..., 128) planar tensors (limb axis 1)."""
+        jf = self.jf
+        return jnp.stack(
+            jf.add_limbs([a[:, l] for l in range(jf.n)], [b[:, l] for l in range(jf.n)]),
+            axis=1,
+        )
+
+    def _sumvec_wires_planar(self, m_pl, sw_pl, jr_m, lag, cp):
+        """SumVec wire evaluations via per-call-slab Pallas contractions.
+
+        evens[u] = sum_k m[k,u] * jr_k^(u+1) * lag_{k+1};
+        odds[u]  = sum_k m[k,u] * lag_{k+1}  -  ccorr;
+        wire     = seeds * lag_0 + zip(evens, odds).
+
+        The evens coefficient klu = jr_k^(u+1) * lag_{k+1} varies over BOTH
+        axes (per-call joint rand, power resetting each call), so unlike the
+        histogram it cannot fold into a per-call scalar.  It is generated
+        and consumed slab-by-slab over the calls axis (lax.scan) so the
+        wide-vector circuits — calls=317 for the 100k-element SumVec —
+        never materialize a meas-sized coefficient tensor, and each slab's
+        contraction runs in the limb-planar kernel.  Exact mod-p identities
+        throughout: limbs match the row path (tests/test_prepare.py).
+        """
+        from .flp_pallas import _pallas_interpret, sumvec_partial_planar
+
+        jf, circ = self.jf, self.circ
+        R, n, calls, _, _ = m_pl.shape
+        B = R * 128
+        lag0, lagk = lag[:, 0], lag[:, 1:]
+        lag_sum = jf.sum(lagk, axis=1)
+        c = jnp.broadcast_to(self.consts["shares_inv_c"], lag_sum.shape)
+        ccorr = jf.mont_mul(c, lag_sum)
+
+        KC = min(calls, 8)
+        calls_pad = -(-calls // KC) * KC
+        if calls_pad != calls:
+            pad = calls_pad - calls
+            # zero meas + zero lagk make pad calls contribute exactly 0.
+            m_pl = jnp.pad(m_pl, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+            lagk = jnp.pad(lagk, ((0, 0), (0, pad), (0, 0)))
+            jr_m = jnp.pad(jr_m, ((0, 0), (0, pad), (0, 0)))
+        NS = calls_pad // KC
+        interpret = _pallas_interpret()
+
+        def slab(s):
+            m_slab = lax.dynamic_slice_in_dim(m_pl, s * KC, KC, axis=2)
+            jr_s = lax.dynamic_slice_in_dim(jr_m, s * KC, KC, axis=1)
+            lagk_s = lax.dynamic_slice_in_dim(lagk, s * KC, KC, axis=1)
+            jr_b = jnp.broadcast_to(jr_s[:, :, None, :], (B, KC, circ.chunk, jf.n))
+            r_pows = jf.cumprod_mont(jr_b, axis=2)  # jr_k^(u+1) * R
+            klu = jf.mont_mul(
+                r_pows, jnp.broadcast_to(lagk_s[:, :, None, :], r_pows.shape)
+            )
+            if cp != circ.chunk:
+                klu = jnp.pad(klu, ((0, 0), (0, 0), (0, cp - circ.chunk), (0, 0)))
+            klu_pl = klu.reshape(R, 128, KC, cp, jf.n).transpose(0, 4, 2, 3, 1)
+            lagk_pl = self._rows_to_planes_small(lagk_s)
+            return sumvec_partial_planar(
+                jf, m_slab, klu_pl, lagk_pl, interpret=interpret
+            )
+
+        ev, od = slab(0)
+        if NS > 1:
+            def body(carry, s):
+                ev_c, od_c = carry
+                ev_p, od_p = slab(s)
+                return (
+                    self._planar_add(ev_c, ev_p),
+                    self._planar_add(od_c, od_p),
+                ), None
+
+            (ev, od), _ = lax.scan(body, (ev, od), jnp.arange(1, NS))
+
+        evens_row = ev.transpose(0, 3, 2, 1).reshape(B, cp, n)[:, : circ.chunk]
+        odds_row = od.transpose(0, 3, 2, 1).reshape(B, cp, n)[:, : circ.chunk]
+        odds_row = jf.sub(odds_row, jnp.broadcast_to(ccorr[:, None, :], odds_row.shape))
+        sw_row = sw_pl.transpose(0, 3, 2, 1).reshape(B, 2 * cp, n)[:, : circ.arity]
+        se = jf.mont_mul(sw_row, jnp.broadcast_to(lag0[:, None, :], sw_row.shape))
+        pair = jnp.stack([evens_row, odds_row], axis=2).reshape(B, circ.arity, n)
+        return jf.add(se, pair)
 
     # -- prep shares -> prep message ------------------------------------
     def prep_shares_to_prep(

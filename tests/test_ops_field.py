@@ -185,3 +185,41 @@ def test_batched_shapes(field):
     assert s.shape == (3, 5, jf.n)
     m = np.asarray(jf.mont_mul(jf.to_mont(a), jf.to_mont(b)))
     assert m.shape == (3, 5, jf.n)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "fields,widths",
+    [
+        pytest.param(("Field64",), (5, 64), id="narrow"),
+        pytest.param(("Field64", "Field128"), (1, 100, 1023), id="wide"),
+    ],
+)
+def test_poly_eval_bsgs_matches_horner_wide(fields, widths):
+    # Slow tier: each (field, C) shape cold-compiles for minutes under the
+    # 8-virtual-device CPU conftest; the identity also holds on the real
+    # chip via bench parity.
+    """poly_eval_mont (baby-step/giant-step) is limb-identical to Horner —
+    _gpoly_at routes every glen >= 64 circuit through it."""
+    import random
+
+    import jax.numpy as jnp
+
+    from janus_tpu import fields as fmod
+
+    random.seed(11)
+    for fname in fields:
+        F = getattr(fmod, fname)
+        jf = JField(F)
+        for C in widths:
+            B = 2
+            coeffs = jnp.asarray(
+                jf.to_limbs([random.randrange(F.MODULUS) for _ in range(B * C)]).reshape(
+                    B, C, jf.n
+                )
+            )
+            xs = [0, 1] + [random.randrange(F.MODULUS) for _ in range(B - 2)]
+            x = jf.to_mont(jnp.asarray(jf.to_limbs(xs[:B]).reshape(B, jf.n)))
+            a = np.asarray(jf.horner_mont(coeffs, x))
+            b = np.asarray(jf.poly_eval_mont(coeffs, x))
+            assert np.array_equal(a, b), (F.__name__, C)

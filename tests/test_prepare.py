@@ -365,3 +365,43 @@ def test_planar_prep_matches_row_path(monkeypatch):
     agg_row = np.asarray(jax.jit(bp.aggregate)(row["out_share"], mask))
     agg_pl = np.asarray(jax.jit(bp.aggregate)(pl["out_share"], mask))
     assert np.array_equal(agg_row, agg_pl)
+
+
+@pytest.mark.slow
+def test_planar_sumvec_matches_row_path(monkeypatch):
+    """SumVec limb-planar path (call-slab scan + klu kernel) byte-matches
+    the row path, including the calls-axis padding (calls=10 -> KC=8, two
+    slabs, 6 zero pad calls).  Interpret mode; slow tier."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("JANUS_TPU_PALLAS", "interpret")
+    vdaf = prio3_sum_vec(length=40, bits=1, chunk_length=4)
+    bp = BatchedPrio3(vdaf)
+    B = 1024
+    rng = np.random.default_rng(6)
+    kw = dict(
+        nonces_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        share_seeds_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        blinds_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        public_parts_u8=jnp.asarray(rng.integers(0, 256, (B, 2, 16), dtype=np.uint8)),
+    )
+    vk = b"\x2a" * 16
+    assert bp.planar_eligible(1, B)
+    row = jax.jit(lambda kw: bp.prep_init(1, verify_key=vk, **kw))(kw)
+    pl = jax.jit(
+        lambda kw: bp.prep_init_planar(
+            1,
+            vk,
+            kw["nonces_u8"],
+            share_seeds_u8=kw["share_seeds_u8"],
+            blinds_u8=kw["blinds_u8"],
+            public_parts_u8=kw["public_parts_u8"],
+        )
+    )(kw)
+    for k in ("verifiers", "ok", "joint_rand_part", "corrected_seed"):
+        assert np.array_equal(np.asarray(row[k]), np.asarray(pl[k])), k
+    osp = np.asarray(pl["out_share"])
+    R, n, L, _ = osp.shape
+    assert np.array_equal(
+        np.asarray(row["out_share"]), osp.transpose(0, 3, 2, 1).reshape(B, L, n)
+    )
