@@ -888,6 +888,16 @@ class BatchedPrio3:
             "corrected_seed": corrected,
         }
 
+    @staticmethod
+    def planar_out_share_to_rows(osp):
+        """(R, n, L, 128) planar out shares -> row-major (B, L, n).
+
+        The single place that knows the planar out_share layout outside the
+        planar pipeline itself (report b lives at (b // 128, ..., b % 128)).
+        """
+        R, n, L, _ = osp.shape
+        return osp.transpose(0, 3, 2, 1).reshape(R * 128, L, n)
+
     def _planar_add(self, a, b):
         """Modular add on (R, n, ..., 128) planar tensors (limb axis 1)."""
         jf = self.jf

@@ -92,16 +92,46 @@ class TpuBackend:
         self._agg_fn = None
 
     # -- jit caches ------------------------------------------------------
+    #: MeshBackend overrides to False: Pallas custom calls do not partition
+    #: under a sharded jit, so the planar fast path is single-chip only
+    #: (each chip of a mesh still runs it inside its own shard via the
+    #: driver's per-chip launches; the mesh prepare path stays row-major).
+    _planar_capable = True
+
     def _prep_fn(self, agg_id: int):
         # verify_key flows as a traced input (it is per-task data), so one
         # compilation per agg_id serves every task.
         fn = self._prep_fns.get(agg_id)
         if fn is None:
-            fn = self._jax.jit(
-                lambda kw: self.bp.prep_init(
-                    agg_id, verify_key=kw.pop("verify_key_u8"), **kw
-                )
-            )
+
+            def prep(kw):
+                vk = kw.pop("verify_key_u8")
+                B = kw["nonces_u8"].shape[0]
+                if (
+                    self._planar_capable
+                    and "share_seeds_u8" in kw
+                    and "blinds_u8" in kw
+                    and self.bp.planar_eligible(agg_id, B)
+                ):
+                    # Limb-planar fast path (the bench pipeline): outputs
+                    # are identical; out_share transposes back to row-major
+                    # for the unmarshal/aggregate interfaces.
+                    out = self.bp.prep_init_planar(
+                        agg_id,
+                        vk,
+                        kw["nonces_u8"],
+                        share_seeds_u8=kw["share_seeds_u8"],
+                        blinds_u8=kw["blinds_u8"],
+                        public_parts_u8=kw["public_parts_u8"],
+                    )
+                    out = dict(
+                        out,
+                        out_share=self.bp.planar_out_share_to_rows(out["out_share"]),
+                    )
+                    return out
+                return self.bp.prep_init(agg_id, verify_key=vk, **kw)
+
+            fn = self._jax.jit(prep)
             self._prep_fns[agg_id] = fn
         return fn
 
@@ -365,6 +395,7 @@ class MeshBackend(TpuBackend):
     """
 
     name = "mesh"
+    _planar_capable = False  # see TpuBackend._planar_capable
 
     def __init__(self, vdaf: Prio3, devices=None):
         super().__init__(vdaf)

@@ -97,3 +97,42 @@ def test_backends_agree_on_job():
             # Healthy reports finish: prep_next accepts on both states.
             state = t_init[0][b][0]
             assert vdaf.prep_next(state, t_comb[b]) == state.out_share
+
+
+@pytest.mark.slow
+def test_tpu_backend_planar_routing_matches_oracle(monkeypatch):
+    """At planar-eligible batch sizes (B % 1024 == 0, pallas on) the
+    TpuBackend routes prep through prep_init_planar; outcomes must equal
+    the oracle's exactly, incl. the out_share row-major re-transpose.
+    Interpret mode, slow tier; the row path is covered by the default
+    suite (on CPU pallas is off, so planar_eligible is False there)."""
+    monkeypatch.setenv("JANUS_TPU_PALLAS", "interpret")
+    vdaf = vdaf_from_instance({"type": "Prio3Histogram", "length": 2, "chunk_length": 1})
+    rng = det_rng("planar-routing")
+    verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+    reports = []
+    for i in range(1000):  # pads to 1024 -> planar-eligible
+        nonce = rng(vdaf.NONCE_SIZE)
+        ps, shares = vdaf.shard(i % 2, nonce, rng(vdaf.RAND_SIZE))
+        reports.append((nonce, ps, shares[1]))
+
+    tpu = TpuBackend(vdaf)
+    assert tpu.bp.planar_eligible(1, 1024)
+    # Spy that the planar path actually traces (identical outcomes would
+    # also come from a silent row-path regression).
+    routed = []
+    orig = tpu.bp.prep_init_planar
+    monkeypatch.setattr(
+        tpu.bp,
+        "prep_init_planar",
+        lambda *a, **kw: (routed.append(True), orig(*a, **kw))[1],
+    )
+    outcomes = tpu.prep_init_batch(verify_key, 1, reports)
+    assert routed, "TpuBackend did not route through prep_init_planar"
+    oracle = OracleBackend(vdaf)
+    expect = oracle.prep_init_batch(verify_key, 1, reports[:8])
+    for got, want in zip(outcomes[:8], expect):
+        assert got[0].out_share == want[0].out_share
+        assert got[0].corrected_joint_rand_seed == want[0].corrected_joint_rand_seed
+        assert got[1].verifiers_share == want[1].verifiers_share
+        assert got[1].joint_rand_part == want[1].joint_rand_part
