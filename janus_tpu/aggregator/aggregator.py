@@ -20,6 +20,7 @@ from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.auth_tokens import AuthenticationToken
+from ..core.dp import dp_strategy_from_dict
 from ..core.hpke import HpkeApplicationInfo, HpkeError, HpkeKeypair, Label, open_, seal
 from ..core.time import Clock, interval_merge, time_add, time_to_batch_interval
 from ..datastore import (
@@ -1100,7 +1101,7 @@ class Aggregator:
                     or cached.checksum.data != req.checksum.data
                 ):
                     raise BatchMismatch("cached aggregate share mismatch")
-                return cached.helper_aggregate_share
+                return cached.helper_aggregate_share, None
 
             share, count, checksum, _interval = compute_aggregate_share(
                 task, ta.vdaf, tx, ident, req.aggregation_parameter
@@ -1114,31 +1115,72 @@ class Aggregator:
                 raise InvalidBatchSize(f"batch too small: {count}")
             if share is None:
                 raise InvalidBatchSize("empty batch")
-            encoded = ta.vdaf.field_for_agg_param(
-                ta.vdaf.decode_agg_param(req.aggregation_parameter)
-            ).encode_vec(share)
-            tx.put_aggregate_share_job(
-                AggregateShareJob(
-                    task_id=task_id,
-                    batch_identifier=ident,
-                    aggregation_parameter=req.aggregation_parameter,
-                    helper_aggregate_share=encoded,
-                    report_count=count,
-                    checksum=checksum,
-                )
-            )
-            # scrub contributing batch aggregations (reference: :2878-3123)
-            for bident in strategy.batch_identifiers_for_collection_identifier(
-                task, ident
-            ):
-                for ba in tx.get_batch_aggregations_for_batch(
-                    task_id, bident, req.aggregation_parameter
-                ):
-                    if ba.state == BatchAggregationState.AGGREGATING:
-                        tx.update_batch_aggregation(ba.scrubbed())
-            return encoded
+            return None, (share, count, checksum)
 
-        encoded_share = await self.datastore.run_tx_async("aggregate_share", tx_fn)
+        encoded_share, computed = await self.datastore.run_tx_async(
+            "aggregate_share", tx_fn
+        )
+        if computed is not None:
+            share, count, checksum = computed
+            # Helper-side DP noise (reference: aggregator.rs:3005
+            # add_noise_to_agg_share): the helper noises its share
+            # independently of the leader so the zCDP guarantee holds
+            # against a collector colluding with either aggregator.  The
+            # exact-rational sampler runs OUTSIDE any transaction (it can
+            # take seconds on wide shares) and off the event loop.
+            field = ta.vdaf.field_for_agg_param(
+                ta.vdaf.decode_agg_param(req.aggregation_parameter)
+            )
+            strategy_dp = dp_strategy_from_dict(task.vdaf.get("dp_strategy"))
+            encoded_share = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: field.encode_vec(
+                    strategy_dp.add_noise_to_agg_share(ta.vdaf, share, count)
+                ),
+            )
+
+            def tx_store(tx):
+                # Re-check the cache: a concurrent request may have stored
+                # its (differently-noised) job first — serve THAT share so
+                # repeated requests stay byte-identical.
+                cached = tx.get_aggregate_share_job(
+                    task_id, ident, req.aggregation_parameter
+                )
+                if cached is not None:
+                    if (
+                        cached.report_count != req.report_count
+                        or cached.checksum.data != req.checksum.data
+                    ):
+                        raise BatchMismatch("cached aggregate share mismatch")
+                    return cached.helper_aggregate_share
+                tx.put_aggregate_share_job(
+                    AggregateShareJob(
+                        task_id=task_id,
+                        batch_identifier=ident,
+                        aggregation_parameter=req.aggregation_parameter,
+                        helper_aggregate_share=encoded_share,
+                        report_count=count,
+                        checksum=checksum,
+                    )
+                )
+                # Scrub contributing batch aggregations ATOMICALLY with the
+                # job insert (reference: :2878-3123): if this transaction
+                # fails, the un-scrubbed aggregations still support a clean
+                # retry; once it commits, every later request is served
+                # from the cache and never recomputes over scrubbed rows.
+                for bident in strategy.batch_identifiers_for_collection_identifier(
+                    task, ident
+                ):
+                    for ba in tx.get_batch_aggregations_for_batch(
+                        task_id, bident, req.aggregation_parameter
+                    ):
+                        if ba.state == BatchAggregationState.AGGREGATING:
+                            tx.update_batch_aggregation(ba.scrubbed())
+                return encoded_share
+
+            encoded_share = await self.datastore.run_tx_async(
+                "aggregate_share_store", tx_store
+            )
         aad = AggregateShareAad(
             task_id, req.aggregation_parameter, req.batch_selector
         ).get_encoded()

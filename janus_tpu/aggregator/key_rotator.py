@@ -22,8 +22,10 @@ the state machine inside a single transaction:
               copies of the keypair keep decrypting in-flight reports).
 
 Every transition is clock-driven and idempotent, so N replicas may run the
-rotator concurrently against the shared datastore (the transaction retry
-loop serializes them).
+rotator concurrently against the shared datastore: serialization-failure
+retries cover state flips, and an insert race on a fresh config id (two
+replicas staging the same slot — a unique violation, which run_tx does NOT
+retry) is swallowed as success since the losing tick's goal already holds.
 """
 
 from __future__ import annotations
@@ -32,7 +34,7 @@ import logging
 from dataclasses import dataclass
 
 from ..core.hpke import HpkeKeypair
-from ..datastore.datastore import Datastore
+from ..datastore.datastore import Datastore, TxConflict
 from ..datastore.models import HpkeKeyState
 from ..messages import Duration
 
@@ -54,10 +56,19 @@ class HpkeKeyRotator:
         self.config = config or KeyRotatorConfig()
 
     async def run(self) -> None:
-        await self.datastore.run_tx_async("key_rotator", self._tick)
+        try:
+            await self.datastore.run_tx_async("key_rotator", self._tick)
+        except TxConflict:
+            # Another replica's rotator inserted the same config id in a
+            # concurrent tick (run_tx does not retry unique violations).
+            # The tick's goal — a key exists in that slot — is satisfied.
+            logger.info("key rotator tick lost an insert race; treating as done")
 
     def run_sync(self) -> None:
-        self.datastore.run_tx("key_rotator", self._tick)
+        try:
+            self.datastore.run_tx("key_rotator", self._tick)
+        except TxConflict:
+            logger.info("key rotator tick lost an insert race; treating as done")
 
     def _next_config_id(self, keypairs) -> int:
         used = {kp.config.id for kp in keypairs}

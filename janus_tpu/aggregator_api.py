@@ -340,15 +340,30 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
     async def post_taskprov_peer(request: web.Request):
         from .aggregator.taskprov import PeerAggregator
 
-        body = await request.json()
-        role = Role[body["peer_role"].upper()]
-        if role not in (Role.LEADER, Role.HELPER):
-            # Matching the reference routes: a peer AGGREGATOR is one of the
-            # two aggregator roles; anything else would store an unusable
-            # peer and silently drop its auth token.
-            raise ValueError("peer_role must be Leader or Helper")
-        vk_init = _unb64u(body["verify_key_init"])
-        peer = PeerAggregator(
+        try:
+            body = await request.json()
+            role = Role[body["peer_role"].upper()]
+            if role not in (Role.LEADER, Role.HELPER):
+                # Matching the reference routes: a peer AGGREGATOR is one of
+                # the two aggregator roles; anything else would store an
+                # unusable peer and silently drop its auth token.
+                raise ValueError("peer_role must be Leader or Helper")
+            vk_init = _unb64u(body["verify_key_init"])
+            peer = _build_peer(PeerAggregator, body, role, vk_init)
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        try:
+            await datastore.run_tx_async(
+                "api_post_taskprov_peer", lambda tx: tx.put_taskprov_peer_aggregator(peer)
+            )
+        except TxConflict as e:
+            # insert-only, as in the reference (routes.rs:416-421): delete
+            # then re-create to change an existing peer.
+            return web.json_response({"error": str(e)}, status=409)
+        return ok_json(_peer_to_json(peer), status=201)
+
+    def _build_peer(PeerAggregator, body, role, vk_init):
+        return PeerAggregator(
             endpoint=body["endpoint"],
             role=role,
             verify_key_init=vk_init,
@@ -377,22 +392,17 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
             if body.get("collector_auth_token")
             else None,
         )
-        try:
-            await datastore.run_tx_async(
-                "api_post_taskprov_peer", lambda tx: tx.put_taskprov_peer_aggregator(peer)
-            )
-        except TxConflict as e:
-            # insert-only, as in the reference (routes.rs:416-421): delete
-            # then re-create to change an existing peer.
-            return web.json_response({"error": str(e)}, status=409)
-        return ok_json(_peer_to_json(peer), status=201)
 
     async def delete_taskprov_peer(request: web.Request):
-        body = await request.json()
-        role = Role[body["peer_role"].upper()]
+        try:
+            body = await request.json()
+            role = Role[body["peer_role"].upper()]
+            endpoint = body["endpoint"]
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
 
         def tx_fn(tx):
-            tx.delete_taskprov_peer_aggregator(body["endpoint"], role)
+            tx.delete_taskprov_peer_aggregator(endpoint, role)
 
         try:
             await datastore.run_tx_async("api_delete_taskprov_peer", tx_fn)

@@ -761,7 +761,7 @@ class BatchedPrio3:
         """Helper prep in the limb-planar layout (histogram family).
 
         Same outputs as prep_init except ``out_share`` stays limb-planar
-        (n, OUTPUT_LEN, R, 128) — ``aggregate`` consumes either layout.  The
+        (R, n, OUTPUT_LEN, 128) — ``aggregate`` consumes either layout.  The
         XOF squeeze planes feed the Pallas wire kernel directly; nothing
         batch-wide is lane-transposed except the (small) verifier tensor.
         """

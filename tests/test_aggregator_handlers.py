@@ -457,6 +457,80 @@ class TestAggregateShare:
                 )
             )
 
+    def test_helper_share_gets_dp_noise(self, env):
+        """A ZCdpDiscreteGaussian task noises the HELPER's aggregate share
+        too (reference: aggregator.rs:3005) — the collector's unsharded
+        total must carry both aggregators' noise, not just the leader's."""
+        ds, agg = env
+        measurements = (2, 3, 2)
+        leader, helper, collector = make_pair_tasks(
+            {
+                "type": "Prio3Histogram",
+                "length": 8,
+                "chunk_length": 3,
+                "dp_strategy": {
+                    "dp_mechanism": "ZCdpDiscreteGaussian",
+                    "epsilon": [1, 100],
+                },
+            }
+        )
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, states, reports = leader_prep_inits(vdaf, leader, helper, measurements)
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        resp = run(
+            agg.handle_aggregate_init(
+                helper.task_id, AggregationJobId.random(), req.get_encoded(), AGG_TOKEN
+            )
+        )
+        leader_out = []
+        checksum = ReportIdChecksum.zero()
+        for pr, state, report in zip(resp.prepare_resps, states, reports):
+            leader_out.append(pp.leader_continued(vdaf, state, pr.result.message).out_share)
+            checksum = checksum_updated_with(checksum, report.metadata.report_id)
+        share_req = AggregateShareReq(
+            batch_selector=BatchSelector.new_time_interval(
+                Interval(NOW, TIME_PRECISION)
+            ),
+            aggregation_parameter=b"",
+            report_count=len(measurements),
+            checksum=checksum,
+        )
+        out = run(
+            agg.handle_aggregate_share(
+                helper.task_id, share_req.get_encoded(), AGG_TOKEN
+            )
+        )
+        from janus_tpu.messages import AggregateShareAad
+
+        aad = AggregateShareAad(
+            helper.task_id, b"", share_req.batch_selector
+        ).get_encoded()
+        helper_share = vdaf.field.decode_vec(
+            open_(
+                collector,
+                HpkeApplicationInfo.new(
+                    Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR
+                ),
+                out.encrypted_aggregate_share,
+                aad,
+            )
+        )
+        # Exact (un-noised) helper share = measurements minus the leader's
+        # out shares; sigma ~ 141 over 8 coordinates makes an all-zero
+        # noise vector astronomically unlikely.
+        f = vdaf.field
+        exact = [0] * 8
+        for m in measurements:
+            exact[m] = (exact[m] + 1) % f.MODULUS
+        leader_agg = vdaf.aggregate(leader_out)
+        exact_helper = [(e - l) % f.MODULUS for e, l in zip(exact, leader_agg)]
+        assert helper_share != exact_helper
+
 
 class TestMultiRoundDummy:
     def test_init_then_continue(self, env):
