@@ -14,7 +14,7 @@
 # JAX_PLATFORMS=cpu (the interop topology in docker-compose.yml does this).
 FROM python:3.12-slim
 
-RUN pip install --no-cache-dir "jax[cpu]" aiohttp cryptography prometheus-client pyyaml click
+RUN pip install --no-cache-dir "jax[cpu]" aiohttp cryptography prometheus-client pyyaml click "psycopg[binary]"
 
 WORKDIR /app
 COPY janus_tpu /app/janus_tpu

@@ -82,7 +82,7 @@ from .models import (
     ReportAggregationState,
     TaskUploadCounter,
 )
-from .schema import SCHEMA, SCHEMA_VERSION
+from .schema import MIGRATIONS, SUPPORTED_SCHEMA_VERSIONS
 from .task import AggregatorTask, TaskQueryType
 
 T = TypeVar("T")
@@ -139,6 +139,8 @@ class Datastore:
         crypter: Crypter,
         clock: Clock,
         max_transaction_retries: int = 30,
+        migrate_on_open: bool = True,
+        _migrations_override: Optional[List[str]] = None,
     ):
         from .backend_sql import backend_for
 
@@ -147,6 +149,11 @@ class Datastore:
         self.crypter = crypter
         self.clock = clock
         self.max_transaction_retries = max_transaction_retries
+        #: True (hermetic default): apply pending schema migrations on open.
+        #: False: the production deploy shape — an operator migrates, the
+        #: binary only checks SUPPORTED_SCHEMA_VERSIONS.
+        self.migrate_on_open = migrate_on_open
+        self._migrations_override = _migrations_override  # tests only
         self._local = threading.local()
         self._init_schema()
 
@@ -158,20 +165,68 @@ class Datastore:
             self._local.conn = conn
         return conn
 
+    def _current_schema_version(self, conn) -> int:
+        """Transaction-safe: probes the catalog first, so a missing table
+        never errors (a failed SELECT would abort a Postgres transaction)."""
+        exists = conn.execute(
+            self.backend.table_exists_sql, ("schema_version",)
+        ).fetchone()
+        if exists is None:
+            return 0
+        row = conn.execute("SELECT version FROM schema_version").fetchone()
+        return 0 if row is None else int(row[0])
+
     def _init_schema(self) -> None:
         conn = self._conn()
-        self.backend.init_schema(conn, SCHEMA)
-        row = conn.execute("SELECT version FROM schema_version").fetchone()
-        if row is None:
-            conn.execute(
-                "INSERT INTO schema_version (version) VALUES (?)", (SCHEMA_VERSION,)
-            )
-            conn.commit()
-        elif row[0] != SCHEMA_VERSION:
-            # reference: supported_schema_versions! (datastore.rs:77-104)
+        current = self._current_schema_version(conn)
+        migrations = self._migrations_override or MIGRATIONS
+        target = len(migrations)
+        if current > target:
             raise DatastoreError(
-                f"unsupported schema version {row[0]} (want {SCHEMA_VERSION})"
+                f"database schema version {current} is newer than this build "
+                f"supports ({target}); refusing to touch it"
             )
+        if not self.migrate_on_open:
+            # Production deploy shape: an operator applies migrations; the
+            # binary only gates (reference: supported_schema_versions!,
+            # datastore.rs:77-104).
+            supported = (
+                (target,) if self._migrations_override else SUPPORTED_SCHEMA_VERSIONS
+            )
+            if current not in supported:
+                raise DatastoreError(
+                    f"unsupported schema version {current} "
+                    f"(supported: {supported})"
+                )
+            return
+        for v in range(current, target):
+            # One migration per transaction, DDL and version stamp TOGETHER:
+            # a crash can never commit DDL without advancing the stamp, so
+            # non-idempotent future migrations stay re-runnable.  (SQLite
+            # runs DDL transactionally; Postgres supports transactional DDL
+            # outright.)  The version is RE-READ under the write lock:
+            # concurrent replica startups serialize here, and a replica
+            # that lost the race skips the migration another already
+            # applied instead of double-applying it.
+            conn.execute(self.backend.begin_sql)
+            try:
+                if self._current_schema_version(conn) != v:
+                    conn.rollback()
+                    continue
+                self.backend.init_schema(conn, migrations[v])
+                if v == 0:
+                    conn.execute(
+                        "INSERT INTO schema_version (version) VALUES (?)", (1,)
+                    )
+                else:
+                    conn.execute("UPDATE schema_version SET version = ?", (v + 1,))
+                conn.commit()
+            except BaseException:
+                try:
+                    conn.rollback()
+                except Exception:
+                    pass
+                raise
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)

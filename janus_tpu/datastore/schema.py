@@ -1,21 +1,24 @@
-"""Datastore SQL schema.
+"""Datastore SQL schema + migrations.
 
 The analog of the reference's migrations (reference:
-db/00000000000001_initial_schema.up.sql).  SQLite dialect: BLOBs for ids and
-ciphertexts, INTEGER seconds for times/durations, TEXT for JSON-serialized
-enums/configs.  Structure (tables, uniqueness, indexes incl. the partial
-index on unaggregated reports and lease-expiry indexes) mirrors the
-reference schema; GiST interval indexes become ordinary (start, end) b-trees.
+db/00000000000001_initial_schema.up.sql and siblings).  SQLite dialect:
+BLOBs for ids and ciphertexts, INTEGER seconds for times/durations, TEXT for
+JSON-serialized enums/configs.  Structure (tables, uniqueness, indexes incl.
+the partial index on unaggregated reports and lease-expiry indexes) mirrors
+the reference schema; GiST interval indexes become ordinary (start, end)
+b-trees.
 
-``SCHEMA_VERSION`` guards compatibility the way the reference's
-``supported_schema_versions!`` does (aggregator_core/src/datastore.rs:77-104).
+``MIGRATIONS[k]`` is the DDL taking a version-k store to version k+1; a
+fresh database applies all of them in order, an existing one only the tail
+past its stamped version (Datastore._init_schema).  The binary-side
+compatibility gate is ``SUPPORTED_SCHEMA_VERSIONS``, the analog of the
+reference's ``supported_schema_versions!``
+(aggregator_core/src/datastore.rs:77-104): with migrate_on_open disabled
+(the production deploy shape, where an operator runs migrations), the
+datastore refuses to operate on any version not in this set.
 """
 
-SCHEMA_VERSION = 1
-
-SCHEMA = """
-PRAGMA journal_mode = WAL;
-
+_INITIAL_SCHEMA = """
 CREATE TABLE IF NOT EXISTS schema_version (
     version INTEGER NOT NULL
 );
@@ -230,3 +233,15 @@ CREATE TABLE IF NOT EXISTS task_upload_counters (
     UNIQUE(task_id, ord)
 );
 """
+
+#: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
+#: edit an entry that has shipped (existing stores have already applied it).
+MIGRATIONS = [_INITIAL_SCHEMA]
+
+SCHEMA_VERSION = len(MIGRATIONS)
+
+#: Versions this build can operate against without migrating.
+SUPPORTED_SCHEMA_VERSIONS = (SCHEMA_VERSION,)
+
+#: Back-compat alias (full schema for a fresh store at version 1).
+SCHEMA = _INITIAL_SCHEMA

@@ -691,3 +691,65 @@ class TestUploadCounters:
         got = ds.run_tx("get", lambda tx: tx.get_task_upload_counter(task.task_id))
         assert got.report_success == 6
         assert got.report_decode_failure == 0
+
+
+class TestSchemaMigrations:
+    """Versioned migrations applied on open + the supported-version gate
+    (reference: supported_schema_versions!, datastore.rs:77-104; sqlx
+    migrations under /db)."""
+
+    _key = generate_key()
+
+    def _open(self, path, clock, **kw):
+        from janus_tpu.datastore.datastore import Datastore
+
+        return Datastore(path, Crypter([self._key]), clock, **kw)
+
+    def test_upgrade_applies_only_the_tail(self, tmp_path):
+        from janus_tpu.datastore.schema import MIGRATIONS
+
+        clock = MockClock()
+        path = str(tmp_path / "m.sqlite3")
+        ds1 = self._open(path, clock)
+        task = make_task(Role.LEADER)
+        ds1.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        ds1.close()
+
+        m2 = "CREATE TABLE IF NOT EXISTS migration_probe (id INTEGER PRIMARY KEY);"
+        ds2 = self._open(path, clock, _migrations_override=list(MIGRATIONS) + [m2])
+        conn = ds2._conn()
+        assert conn.execute("SELECT version FROM schema_version").fetchone()[0] == 2
+        conn.execute("INSERT INTO migration_probe (id) VALUES (1)")
+        # v1 data survives the upgrade
+        got = ds2.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id))
+        assert got is not None and got.task_id == task.task_id
+        ds2.close()
+
+    def test_future_version_refused(self, tmp_path):
+        clock = MockClock()
+        path = str(tmp_path / "f.sqlite3")
+        ds = self._open(path, clock)
+        conn = ds._conn()
+        conn.execute("UPDATE schema_version SET version = 99")
+        conn.commit()
+        ds.close()
+        from janus_tpu.datastore.datastore import DatastoreError
+
+        with pytest.raises(DatastoreError, match="newer than this build"):
+            self._open(path, clock)
+
+    def test_gate_without_migrate_on_open(self, tmp_path):
+        from janus_tpu.datastore.schema import MIGRATIONS
+
+        clock = MockClock()
+        path = str(tmp_path / "g.sqlite3")
+        from janus_tpu.datastore.datastore import DatastoreError
+
+        # Un-migrated (empty) store: the gating-only open must refuse...
+        with pytest.raises(DatastoreError, match="unsupported schema version 0"):
+            self._open(path, clock, migrate_on_open=False)
+        # ...and after an operator-style migration it opens clean.
+        self._open(str(tmp_path / "g2.sqlite3"), clock).close()
+        ds = self._open(str(tmp_path / "g2.sqlite3"), clock, migrate_on_open=False)
+        ds.run_tx("noop", lambda tx: None)
+        ds.close()

@@ -189,3 +189,85 @@ def test_postgres_retry_classification():
     assert be.is_retryable(FakePgError("40P01"))
     assert not be.is_retryable(FakePgError("23505"))
     assert not be.is_retryable(ValueError("boom"))
+
+
+class TestSqlDialectGuards:
+    """Static guards keeping the mechanical SQLite->Postgres translation
+    sound (VERDICT r4 weak #3): the blind ?->%s rewrite requires that no
+    Transaction SQL puts ? or % inside a quoted string literal, and DDL
+    splitting must survive triggers/functions."""
+
+    @staticmethod
+    def _sql_literals():
+        """Every string constant that flows into conn.execute*() across the
+        datastore layer, extracted from the AST."""
+        import ast
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[1] / "janus_tpu"
+        sqls = []
+        for path in (root / "datastore").glob("*.py"):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "execute",
+                    "executemany",
+                ):
+                    if node.args and isinstance(node.args[0], ast.Constant):
+                        v = node.args[0].value
+                        if isinstance(v, str):
+                            sqls.append((str(path), v))
+        return sqls
+
+    def test_no_placeholder_chars_inside_string_literals(self):
+        import re
+
+        sqls = self._sql_literals()
+        assert len(sqls) > 50, "extraction should see the Transaction SQL"
+        bad = []
+        for path, sql in sqls:
+            for lit in re.findall(r"'[^']*'", sql):
+                if "?" in lit or "%" in lit:
+                    bad.append((path, sql.strip()[:80], lit))
+        assert not bad, f"string literals break the ?->%s rewrite: {bad}"
+
+    def test_ddl_splitter_handles_quotes_comments_and_dollar_bodies(self):
+        from janus_tpu.datastore.backend_sql import split_sql_statements
+
+        script = """
+        -- a comment; with a semicolon
+        CREATE TABLE t (x TEXT DEFAULT 'a;b');
+        /* block; comment */
+        CREATE FUNCTION f() RETURNS trigger AS $fn$
+        BEGIN
+            INSERT INTO t VALUES ('x;y');
+            RETURN NEW;
+        END;
+        $fn$ LANGUAGE plpgsql;
+        CREATE TRIGGER tr AFTER INSERT ON t EXECUTE FUNCTION f()
+        """
+        stmts = split_sql_statements(script)
+        assert len(stmts) == 3, stmts
+        assert stmts[0].startswith("-- a comment")
+        assert "'a;b'" in stmts[0]
+        assert "$fn$" in stmts[1] and "END;" in stmts[1]
+        assert stmts[2].lstrip().startswith("CREATE TRIGGER")
+
+    def test_full_schema_splits_statement_per_table_or_index(self):
+        from janus_tpu.datastore.backend_sql import (
+            split_sql_statements,
+            translate_schema_to_postgres,
+        )
+        from janus_tpu.datastore.schema import MIGRATIONS
+
+        for mig in MIGRATIONS:
+            stmts = split_sql_statements(translate_schema_to_postgres(mig))
+            assert all(
+                s.upper().lstrip("-— \n").startswith(("CREATE", "--", "ALTER", "DROP", "INSERT", "UPDATE"))
+                or s.startswith("--")
+                for s in stmts
+            ), stmts
+            assert len(stmts) >= 10

@@ -1,0 +1,151 @@
+"""Live-Postgres datastore suite (VERDICT r4 missing #1).
+
+Runs the core datastore behaviors — schema init through the real DDL
+splitter, task CRUD through the crypter, transaction retry classification,
+and the exactly-once lease race across two Datastore handles — against an
+actual PostgreSQL server.  Enabled by ``JANUS_TPU_TEST_PG_DSN`` (e.g.
+``postgres://postgres@127.0.0.1:5432/janus_test``); ``./ci.sh postgres``
+provisions a throwaway server when pg binaries are available and sets it.
+
+Reference analog: the reference test suite runs everything against
+ephemeral Postgres databases (aggregator_core/src/datastore.rs:1916-1985
+ephemeral_datastore).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.crypter import Crypter, generate_key
+from janus_tpu.datastore.datastore import Datastore
+from janus_tpu.messages import Duration, Role
+
+DSN = os.environ.get("JANUS_TPU_TEST_PG_DSN", "")
+
+
+def _have_driver() -> bool:
+    try:
+        import psycopg  # noqa: F401
+
+        return True
+    except ImportError:
+        try:
+            import psycopg2  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+
+pytestmark = pytest.mark.skipif(
+    not DSN or not _have_driver(),
+    reason="live Postgres suite needs JANUS_TPU_TEST_PG_DSN + a psycopg driver",
+)
+
+
+@pytest.fixture()
+def pg_datastore():
+    key = generate_key()
+    clock = MockClock()
+    def drop_all(conn):
+        rows = conn.execute(
+            "SELECT tablename FROM pg_tables WHERE schemaname = 'public'"
+        ).fetchall()
+        for (t,) in rows:
+            conn.execute(f'DROP TABLE IF EXISTS "{t}" CASCADE')
+        conn.commit()
+
+    # fresh tables per test, BEFORE and after: stale rows from a crashed
+    # prior run must not leak into assertions
+    probe = Datastore(DSN, Crypter([key]), clock)
+    drop_all(probe._conn())
+    probe.close()
+    ds = Datastore(DSN, Crypter([key]), clock)
+    yield ds, key, clock
+    drop_all(ds._conn())
+    ds.close()
+
+
+def _make_task(role=Role.LEADER):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_datastore import make_task
+
+    return make_task(role)
+
+
+def test_schema_init_and_task_roundtrip(pg_datastore):
+    ds, key, clock = pg_datastore
+    task = _make_task()
+    ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+    got = ds.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id))
+    assert got is not None
+    assert got.task_id == task.task_id
+    assert got.vdaf_verify_key == task.vdaf_verify_key  # crypter round-trip
+    ids = ds.run_tx("ids", lambda tx: tx.get_task_ids())
+    assert task.task_id in ids
+
+
+def test_lease_exactly_once_across_handles(pg_datastore):
+    """Two handles racing FOR UPDATE SKIP LOCKED acquisition: every job is
+    leased exactly once (the multi-replica invariant, live)."""
+    from test_datastore import make_task
+    from janus_tpu.datastore import AggregationJob, AggregationJobState
+    from janus_tpu.messages import AggregationJobId, Interval, Time
+
+    ds, key, clock = pg_datastore
+    task = _make_task()
+    ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+
+    jobs = []
+    for _ in range(8):
+        job = AggregationJob(
+            task_id=task.task_id,
+            aggregation_job_id=AggregationJobId.random(),
+            aggregation_parameter=b"",
+            batch_id=None,
+            client_timestamp_interval=Interval(Time(0), Duration(3600)),
+            state=AggregationJobState.IN_PROGRESS,
+            step=0,
+        )
+        jobs.append(job)
+
+    def put_all(tx):
+        for j in jobs:
+            tx.put_aggregation_job(j)
+
+    ds.run_tx("jobs", put_all)
+
+    ds2 = Datastore(DSN, Crypter([key]), clock)
+    acquired: list = []
+    lock = threading.Lock()
+
+    def worker(handle):
+        got = handle.run_tx(
+            "acq",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 8),
+        )
+        with lock:
+            acquired.extend(got)
+
+    t1 = threading.Thread(target=worker, args=(ds,))
+    t2 = threading.Thread(target=worker, args=(ds2,))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    ds2.close()
+    ids = [l.aggregation_job_id for l in acquired]
+    assert len(ids) == 8 and len(set(ids)) == 8, "a job was double-leased or lost"
+
+
+def test_tx_conflict_maps_integrity_error(pg_datastore):
+    from janus_tpu.datastore.datastore import TxConflict
+
+    ds, key, clock = pg_datastore
+    task = _make_task()
+    ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+    with pytest.raises(TxConflict):
+        ds.run_tx("dup", lambda tx: tx.put_aggregator_task(task))
