@@ -71,6 +71,8 @@ class JobDriver:
             await asyncio.gather(*list(self._inflight), return_exceptions=True)
 
     async def _step(self, sem: asyncio.Semaphore, lease: Lease) -> None:
+        from ..core.trace import trace_span
+
         async with sem:
             # per-job timeout: remaining lease minus skew allowance
             # (reference: job_driver.rs:222-247)
@@ -80,9 +82,14 @@ class JobDriver:
                 - self.clock.now().seconds
                 - self.worker_lease_clock_skew_allowance.seconds,
             )
-            try:
-                await asyncio.wait_for(self.stepper(lease), timeout=timeout)
-            except asyncio.TimeoutError:
-                logger.warning("job step timed out; lease will expire naturally")
-            except Exception:
-                logger.exception("job step failed")
+            with trace_span(
+                "job_step",
+                job_type=type(lease.leased).__name__,
+                attempts=lease.lease_attempts,
+            ):
+                try:
+                    await asyncio.wait_for(self.stepper(lease), timeout=timeout)
+                except asyncio.TimeoutError:
+                    logger.warning("job step timed out; lease will expire naturally")
+                except Exception:
+                    logger.exception("job step failed")

@@ -56,6 +56,13 @@ class CommonConfig:
     health_check_listen_address: str = "127.0.0.1:8000"
     max_transaction_retries: int = 30
     log_level: str = "INFO"
+    #: Chrome-trace (Trace Event Format) output path for job/launch spans —
+    #: load in chrome://tracing or Perfetto (reference: trace.rs:145-156
+    #: chrome tracing layer).  Off when empty.
+    chrome_trace_path: str = ""
+    #: jax.profiler server port for on-demand device captures (0 = off;
+    #: reference analog: trace.rs:158-236 always-on tooling sockets).
+    profiler_port: int = 0
 
 
 @dataclass

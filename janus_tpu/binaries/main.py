@@ -40,9 +40,20 @@ logger = logging.getLogger("janus_tpu.binaries")
 
 
 def _bootstrap(config_common):
-    from ..core.trace import TraceConfiguration, install_trace_subscriber
+    from ..core.trace import (
+        TraceConfiguration,
+        configure_chrome_trace,
+        install_trace_subscriber,
+        start_profiler_server,
+    )
 
     install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
+    if getattr(config_common, "chrome_trace_path", ""):
+        configure_chrome_trace(config_common.chrome_trace_path)
+        logger.info("chrome trace -> %s", config_common.chrome_trace_path)
+    if getattr(config_common, "profiler_port", 0):
+        if start_profiler_server(config_common.profiler_port):
+            logger.info("jax profiler server on :%d", config_common.profiler_port)
     clock = RealClock()
     crypter = Crypter(datastore_keys_from_env())
     logger.info("datastore: %s", redact_database_url(config_common.database.path))

@@ -63,3 +63,135 @@ def reload_trace_filter(level: str) -> None:
     """Runtime log-level reload (reference: binary_utils.rs:422-456
     /traceconfigz)."""
     logging.getLogger().setLevel(getattr(logging, level.upper(), logging.INFO))
+
+
+# -- chrome-trace export -----------------------------------------------------
+# The analog of the reference's chrome tracing layer (trace.rs:145-156
+# ChromeLayer): spans around job steps / device launches, written in the
+# Trace Event Format chrome://tracing and Perfetto load directly.
+
+
+class ChromeTracer:
+    """Incremental Trace-Event-Format writer (JSON array of "X" events).
+
+    Thread-safe; events are appended as they close, so a crash loses at most
+    the open spans (the format tolerates a missing closing bracket).
+    """
+
+    def __init__(self, path: str):
+        import threading
+
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "w")
+        self._f.write("[\n")
+        self._t0 = time.monotonic()
+
+    def emit(self, name: str, cat: str, start_s: float, dur_s: float, **args) -> None:
+        import threading
+
+        # Concurrent spans must land on distinct tracks: same-track
+        # overlapping "X" events render as bogus nesting in trace viewers.
+        # Thread identity separates executor/launch spans; same-thread
+        # asyncio concurrency (job steps) additionally keys on the running
+        # task so parallel steps get their own rows.
+        tid = threading.get_ident() % 100000
+        try:
+            import asyncio
+
+            task = asyncio.current_task()
+            if task is not None:
+                tid = 100000 + id(task) % 100000
+        except RuntimeError:
+            pass
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "pid": 1,
+            "tid": tid,
+            "ts": round((start_s - self._t0) * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+        }
+        if args:
+            ev["args"] = args
+        line = json.dumps(ev) + ",\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+
+    def span(self, name: str, cat: str = "job", **args):
+        return _Span(self, name, cat, args)
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.write("{}]\n")  # sentinel keeps the array valid JSON
+            self._f.close()
+
+
+class _Span:
+    def __init__(self, tracer: ChromeTracer, name: str, cat: str, args):
+        self.tracer, self.name, self.cat, self.args = tracer, name, cat, args
+
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, *_):
+        self.tracer.emit(
+            self.name,
+            self.cat,
+            self.start,
+            time.monotonic() - self.start,
+            ok=exc_type is None,
+            **self.args,
+        )
+        return False
+
+
+_GLOBAL_TRACER: Optional[ChromeTracer] = None
+
+
+def configure_chrome_trace(path: Optional[str]) -> Optional[ChromeTracer]:
+    """Enable (or disable with None) process-wide chrome-trace output."""
+    global _GLOBAL_TRACER
+    if _GLOBAL_TRACER is not None:
+        _GLOBAL_TRACER.close()
+        _GLOBAL_TRACER = None
+    if path:
+        _GLOBAL_TRACER = ChromeTracer(path)
+    return _GLOBAL_TRACER
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def trace_span(name: str, cat: str = "job", **args):
+    """Span against the global tracer; free no-op when tracing is off."""
+    t = _GLOBAL_TRACER
+    return t.span(name, cat, **args) if t is not None else _NULL_SPAN
+
+
+def start_profiler_server(port: int) -> bool:
+    """Opt-in on-device profiling: a jax.profiler server an operator can
+    capture from at any time (the analog of the reference's tokio-console /
+    OTLP always-on observability sockets, trace.rs:158-236).  Returns False
+    when jax is unavailable in this process (control-plane binaries)."""
+    try:
+        import jax
+
+        jax.profiler.start_server(port)
+        return True
+    except Exception:
+        logging.getLogger("janus_tpu.trace").exception(
+            "could not start jax profiler server"
+        )
+        return False

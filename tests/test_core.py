@@ -162,3 +162,41 @@ def test_secret_types_redact_repr():
     from janus_tpu.binaries.config import DbConfig
 
     assert "s3cret" not in repr(DbConfig(path="postgres://u:s3cret@h/d"))
+
+
+class TestChromeTrace:
+    """Chrome-trace export (reference: trace.rs:145-156 chrome layer)."""
+
+    def test_span_events_are_valid_trace_json(self, tmp_path):
+        import json as _json
+
+        from janus_tpu.core.trace import ChromeTracer
+
+        path = str(tmp_path / "trace.json")
+        tr = ChromeTracer(path)
+        with tr.span("step_a", cat="job", job="agg"):
+            pass
+        with tr.span("step_b", cat="launch", batch=4096):
+            pass
+        tr.close()
+        doc = _json.load(open(path))
+        events = [e for e in doc if e]
+        assert [e["name"] for e in events] == ["step_a", "step_b"]
+        assert all(e["ph"] == "X" and "dur" in e and "ts" in e for e in events)
+        assert events[1]["args"]["batch"] == 4096
+        assert events[0]["args"]["ok"] is True
+
+    def test_global_span_noop_and_enabled(self, tmp_path):
+        import json as _json
+
+        from janus_tpu.core import trace as trace_mod
+
+        with trace_mod.trace_span("off"):  # no tracer configured: free no-op
+            pass
+        path = str(tmp_path / "g.json")
+        trace_mod.configure_chrome_trace(path)
+        with trace_mod.trace_span("on", cat="job", k=1):
+            pass
+        trace_mod.configure_chrome_trace(None)  # closes + disables
+        events = [e for e in _json.load(open(path)) if e]
+        assert events and events[0]["name"] == "on"
