@@ -41,10 +41,15 @@ import sys
 import time
 
 
-def build_pipeline(vdaf, batch: int, multi_task: int = 0):
+def build_pipeline(vdaf, batch: int, multi_task: int = 0, side: str = "helper"):
     """``multi_task`` > 0 benches the BASELINE configs[4] launch shape: the
     batch carries reports from that many tasks, so the verify key becomes a
-    per-ROW traced input (exactly what TpuBackend.prep_init_multi passes)."""
+    per-ROW traced input (exactly what TpuBackend.prep_init_multi passes).
+
+    ``side`` selects which aggregator's prepare is measured: "helper"
+    expands share seeds through the XOF; "leader" preps its explicit
+    meas/proof limbs (reference: the leader prepares every report too,
+    aggregation_job_driver.rs:397-449)."""
     import jax
     import jax.numpy as jnp
 
@@ -53,34 +58,45 @@ def build_pipeline(vdaf, batch: int, multi_task: int = 0):
     bp = BatchedPrio3(vdaf)
     has_jr = vdaf.flp.JOINT_RAND_LEN > 0
     verify_key = b"\x2a" * vdaf.VERIFY_KEY_SIZE
-    use_planar = bp.planar_eligible(1, batch)
+    agg_id = 0 if side == "leader" else 1
+    use_planar = bp.planar_eligible(agg_id, batch)
 
-    def helper_step(kw):
-        """One helper aggregate-init step over a whole job: prep + decide
-        against the leader's verifier share + masked aggregate."""
+    def prep_step(kw):
+        """One aggregate-init step over a whole job: prep + decide against
+        the peer's verifier share + masked aggregate."""
         vk = kw.get("verify_keys_u8", verify_key)
         if use_planar:
             out = bp.prep_init_planar(
-                1,
+                agg_id,
                 vk,
                 kw["nonces_u8"],
-                share_seeds_u8=kw["share_seeds_u8"],
-                blinds_u8=kw["blinds_u8"],
-                public_parts_u8=kw["public_parts_u8"],
+                share_seeds_u8=kw.get("share_seeds_u8"),
+                meas_limbs=kw.get("meas_limbs"),
+                proofs_limbs=kw.get("proofs_limbs"),
+                blinds_u8=kw.get("blinds_u8"),
+                public_parts_u8=kw.get("public_parts_u8"),
+                keep_planar=True,
             )
         else:
-            out = bp.prep_init(1, verify_key=vk, **{
+            out = bp.prep_init(agg_id, verify_key=vk, **{
                 k: v for k, v in kw.items()
-                if k not in ("leader_verifiers", "verify_keys_u8")
+                if k not in ("peer_verifiers", "verify_keys_u8")
             })
-        comb = bp.prep_shares_to_prep(
-            [kw["leader_verifiers"], out["verifiers"]],
-            [out["joint_rand_part"], out["joint_rand_part"]] if has_jr else None,
+        parts = (
+            [out["joint_rand_part"], out["joint_rand_part"]] if has_jr else None
         )
+        if "wire_ev_pl" in out:
+            # Verifier planes never leave plane layout: the combined-wire
+            # gadget contraction runs in the planar Pallas kernel.
+            comb = bp.prep_shares_to_prep_planar(out, kw["peer_verifiers"], parts)
+        else:
+            comb = bp.prep_shares_to_prep(
+                [kw["peer_verifiers"], out["verifiers"]], parts
+            )
         agg = bp.aggregate(out["out_share"], comb["decide"])
         return agg, comb["decide"], out["ok"]
 
-    fn = jax.jit(helper_step)
+    fn = jax.jit(prep_step)
 
     def make_inputs(seed: int):
         import numpy as np
@@ -88,14 +104,29 @@ def build_pipeline(vdaf, batch: int, multi_task: int = 0):
         rng = np.random.default_rng(seed)
         kw = {
             "nonces_u8": rng.integers(0, 256, (batch, 16), dtype=np.uint8),
-            "share_seeds_u8": rng.integers(0, 256, (batch, 16), dtype=np.uint8),
-            "leader_verifiers": rng.integers(
+            "peer_verifiers": rng.integers(
                 0,
                 1 << 16,
                 (batch, vdaf.flp.VERIFIER_LEN * vdaf.num_proofs, bp.jf.n),
                 dtype=np.uint32,
             ),
         }
+        if agg_id == 0:
+            # Explicit leader shares: random canonical limbs (every limb
+            # < 2^16 keeps the value far below the modulus; the prepare
+            # op sequence is input-oblivious, so throughput matches real
+            # shares).
+            kw["meas_limbs"] = rng.integers(
+                0, 1 << 16, (batch, vdaf.flp.MEAS_LEN, bp.jf.n), dtype=np.uint32
+            )
+            kw["proofs_limbs"] = rng.integers(
+                0,
+                1 << 16,
+                (batch, vdaf.flp.PROOF_LEN * vdaf.num_proofs, bp.jf.n),
+                dtype=np.uint32,
+            )
+        else:
+            kw["share_seeds_u8"] = rng.integers(0, 256, (batch, 16), dtype=np.uint8)
         if has_jr:
             kw["blinds_u8"] = rng.integers(0, 256, (batch, 16), dtype=np.uint8)
             kw["public_parts_u8"] = rng.integers(
@@ -173,7 +204,7 @@ CONFIGS = {
 DEFAULT_SET = ["count", "sum32", "histogram1024", "sumvec100k", "multitask16"]
 
 
-def run_config(name: str, args) -> dict:
+def run_config(name: str, args, side: str = "helper") -> dict:
     """Measure one config; returns the result dict (or an error record)."""
     import jax
 
@@ -195,7 +226,8 @@ def run_config(name: str, args) -> dict:
     while batch >= 64:
         try:
             fn, make_inputs = build_pipeline(
-                vdaf, batch, multi_task=16 if name == "multitask16" else 0
+                vdaf, batch, multi_task=16 if name == "multitask16" else 0,
+                side=side,
             )
             inputs = make_inputs(0)
             t0 = time.monotonic()
@@ -218,6 +250,7 @@ def run_config(name: str, args) -> dict:
     reports_per_sec = batch / pipelined
     return {
         "config": desc,
+        "side": side,
         "value": round(reports_per_sec, 1),
         "unit": "reports/s",
         "batch": batch,
@@ -232,12 +265,19 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch", type=int, default=16384)
     parser.add_argument("--iters", type=int, default=8)
-    parser.add_argument("--pipeline-depth", type=int, default=48)
+    parser.add_argument("--pipeline-depth", type=int, default=96)
     parser.add_argument(
         "--config",
         default="all",
         choices=["all"] + list(CONFIGS),
         help="one config, or 'all' for every BASELINE.md row (default)",
+    )
+    parser.add_argument(
+        "--side",
+        default="both",
+        choices=["helper", "leader", "both"],
+        help="which aggregator's prepare to measure (default: both — the "
+        "reference accelerates both halves of the protocol)",
     )
     args = parser.parse_args()
 
@@ -251,13 +291,20 @@ def main() -> int:
     names = DEFAULT_SET if args.config == "all" else [args.config]
     results = {}
     for name in names:
-        try:
-            results[name] = run_config(name, args)
-        except Exception as e:  # never lose completed configs to one failure
-            sys.stderr.write(f"{name} failed: {type(e).__name__}: {e}\n")
-            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        for side in ("helper",) if args.side == "helper" else (
+            ("leader",) if args.side == "leader" else ("helper", "leader")
+        ):
+            key = name if side == "helper" else f"{name}_leader"
+            try:
+                results[key] = run_config(name, args, side=side)
+            except Exception as e:  # never lose completed configs to one failure
+                sys.stderr.write(f"{key} failed: {type(e).__name__}: {e}\n")
+                results[key] = {"error": f"{type(e).__name__}: {e}"}
 
-    headline = "histogram1024" if "histogram1024" in results else names[0]
+    headline = next(
+        (k for k in ("histogram1024", "histogram1024_leader") if k in results),
+        next(iter(results)),
+    )
     head = results[headline]
     reports_per_sec = head.get("value", 0.0)
 

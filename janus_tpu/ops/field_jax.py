@@ -467,6 +467,29 @@ class JField:
         axis = axis % (a.ndim - 1)
         return _scan_fence(lax.associative_scan(self.mont_mul, a, axis=axis))
 
+    @_eager_jit(static_argnums=(0, 2))
+    def pow_range_mont(self, x, count: int):
+        """x^1..x^count as (..., count, n), x Montgomery -> Montgomery.
+
+        Baby-step/giant-step: two short sequential chains (~2*sqrt(count)
+        tiny multiplies) plus ONE wide multiply — where cumprod_mont's
+        associative scan costs log2(count) full-width passes over the
+        (batch, count, n) tensor.  Exact Montgomery identities
+        (mont_mul(aR, bR) = abR), so the limbs are byte-identical to the
+        cumulative-product form (tests/test_ops_field.py)."""
+        bs = max(1, math.isqrt(count))
+        gs = -(-count // bs)
+        baby = [x]  # baby[i] = x^(i+1) * R for i in 0..bs-1
+        for _ in range(bs - 1):
+            baby.append(self.mont_mul(baby[-1], x))
+        giant = [jnp.broadcast_to(self.mont_one(), x.shape)]
+        for _ in range(gs - 1):  # giant[g] = x^(bs*g) * R
+            giant.append(self.mont_mul(giant[-1], baby[-1]))
+        baby_t = jnp.stack(baby, axis=-2)  # (..., bs, n)
+        giant_t = jnp.stack(giant, axis=-2)  # (..., gs, n)
+        out = self.mont_mul(giant_t[..., :, None, :], baby_t[..., None, :, :])
+        return out.reshape(x.shape[:-1] + (gs * bs, self.n))[..., :count, :]
+
     @_eager_jit(static_argnums=(0,))
     def poly_eval_mont(self, coeffs, x):
         """Polynomial evaluation via baby-step/giant-step powers.
@@ -546,6 +569,37 @@ class JField:
             t = self.mont_mul(odd, jnp.broadcast_to(tw, odd.shape))
             xr = jnp.concatenate([self.add(even, t), self.sub(even, t)], axis=-2)
             x = xr.reshape(x.shape)
+            m *= 2
+        return x
+
+    def ntt_eval_mont_limbs(self, coeffs: List, bitrev_idx, tw_stages) -> List:
+        """Planar twin of ntt_eval_mont on limb lists.
+
+        coeffs: n arrays (R, P, 128) canonical -> values, same shapes.  The
+        butterfly schedule is identical op-for-op (one mont_mul + add/sub
+        per butterfly, same order), so outputs are byte-identical to the
+        row form — the lanes just hold reports instead of T(1,128) rows.
+        """
+        P = coeffs[0].shape[1]
+        idx = jnp.asarray(bitrev_idx)
+        x = [jnp.take(c, idx, axis=1) for c in coeffs]
+        R = x[0].shape[0]
+        m = 2
+        for tw in tw_stages:  # (m/2, n) Montgomery twiddles
+            xr = [c.reshape(R, P // m, m, 128) for c in x]
+            even = [c[:, :, : m // 2] for c in xr]
+            odd = [c[:, :, m // 2 :] for c in xr]
+            twl = [
+                jnp.broadcast_to(tw[:, l][None, None, :, None], odd[0].shape)
+                for l in range(self.n)
+            ]
+            t = self.mont_mul_limbs(odd, twl)
+            hi = self.add_limbs(even, t)
+            lo = self.sub_limbs(even, t)
+            x = [
+                jnp.concatenate([h, l_], axis=2).reshape(R, P, 128)
+                for h, l_ in zip(hi, lo)
+            ]
             m *= 2
         return x
 

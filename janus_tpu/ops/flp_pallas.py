@@ -56,19 +56,55 @@ def _uchunks(chunk_pad: int) -> int:
     return 2 if chunk_pad > 160 else 1
 
 
-def _wire_kernel(jf: JField, calls: int, m_ref, sw_ref, rch_ref, kl_ref,
-                 lagk_ref, lag0_ref, ccorr_ref, out_ref):
+def _grid_chunk(chunk: int):
+    """(NJ, UC) for chunk-axis grid splitting: UC-column steps with UC a
+    sublane (8) multiple — Mosaic requires block minor-2 dims divisible by 8
+    — and NJ*UC >= chunk (the <=7-column ragged tail is masked/clipped)."""
+    NJ = -(-chunk // 96)
+    UC = 8 * (-(-chunk // (8 * NJ)))
+    return NJ, UC
+
+
+def _wire_kernel(jf: JField, meas_len: int, chunk: int, calls: int, UC: int,
+                 m_ref, p_ref, rch_ref, kl_ref, lagk_ref, lag0_ref,
+                 ccorr_ref, ev_ref, od_ref):
+    """Histogram wire evals straight off the RAW limb-planar streams.
+
+    m_ref block (1, n, meas_len, 128): the measurement-share squeeze planes
+    with NO padding — the circuit's zero padding of positions
+    meas_len..calls*chunk-1 is applied in-register (mask on the last call's
+    tail), and per-call columns are unaligned static slices (Mosaic handles
+    non-tile-aligned slices on the sublane axis).  p_ref block
+    (1, n, PROOF_LEN, 128): the raw proof planes; the zipped wire seeds
+    [a0, b0, a1, b1, ...] are de-interleaved in-register via a sublane
+    reshape.  This removes every XLA-side pad / de-interleave / calls
+    reshape pass (~100s of MB per launch) between the XOF and the wires.
+
+    The chunk axis is processed in UC-column grid steps (minor grid dim) to
+    bound the Mosaic VMEM stack; the stream blocks' index maps ignore that
+    dim, so they are fetched once per R row.
+    """
     n = jf.n
-    UC = m_ref.shape[3]
-    shape = (UC, 128)
+    j = pl.program_id(1)
 
     def scal(ref, *idx):
-        return jnp.broadcast_to(ref[idx].reshape(1, 128), shape)
+        return jnp.broadcast_to(ref[idx].reshape(1, 128), (UC, 128))
 
     s1: List = None
     s2: List = None
     for k in range(calls):
-        mk = [m_ref[0, l, k, :, :] for l in range(n)]
+        lo = k * chunk  # + j*UC dynamically below
+        lim_full = meas_len - k * chunk  # valid columns in this call
+        mk = [
+            m_ref[0, l, pl.dslice(lo + j * UC, UC), :] for l in range(n)
+        ]
+        if lim_full < chunk:
+            # circuit zero padding for the final partial call: column
+            # j*UC + i is valid iff j*UC + i < lim_full.
+            upos = jax.lax.broadcasted_iota(jnp.uint32, (UC, 128), 0) + j * UC
+            keep = upos < lim_full
+            zero = jnp.zeros((UC, 128), dtype=jnp.uint32)
+            mk = [jnp.where(keep, x, zero) for x in mk]
         t1 = jf.mont_mul_limbs(mk, [scal(kl_ref, 0, l, k) for l in range(n)])
         s1 = t1 if s1 is None else jf.add_limbs(s1, t1)
         t2 = jf.mont_mul_limbs(mk, [scal(lagk_ref, 0, l, k) for l in range(n)])
@@ -76,16 +112,18 @@ def _wire_kernel(jf: JField, calls: int, m_ref, sw_ref, rch_ref, kl_ref,
     rch = [rch_ref[0, l, :, :] for l in range(n)]
     evens = jf.mont_mul_limbs(s1, rch)
     odds = jf.sub_limbs(s2, [scal(ccorr_ref, 0, l) for l in range(n)])
-    sshape = (2 * UC, 128)
-    sw = [sw_ref[0, l, :, :] for l in range(n)]
-    lag0 = [
-        jnp.broadcast_to(lag0_ref[0, l].reshape(1, 128), sshape) for l in range(n)
+    lag0 = [scal(lag0_ref, 0, l) for l in range(n)]
+    sw = [
+        p_ref[0, l, pl.dslice(2 * j * UC, 2 * UC), :].reshape(UC, 2, 128)
+        for l in range(n)
     ]
-    se = jf.mont_mul_limbs(sw, lag0)
-    eo = [jnp.stack([evens[l], odds[l]], axis=1).reshape(sshape) for l in range(n)]
-    wire = jf.add_limbs(se, eo)
+    swe = [s[:, 0, :] for s in sw]
+    swo = [s[:, 1, :] for s in sw]
+    ev = jf.add_limbs(jf.mont_mul_limbs(swe, lag0), evens)
+    od = jf.add_limbs(jf.mont_mul_limbs(swo, lag0), odds)
     for l in range(n):
-        out_ref[0, l, :, :] = wire[l]
+        ev_ref[0, l, :, :] = ev[l]
+        od_ref[0, l, :, :] = od[l]
 
 
 def _sumvec_partial_kernel(jf: JField, kc: int, m_ref, klu_ref, lagk_ref,
@@ -160,32 +198,52 @@ def sumvec_partial_planar(
 
 def wire_evals_planar(
     jf: JField,
-    m_pl: jnp.ndarray,      # (R, n, calls, chunk_pad, 128) canonical
-    sw_pl: jnp.ndarray,     # (R, n, 2*chunk_pad, 128) canonical
-    rch_pl: jnp.ndarray,    # (R, n, chunk_pad, 128) Montgomery r^(u+1)
+    meas_len: int,
+    chunk: int,
+    m_pl: jnp.ndarray,      # (R, n, MEAS_LEN, 128) canonical (raw planes)
+    proof_pl: jnp.ndarray,  # (R, n, PROOF_LEN, 128) canonical (raw planes)
+    rch_pl: jnp.ndarray,    # (R, n, chunk, 128) Montgomery r^(u+1)
     kl_pl: jnp.ndarray,     # (R, n, calls, 128) Montgomery
     lagk_pl: jnp.ndarray,   # (R, n, calls, 128) Montgomery
     lag0_pl: jnp.ndarray,   # (R, n, 128) Montgomery
     ccorr_pl: jnp.ndarray,  # (R, n, 128) canonical
     *,
     interpret: bool = False,
-) -> jnp.ndarray:
-    """Histogram-family wire evals -> (R, n, 2*chunk_pad, 128) canonical."""
-    R, n, calls, chunk_pad, _ = m_pl.shape
-    NJ = _uchunks(chunk_pad)
-    UC = chunk_pad // NJ
+):
+    """Histogram-family wire evals off the raw streams, kept as separate
+    even/odd planes (w_{2u} and w_{2u+1}) -> two (R, n, chunk, 128)
+    canonical tensors.  Circuit zero-padding, per-call splitting, and wire
+    seed de-interleaving all happen in-register (see _wire_kernel)."""
+    R, n, L, _ = m_pl.shape
+    cp2 = rch_pl.shape[2]
+    calls = kl_pl.shape[2]
+    plen = proof_pl.shape[2]
+    # UC-column grid steps bound the Mosaic stack; the stream blocks span
+    # the whole row.  Blocks may exceed the array (ragged NJ*UC tails, the
+    # m tail past meas_len): that region is Mosaic edge padding, read only
+    # under the zero mask / in out columns >= chunk which consumers clip.
+    NJ, UC = _grid_chunk(chunk)
+    assert cp2 == NJ * UC, (cp2, NJ, UC)
+
+    def blk8(dim: int, array_dim: int) -> int:
+        return dim if dim == array_dim else 8 * (-(-dim // 8))
+
+    mblk = blk8(max((calls - 1) * chunk + NJ * UC, L), L)
+    pblk = blk8(max(plen, 2 * NJ * UC), plen)
     grid = (R, NJ)
-    kern = partial(_wire_kernel, jf, calls)
+    kern = partial(_wire_kernel, jf, meas_len, chunk, calls, UC)
+    out_shape = jax.ShapeDtypeStruct((R, n, cp2, 128), jnp.uint32)
+    uc_spec = pl.BlockSpec((1, n, UC, 128), lambda r, j: (r, 0, j, 0),
+                           memory_space=pltpu.VMEM)
     return pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, n, calls, UC, 128), lambda r, j: (r, 0, 0, j, 0),
+            pl.BlockSpec((1, n, mblk, 128), lambda r, j: (r, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, 2 * UC, 128), lambda r, j: (r, 0, j, 0),
+            pl.BlockSpec((1, n, pblk, 128), lambda r, j: (r, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, UC, 128), lambda r, j: (r, 0, j, 0),
-                         memory_space=pltpu.VMEM),
+            uc_spec,
             pl.BlockSpec((1, n, calls, 128), lambda r, j: (r, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n, calls, 128), lambda r, j: (r, 0, 0, 0),
@@ -195,8 +253,88 @@ def wire_evals_planar(
             pl.BlockSpec((1, n, 128), lambda r, j: (r, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, n, 2 * UC, 128), lambda r, j: (r, 0, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((R, n, 2 * chunk_pad, 128), jnp.uint32),
+        out_specs=[uc_spec, uc_spec],
+        out_shape=[out_shape, out_shape],
         interpret=interpret,
-    )(m_pl, sw_pl, rch_pl, kl_pl, lagk_pl, lag0_pl, ccorr_pl)
+    )(m_pl, proof_pl, rch_pl, kl_pl, lagk_pl, lag0_pl, ccorr_pl)
+
+
+def _combine_decide_kernel(jf: JField, chunk: int, UC: int, he_ref, ho_ref,
+                           pv_ref, g_ref):
+    """Combined-verifier gadget sum for one (R, UC-columns) grid step:
+
+        g_part = sum_u mont_mul(he[u] + pe[u], ho[u] + po[u])
+
+    he/ho are our even/odd wire planes; pv is the peer's verifier in plane
+    layout as it came off the wire (row 0 = v, rows 1..2*chunk = zipped
+    wires, row 2*chunk+1 = gpoly(t)) — the zipped wires are de-interleaved
+    in-register.  Output: 8-sublane partial sums (1, n, 8, 128) per j step;
+    the caller folds sublanes and steps with add_limbs (tiny)."""
+    n = jf.n
+    j = pl.program_id(1)
+    pv = [
+        pv_ref[0, l, pl.dslice(1 + 2 * j * UC, 2 * UC), :].reshape(UC, 2, 128)
+        for l in range(n)
+    ]
+    xe = jf.add_limbs([he_ref[0, l] for l in range(n)],
+                      [p[:, 0, :] for p in pv])
+    xo = jf.add_limbs([ho_ref[0, l] for l in range(n)],
+                      [p[:, 1, :] for p in pv])
+    prod = jf.mont_mul_limbs(xe, xo)
+    # columns past chunk in the final step are he/ho edge padding: zero them
+    upos = jax.lax.broadcasted_iota(jnp.uint32, (UC, 128), 0) + j * UC
+    keep = upos < chunk
+    zero = jnp.zeros((UC, 128), dtype=jnp.uint32)
+    prod = [jnp.where(keep, p, zero) for p in prod]
+    # fold UC -> 8 sublanes (zero-pad the ragged tail slab)
+    slabs = -(-UC // 8)
+    if UC < slabs * 8:
+        prod = [jnp.pad(p, ((0, slabs * 8 - UC), (0, 0))) for p in prod]
+    acc = [p[:8] for p in prod]
+    for i in range(1, slabs):
+        acc = jf.add_limbs(acc, [p[8 * i : 8 * (i + 1)] for p in prod])
+    for l in range(n):
+        g_ref[0, l] = acc[l]
+
+
+def combine_decide_planar(
+    jf: JField,
+    chunk: int,
+    he_pl: jnp.ndarray,  # (R, n, chunk, 128) canonical even wires (ours)
+    ho_pl: jnp.ndarray,  # (R, n, chunk, 128) canonical odd wires (ours)
+    pv_pl: jnp.ndarray,  # (R, n, VERIFIER_LEN, 128) canonical (peer, zipped)
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """ParallelSum(Mul) gadget over the COMBINED wires -> g (R, n, 8*NJ, 128)
+    partial sums (caller folds the sublane axis).  This is the decide step's
+    hot contraction — chunk Montgomery multiplies per report — which XLA
+    otherwise emits as unfused (B, chunk, n) passes at several times the
+    kernel's cost."""
+    R, n, chunk_c, _ = he_pl.shape
+    vlen = pv_pl.shape[2]
+    NJ, UC = _grid_chunk(chunk)
+    assert chunk_c == NJ * UC, (chunk_c, NJ, UC)
+    vblk = max(vlen, 1 + 2 * NJ * UC)
+    if vblk != vlen:
+        vblk = 8 * (-(-vblk // 8))
+    grid = (R, NJ)
+    kern = partial(_combine_decide_kernel, jf, chunk, UC)
+    uc_spec = pl.BlockSpec((1, n, UC, 128), lambda r, j: (r, 0, j, 0),
+                           memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            uc_spec,
+            uc_spec,
+            # Block may exceed vlen when NJ*UC is ragged: the excess is
+            # Mosaic edge padding, only ever read under the zero mask.
+            pl.BlockSpec((1, n, vblk, 128), lambda r, j: (r, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, n, 8, 128), lambda r, j: (r, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, n, 8 * NJ, 128), jnp.uint32),
+        interpret=interpret,
+    )(he_pl, ho_pl, pv_pl)

@@ -293,9 +293,7 @@ class _DHistogram(_DChunked):
         B = jr_m.shape[0]
         lag0, lagk = lag[:, 0], lag[:, 1:]
         r = jr_m[:, 0]
-        r_ch = jf.cumprod_mont(
-            jnp.broadcast_to(r[:, None, :], (B, self.chunk, jf.n)), axis=1
-        )
+        r_ch = jf.pow_range_mont(r, self.chunk)  # r^(u+1), u < chunk
         rc = r_ch[:, -1]
         ones = jf.mont_one()[None, None, :]
         if self.calls > 1:
@@ -337,8 +335,12 @@ class BatchedPrio3:
     byte-identical to the CPU oracle.
     """
 
-    def __init__(self, prio3: Prio3, ntt_min_p: int = 64):
-        if prio3.xof is not XofTurboShake128:
+    def __init__(self, prio3: Prio3, ntt_min_p: int = 64, require_device_xof: bool = True):
+        #: TurboSHAKE has device (Pallas) kernels; other XOFs (the HMAC
+        #: multiproof variant) run on the HOST and feed query_batch — the
+        #: hybrid split in vdaf/backend.py HybridXofBackend.
+        self.device_xof = prio3.xof is XofTurboShake128
+        if require_device_xof and not self.device_xof:
             raise NotImplementedError("device path requires XofTurboShake128")
         self.prio3 = prio3
         self.flp = prio3.flp
@@ -635,6 +637,64 @@ class BatchedPrio3:
         out["ok"] = ok
         return out
 
+    def query_batch(
+        self,
+        meas_limbs: jnp.ndarray,
+        proofs_limbs: jnp.ndarray,
+        jr_limbs: Optional[jnp.ndarray],
+        qr_limbs: jnp.ndarray,
+    ) -> Dict[str, jnp.ndarray]:
+        """FLP query ONLY — every XOF output precomputed by the caller.
+
+        The device half of the hybrid path for host-XOF VDAFs (the
+        HMAC-SHA256-AES128 multiproof variant, reference:
+        core/src/vdaf.rs:178-195): meas (B, MEAS_LEN, n), proofs
+        (B, num_proofs*PROOF_LEN, n), jr (B, num_proofs*JR_LEN, n) or None,
+        qr (B, num_proofs*QUERY_RAND_LEN, n), all canonical.  Returns
+        verifiers (B, num_proofs*VER, n), out_share (B, OUT, n), and ok
+        (rows whose query point hit an interpolation root).  Identical
+        field math to prep_init's verifier loop — byte parity with the
+        oracle's FlpGeneric.query per proof.
+        """
+        prio3, flp, jf = self.prio3, self.flp, self.jf
+        B = meas_limbs.shape[0]
+        ok = jnp.ones((B,), dtype=bool)
+        jr_m = jf.to_mont(jr_limbs) if jr_limbs is not None else None
+        verifiers = []
+        for i in range(prio3.num_proofs):
+            pm = proofs_limbs[:, i * flp.PROOF_LEN : (i + 1) * flp.PROOF_LEN]
+            ti = jf.to_mont(qr_limbs[:, i * flp.QUERY_RAND_LEN])
+            ji = (
+                jr_m[:, i * flp.JOINT_RAND_LEN : (i + 1) * flp.JOINT_RAND_LEN]
+                if jr_m is not None
+                else jnp.zeros((B, 0, jf.n), dtype=_U32)
+            )
+            ver, t_ok = self._query_one(meas_limbs, pm, ji, ti)
+            ok = ok & t_ok
+            verifiers.append(ver)
+        return {
+            "verifiers": jnp.concatenate(verifiers, axis=1),
+            "out_share": self.circ.truncate(jf, meas_limbs, self.consts),
+            "ok": ok,
+        }
+
+    def decide_batch(self, combined_verifiers: jnp.ndarray) -> jnp.ndarray:
+        """Decide from the COMBINED (summed) verifier tensor — the field
+        half of prep_shares_to_prep, XOF-free for the hybrid backend."""
+        prio3, flp, jf, circ = self.prio3, self.flp, self.jf, self.circ
+        B = combined_verifiers.shape[0]
+        decide = jnp.ones((B,), dtype=bool)
+        for i in range(prio3.num_proofs):
+            ver = combined_verifiers[
+                :, i * flp.VERIFIER_LEN : (i + 1) * flp.VERIFIER_LEN
+            ]
+            v = ver[:, 0]
+            x = ver[:, 1 : 1 + circ.arity]
+            y_scaled = jf.from_mont(ver[:, 1 + circ.arity])
+            g = circ.gadget_eval_scaled(jf, x)
+            decide = decide & jf.is_zero(v) & jf.eq(g, y_scaled)
+        return decide
+
     # -- planar (limb-plane) helper prep --------------------------------
     def planar_eligible(self, agg_id: int, batch: int) -> bool:
         """True when the limb-planar Pallas fast path serves this prep."""
@@ -647,12 +707,11 @@ class BatchedPrio3:
             # bits > 1 would need a planar truncate (out_share != meas).
             circuit_ok = self.flp.valid.bits == 1
         else:
-            circuit_ok = False
+            # Count/Sum ride the all-planes small-circuit path.
+            circuit_ok = isinstance(self.circ, (_DCount, _DSum))
         return (
-            agg_id != 0
-            and circuit_ok
+            circuit_ok
             and self.prio3.num_proofs == 1
-            and self.flp.JOINT_RAND_LEN > 0
             # planar aggregate's lazy batch sum is exact to 65535 terms.
             and batch <= 65535
             and pallas_enabled(batch)
@@ -674,6 +733,236 @@ class BatchedPrio3:
         """(B, L, n) row-major limbs -> (R, n, L, 128) planes (narrow L)."""
         B, L, n = rows3.shape
         return rows3.reshape(B // 128, 128, L, n).transpose(0, 3, 2, 1)
+
+    def _ones_planes(self, R):
+        jf = self.jf
+        return [jnp.broadcast_to(jf.mont_one()[l], (R, 128)) for l in range(jf.n)]
+
+    def _pow_range_planes(self, x_pl, count):
+        """x^1..x^count on limb-list planes via baby-step/giant-step.
+
+        x_pl: n arrays (R, 128) Montgomery -> n arrays (R, count, 128).
+        Exact Montgomery identities (byte parity with cumprod)."""
+        import math
+
+        jf = self.jf
+        n = jf.n
+        R = x_pl[0].shape[0]
+        bs = max(1, math.isqrt(count))
+        gs = -(-count // bs)
+        baby = [x_pl]
+        for _ in range(bs - 1):
+            baby.append(jf.mont_mul_limbs(baby[-1], x_pl))
+        giant = [self._ones_planes(R)]
+        for _ in range(gs - 1):
+            giant.append(jf.mont_mul_limbs(giant[-1], baby[-1]))
+        baby_t = [jnp.stack([b[l] for b in baby], axis=1) for l in range(n)]
+        giant_t = [jnp.stack([g[l] for g in giant], axis=1) for l in range(n)]
+        outer = jf.mont_mul_limbs(
+            [g[:, :, None, :] for g in giant_t], [b[:, None, :, :] for b in baby_t]
+        )
+        return [o.reshape(R, gs * bs, 128)[:, :count] for o in outer]
+
+    def _gpoly_at_planes(self, gp, t_pl):
+        """gpoly(t) on limb-list planes (baby-step/giant-step).
+
+        gp: n arrays (R, glen, 128) canonical coefficients, t_pl: n arrays
+        (R, 128) Montgomery -> n arrays (R, 128) canonical."""
+        import math
+
+        jf = self.jf
+        glen = gp[0].shape[1]
+        R = gp[0].shape[0]
+        bs = max(1, math.isqrt(glen))
+        gs = -(-glen // bs)
+        one = self._ones_planes(R)
+        baby = [one]  # t^j for j in 0..bs-1
+        for _ in range(bs - 1):
+            baby.append(jf.mont_mul_limbs(baby[-1], t_pl))
+        tbs = jf.mont_mul_limbs(baby[-1], t_pl)  # t^bs
+        giant = [one]
+        for _ in range(gs - 1):
+            giant.append(jf.mont_mul_limbs(giant[-1], tbs))
+        gpt = None
+        for g in range(gs):
+            inner = None
+            for j in range(bs):
+                idx = g * bs + j
+                if idx >= glen:
+                    break
+                term = jf.mont_mul_limbs([x[:, idx] for x in gp], baby[j])
+                inner = term if inner is None else jf.add_limbs(inner, term)
+            outer = jf.mont_mul_limbs(inner, giant[g])
+            gpt = outer if gpt is None else jf.add_limbs(gpt, outer)
+        return gpt
+
+    def _lagrange_planes(self, t_pl):
+        """Planar twin of _lagrange_coeffs.
+
+        t_pl: limb list of (R, 128) Montgomery -> (lag_pl (R, n, K, 128)
+        Montgomery, t_ok (R, 128) bool).  Same inversion-free barycentric
+        construction (z/(t - w^k) = prod_{j != k} (t - w^j)); prefix/suffix
+        chains are lane-wide multiplies instead of T(1,128) row passes.
+        Byte parity follows from exact Montgomery identities.
+        """
+        jf, circ = self.jf, self.circ
+        n = jf.n
+        R = t_pl[0].shape[0]
+        P = circ.P
+        K = circ.calls + 1
+        one = [jnp.broadcast_to(jf.mont_one()[l], (R, 128)) for l in range(n)]
+
+        tp = t_pl
+        for _ in range(self._log2_P):
+            tp = jf.mont_mul_limbs(tp, tp)
+        z = jf.sub_limbs(tp, one)  # t^P - 1
+        nz = z[0]
+        for l in range(1, n):
+            nz = nz | z[l]
+        t_ok = nz != 0
+
+        roots = self.roots_all_m  # (P, n) Montgomery
+        denom = [
+            jf.sub_limbs(
+                t_pl,
+                [jnp.broadcast_to(roots[k, l], (R, 128)) for l in range(n)],
+            )
+            for k in range(P)
+        ]
+        prefix = [one]
+        for k in range(1, P):
+            prefix.append(jf.mont_mul_limbs(prefix[-1], denom[k - 1]))
+        suffix = [one] * P
+        for k in range(P - 2, -1, -1):
+            suffix[k] = jf.mont_mul_limbs(suffix[k + 1], denom[k + 1])
+        bary = self.bary_c_m  # (K, n) Montgomery
+        lag_cols = []
+        for k in range(K):
+            others = jf.mont_mul_limbs(prefix[k], suffix[k])
+            lag_cols.append(
+                jf.mont_mul_limbs(
+                    others,
+                    [jnp.broadcast_to(bary[k, l], (R, 128)) for l in range(n)],
+                )
+            )
+        lag_pl = jnp.stack(
+            [jnp.stack([col[l] for col in lag_cols], axis=1) for l in range(n)],
+            axis=1,
+        )  # (R, n, K, 128)
+        return lag_pl, t_ok
+
+    def _alpha_mat_m(self):
+        """Constant w^{k*j} Montgomery table (calls, glen, n) for the planar
+        direct-sum gadget evaluation (lazy; small-P circuits only)."""
+        mat = getattr(self, "_alpha_mat_cache", None)
+        if mat is None:
+            field, circ, jf = self.flp.field, self.circ, self.jf
+            p = field.MODULUS
+            w = field.root(circ.P)
+
+            def mont_np(x: int) -> np.ndarray:
+                return jf._int_to_limbs_np((x % p) * (1 << (32 * jf.n)) % p)
+
+            # Cached as a HOST array: a jnp constant created inside one jit
+            # trace must not be cached across traces (tracer leak).
+            mat = np.stack(
+                [
+                    np.stack(
+                        [mont_np(pow(w, k * j, p)) for j in range(circ.glen)]
+                    )
+                    for k in range(1, circ.calls + 1)
+                ]
+            )  # (calls, glen, n)
+            self._alpha_mat_cache = mat
+        return mat
+
+    def _gadget_planes(self, gp_pl, t_pl):
+        """Planar gadget-polynomial evaluations.
+
+        gp_pl (R, n, glen, 128) canonical coefficient planes, t_pl limb list
+        of (R, 128) Montgomery -> (gk planes (R, n, calls, 128) canonical,
+        gpoly(t) limb list of (R, 128) canonical).  gk[k] = gpoly(alpha^k)
+        as the DIRECT sum over coefficients times constant w^{kj} powers —
+        the same residue the row path's Horner chain produces, and canonical
+        limbs are unique, so byte parity holds while the glen-step serial
+        chain over T(1,128) row tensors disappears.
+        """
+        import math
+
+        jf, circ = self.jf, self.circ
+        n = jf.n
+        R = gp_pl.shape[0]
+        glen = gp_pl.shape[2]
+        gp = [gp_pl[:, l] for l in range(n)]  # (R, glen, 128)
+        amat = self._alpha_mat_m()  # (calls, glen, n)
+        gk_cols = []
+        for k in range(circ.calls):
+            c = [
+                jnp.broadcast_to(amat[k, :, l][None, :, None], (R, glen, 128))
+                for l in range(n)
+            ]
+            terms = jf.mont_mul_limbs(gp, c)
+            acc = [t[:, 0] for t in terms]
+            for j in range(1, glen):
+                acc = jf.add_limbs(acc, [t[:, j] for t in terms])
+            gk_cols.append(acc)
+        gk_pl = jnp.stack(
+            [jnp.stack([col[l] for col in gk_cols], axis=1) for l in range(n)],
+            axis=1,
+        )  # (R, n, calls, 128)
+        return gk_pl, self._gpoly_at_planes(gp, t_pl)
+
+    def _histogram_coeff_planes(self, jr_m, lag_pl, cp):
+        """Planar twin of _DHistogram.planar_coeffs.
+
+        Generates every wire-kernel coefficient tensor DIRECTLY in plane
+        layout with limb-list Montgomery ops (lanes = reports), so no
+        full-width row-major (B, chunk, n) pass exists — XLA lays those out
+        T(1,128) (batch minor) at several times the planar cost.  The chunk
+        power table r^(u+1) uses baby-step/giant-step (two ~sqrt(cp)
+        sequential chains of lane-wide multiplies + one wide outer product).
+        Every step is an exact Montgomery identity, so the values are
+        byte-identical to planar_coeffs (tests/test_prepare.py planar
+        parity).  Returns (rch_pl (R,n,cp,128), kl_pl (R,n,calls,128),
+        lagk_pl, lag0_pl (R,n,128), ccorr_pl (R,n,128)).
+
+        Pad columns u in [chunk, cp) get REAL powers r^(u+1) rather than
+        planar_coeffs' zero padding — sound because the measurement pad
+        columns are zero, so those wire outputs are garbage either way and
+        the consumers mask/slice them.
+        """
+        import math
+
+        jf, circ = self.jf, self.circ
+        n = jf.n
+        calls = circ.calls
+        jr_pl = self._rows_to_planes_small(jr_m)  # (R, n, JR, 128)
+        R = jr_pl.shape[0]
+        one = self._ones_planes(R)
+        r = [jr_pl[:, l, 0] for l in range(n)]
+        rch = self._pow_range_planes(r, cp)
+        rc = [l_[:, circ.chunk - 1] for l_ in rch]  # r^chunk
+        r_call = [one]
+        for _ in range(calls - 1):
+            r_call.append(jf.mont_mul_limbs(r_call[-1], rc))
+        r_call_t = [jnp.stack([c[l] for c in r_call], axis=1) for l in range(n)]
+        lagk_t = [lag_pl[:, l, 1 : 1 + calls] for l in range(n)]
+        kl = jf.mont_mul_limbs(r_call_t, lagk_t)
+
+        lag_sum = [lagk_t[l][:, 0] for l in range(n)]
+        for k in range(1, calls):
+            lag_sum = jf.add_limbs(lag_sum, [lagk_t[l][:, k] for l in range(n)])
+        c = self.consts["shares_inv_c"]
+        c_pl = [jnp.broadcast_to(c[l], (R, 128)) for l in range(n)]
+        ccorr = jf.mont_mul_limbs(c_pl, lag_sum)
+
+        return (
+            jnp.stack(rch, axis=1),  # (R, n, cp, 128)
+            jnp.stack(kl, axis=1),  # (R, n, calls, 128)
+            jnp.stack(lagk_t, axis=1),  # (R, n, calls, 128)
+            lag_pl[:, :, 0],  # (R, n, 128)
+            jnp.stack(ccorr, axis=1),  # (R, n, 128)
+        )
 
     def _jr_part_planes(self, agg_id, blinds_u8, nonces_u8, meas_stream):
         """Joint-rand-part XOF with the 16 KB meas binder built in-plane.
@@ -754,60 +1043,80 @@ class BatchedPrio3:
         verify_key,
         nonces_u8: jnp.ndarray,
         *,
-        share_seeds_u8: jnp.ndarray,
-        blinds_u8: jnp.ndarray,
-        public_parts_u8: jnp.ndarray,
+        share_seeds_u8: Optional[jnp.ndarray] = None,
+        meas_limbs: Optional[jnp.ndarray] = None,
+        proofs_limbs: Optional[jnp.ndarray] = None,
+        blinds_u8: Optional[jnp.ndarray] = None,
+        public_parts_u8: Optional[jnp.ndarray] = None,
+        keep_planar: bool = False,
     ) -> Dict[str, jnp.ndarray]:
-        """Helper prep in the limb-planar layout (histogram family).
+        """Prep in the limb-planar layout (histogram family), either side.
+
+        Helpers (agg_id > 0) pass ``share_seeds_u8`` and the meas/proof
+        streams come from the planar XOF squeeze; the leader (agg_id == 0)
+        passes its explicit ``meas_limbs``/``proofs_limbs`` row-major and
+        they are lane-transposed into the same stream planes (no XOF
+        expansion and no canonicality recheck — reference leader prep:
+        aggregator/src/aggregator/aggregation_job_driver.rs:397-449).
 
         Same outputs as prep_init except ``out_share`` stays limb-planar
         (R, n, OUTPUT_LEN, 128) — ``aggregate`` consumes either layout.  The
-        XOF squeeze planes feed the Pallas wire kernel directly; nothing
+        stream planes feed the Pallas wire kernel directly; nothing
         batch-wide is lane-transposed except the (small) verifier tensor.
         """
+        if isinstance(self.circ, (_DCount, _DSum)):
+            return self.prep_init_planar_small(
+                agg_id,
+                verify_key,
+                nonces_u8,
+                share_seeds_u8=share_seeds_u8,
+                meas_limbs=meas_limbs,
+                proofs_limbs=proofs_limbs,
+                blinds_u8=blinds_u8,
+                public_parts_u8=public_parts_u8,
+            )
         from .keccak_jax import words_to_bytes
-        from .keccak_pallas import xof_planes_pallas
+        from .keccak_pallas import rows_to_planes, xof_planes_pallas
         from .flp_pallas import pad_chunk, wire_evals_planar, _pallas_interpret
 
         prio3, flp, jf, circ = self.prio3, self.flp, self.jf, self.circ
         B = nonces_u8.shape[0]
         R = B // 128
         n = jf.n
-        binder = jnp.broadcast_to(
-            jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1)
-        )
 
-        meas_st = xof_planes_pallas(
-            share_seeds_u8, self._dst(USAGE_MEAS_SHARE), binder, flp.MEAS_LEN * n
-        )  # (MEAS_LEN*n, R, 128)
-        proofs_st = xof_planes_pallas(
-            share_seeds_u8, self._dst(USAGE_PROOF_SHARE), binder, flp.PROOF_LEN * n
-        )
-        ok = self._planar_ok(meas_st, flp.MEAS_LEN) & self._planar_ok(
-            proofs_st, flp.PROOF_LEN
-        )
+        if agg_id == 0:
+            # Leader: explicit shares -> stream planes (word w of element e,
+            # limb l at stream position e*n + l, little-endian — the same
+            # order the XOF squeeze emits).
+            meas_st = rows_to_planes(meas_limbs.reshape(B, flp.MEAS_LEN * n))
+            proofs_st = rows_to_planes(
+                proofs_limbs.reshape(B, flp.PROOF_LEN * n)
+            )
+            ok = jnp.ones((B,), dtype=bool)
+        else:
+            binder = jnp.broadcast_to(
+                jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1)
+            )
+            meas_st = xof_planes_pallas(
+                share_seeds_u8, self._dst(USAGE_MEAS_SHARE), binder, flp.MEAS_LEN * n
+            )  # (MEAS_LEN*n, R, 128)
+            proofs_st = xof_planes_pallas(
+                share_seeds_u8, self._dst(USAGE_PROOF_SHARE), binder, flp.PROOF_LEN * n
+            )
+            ok = self._planar_ok(meas_st, flp.MEAS_LEN) & self._planar_ok(
+                proofs_st, flp.PROOF_LEN
+            )
 
-        # Limb-planar views: lanes stay report-indexed throughout; the
-        # chunk axis is zero-padded to the kernel's tiling multiple and the
-        # garbage wires of pad columns are sliced off after the kernel.
+        # Limb-planar views: lanes stay report-indexed throughout.  The
+        # histogram wire kernel reads the RAW streams (one transpose each —
+        # circuit padding / per-call splitting / seed de-interleaving happen
+        # in-register); only the SumVec slab path still builds the padded
+        # chunk layout.
         cp = pad_chunk(circ.chunk)
         m_el = meas_st.reshape(flp.MEAS_LEN, n, R, 128)
         m_lp = m_el.transpose(2, 1, 0, 3)  # (R, n, MEAS_LEN, 128)
-        if circ.pad_len:
-            m_pad = jnp.concatenate(
-                [m_lp, jnp.zeros((R, n, circ.pad_len, 128), dtype=_U32)], axis=2
-            )
-        else:
-            m_pad = m_lp
-        m_pl = m_pad.reshape(R, n, circ.calls, circ.chunk, 128)
-        if cp != circ.chunk:
-            m_pl = jnp.pad(m_pl, ((0, 0), (0, 0), (0, 0), (0, cp - circ.chunk), (0, 0)))
         p_el = proofs_st.reshape(flp.PROOF_LEN, n, R, 128)
-        sw_pl = p_el[: circ.arity].transpose(2, 1, 0, 3)  # (R, n, arity, 128)
-        if cp != circ.chunk:
-            sw_pl = jnp.pad(
-                sw_pl, ((0, 0), (0, 0), (0, 2 * cp - circ.arity), (0, 0))
-            )
+        p_lp = p_el.transpose(2, 1, 0, 3)  # (R, n, PROOF_LEN, 128)
         gpoly = (
             p_el[circ.arity :].transpose(2, 3, 0, 1).reshape(B, circ.glen, n)
         )  # small row-major
@@ -843,27 +1152,39 @@ class BatchedPrio3:
 
         jr_m = jf.to_mont(jr_vec)
         t_m = jf.to_mont(qr[:, 0])
-        lag, t_ok = self._lagrange_coeffs(t_m)
-        ok = ok & t_ok
-        gk = self._gadget_outputs(gpoly, B)
 
+        ev_pl = od_pl = None
         if isinstance(circ, _DHistogram):
-            kl, lagk, lag0, ccorr, r_ch = circ.planar_coeffs(jf, jr_m, lag, self.consts)
-            if cp != circ.chunk:
-                r_ch = jnp.pad(r_ch, ((0, 0), (0, cp - circ.chunk), (0, 0)))
-            wire_pl = wire_evals_planar(
+            from .flp_pallas import _grid_chunk
+
+            t_planes_a = self._rows_to_planes_small(t_m[:, None, :])[:, :, 0]
+            t_pl = [t_planes_a[:, l] for l in range(n)]
+            lag_pl, t_ok_pl = self._lagrange_planes(t_pl)
+            ok = ok & t_ok_pl.reshape(B)
+            NJc, UCc = _grid_chunk(circ.chunk)
+            rch_pl, kl_pl, lagk_pl, lag0_pl, ccorr_pl = self._histogram_coeff_planes(
+                jr_m, lag_pl, NJc * UCc
+            )
+            ev_pl, od_pl = wire_evals_planar(
                 jf,
-                m_pl,
-                sw_pl,
-                self._rows_to_planes_small(r_ch),
-                self._rows_to_planes_small(kl),
-                self._rows_to_planes_small(lagk),
-                self._rows_to_planes_small(lag0[:, None, :])[:, :, 0],
-                self._rows_to_planes_small(ccorr[:, None, :])[:, :, 0],
+                flp.MEAS_LEN,
+                circ.chunk,
+                m_lp,
+                p_lp,
+                rch_pl,
+                kl_pl,
+                lagk_pl,
+                lag0_pl,
+                ccorr_pl,
                 interpret=_pallas_interpret(),
-            )  # (R, n, 2*cp, 128)
-            wire = (
-                wire_pl.transpose(0, 3, 2, 1).reshape(B, 2 * cp, n)[:, : circ.arity]
+            )  # each (R, n, chunk, 128)
+            # Gadget polynomial: planar direct-sum evaluation (no glen-step
+            # row-major Horner chain); gk back to rows only for the tiny
+            # (B, calls, n) v computation.
+            gk_pl, gpt_limbs = self._gadget_planes(p_lp[:, :, circ.arity :], t_pl)
+            gk = gk_pl.transpose(0, 3, 2, 1).reshape(B, circ.calls, n)
+            gp_t = (
+                jnp.stack(gpt_limbs, axis=1).transpose(0, 2, 1).reshape(B, n)
             )
             # v from the lazily-summed measurement (see JField._sum_lazy).
             slo = jnp.sum(m_lp & np.uint32(0xFFFF), axis=2)  # (R, n, 128)
@@ -873,20 +1194,265 @@ class BatchedPrio3:
                 shi.transpose(0, 2, 1).reshape(B, n),
             )
             v = circ.v_from_meas_sum(jf, gk, meas_sum, jr_m, self.consts)
-        else:  # _DSumVec
-            wire = self._sumvec_wires_planar(m_pl, sw_pl, jr_m, lag, cp)
+        else:  # _DSumVec: padded chunk layout for the call-slab kernels
+            lag, t_ok = self._lagrange_coeffs(t_m)
+            ok = ok & t_ok
+            if circ.pad_len:
+                m_pad = jnp.concatenate(
+                    [m_lp, jnp.zeros((R, n, circ.pad_len, 128), dtype=_U32)],
+                    axis=2,
+                )
+            else:
+                m_pad = m_lp
+            m_pl = m_pad.reshape(R, n, circ.calls, circ.chunk, 128)
+            if cp != circ.chunk:
+                m_pl = jnp.pad(
+                    m_pl, ((0, 0), (0, 0), (0, 0), (0, cp - circ.chunk), (0, 0))
+                )
+            swe_pl = p_lp[:, :, 0 : circ.arity : 2]
+            swo_pl = p_lp[:, :, 1 : circ.arity : 2]
+            if cp != circ.chunk:
+                hpad = ((0, 0), (0, 0), (0, cp - circ.chunk), (0, 0))
+                swe_pl = jnp.pad(swe_pl, hpad)
+                swo_pl = jnp.pad(swo_pl, hpad)
+            wire = self._sumvec_wires_planar(m_pl, swe_pl, swo_pl, jr_m, lag, cp)
+            gk = self._gadget_outputs(gpoly, B)
             v = jf.sum(gk, axis=1)
+            gp_t = self._gpoly_at(gpoly, t_m)
 
-        gp_t = self._gpoly_at(gpoly, t_m)
-        verifier = jnp.concatenate([v[:, None], wire, gp_t[:, None]], axis=1)
-
-        return {
-            "verifiers": verifier,
+        out = {
             "out_share": m_lp,  # planar; aggregate() accepts this layout
             "ok": ok,
             "joint_rand_part": part,
             "corrected_seed": corrected,
         }
+        if ev_pl is not None and keep_planar:
+            # Planar-combine consumers: wires stay in plane layout; only the
+            # tiny v / gpoly(t) rows leave it.  No row-major verifier is
+            # materialized (prep_shares_to_prep_planar pairs the planes
+            # directly).
+            out.update(wire_ev_pl=ev_pl, wire_od_pl=od_pl, v_row=v, gpt_row=gp_t)
+            return out
+        if ev_pl is not None:
+            wire = self._zip_planes_to_rows(ev_pl, od_pl)[:, : circ.arity]
+        out["verifiers"] = jnp.concatenate([v[:, None], wire, gp_t[:, None]], axis=1)
+        return out
+
+    def _stream_to_limb_planes(self, stream, num_elems):
+        """(L*n, R, 128) stream words -> limb list of n arrays (R, L, 128)."""
+        jf = self.jf
+        el = stream[: num_elems * jf.n].reshape(num_elems, jf.n, -1, 128)
+        return [el[:, l].transpose(1, 0, 2) for l in range(jf.n)]
+
+    def prep_init_planar_small(
+        self,
+        agg_id: int,
+        verify_key,
+        nonces_u8: jnp.ndarray,
+        *,
+        share_seeds_u8: Optional[jnp.ndarray] = None,
+        meas_limbs: Optional[jnp.ndarray] = None,
+        proofs_limbs: Optional[jnp.ndarray] = None,
+        blinds_u8: Optional[jnp.ndarray] = None,
+        public_parts_u8: Optional[jnp.ndarray] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Count/Sum prep entirely in plane layout (no wire Pallas kernel).
+
+        These circuits move a few dozen field elements per report, so the
+        whole FLP query fits in limb-list ops over (R, L, 128) planes —
+        which XLA tiles (8, 128) and fuses well, unlike the row-major
+        T(1,128) emission this path replaces.  The XOF expansion/absorb
+        still runs in the planar Keccak kernels.  Outputs and byte parity
+        match prep_init exactly (tests/test_prepare.py); out_share stays
+        planar (R, n, OUTPUT_LEN, 128) like prep_init_planar's.
+
+        Reference twins: leader aggregation_job_driver.rs:397-449, helper
+        aggregator.rs:2101 — both sides of the small-circuit VDAFs ride the
+        same accelerated path as the histogram family.
+        """
+        from .keccak_jax import words_to_bytes
+        from .keccak_pallas import rows_to_planes, xof_planes_pallas
+
+        prio3, flp, jf, circ = self.prio3, self.flp, self.jf, self.circ
+        B = nonces_u8.shape[0]
+        R = B // 128
+        n = jf.n
+        has_jr = flp.JOINT_RAND_LEN > 0
+
+        if agg_id == 0:
+            meas_st = rows_to_planes(meas_limbs.reshape(B, flp.MEAS_LEN * n))
+            proofs_st = rows_to_planes(proofs_limbs.reshape(B, flp.PROOF_LEN * n))
+            ok = jnp.ones((B,), dtype=bool)
+        else:
+            binder = jnp.broadcast_to(
+                jnp.asarray(np.array([agg_id], dtype=np.uint8)), (B, 1)
+            )
+            meas_st = xof_planes_pallas(
+                share_seeds_u8, self._dst(USAGE_MEAS_SHARE), binder, flp.MEAS_LEN * n
+            )
+            proofs_st = xof_planes_pallas(
+                share_seeds_u8, self._dst(USAGE_PROOF_SHARE), binder, flp.PROOF_LEN * n
+            )
+            ok = self._planar_ok(meas_st, flp.MEAS_LEN) & self._planar_ok(
+                proofs_st, flp.PROOF_LEN
+            )
+
+        m = self._stream_to_limb_planes(meas_st, flp.MEAS_LEN)  # n x (R, MEAS, 128)
+        p = self._stream_to_limb_planes(proofs_st, flp.PROOF_LEN)
+        sw = [x[:, : circ.arity] for x in p]
+        gp = [x[:, circ.arity :] for x in p]
+
+        out: Dict[str, jnp.ndarray] = {}
+        if has_jr:
+            part_planes = self._jr_part_planes(agg_id, blinds_u8, nonces_u8, meas_st)
+            from .keccak_pallas import planes_to_rows
+
+            part = words_to_bytes(planes_to_rows(part_planes))
+            S = prio3.num_shares
+            pieces = []
+            if agg_id > 0:
+                pieces.append(public_parts_u8[:, :agg_id].reshape(B, -1))
+            pieces.append(part)
+            if agg_id < S - 1:
+                pieces.append(public_parts_u8[:, agg_id + 1 :].reshape(B, -1))
+            seed_binder = jnp.concatenate(pieces, axis=-1)
+            zero_seed = jnp.zeros((B, prio3.xof.SEED_SIZE), dtype=jnp.uint8)
+            corrected = self._xof_seed(
+                zero_seed, self._dst(USAGE_JOINT_RAND_SEED), seed_binder
+            )
+            jr_vec, ok_j = self._expand_vec(
+                corrected,
+                self._dst(USAGE_JOINT_RANDOMNESS),
+                jnp.zeros((B, 0), dtype=jnp.uint8),
+                flp.JOINT_RAND_LEN,
+            )
+            ok = ok & ok_j
+            out["joint_rand_part"] = part
+            out["corrected_seed"] = corrected
+            jr_m = jf.to_mont(jr_vec)
+            jr_planes = self._rows_to_planes_small(jr_m)
+            jr_pl = [jr_planes[:, l, 0] for l in range(n)]  # (R, 128) limbs
+
+        if isinstance(verify_key, (bytes, bytearray)):
+            verify_key = jnp.asarray(np.frombuffer(bytes(verify_key), dtype=np.uint8))
+        vk = jnp.broadcast_to(verify_key, (B, verify_key.shape[-1]))
+        qr, ok_q = self._expand_vec(
+            vk, self._dst(USAGE_QUERY_RANDOMNESS), nonces_u8, flp.QUERY_RAND_LEN
+        )
+        ok = ok & ok_q
+        t_m = jf.to_mont(qr[:, 0])
+        t_planes = self._rows_to_planes_small(t_m[:, None, :])[:, :, 0]
+        t_pl = [t_planes[:, l] for l in range(n)]
+        lag_pl, t_ok_pl = self._lagrange_planes(t_pl)
+        ok = ok & t_ok_pl.reshape(B)
+        lag0 = [lag_pl[:, l, 0] for l in range(n)]
+        lagk = [lag_pl[:, l, 1:] for l in range(n)]  # (R, calls, 128)
+
+        # gadget outputs gk at alpha^1..alpha^calls
+        if self._ntt is not None:
+            P = circ.P
+            folded = [
+                jf.add_limbs(
+                    [x[:, :P] for x in gp],
+                    [
+                        jnp.concatenate(
+                            [
+                                x[:, P:],
+                                jnp.zeros(
+                                    (R, 2 * P - circ.glen, 128), dtype=_U32
+                                ),
+                            ],
+                            axis=1,
+                        )
+                        for x in gp
+                    ],
+                )[l]
+                for l in range(n)
+            ]
+            evals = jf.ntt_eval_mont_limbs(folded, *self._ntt)
+            gk = [e[:, 1 : circ.calls + 1] for e in evals]
+        else:
+            amat = self._alpha_mat_m()  # (calls, glen, n)
+            gk_cols = []
+            for k in range(circ.calls):
+                c = [
+                    jnp.broadcast_to(
+                        amat[k, :, l][None, :, None], (R, circ.glen, 128)
+                    )
+                    for l in range(n)
+                ]
+                terms = jf.mont_mul_limbs(gp, c)
+                acc = [t[:, 0] for t in terms]
+                for j in range(1, circ.glen):
+                    acc = jf.add_limbs(acc, [t[:, j] for t in terms])
+                gk_cols.append(acc)
+            gk = [
+                jnp.stack([col[l] for col in gk_cols], axis=1) for l in range(n)
+            ]  # (R, calls, 128)
+
+        if isinstance(circ, _DCount):
+            # v = gk[0] - m[0]; wires w0 = w1 = sw_i*lag0 + m0*lag1
+            v = jf.sub_limbs(
+                [g[:, 0] for g in gk], [x[:, 0] for x in m]
+            )
+            m0lag1 = jf.mont_mul_limbs(
+                [x[:, 0] for x in m], [lk[:, 0] for lk in lagk]
+            )
+            wires = []
+            for i in range(2):
+                se = jf.mont_mul_limbs([x[:, i] for x in sw], lag0)
+                wires.append(jf.add_limbs(se, m0lag1))
+        else:  # _DSum
+            # v = sum_k r^(k+1) * gk[k]
+            r_pows = self._pow_range_planes(jr_pl, circ.calls)  # (R, calls, 128)
+            vk_terms = jf.mont_mul_limbs(r_pows, gk)
+            v = [t[:, 0] for t in vk_terms]
+            for k in range(1, circ.calls):
+                v = jf.add_limbs(v, [t[:, k] for t in vk_terms])
+            # single wire: sw0*lag0 + sum_k m[k]*lag_{k+1}
+            mk = jf.mont_mul_limbs(m, lagk)
+            s = [t[:, 0] for t in mk]
+            for k in range(1, circ.calls):
+                s = jf.add_limbs(s, [t[:, k] for t in mk])
+            se = jf.mont_mul_limbs([x[:, 0] for x in sw], lag0)
+            wires = [jf.add_limbs(se, s)]
+
+        gpt = self._gpoly_at_planes(gp, t_pl)
+
+        # verifier rows (B, VERIFIER_LEN, n): tiny stack + transpose
+        cols = [v] + wires + [gpt]  # each: n x (R, 128)
+        ver_pl = jnp.stack(
+            [jnp.stack([col[l] for col in cols], axis=1) for l in range(n)],
+            axis=1,
+        )  # (R, n, VER, 128)
+        out["verifiers"] = ver_pl.transpose(0, 3, 2, 1).reshape(B, len(cols), n)
+
+        # out_share planar (R, n, OUTPUT_LEN, 128)
+        if isinstance(circ, _DCount):
+            osh = [x[:, 0:1] for x in m]
+        else:
+            w = self.consts["pow2_m"]  # (bits, n) Montgomery
+            terms = jf.mont_mul_limbs(
+                m,
+                [
+                    jnp.broadcast_to(w[:, l][None, :, None], (R, circ.calls, 128))
+                    for l in range(n)
+                ],
+            )
+            acc = [t[:, 0] for t in terms]
+            for k in range(1, circ.calls):
+                acc = jf.add_limbs(acc, [t[:, k] for t in terms])
+            osh = [a[:, None, :] for a in acc]
+        out["out_share"] = jnp.stack(osh, axis=1)  # (R, n, OUT, 128)
+        out["ok"] = ok
+        return out
+
+    @staticmethod
+    def _zip_planes_to_rows(ev_pl, od_pl):
+        """Interleave even/odd wire planes -> row-major (B, 2*cp, n)."""
+        R, n, cp, _ = ev_pl.shape
+        zipped = jnp.stack([ev_pl, od_pl], axis=3)  # (R, n, cp, 2, 128)
+        return zipped.transpose(0, 4, 2, 3, 1).reshape(R * 128, 2 * cp, n)
 
     @staticmethod
     def planar_out_share_to_rows(osp):
@@ -906,7 +1472,7 @@ class BatchedPrio3:
             axis=1,
         )
 
-    def _sumvec_wires_planar(self, m_pl, sw_pl, jr_m, lag, cp):
+    def _sumvec_wires_planar(self, m_pl, swe_pl, swo_pl, jr_m, lag, cp):
         """SumVec wire evaluations via per-call-slab Pallas contractions.
 
         evens[u] = sum_k m[k,u] * jr_k^(u+1) * lag_{k+1};
@@ -947,8 +1513,7 @@ class BatchedPrio3:
             m_slab = lax.dynamic_slice_in_dim(m_pl, s * KC, KC, axis=2)
             jr_s = lax.dynamic_slice_in_dim(jr_m, s * KC, KC, axis=1)
             lagk_s = lax.dynamic_slice_in_dim(lagk, s * KC, KC, axis=1)
-            jr_b = jnp.broadcast_to(jr_s[:, :, None, :], (B, KC, circ.chunk, jf.n))
-            r_pows = jf.cumprod_mont(jr_b, axis=2)  # jr_k^(u+1) * R
+            r_pows = jf.pow_range_mont(jr_s, circ.chunk)  # jr_k^(u+1) * R
             klu = jf.mont_mul(
                 r_pows, jnp.broadcast_to(lagk_s[:, :, None, :], r_pows.shape)
             )
@@ -975,7 +1540,9 @@ class BatchedPrio3:
         evens_row = ev.transpose(0, 3, 2, 1).reshape(B, cp, n)[:, : circ.chunk]
         odds_row = od.transpose(0, 3, 2, 1).reshape(B, cp, n)[:, : circ.chunk]
         odds_row = jf.sub(odds_row, jnp.broadcast_to(ccorr[:, None, :], odds_row.shape))
-        sw_row = sw_pl.transpose(0, 3, 2, 1).reshape(B, 2 * cp, n)[:, : circ.arity]
+        swe_row = swe_pl.transpose(0, 3, 2, 1).reshape(B, cp, n)[:, : circ.chunk]
+        swo_row = swo_pl.transpose(0, 3, 2, 1).reshape(B, cp, n)[:, : circ.chunk]
+        sw_row = jnp.stack([swe_row, swo_row], axis=2).reshape(B, circ.arity, n)
         se = jf.mont_mul(sw_row, jnp.broadcast_to(lag0[:, None, :], sw_row.shape))
         pair = jnp.stack([evens_row, odds_row], axis=2).reshape(B, circ.arity, n)
         return jf.add(se, pair)
@@ -1007,6 +1574,53 @@ class BatchedPrio3:
             y_scaled = jf.from_mont(ver[:, 1 + circ.arity])
             g = circ.gadget_eval_scaled(jf, x)
             decide = decide & jf.is_zero(v) & jf.eq(g, y_scaled)
+        out: Dict[str, jnp.ndarray] = {"decide": decide}
+        if flp.JOINT_RAND_LEN > 0:
+            binder = jnp.concatenate(list(joint_rand_parts_u8), axis=-1)
+            zero_seed = jnp.zeros((B, prio3.xof.SEED_SIZE), dtype=jnp.uint8)
+            out["prep_msg_seed"] = self._xof_seed(
+                zero_seed, self._dst(USAGE_JOINT_RAND_SEED), binder
+            )
+        return out
+
+    def prep_shares_to_prep_planar(
+        self,
+        own: Dict[str, jnp.ndarray],
+        peer_verifiers: jnp.ndarray,
+        joint_rand_parts_u8: Optional[List[jnp.ndarray]] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Combine + decide with OUR verifier still in plane layout.
+
+        ``own`` is a prep_init_planar(keep_planar=True) result (wire_ev_pl /
+        wire_od_pl planes + v_row / gpt_row); ``peer_verifiers`` is the other
+        aggregator's share, row-major (B, VERIFIER_LEN, n) canonical as it
+        arrives off the wire.  The gadget contraction over the combined
+        wires runs in the planar Pallas kernel (combine_decide_planar);
+        only v / gpoly(t) / the folded gadget sum touch row layout (tiny).
+        Exact mod-p identities throughout — ``decide`` and the derived
+        prep-message seed are bit-identical to prep_shares_to_prep
+        (tests/test_prepare.py).  num_proofs == 1 (planar_eligible).
+        """
+        from .flp_pallas import _pallas_interpret, combine_decide_planar
+
+        prio3, flp, jf, circ = self.prio3, self.flp, self.jf, self.circ
+        ev_pl, od_pl = own["wire_ev_pl"], own["wire_od_pl"]
+        B = peer_verifiers.shape[0]
+        # One transpose puts the peer's whole verifier in plane layout; the
+        # kernel de-interleaves its zipped wires in-register.
+        pv_pl = self._rows_to_planes_small(peer_verifiers)
+        g_parts = combine_decide_planar(
+            jf, circ.chunk, ev_pl, od_pl, pv_pl,
+            interpret=_pallas_interpret(),
+        )  # (R, n, 8, 128) partial sums
+        R, n, S8, _ = g_parts.shape
+        g = jf.sum(g_parts.transpose(0, 3, 2, 1).reshape(B, S8, n), axis=1)
+
+        v = jf.add(own["v_row"], peer_verifiers[:, 0])
+        y = jf.add(own["gpt_row"], peer_verifiers[:, 1 + circ.arity])
+        # g is (a*b)*R^-1-scaled (gadget_eval_scaled); compare against
+        # y*R^-1 — R invertible, so the predicate equals g == y.
+        decide = jf.is_zero(v) & jf.eq(g, jf.from_mont(y))
         out: Dict[str, jnp.ndarray] = {"decide": decide}
         if flp.JOINT_RAND_LEN > 0:
             binder = jnp.concatenate(list(joint_rand_parts_u8), axis=-1)

@@ -223,3 +223,22 @@ def test_poly_eval_bsgs_matches_horner_wide(fields, widths):
             a = np.asarray(jf.horner_mont(coeffs, x))
             b = np.asarray(jf.poly_eval_mont(coeffs, x))
             assert np.array_equal(a, b), (F.__name__, C)
+
+
+@pytest.mark.parametrize("field", FIELDS)
+@pytest.mark.parametrize("count", [1, 2, 7, 16, 316])
+def test_pow_range_matches_cumprod(field, count):
+    """pow_range_mont (baby-step/giant-step power table) is limb-identical
+    to the cumulative-product form it replaces in the planar coefficient
+    generation (histogram r_ch, SumVec klu slabs)."""
+    import jax.numpy as jnp
+
+    jf = JField(field)
+    random.seed(17)
+    xs = [1, field.MODULUS - 1] + [random.randrange(field.MODULUS) for _ in range(3)]
+    x = jf.to_mont(jnp.asarray(jf.to_limbs(xs).reshape(len(xs), jf.n)))
+    via_cum = jf.cumprod_mont(
+        jnp.broadcast_to(x[:, None, :], (len(xs), count, jf.n)), axis=1
+    )
+    via_bsgs = jf.pow_range_mont(x, count)
+    assert np.array_equal(np.asarray(via_cum), np.asarray(via_bsgs))

@@ -366,6 +366,149 @@ def test_planar_prep_matches_row_path(monkeypatch):
     agg_pl = np.asarray(jax.jit(bp.aggregate)(pl["out_share"], mask))
     assert np.array_equal(agg_row, agg_pl)
 
+    # keep_planar + planar combine: decide / prep-msg seed bit-parity with
+    # the row-major prep_shares_to_prep over a random peer verifier share.
+    pl2 = jax.jit(
+        lambda kw: bp.prep_init_planar(
+            1,
+            vk,
+            kw["nonces_u8"],
+            share_seeds_u8=kw["share_seeds_u8"],
+            blinds_u8=kw["blinds_u8"],
+            public_parts_u8=kw["public_parts_u8"],
+            keep_planar=True,
+        )
+    )(kw)
+    peer = jnp.asarray(
+        rng.integers(
+            0, 1 << 16, (B, vdaf.flp.VERIFIER_LEN, bp.jf.n), dtype=np.uint32
+        )
+    )
+    parts = [pl2["joint_rand_part"], pl2["joint_rand_part"]]
+    c_row = jax.jit(lambda a, b, p: bp.prep_shares_to_prep([a, b], p))(
+        peer, row["verifiers"], parts
+    )
+    c_pl = jax.jit(lambda o, b, p: bp.prep_shares_to_prep_planar(o, b, p))(
+        pl2, peer, parts
+    )
+    assert np.array_equal(np.asarray(c_row["decide"]), np.asarray(c_pl["decide"]))
+    assert np.array_equal(
+        np.asarray(c_row["prep_msg_seed"]), np.asarray(c_pl["prep_msg_seed"])
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,agg_id", [
+    ("count", 0), ("count", 1), ("sum", 0), ("sum", 1),
+])
+def test_planar_small_circuits_match_row_path(monkeypatch, kind, agg_id):
+    """Count/Sum through the all-planes small-circuit path
+    (prep_init_planar_small) is byte-identical to the row path on both
+    sides.  Interpret mode; slow tier."""
+    import jax.numpy as jnp
+
+    from janus_tpu.vdaf.instances import prio3_count, prio3_sum
+
+    monkeypatch.setenv("JANUS_TPU_PALLAS", "interpret")
+    vdaf = prio3_count() if kind == "count" else prio3_sum(bits=8)
+    bp = BatchedPrio3(vdaf)
+    flp, jf = vdaf.flp, bp.jf
+    B = 1024
+    rng = np.random.default_rng(4)
+    kw = dict(nonces_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)))
+    if agg_id == 0:
+        kw["meas_limbs"] = jnp.asarray(
+            rng.integers(0, 1 << 16, (B, flp.MEAS_LEN, jf.n), dtype=np.uint32)
+        )
+        kw["proofs_limbs"] = jnp.asarray(
+            rng.integers(0, 1 << 16, (B, flp.PROOF_LEN, jf.n), dtype=np.uint32)
+        )
+    else:
+        kw["share_seeds_u8"] = jnp.asarray(
+            rng.integers(0, 256, (B, 16), dtype=np.uint8)
+        )
+    if flp.JOINT_RAND_LEN > 0:
+        kw["blinds_u8"] = jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8))
+        kw["public_parts_u8"] = jnp.asarray(
+            rng.integers(0, 256, (B, 2, 16), dtype=np.uint8)
+        )
+    vk = b"\x2a" * 16
+    assert bp.planar_eligible(agg_id, B)
+    row = jax.jit(lambda kw: bp.prep_init(agg_id, verify_key=vk, **kw))(kw)
+    pl = jax.jit(
+        lambda kw: bp.prep_init_planar(
+            agg_id,
+            vk,
+            kw["nonces_u8"],
+            **{
+                k: kw.get(k)
+                for k in (
+                    "share_seeds_u8",
+                    "meas_limbs",
+                    "proofs_limbs",
+                    "blinds_u8",
+                    "public_parts_u8",
+                )
+            },
+        )
+    )(kw)
+    keys = ["verifiers", "ok"] + (
+        ["joint_rand_part", "corrected_seed"] if flp.JOINT_RAND_LEN else []
+    )
+    for k in keys:
+        assert np.array_equal(np.asarray(row[k]), np.asarray(pl[k])), k
+    osp = np.asarray(pl["out_share"])
+    R, n, L, _ = osp.shape
+    assert np.array_equal(
+        np.asarray(row["out_share"]), osp.transpose(0, 3, 2, 1).reshape(B, L, n)
+    )
+
+
+@pytest.mark.slow
+def test_planar_leader_matches_row_path(monkeypatch):
+    """Leader-side planar prep (explicit meas/proof limbs, no XOF share
+    expansion) is byte-identical to the row path for every output.
+    Interpret mode; slow tier."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("JANUS_TPU_PALLAS", "interpret")
+    vdaf = prio3_histogram(length=4, chunk_length=2)
+    bp = BatchedPrio3(vdaf)
+    B = 1024
+    rng = np.random.default_rng(9)
+    kw = dict(
+        nonces_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        meas_limbs=jnp.asarray(
+            rng.integers(0, 1 << 16, (B, vdaf.flp.MEAS_LEN, bp.jf.n), dtype=np.uint32)
+        ),
+        proofs_limbs=jnp.asarray(
+            rng.integers(0, 1 << 16, (B, vdaf.flp.PROOF_LEN, bp.jf.n), dtype=np.uint32)
+        ),
+        blinds_u8=jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8)),
+        public_parts_u8=jnp.asarray(rng.integers(0, 256, (B, 2, 16), dtype=np.uint8)),
+    )
+    vk = b"\x2a" * 16
+    assert bp.planar_eligible(0, B)
+    row = jax.jit(lambda kw: bp.prep_init(0, verify_key=vk, **kw))(kw)
+    pl = jax.jit(
+        lambda kw: bp.prep_init_planar(
+            0,
+            vk,
+            kw["nonces_u8"],
+            meas_limbs=kw["meas_limbs"],
+            proofs_limbs=kw["proofs_limbs"],
+            blinds_u8=kw["blinds_u8"],
+            public_parts_u8=kw["public_parts_u8"],
+        )
+    )(kw)
+    for k in ("verifiers", "ok", "joint_rand_part", "corrected_seed"):
+        assert np.array_equal(np.asarray(row[k]), np.asarray(pl[k])), k
+    osp = np.asarray(pl["out_share"])
+    R, n, L, _ = osp.shape
+    assert np.array_equal(
+        np.asarray(row["out_share"]), osp.transpose(0, 3, 2, 1).reshape(B, L, n)
+    )
+
 
 @pytest.mark.slow
 def test_planar_sumvec_matches_row_path(monkeypatch):
