@@ -605,6 +605,8 @@ class Aggregator:
         vdaf = ta.vdaf
         if isinstance(vdaf, Prio3):
             return self._helper_prepare_batch_prio3(ta, decoded)
+        if hasattr(ta.backend, "prep_init_batch_poplar"):
+            return self._helper_prepare_batch_poplar1(ta, decoded, agg_param)
         results: Dict[int, object] = {}
         vk = ta.task.vdaf_verify_key
         for idx, (nonce, public_parts, input_share, leader_msg) in decoded:
@@ -612,6 +614,56 @@ class Aggregator:
                 trans = pp.helper_initialized(
                     vdaf, vk, agg_param, nonce, public_parts, input_share, leader_msg
                 )
+                state, outbound = trans.evaluate(vdaf)
+            except (VdafError, pp.PingPongError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            if isinstance(state, pp.PingPongFinished):
+                results[idx] = ("finished", state.out_share, outbound)
+            else:
+                results[idx] = (
+                    "continued",
+                    vdaf.ping_pong_encode_state(state.prep_state),
+                    outbound,
+                )
+        return results
+
+    def _helper_prepare_batch_poplar1(self, ta: TaskAggregator, decoded, agg_param):
+        """Heavy hitters through the batched backend: the round-0 IDPF tree
+        walk + sketch runs once for the whole job (ops/poplar1_batch.py);
+        the per-report remainder is the same combine/transition
+        helper_initialized performs (reference: Poplar1 rides the common
+        accelerated dispatch, core/src/vdaf.rs:96)."""
+        vdaf = ta.vdaf
+        vk = ta.task.vdaf_verify_key
+        results: Dict[int, object] = {}
+        rows = []
+        for idx, (nonce, public_parts, input_share, leader_msg) in decoded:
+            try:
+                if leader_msg.variant != pp.PingPongMessage.INITIALIZE:
+                    raise pp.PingPongError("expected initialize message")
+                leader_share = vdaf.ping_pong_decode_prep_share(
+                    leader_msg.prep_share, round=0
+                )
+            except (VdafError, pp.PingPongError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            rows.append((idx, nonce, public_parts, input_share, leader_share))
+        if not rows:
+            return results
+        prep_out = ta.backend.prep_init_batch_poplar(
+            vk, 1, agg_param, [(n, p, s) for (_, n, p, s, _) in rows]
+        )
+        for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
+            if isinstance(outcome, VdafError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            prep_state, helper_share = outcome
+            try:
+                prep_msg = vdaf.ping_pong_prep_shares_to_prep(
+                    agg_param, [leader_share, helper_share], round=0
+                )
+                trans = pp.PingPongTransition(prep_state, prep_msg, 0)
                 state, outbound = trans.evaluate(vdaf)
             except (VdafError, pp.PingPongError):
                 results[idx] = PrepareError.VDAF_PREP_ERROR

@@ -16,6 +16,7 @@ overflowed (``ok`` mask — astronomically rare, but exact).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -34,6 +35,14 @@ from .prio3 import (
 PrepOutcome = Union[Tuple[Prio3PrepareState, Prio3PrepareShare], VdafError]
 
 
+def _observe_prepare(backend: str, phase: str, reports: int, seconds: float) -> None:
+    """Per-backend steady-state throughput/latency metrics (VERDICT r4 #6)."""
+    from ..core.metrics import GLOBAL_METRICS
+
+    if GLOBAL_METRICS.registry is not None:
+        GLOBAL_METRICS.observe_prepare(backend, phase, reports, seconds)
+
+
 class OracleBackend:
     """Scalar per-report loop — the analog of the reference's rayon hop
     (reference: aggregator/src/aggregator.rs:2101)."""
@@ -49,6 +58,7 @@ class OracleBackend:
         agg_id: int,
         reports: Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]],
     ) -> List[PrepOutcome]:
+        t0 = time.monotonic()
         out: List[PrepOutcome] = []
         for nonce, public_share, input_share in reports:
             try:
@@ -57,17 +67,20 @@ class OracleBackend:
                 )
             except VdafError as e:
                 out.append(e)
+        _observe_prepare(self.name, "init", len(out), time.monotonic() - t0)
         return out
 
     def prep_shares_to_prep_batch(
         self, prep_shares: Sequence[Sequence[Prio3PrepareShare]]
     ) -> List[Union[Optional[bytes], VdafError]]:
+        t0 = time.monotonic()
         out: List[Union[Optional[bytes], VdafError]] = []
         for shares in prep_shares:
             try:
                 out.append(self.vdaf.prep_shares_to_prep(shares))
             except VdafError as e:
                 out.append(e)
+        _observe_prepare(self.name, "combine", len(out), time.monotonic() - t0)
         return out
 
 
@@ -92,10 +105,11 @@ class TpuBackend:
         self._agg_fn = None
 
     # -- jit caches ------------------------------------------------------
-    #: MeshBackend overrides to False: Pallas custom calls do not partition
-    #: under a sharded jit, so the planar fast path is single-chip only
-    #: (each chip of a mesh still runs it inside its own shard via the
-    #: driver's per-chip launches; the mesh prepare path stays row-major).
+    #: Gate for the limb-planar fast path.  Pallas custom calls do not
+    #: partition under SHARDED jit, but MeshBackend routes its launches
+    #: through shard_map (manual partitioning), where each chip runs the
+    #: planar kernels on its own shard — so both backends keep this True;
+    #: it remains a seam for environments whose compiler lacks the kernels.
     _planar_capable = True
 
     def _prep_fn(self, agg_id: int):
@@ -107,22 +121,21 @@ class TpuBackend:
             def prep(kw):
                 vk = kw.pop("verify_key_u8")
                 B = kw["nonces_u8"].shape[0]
-                if (
-                    self._planar_capable
-                    and "share_seeds_u8" in kw
-                    and "blinds_u8" in kw
-                    and self.bp.planar_eligible(agg_id, B)
-                ):
-                    # Limb-planar fast path (the bench pipeline): outputs
-                    # are identical; out_share transposes back to row-major
-                    # for the unmarshal/aggregate interfaces.
+                if self._planar_capable and self.bp.planar_eligible(agg_id, B):
+                    # Limb-planar fast path (the bench pipeline), both
+                    # sides: helpers expand share seeds through the planar
+                    # XOF, the leader transposes its explicit shares in.
+                    # Outputs are identical; out_share transposes back to
+                    # row-major for the unmarshal/aggregate interfaces.
                     out = self.bp.prep_init_planar(
                         agg_id,
                         vk,
                         kw["nonces_u8"],
-                        share_seeds_u8=kw["share_seeds_u8"],
-                        blinds_u8=kw["blinds_u8"],
-                        public_parts_u8=kw["public_parts_u8"],
+                        share_seeds_u8=kw.get("share_seeds_u8"),
+                        meas_limbs=kw.get("meas_limbs"),
+                        proofs_limbs=kw.get("proofs_limbs"),
+                        blinds_u8=kw.get("blinds_u8"),
+                        public_parts_u8=kw.get("public_parts_u8"),
                     )
                     out = dict(
                         out,
@@ -288,9 +301,11 @@ class TpuBackend:
                     )
                 )
 
+        t0 = time.monotonic()
         out = self._combine()(vs, parts)
         decide = np.asarray(out["decide"])[:B]
         seeds = np.asarray(out["prep_msg_seed"])[:B] if has_jr else None
+        _observe_prepare(self.name, "combine", B, time.monotonic() - t0)
 
         results: List[Union[Optional[bytes], VdafError]] = []
         for b in range(B):
@@ -340,9 +355,14 @@ class TpuBackend:
         if GLOBAL_METRICS.registry is not None:
             GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
             GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
-        out = self._prep_fn(agg_id)(self._place(kw))
-        # One readback for the whole launch, then slice per request.
-        outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
+        from ..core.trace import trace_span
+
+        t0 = time.monotonic()
+        with trace_span("prep_launch", cat="device", backend=self.name, batch=B):
+            out = self._prep_fn(agg_id)(self._place(kw))
+            # One readback for the whole launch, then slice per request.
+            outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
+        _observe_prepare(self.name, "init", B, time.monotonic() - t0)
         start = 0
         results: List[List[PrepOutcome]] = []
         for verify_key, reports in requests:
@@ -395,7 +415,6 @@ class MeshBackend(TpuBackend):
     """
 
     name = "mesh"
-    _planar_capable = False  # see TpuBackend._planar_capable
 
     def __init__(self, vdaf: Prio3, devices=None):
         super().__init__(vdaf)
@@ -407,6 +426,72 @@ class MeshBackend(TpuBackend):
         self._batch_sharding = NamedSharding(self.mesh, PartitionSpec("batch"))
         self._replicated = NamedSharding(self.mesh, PartitionSpec())
 
+    # -- sharded launches -------------------------------------------------
+    # prepare/combine run under shard_map (manual partitioning): each chip
+    # executes the SAME per-shard program TpuBackend runs — including the
+    # limb-planar Pallas kernels, which do not partition under sharded jit
+    # but run fine per-shard — on its 1/N of the batch.  No cross-shard
+    # dataflow exists in prepare, so out_specs are batch-sharded
+    # everywhere; the cross-chip psum stays in aggregate_batch (sharded
+    # jit, XLA inserts the all-reduce).  planar_eligible is evaluated on
+    # the LOCAL (per-shard) batch during tracing, so planar engages exactly
+    # when each chip's shard satisfies the kernels' tiling.
+
+    def _shard_wrap(self, per_shard):
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        return jax.jit(
+            shard_map(
+                per_shard,
+                mesh=self.mesh,
+                in_specs=(PartitionSpec("batch"),),
+                out_specs=PartitionSpec("batch"),
+                check_rep=False,
+            )
+        )
+
+    def _prep_fn(self, agg_id: int):
+        fn = self._prep_fns.get(agg_id)
+        if fn is None:
+
+            def per_shard(kw):
+                vk = kw.pop("verify_key_u8")
+                B = kw["nonces_u8"].shape[0]
+                if self._planar_capable and self.bp.planar_eligible(agg_id, B):
+                    out = self.bp.prep_init_planar(
+                        agg_id,
+                        vk,
+                        kw["nonces_u8"],
+                        share_seeds_u8=kw.get("share_seeds_u8"),
+                        meas_limbs=kw.get("meas_limbs"),
+                        proofs_limbs=kw.get("proofs_limbs"),
+                        blinds_u8=kw.get("blinds_u8"),
+                        public_parts_u8=kw.get("public_parts_u8"),
+                    )
+                    return dict(
+                        out,
+                        out_share=self.bp.planar_out_share_to_rows(out["out_share"]),
+                    )
+                return self.bp.prep_init(agg_id, verify_key=vk, **kw)
+
+            fn = self._shard_wrap(per_shard)
+            self._prep_fns[agg_id] = fn
+        return fn
+
+    def _combine(self):
+        if self._combine_fn is None:
+            has_jr = self.vdaf.flp.JOINT_RAND_LEN > 0
+
+            def per_shard(args):
+                vs, parts = args
+                return self.bp.prep_shares_to_prep(vs, parts if has_jr else None)
+
+            wrapped = self._shard_wrap(per_shard)
+            self._combine_fn = lambda vs, parts: wrapped((vs, parts))
+        return self._combine_fn
+
     # The batch APIs are inherited: only padding and placement differ.
     def _pad_to(self, B: int) -> int:
         # Power-of-two bucketing (bounds recompiles) rounded up so the mesh
@@ -415,15 +500,200 @@ class MeshBackend(TpuBackend):
         return max(next_power_of_2(B), n)
 
     def _place(self, kw: Dict[str, np.ndarray]) -> Dict[str, object]:
-        """Commit per-report arrays shard-per-device; replicate scalars."""
-        placed: Dict[str, object] = {}
-        for k, v in kw.items():
-            sharding = self._replicated if k == "verify_key_u8" else self._batch_sharding
-            placed[k] = self._jax.device_put(v, sharding)
-        return placed
+        """Commit per-report arrays shard-per-device.
+
+        Every marshaled array — including verify_key_u8, which
+        prep_init_multi expands to one row per report — has the batch as
+        its leading axis, matching _shard_wrap's in_specs."""
+        return {
+            k: self._jax.device_put(v, self._batch_sharding) for k, v in kw.items()
+        }
 
     def _place_batch(self, arr: np.ndarray):
         return self._jax.device_put(arr, self._batch_sharding)
+
+
+class HybridXofBackend:
+    """Host-XOF + device-FLP hybrid for non-TurboSHAKE Prio3 instances.
+
+    The HMAC-SHA256-AES128 multiproof VDAF (reference:
+    core/src/vdaf.rs:178-195) keeps its XOF on the host — HMAC/AES have no
+    TPU kernels worth writing, and the multiproof circuits' XOF volume is
+    tiny — while the FLP queries (num_proofs of them) and the decide run
+    as one batched device launch (BatchedPrio3.query_batch/decide_batch).
+    Byte parity with the oracle is the same contract as TpuBackend's
+    (tests/test_backend.py)."""
+
+    name = "tpu-hybrid"
+
+    def __init__(self, vdaf: Prio3):
+        import jax
+
+        from ..ops.prepare import BatchedPrio3
+
+        self.vdaf = vdaf
+        self.bp = BatchedPrio3(vdaf, require_device_xof=False)
+        self.oracle = OracleBackend(vdaf)
+        self._jax = jax
+        self._query_fn = None
+        self._decide_fn = None
+
+    def _pad_to(self, B: int) -> int:
+        return next_power_of_2(B)
+
+    def prep_init_batch(self, verify_key, agg_id, reports):
+        if not reports:
+            return []
+        vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
+        t0 = time.monotonic()
+        B = len(reports)
+        has_jr = flp.JOINT_RAND_LEN > 0
+        meas_rows: List[int] = []
+        proof_rows: List[int] = []
+        qr_rows: List[int] = []
+        jr_rows: List[int] = []
+        parts: List[Optional[bytes]] = []
+        corrected: List[Optional[bytes]] = []
+        for nonce, public_share, input_share in reports:
+            # host XOF stage — mirrors Prio3.prep_init element for element
+            if agg_id == 0:
+                meas = input_share.meas_share
+                proofs = input_share.proofs_share
+            else:
+                meas = vdaf._helper_meas_share(agg_id, input_share.share_seed)
+                proofs = vdaf._helper_proofs_share(agg_id, input_share.share_seed)
+            meas_rows.extend(meas)
+            proof_rows.extend(proofs)
+            qr_rows.extend(vdaf._query_rands(verify_key, nonce))
+            if has_jr:
+                part = vdaf._joint_rand_part(
+                    agg_id, input_share.joint_rand_blind, meas, nonce
+                )
+                ps = list(public_share)
+                ps[agg_id] = part
+                cs = vdaf._joint_rand_seed(ps)
+                jr_rows.extend(vdaf._joint_rands(cs))
+                parts.append(part)
+                corrected.append(cs)
+            else:
+                parts.append(None)
+                corrected.append(None)
+
+        pad_to = self._pad_to(B)
+
+        def limb_mat(vals, width):
+            arr = jf.to_limbs(vals).reshape(B, width, jf.n)
+            return np.concatenate([arr, np.repeat(arr[-1:], pad_to - B, axis=0)])
+
+        meas_l = limb_mat(meas_rows, flp.MEAS_LEN)
+        proofs_l = limb_mat(proof_rows, flp.PROOF_LEN * vdaf.num_proofs)
+        qr_l = limb_mat(qr_rows, flp.QUERY_RAND_LEN * vdaf.num_proofs)
+        jr_l = (
+            limb_mat(jr_rows, flp.JOINT_RAND_LEN * vdaf.num_proofs)
+            if has_jr
+            else None
+        )
+        if self._query_fn is None:
+            self._query_fn = self._jax.jit(self.bp.query_batch)
+        out = self._query_fn(meas_l, proofs_l, jr_l, qr_l)
+        ok = np.asarray(out["ok"])[:B]
+        verifiers = np.asarray(out["verifiers"])[:B]
+        out_shares = np.asarray(out["out_share"])[:B]
+
+        results: List[PrepOutcome] = []
+        for b in range(B):
+            if not ok[b]:
+                results.extend(
+                    self.oracle.prep_init_batch(verify_key, agg_id, [reports[b]])
+                )
+                continue
+            state = Prio3PrepareState(
+                out_share=jf.from_limbs(out_shares[b]),
+                corrected_joint_rand_seed=corrected[b],
+            )
+            share = Prio3PrepareShare(
+                verifiers_share=jf.from_limbs(verifiers[b]),
+                joint_rand_part=parts[b],
+            )
+            results.append((state, share))
+        _observe_prepare(self.name, "init", B, time.monotonic() - t0)
+        return results
+
+    def prep_shares_to_prep_batch(self, prep_shares):
+        if not prep_shares:
+            return []
+        vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
+        t0 = time.monotonic()
+        S = vdaf.num_shares
+        bad_rows = {i for i, row in enumerate(prep_shares) if len(row) != S}
+        if bad_rows:
+            results = []
+            good = [row for i, row in enumerate(prep_shares) if i not in bad_rows]
+            good_iter = iter(self.prep_shares_to_prep_batch(good))
+            for i in range(len(prep_shares)):
+                results.append(
+                    VdafError("wrong number of prepare shares")
+                    if i in bad_rows
+                    else next(good_iter)
+                )
+            return results
+        B = len(prep_shares)
+        pad_to = self._pad_to(B)
+        ver_len = flp.VERIFIER_LEN * vdaf.num_proofs
+        acc_rows = [row[0].verifiers_share for row in prep_shares]
+        for a in range(1, S):
+            acc_rows = [
+                flp.field.vec_add(prev, row[a].verifiers_share)
+                for prev, row in zip(acc_rows, prep_shares)
+            ]
+        comb_l = jf.to_limbs([x for row in acc_rows for x in row]).reshape(
+            B, ver_len, jf.n
+        )
+        comb_l = np.concatenate(
+            [comb_l, np.repeat(comb_l[-1:], pad_to - B, axis=0)]
+        )
+        if self._decide_fn is None:
+            self._decide_fn = self._jax.jit(self.bp.decide_batch)
+        decide = np.asarray(self._decide_fn(comb_l))[:B]
+        results = []
+        has_jr = flp.JOINT_RAND_LEN > 0
+        for b in range(B):
+            if not decide[b]:
+                results.append(VdafError("proof verification failed"))
+            elif has_jr:
+                results.append(
+                    vdaf._joint_rand_seed(
+                        [row.joint_rand_part for row in prep_shares[b]]
+                    )
+                )
+            else:
+                results.append(None)
+        _observe_prepare(self.name, "combine", B, time.monotonic() - t0)
+        return results
+
+
+class Poplar1Backend:
+    """Batched prepare for Poplar1 (heavy hitters): bulk-AES IDPF tree walk
+    on the host (AES-NI territory) + JField sketch inner products on the
+    accelerator — see ops/poplar1_batch.py.  Exposed through the same
+    dispatch seam as the Prio3 backends so the role logic stays
+    VDAF-agnostic (reference: core/src/vdaf.rs:96 — Poplar1 rides the same
+    accelerated dispatch as Prio3)."""
+
+    name = "poplar1-batch"
+
+    def __init__(self, vdaf):
+        from ..ops.poplar1_batch import BatchedPoplar1
+
+        self.vdaf = vdaf
+        self.bp = BatchedPoplar1(vdaf)
+
+    def prep_init_batch_poplar(self, verify_key, agg_id, agg_param, reports):
+        """Batched round-0 prep: per-report (state, share), oracle parity."""
+        t0 = time.monotonic()
+        out = self.bp.prep_init_batch(verify_key, agg_id, agg_param, reports)
+        _observe_prepare(self.name, "init", len(out), time.monotonic() - t0)
+        return out
 
 
 BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend, "mesh": MeshBackend}
@@ -447,21 +717,32 @@ def device_supported(vdaf) -> Tuple[bool, str]:
     split to begin with).  jax-free by design.
     """
     if not isinstance(vdaf, Prio3):
+        if type(vdaf).__name__ == "Poplar1":
+            return True, ""  # batched host-AES + device-sketch path
         return False, f"{type(vdaf).__name__} is not a Prio3 VDAF"
-    if vdaf.xof is not XofTurboShake128:
-        return False, (
-            f"XOF {vdaf.xof.__name__} has no device kernel (TurboShake128 only)"
-        )
     circuit = type(vdaf.flp.valid).__name__
     if circuit not in DEVICE_CIRCUITS:
         return False, f"no device circuit for {circuit}"
+    # Non-TurboSHAKE XOFs (HMAC multiproof) ride the hybrid backend: host
+    # XOF, device FLP query/decide (HybridXofBackend).
     return True, ""
 
 
-def make_backend(vdaf: Prio3, backend: str = "oracle"):
+def make_backend(vdaf, backend: str = "oracle"):
     """Backend factory — the dispatch gate named in the north star."""
     try:
         cls = BACKENDS[backend]
     except KeyError:
         raise VdafError(f"unknown backend {backend!r}")
+    if backend != "oracle" and type(vdaf).__name__ == "Poplar1":
+        # Heavy hitters: the device configs route Poplar1 through the
+        # batched AES/sketch path instead of the Prio3-shaped backends.
+        return Poplar1Backend(vdaf)
+    if (
+        backend != "oracle"
+        and isinstance(vdaf, Prio3)
+        and vdaf.xof is not XofTurboShake128
+    ):
+        # Host-XOF VDAFs (HMAC multiproof): device FLP, host XOF.
+        return HybridXofBackend(vdaf)
     return cls(vdaf)

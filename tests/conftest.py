@@ -48,6 +48,7 @@ DEVICE_TIER_MODULES = {
     "test_mesh",
     "test_integration_pair",
     "test_backend",
+    "test_poplar1_batch",
 }
 
 

@@ -22,7 +22,7 @@ def test_backend_dispatch_gate():
     assert isinstance(vdaf.backend, TpuBackend)
     with pytest.raises(VdafError):
         make_backend(vdaf, "gpu")
-    # The HMAC XOF instance has no device path.
+    # The HMAC XOF instance rides the hybrid backend (host XOF, device FLP).
     hm = vdaf_from_instance(
         {
             "type": "Prio3SumVecField64MultiproofHmacSha256Aes128",
@@ -32,8 +32,9 @@ def test_backend_dispatch_gate():
             "chunk_length": 2,
         }
     )
-    with pytest.raises(VdafError):
-        make_backend(hm, "tpu")
+    from janus_tpu.vdaf.backend import HybridXofBackend
+
+    assert isinstance(make_backend(hm, "tpu"), HybridXofBackend)
 
 
 @pytest.mark.slow
@@ -136,3 +137,54 @@ def test_tpu_backend_planar_routing_matches_oracle(monkeypatch):
         assert got[0].corrected_joint_rand_seed == want[0].corrected_joint_rand_seed
         assert got[1].verifiers_share == want[1].verifiers_share
         assert got[1].joint_rand_part == want[1].joint_rand_part
+
+
+def test_hybrid_backend_agrees_on_multiproof_job():
+    """The host-XOF/device-FLP hybrid (HMAC multiproof VDAF) produces
+    byte-identical prep artifacts to the oracle, including rejecting a
+    tampered report in BOTH proofs' decide."""
+    vdaf = vdaf_from_instance(
+        {
+            "type": "Prio3SumVecField64MultiproofHmacSha256Aes128",
+            "proofs": 2,
+            "length": 4,
+            "bits": 2,
+            "chunk_length": 3,
+        }
+    )
+    rng = det_rng("hybrid-agree")
+    verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+    reports = []
+    for m in ([0, 1, 2, 3], [3, 3, 3, 3], [1, 0, 0, 2]):
+        nonce = rng(vdaf.NONCE_SIZE)
+        public_share, input_shares = vdaf.shard(m, nonce, rng(vdaf.RAND_SIZE))
+        reports.append((nonce, public_share, input_shares))
+    bad = bytearray(reports[1][2][1].share_seed)
+    bad[0] ^= 0x80
+    reports[1][2][1].share_seed = bytes(bad)
+
+    oracle = make_backend(vdaf, "oracle")
+    hybrid = make_backend(vdaf, "tpu")
+    results = {}
+    for backend in (oracle, hybrid):
+        per_agg = [
+            backend.prep_init_batch(
+                verify_key, agg_id, [(n, p, s[agg_id]) for n, p, s in reports]
+            )
+            for agg_id in (0, 1)
+        ]
+        combined = backend.prep_shares_to_prep_batch(
+            [[per_agg[0][b][1], per_agg[1][b][1]] for b in range(len(reports))]
+        )
+        results[backend.name] = (per_agg, combined)
+    o_init, o_comb = results["oracle"]
+    h_init, h_comb = results["tpu-hybrid"]
+    for agg_id in (0, 1):
+        for b in range(len(reports)):
+            assert h_init[agg_id][b][1].encode(vdaf) == o_init[agg_id][b][1].encode(vdaf)
+            assert h_init[agg_id][b][0].out_share == o_init[agg_id][b][0].out_share
+    for b in range(len(reports)):
+        if b == 1:
+            assert isinstance(h_comb[b], VdafError) and isinstance(o_comb[b], VdafError)
+        else:
+            assert h_comb[b] == o_comb[b]

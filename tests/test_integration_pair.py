@@ -265,11 +265,22 @@ def test_multiround_fake_vdaf_e2e():
 
 
 def test_poplar1_e2e():
+    _poplar1_e2e("oracle")
+
+
+def test_poplar1_e2e_batched_backend():
+    """Same flow with vdaf_backend=tpu: the helper routes through the
+    batched Poplar1 path (bulk-AES IDPF + device sketch,
+    ops/poplar1_batch.py) instead of per-report ping-pong."""
+    _poplar1_e2e("tpu")
+
+
+def _poplar1_e2e(backend):
     """Poplar1 through the whole service: upload, collection-request-driven
     job creation at a level, two-round aggregation over HTTP, collect."""
     from janus_tpu.vdaf.poplar1 import Poplar1AggregationParam
 
-    pair = InProcessPair({"type": "Poplar1", "bits": 4})
+    pair = InProcessPair({"type": "Poplar1", "bits": 4}, backend=backend)
     measurements = [0b1011, 0b1011, 0b0100, 0b1111]
 
     async def flow():
