@@ -56,6 +56,16 @@ class CommonConfig:
     health_check_listen_address: str = "127.0.0.1:8000"
     max_transaction_retries: int = 30
     log_level: str = "INFO"
+    #: Multi-HOST accelerator mesh over DCN (the analog of the reference's
+    #: NCCL/MPI multi-node backend): when set, the process joins a
+    #: jax.distributed cluster before creating backends, so
+    #: ``vdaf_backend: mesh`` spans every host's chips — shard_map splits
+    #: batches across all of them and the aggregate all-reduce rides
+    #: ICI within a host and DCN across hosts, with XLA choosing the
+    #: collective topology.  Fields mirror jax.distributed.initialize.
+    distributed_coordinator: str = ""  # "host:port"; empty = single host
+    distributed_num_processes: int = 0
+    distributed_process_id: int = -1
     #: Chrome-trace (Trace Event Format) output path for job/launch spans —
     #: load in chrome://tracing or Perfetto (reference: trace.rs:145-156
     #: chrome tracing layer).  Off when empty.

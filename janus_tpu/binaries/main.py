@@ -28,6 +28,7 @@ from ..datastore import Crypter, Datastore
 from ..messages import Duration
 from .config import (
     AggregatorConfig,
+    ConfigError,
     JobCreatorConfig,
     JobDriverBinaryConfig,
     datastore_keys_from_env,
@@ -48,6 +49,35 @@ def _bootstrap(config_common):
     )
 
     install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
+    if getattr(config_common, "distributed_coordinator", ""):
+        # Join the jax.distributed cluster BEFORE any backend touches jax.
+        # The daemons keep their mesh LOCAL (per-replica chips over ICI;
+        # cross-host scale-out is the N-replica shared-datastore model) —
+        # a global-span mesh (JANUS_TPU_MESH_SPAN=global) is only sound
+        # for gang-scheduled SPMD deployments whose launcher runs every
+        # process in lockstep.  Reference analog: the NCCL/MPI comm
+        # backend is likewise formed at process start (trace/runtime
+        # bring-up), with the collective topology chosen by the runtime.
+        nproc = config_common.distributed_num_processes
+        pid = config_common.distributed_process_id
+        if (nproc > 0) != (pid >= 0):
+            raise ConfigError(
+                "distributed_num_processes and distributed_process_id must "
+                "be set together (or both left to auto-detection)"
+            )
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=config_common.distributed_coordinator,
+            num_processes=nproc or None,
+            process_id=pid if pid >= 0 else None,
+        )
+        logger.info(
+            "joined distributed cluster via %s (process %d of %d)",
+            config_common.distributed_coordinator,
+            jax.process_index(),
+            jax.process_count(),
+        )
     if getattr(config_common, "chrome_trace_path", ""):
         configure_chrome_trace(config_common.chrome_trace_path)
         logger.info("chrome trace -> %s", config_common.chrome_trace_path)

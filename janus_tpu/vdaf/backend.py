@@ -418,10 +418,24 @@ class MeshBackend(TpuBackend):
 
     def __init__(self, vdaf: Prio3, devices=None):
         super().__init__(vdaf)
+        import os
+
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-        devs = list(devices) if devices is not None else jax.devices()
+        if devices is not None:
+            devs = list(devices)
+        elif os.environ.get("JANUS_TPU_MESH_SPAN", "local") == "global":
+            # Multi-controller SPMD: ONLY sound when every process runs the
+            # same launch sequence in lockstep (gang-scheduled deployments;
+            # a lease-driven daemon must NOT set this — its launches are
+            # per-replica and a cross-host collective would deadlock).
+            devs = jax.devices()
+        else:
+            # Per-replica mesh over this host's chips (ICI); cross-host
+            # scale-out is the N-replica shared-datastore model, exactly
+            # the reference's deployment shape (docs/DEPLOYING.md:29-31).
+            devs = jax.local_devices()
         self.mesh = Mesh(np.array(devs), ("batch",))
         self._batch_sharding = NamedSharding(self.mesh, PartitionSpec("batch"))
         self._replicated = NamedSharding(self.mesh, PartitionSpec())

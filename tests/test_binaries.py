@@ -151,3 +151,23 @@ class TestCli:
         )
         assert result.exit_code == 0, result.output
         assert "CollectionReq" in result.output
+
+
+def test_distributed_mesh_config_parses():
+    """Multi-host (DCN) mesh knobs parse from YAML; empty coordinator means
+    single-host (no jax.distributed call is made)."""
+    from janus_tpu.binaries.config import AggregatorConfig, load_config
+
+    cfg = load_config(
+        AggregatorConfig,
+        text="""
+common:
+  distributed_coordinator: "10.0.0.2:8476"
+  distributed_num_processes: 4
+  distributed_process_id: 1
+""",
+    )
+    assert cfg.common.distributed_coordinator == "10.0.0.2:8476"
+    assert cfg.common.distributed_num_processes == 4
+    assert cfg.common.distributed_process_id == 1
+    assert AggregatorConfig().common.distributed_coordinator == ""
