@@ -56,14 +56,20 @@ class CommonConfig:
     health_check_listen_address: str = "127.0.0.1:8000"
     max_transaction_retries: int = 30
     log_level: str = "INFO"
-    #: Multi-HOST accelerator mesh over DCN (the analog of the reference's
-    #: NCCL/MPI multi-node backend): when set, the process joins a
-    #: jax.distributed cluster before creating backends, so
-    #: ``vdaf_backend: mesh`` spans every host's chips — shard_map splits
-    #: batches across all of them and the aggregate all-reduce rides
-    #: ICI within a host and DCN across hosts, with XLA choosing the
-    #: collective topology.  Fields mirror jax.distributed.initialize.
-    distributed_coordinator: str = ""  # "host:port"; empty = single host
+    #: jax.distributed cluster membership, for GANG-SCHEDULED SPMD
+    #: deployments whose launcher starts (and restarts) every process
+    #: together and runs the same launch sequence in lockstep — with
+    #: JANUS_TPU_MESH_SPAN=global the mesh then spans every host's chips,
+    #: DCN collectives between hosts (the analog of the reference's
+    #: NCCL/MPI multi-node backend).  The ORDINARY lease-driven daemons
+    #: must leave this empty: they issue independent per-replica launches
+    #: (a cross-host collective would deadlock), their mesh is the local
+    #: host's chips, and cross-host scale-out is the N-stateless-replica
+    #: shared-datastore model — note initialize() also blocks at a
+    #: startup barrier until ALL processes join, which fits a gang
+    #: scheduler and not independently-restarting replicas.  Fields
+    #: mirror jax.distributed.initialize.
+    distributed_coordinator: str = ""  # "host:port"; empty = no cluster
     distributed_num_processes: int = 0
     distributed_process_id: int = -1
     #: Chrome-trace (Trace Event Format) output path for job/launch spans —

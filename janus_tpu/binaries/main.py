@@ -50,14 +50,12 @@ def _bootstrap(config_common):
 
     install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
     if getattr(config_common, "distributed_coordinator", ""):
-        # Join the jax.distributed cluster BEFORE any backend touches jax.
-        # The daemons keep their mesh LOCAL (per-replica chips over ICI;
-        # cross-host scale-out is the N-replica shared-datastore model) —
-        # a global-span mesh (JANUS_TPU_MESH_SPAN=global) is only sound
-        # for gang-scheduled SPMD deployments whose launcher runs every
-        # process in lockstep.  Reference analog: the NCCL/MPI comm
-        # backend is likewise formed at process start (trace/runtime
-        # bring-up), with the collective topology chosen by the runtime.
+        # Gang-scheduled SPMD mode ONLY (see CommonConfig): join the
+        # cluster BEFORE any backend touches jax.  initialize() blocks
+        # until every process arrives — correct under a gang scheduler
+        # that restarts the whole set together, wrong for independently
+        # restarting replicas, which must leave this unset (their mesh is
+        # local and the shared datastore is the cross-host scale model).
         nproc = config_common.distributed_num_processes
         pid = config_common.distributed_process_id
         if (nproc > 0) != (pid >= 0):

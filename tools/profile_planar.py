@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Per-op device profile of the prepare pipeline, with HLO source mapping.
+
+The round-5 optimization methodology in one command: run the bench pipeline
+under ``jax.profiler``, parse the chrome trace's device track, and join op
+names against the compiled HLO's source attribution, so time lands on
+``file:line`` instead of ``fusion.180``.  This replaces differential
+micro-benchmarking, which is unreliable on shared-chip / remote-compile
+environments (near-identical graphs can compile 2x apart; see BASELINE.md
+round-4 notes).
+
+Usage:
+    python tools/profile_planar.py [--config histogram1024] [--batch 16384]
+                                   [--depth 16] [--side helper]
+
+Prints ms/batch by source location and the top individual ops.  The raw
+chrome trace stays in --logdir for Perfetto.
+
+Reference analog: the reference leans on tokio-console / chrome tracing for
+the same question (aggregator/src/trace.rs:119-236); here the hot loop is
+one device launch, so the profile of record is the per-op device timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import re
+import sys
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="histogram1024")
+    parser.add_argument("--batch", type=int, default=16384)
+    parser.add_argument("--depth", type=int, default=16)
+    parser.add_argument("--side", default="helper", choices=["helper", "leader"])
+    parser.add_argument("--logdir", default="/tmp/janus_tpu_profile")
+    parser.add_argument("--top", type=int, default=25)
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import CONFIGS, build_pipeline
+    from janus_tpu.utils.jax_setup import enable_compile_cache
+    from janus_tpu.vdaf import instances
+
+    enable_compile_cache()
+    desc, ctor_name, ctor_kw = CONFIGS[args.config]
+    vdaf = getattr(instances, ctor_name)(**ctor_kw)
+    fn, make_inputs = build_pipeline(
+        vdaf,
+        args.batch,
+        multi_task=16 if args.config == "multitask16" else 0,
+        side=args.side,
+    )
+    staged = [make_inputs(i) for i in range(2)]
+    out = fn(staged[0])
+    jax.block_until_ready(out)
+    hlo = fn.lower(staged[0]).compile().as_text()
+
+    # warm pipelined round, then the traced one
+    outs = [fn(staged[k % 2]) for k in range(args.depth)]
+    jax.block_until_ready(outs)
+    with jax.profiler.trace(args.logdir):
+        t0 = time.monotonic()
+        outs = [fn(staged[k % 2]) for k in range(args.depth)]
+        jax.block_until_ready(outs)
+        np.asarray(outs[-1][1][:4])
+        dt = time.monotonic() - t0
+    print(
+        f"{desc} [{args.side}]: {dt / args.depth * 1e3:.2f} ms/batch "
+        f"({args.batch / (dt / args.depth):,.0f} reports/s) at depth {args.depth}"
+    )
+
+    paths = sorted(glob.glob(args.logdir + "/**/*.trace.json.gz", recursive=True))
+    if not paths:
+        print("no trace produced", file=sys.stderr)
+        return 1
+    events = json.load(gzip.open(paths[-1]))["traceEvents"]
+    pid_names = {
+        e["pid"]: e["args"].get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    totals: collections.Counter = collections.Counter()
+    for e in events:
+        pname = pid_names.get(e.get("pid"), "")
+        if (
+            e.get("ph") == "X"
+            and "dur" in e
+            and ("TPU" in pname or "/device:" in pname)
+        ):
+            if not e["name"].startswith("jit_"):  # skip the umbrella span
+                totals[e["name"]] += e["dur"]
+
+    src = {}
+    pat = re.compile(
+        r"%([\w.\-]+) = (\S+).*?source_file=\"([^\"]+)\" source_line=(\d+)"
+    )
+    for line in hlo.splitlines():
+        m = pat.search(line)
+        if m:
+            name, shape, f, ln = m.groups()
+            src.setdefault(name, (f.rsplit("/", 1)[-1] + ":" + ln, shape))
+
+    by_src: collections.Counter = collections.Counter()
+    for name, us in totals.items():
+        by_src[src.get(name, ("<unattributed>", ""))[0]] += us
+    total = sum(totals.values())
+    print(f"\ndevice op time {total / args.depth / 1e3:.2f} ms/batch by source:")
+    for key, us in by_src.most_common(args.top):
+        print(f"  {us / args.depth / 1e3:8.3f} ms/b {us / total * 100:5.1f}%  {key}")
+    print("\ntop individual ops:")
+    for name, us in totals.most_common(args.top):
+        loc, shape = src.get(name, ("<unattributed>", ""))
+        print(f"  {us / args.depth / 1e3:8.3f} ms/b  {name[:44]:46} {loc:30} {shape[:42]}")
+    print(f"\nraw trace: {paths[-1]} (open in Perfetto)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
