@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -167,6 +168,98 @@ def measure(fn, staged, iters: int, pipeline_depth: int):
         np.asarray(outs[-1][1][:4])
         rounds.append((time.monotonic() - t0) / pipeline_depth)
     return sync, rounds
+
+
+def run_executor_config(args, scaled: bool) -> dict:
+    """BASELINE configs[5] local proxy: N concurrent tasks through the
+    DEVICE EXECUTOR (janus_tpu/executor/), the continuous cross-job
+    batcher.  16 async submitters — one per task, each with its own verify
+    key — submit small per-job batches concurrently; the executor
+    coalesces them into pow2-padded mega-batches.  Reported: aggregate
+    reports/s end-to-end (submit -> unmarshaled oracle-level outcomes) and
+    the mean flush mega-batch size, which must exceed the per-submitter
+    batch size for cross-job coalescing to have actually happened.
+
+    ``scaled`` (CPU-only machines): a small histogram shape keeps the
+    XLA:CPU compile in seconds; the coalescing measurement is shape-
+    independent.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from janus_tpu.executor import DeviceExecutor, ExecutorConfig
+    from janus_tpu.vdaf.backend import TpuBackend
+    from janus_tpu.vdaf.instances import prio3_histogram
+
+    n_tasks = 16
+    if scaled:
+        vdaf = prio3_histogram(length=4, chunk_length=2)
+        per, rounds = 8, 2
+        desc = "16 concurrent tasks x Prio3Histogram len=4 (executor, scaled)"
+    else:
+        vdaf = prio3_histogram(length=1024, chunk_length=316)
+        per, rounds = 32, 4
+        desc = "16 concurrent tasks x Prio3Histogram len=1024 (executor)"
+
+    backend = TpuBackend(vdaf)
+    executor = DeviceExecutor(
+        ExecutorConfig(
+            enabled=True,
+            flush_max_rows=n_tasks * per,
+            flush_window_s=0.005,
+        )
+    )
+    shape_key = ("bench-executor", type(vdaf.flp.valid).__name__)
+
+    # One shard per task, repeated per row: prepare is input-oblivious, so
+    # identical rows measure real throughput without paying n_tasks*per*
+    # rounds host-side shards.
+    rng = np.random.default_rng(7)
+    tasks = []
+    for t in range(n_tasks):
+        vk = rng.integers(0, 256, vdaf.VERIFY_KEY_SIZE, dtype=np.uint8).tobytes()
+        nonce = rng.integers(0, 256, vdaf.NONCE_SIZE, dtype=np.uint8).tobytes()
+        rand = rng.integers(0, 256, vdaf.RAND_SIZE, dtype=np.uint8).tobytes()
+        public, shares = vdaf.shard(t % vdaf.flp.valid.length, nonce, rand)
+        tasks.append((vk, [(nonce, public, shares[0])] * per))
+
+    async def submitter(vk, reports):
+        for _ in range(rounds):
+            out = await executor.submit(
+                shape_key, "prep_init", (vk, reports), backend=backend, agg_id=0
+            )
+            assert len(out) == len(reports)
+
+    async def drive():
+        await asyncio.gather(*[submitter(vk, reports) for vk, reports in tasks])
+        await executor.drain()
+
+    # Warmup pass compiles the mega-batch executable outside the timing;
+    # stats are diffed against this snapshot so flushes/mean_flush_rows
+    # describe ONLY the timed pass.
+    asyncio.run(drive())
+    warm = next(iter(executor.stats().values()), {})
+    t0 = time.monotonic()
+    asyncio.run(drive())
+    elapsed = time.monotonic() - t0
+    executor.shutdown()
+
+    stats = next(iter(executor.stats().values()), {})
+    total = n_tasks * per * rounds
+    flushes = stats.get("flushes", 0) - warm.get("flushes", 0)
+    flushed_rows = stats.get("flushed_rows", 0) - warm.get("flushed_rows", 0)
+    mean_flush = round(flushed_rows / flushes, 2) if flushes else 0.0
+    return {
+        "config": desc,
+        "value": round(total / elapsed, 1),
+        "unit": "reports/s",
+        "submitters": n_tasks,
+        "per_submitter_rows": per,
+        "mean_flush_rows": mean_flush,
+        "flushes": flushes,
+        "cross_job_coalesced": bool(mean_flush > per),
+    }
 
 
 CONFIGS = {
@@ -336,8 +429,9 @@ def main() -> int:
     parser.add_argument(
         "--config",
         default="all",
-        choices=["all"] + list(CONFIGS),
-        help="one config, or 'all' for every BASELINE.md row (default)",
+        choices=["all"] + list(CONFIGS) + ["executor16"],
+        help="one config, or 'all' for every BASELINE.md row (default); "
+        "executor16 is the device-executor concurrent-task row",
     )
     parser.add_argument(
         "--side",
@@ -354,13 +448,50 @@ def main() -> int:
 
     enable_compile_cache()
 
-    platform = jax.devices()[0].platform
+    # Backend init with CPU fallback (BENCH_r05: rc=1, "Unable to
+    # initialize backend 'axon'", when the TPU plugin is unreachable).  jax
+    # caches the failed backend election for the process lifetime, so the
+    # retry re-execs this interpreter with JAX_PLATFORMS='' overridden to
+    # CPU and a marker that the output JSON records as the platform.
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError as e:
+        if os.environ.get("JANUS_TPU_BENCH_CPU_FALLBACK") == "1":
+            raise  # the CPU fallback itself failed; nothing left to try
+        sys.stderr.write(
+            f"backend init failed ({e}); retrying on CPU\n"
+        )
+        env = dict(
+            os.environ, JANUS_TPU_BENCH_CPU_FALLBACK="1", JAX_PLATFORMS="cpu"
+        )
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    if os.environ.get("JANUS_TPU_BENCH_CPU_FALLBACK") == "1":
+        platform = "cpu_fallback"
+    #: On a CPU-only machine the full-size circuits cold-compile for tens of
+    #: minutes each (no persistent XLA:CPU cache — see utils/jax_setup.py),
+    #: so the run scales down to the cheap config + the executor row and
+    #: records what it skipped, instead of hanging or dying.
+    scaled = platform in ("cpu", "cpu_fallback")
+
     names = DEFAULT_SET if args.config == "all" else [args.config]
     results = {}
+    if scaled and args.config == "all":
+        names = ["count"]
+        args.batch = min(args.batch, 256)
+        args.iters = min(args.iters, 3)
+        args.pipeline_depth = min(args.pipeline_depth, 4)
+        for skipped in DEFAULT_SET:
+            if skipped not in names:
+                results[skipped] = {
+                    "skipped": "cpu-only run: XLA:CPU cold-compile of this "
+                    "shape takes minutes to hours"
+                }
+    run_executor_row = args.config in ("all", "executor16")
+    names = [n for n in names if n != "executor16"]
     # Leader-side rows for the configs whose explicit-share inputs fit the
     # tunnel comfortably; sumvec100k's leader would ship ~1.6 GB of host
     # limbs per staged input, and multitask16's leader is histogram1024's.
-    leader_ok = {"count", "sum32", "histogram1024", "sumvec"}
+    leader_ok = set() if scaled else {"count", "sum32", "histogram1024", "sumvec"}
     for name in names:
         sides = ("helper",)
         if args.side == "leader":
@@ -375,10 +506,25 @@ def main() -> int:
                 sys.stderr.write(f"{key} failed: {type(e).__name__}: {e}\n")
                 results[key] = {"error": f"{type(e).__name__}: {e}"}
 
+    if run_executor_row:
+        # The device-executor concurrent-task row (BASELINE configs[5]
+        # proxy): cross-job coalescing measured end-to-end.
+        try:
+            results["executor16"] = run_executor_config(args, scaled=scaled)
+        except Exception as e:
+            sys.stderr.write(f"executor16 failed: {type(e).__name__}: {e}\n")
+            results["executor16"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # Headline: the north-star config when measured, else the first row
+    # that produced a number (a skipped/errored headline must not zero out
+    # an otherwise-valid run).
+    candidates = ["histogram1024", "histogram1024_leader", "count", "executor16"]
+    candidates += [k for k in results if k not in candidates]
     headline = next(
-        (k for k in ("histogram1024", "histogram1024_leader") if k in results),
-        next(iter(results)),
+        (k for k in candidates if "value" in results.get(k, {})), None
     )
+    if headline is None:
+        headline = next(iter(results))
     head = results[headline]
     reports_per_sec = head.get("value", 0.0)
 

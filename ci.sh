@@ -16,6 +16,9 @@
 #   ./ci.sh slow       heavy tier plus RUN_SLOW=1 parametrizations
 #                      (full per-family device parity, planar interpret).
 #   ./ci.sh all        fast + heavy in sequence.
+#   ./ci.sh tier1      the ROADMAP.md tier-1 command VERBATIM, gated on the
+#                      recorded DOTS_PASSED floor (tests/tier1_floor.txt):
+#                      fewer passing dots than the floor fails the gate.
 #   ./ci.sh dryrun     the driver's gates: multichip dryrun + entry compile.
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -59,6 +62,32 @@ case "$tier" in
     exec python -m pytest tests/test_postgres_live.py \
       "tests/test_multi_replica.py::TestSqlDialectGuards" -q
     ;;
+  tier1)
+    # Regression gate against the seed baseline: run the tier-1 command
+    # exactly as ROADMAP.md records it (single source of truth — edits to
+    # the roadmap automatically propagate here), then compare the passing
+    # dot count to the recorded floor.  The suite can hit its own timeout
+    # (rc=124 at the seed), so the gate is the DOTS_PASSED floor, not rc.
+    cmd=$(sed -n 's/^\*\*Tier-1 verify:\*\* `\(.*\)`$/\1/p' ROADMAP.md)
+    if [ -z "$cmd" ]; then
+      echo "tier-1 command not found in ROADMAP.md" >&2
+      exit 2
+    fi
+    floor=$(cat tests/tier1_floor.txt)
+    set +e
+    bash -c "$cmd" 2>&1 | tee /tmp/_t1_gate.log
+    rc=${PIPESTATUS[0]}
+    set -e
+    # the command itself emits the canonical count; parse, don't recompute
+    dots=$(sed -n 's/^DOTS_PASSED=//p' /tmp/_t1_gate.log | tail -n1)
+    dots=${dots:-0}
+    echo "tier1: DOTS_PASSED=$dots floor=$floor rc=$rc"
+    if [ "$dots" -lt "$floor" ]; then
+      echo "tier1 REGRESSION: DOTS_PASSED=$dots < floor=$floor" >&2
+      exit 1
+    fi
+    exit 0
+    ;;
   dryrun)
     python __graft_entry__.py 8
     exec python - <<'EOF'
@@ -70,7 +99,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|dryrun]" >&2
     exit 2
     ;;
 esac

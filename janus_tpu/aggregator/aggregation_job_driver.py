@@ -69,6 +69,11 @@ class DriverConfig:
     #: into one device launch (BASELINE configs[4]); 0 disables.  Only
     #: meaningful for device backends — the oracle ignores it.
     multi_task_launch_window_s: float = 0.005
+    #: When set and enabled, prepare launches route through the
+    #: process-wide device executor (janus_tpu/executor/): continuous
+    #: cross-job batching shared by ALL drivers, instead of this driver's
+    #: private gather window above.  None/disabled = legacy path.
+    device_executor: Optional[object] = None  # executor.ExecutorConfig
 
 
 class AggregationJobDriver:
@@ -85,6 +90,15 @@ class AggregationJobDriver:
         self._backends: Dict[tuple, object] = {}
         # key -> [(verify_key, prep_rows, future)] awaiting a coalesced launch
         self._pending_prep: Dict[int, list] = {}
+        # Process-wide continuous batcher: every driver in the process
+        # feeds ONE executor so concurrent tasks form one saturated
+        # pipeline rather than N contending ones.
+        self._executor = None
+        exec_cfg = self.config.device_executor
+        if exec_cfg is not None and getattr(exec_cfg, "enabled", False):
+            from ..executor import get_global_executor
+
+            self._executor = get_global_executor(exec_cfg)
 
     def _get_session(self):
         """One shared connection-pooled session per driver (the analog of the
@@ -236,22 +250,53 @@ class AggregationJobDriver:
                             vdaf_type=vdaf_type, reason=reason[:80]
                         ).inc()
                     backend_name = "oracle"  # don't even attempt the device
-            try:
-                b = make_backend(vdaf, backend_name)
-            except (VdafError, NotImplementedError):
-                b = make_backend(vdaf, "oracle")
+            def factory():
+                try:
+                    return make_backend(vdaf, backend_name)
+                except (VdafError, NotImplementedError):
+                    return make_backend(vdaf, "oracle")
+
+            if self._executor is not None:
+                # Shape-keyed cache lives in the process-wide executor:
+                # every driver (and its compiled graphs/warmup) shares one
+                # backend per VDAF shape.
+                b = self._executor.backend_for(key, factory)
+            else:
+                b = factory()
             self._backends[key] = b
         return b
 
     async def _coalesced_prep_init(self, backend, verify_key: bytes, prep_in):
         """Join concurrent same-shape jobs (across tasks) into ONE launch.
 
-        The first arrival opens a short gather window; jobs landing inside
-        it ride the same ``prep_init_multi`` launch with per-row verify
-        keys (BASELINE configs[4]'s 16-task shape).  Window 0 or a backend
-        without prep_init_multi degrades to a per-job launch.
+        With the device executor enabled, submission routes through the
+        PROCESS-WIDE continuous batcher instead: all drivers' same-shape
+        jobs coalesce into pow2-padded mega-batches with size/deadline
+        flushing, and backpressure rejections surface as retryable
+        JobStepErrors (the lease machinery redelivers the job).
+
+        Otherwise the first arrival opens a short gather window; jobs
+        landing inside it ride the same ``prep_init_multi`` launch with
+        per-row verify keys (BASELINE configs[4]'s 16-task shape).  Window
+        0 or a backend without prep_init_multi degrades to a per-job
+        launch.
         """
         loop = asyncio.get_running_loop()
+        if self._executor is not None and hasattr(backend, "stage_prep_init_multi"):
+            from ..executor import ExecutorOverloadedError
+
+            try:
+                return await self._executor.submit(
+                    self._vdaf_shape_key(backend.vdaf),
+                    "prep_init",
+                    (verify_key, prep_in),
+                    backend=backend,
+                    agg_id=0,
+                )
+            except ExecutorOverloadedError as e:
+                raise JobStepError(
+                    f"device executor overloaded: {e}", retryable=True
+                )
         window = self.config.multi_task_launch_window_s
         if window <= 0 or not hasattr(backend, "prep_init_multi"):
             return await loop.run_in_executor(

@@ -82,6 +82,42 @@ class CommonConfig:
 
 
 @dataclass
+class DeviceExecutorConfig:
+    """Process-wide device executor (janus_tpu/executor/): continuous
+    cross-job batching of Prio3 prepare.  Default OFF — the per-driver
+    gather-window path stays the oracle-verified default; enabling routes
+    every driver's prepare through one bucketed continuous batcher that
+    owns the chip."""
+
+    enabled: bool = False
+    #: flush a bucket once it holds this many rows (pow2-padded launch)
+    flush_max_rows: int = 16384
+    #: deadline (ms) from a bucket's first pending submission to its flush
+    flush_window_ms: float = 5.0
+    #: per-bucket queued+in-flight row bound; beyond it submits are
+    #: rejected retryably (lease redelivery provides the retry)
+    max_queue_rows: int = 131072
+    #: per-submission deadline; queued past it -> retryable rejection
+    #: (<= 0 disables deadline rejection)
+    submit_timeout_s: float = 30.0
+    #: mega-batch size to precompile per backend at startup (0 = off)
+    warmup_rows: int = 0
+
+    def to_executor_config(self):
+        """Build the runtime ExecutorConfig (jax-free import path)."""
+        from ..executor import ExecutorConfig
+
+        return ExecutorConfig(
+            enabled=self.enabled,
+            flush_max_rows=self.flush_max_rows,
+            flush_window_s=self.flush_window_ms / 1000.0,
+            max_queue_rows=self.max_queue_rows,
+            submit_timeout_s=self.submit_timeout_s,
+            warmup_rows=self.warmup_rows,
+        )
+
+
+@dataclass
 class JobDriverConfig:
     """reference: config.rs:172 JobDriverConfig"""
 
@@ -126,6 +162,8 @@ class JobDriverBinaryConfig:
     job_driver: JobDriverConfig = field(default_factory=JobDriverConfig)
     batch_aggregation_shard_count: int = 8
     vdaf_backend: str = "tpu"
+    #: Continuous cross-job batching for device prepare (default off).
+    device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
 
 
 def _merge_dataclass(cls, data: dict):
@@ -143,7 +181,10 @@ def _merge_dataclass(cls, data: dict):
         raise ConfigError(f"unknown {cls.__name__} keys: {sorted(unknown)}")
     # `from __future__ import annotations` makes f.type a string; resolve
     # nested config classes by name.
-    nested = {c.__name__: c for c in (CommonConfig, DbConfig, JobDriverConfig)}
+    nested = {
+        c.__name__: c
+        for c in (CommonConfig, DbConfig, JobDriverConfig, DeviceExecutorConfig)
+    }
     kwargs = {}
     for name, f in fields.items():
         if name not in data:

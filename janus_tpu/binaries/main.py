@@ -278,6 +278,11 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
     )
 
     if kind == "aggregation":
+        exec_cfg = (
+            cfg.device_executor.to_executor_config()
+            if cfg.device_executor.enabled
+            else None
+        )
         stepper_impl = AggregationJobDriver(
             datastore,
             aiohttp.ClientSession,
@@ -285,8 +290,37 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
                 maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
                 vdaf_backend=cfg.vdaf_backend,
+                device_executor=exec_cfg,
             ),
         )
+        if exec_cfg is not None and exec_cfg.warmup_rows:
+            # Startup warmup: compile the mega-batch executables for every
+            # provisioned task's VDAF shape now, not at peak traffic.
+            try:
+                tasks = datastore.run_tx(
+                    "warmup_tasks", lambda tx: tx.get_aggregator_tasks()
+                )
+            except Exception:
+                tasks = []
+                logger.exception("device executor warmup failed (serving cold)")
+            warmed = 0
+            for task in tasks:
+                # per-task containment: one bad VDAF must not leave every
+                # other task paying its mega-batch compile at peak traffic
+                try:
+                    stepper_impl._backend_for(task, task.vdaf_instance())
+                    warmed += 1
+                except Exception:
+                    logger.exception(
+                        "executor warmup failed for task %s (it serves cold)",
+                        task.task_id,
+                    )
+            if tasks:
+                logger.info(
+                    "device executor warmup covered %d/%d task(s)",
+                    warmed,
+                    len(tasks),
+                )
 
         async def acquirer(duration, limit):
             return await datastore.run_tx_async(

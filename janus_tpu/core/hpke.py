@@ -23,18 +23,54 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM, ChaCha20Poly1305
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    NoEncryption,
-    PrivateFormat,
-    PublicFormat,
-)
+try:
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM, ChaCha20Poly1305
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - baked into the prod image
+    # Import gate for environments without the ``cryptography`` wheel
+    # (compute-only containers): this module — and everything that imports
+    # it, e.g. the datastore and job drivers — stays importable; any
+    # actual KEM/AEAD operation raises ModuleNotFoundError at call time.
+    HAVE_CRYPTOGRAPHY = False
+
+    class _MissingCryptography:
+        """Defers the missing-dependency error from import to first use."""
+
+        def __init__(self, name: str):
+            self._name = name
+
+        def __getattr__(self, item: str):
+            if item.startswith("__"):
+                raise AttributeError(item)
+            return _MissingCryptography(f"{self._name}.{item}")
+
+        def __call__(self, *args, **kwargs):
+            raise ModuleNotFoundError(
+                f"the 'cryptography' package is required for HPKE "
+                f"(tried to call {self._name})"
+            )
+
+    ec = _MissingCryptography("ec")
+    X25519PrivateKey = _MissingCryptography("X25519PrivateKey")
+    X25519PublicKey = _MissingCryptography("X25519PublicKey")
+    AESGCM = _MissingCryptography("AESGCM")
+    ChaCha20Poly1305 = _MissingCryptography("ChaCha20Poly1305")
+    Encoding = _MissingCryptography("Encoding")
+    NoEncryption = _MissingCryptography("NoEncryption")
+    PrivateFormat = _MissingCryptography("PrivateFormat")
+    PublicFormat = _MissingCryptography("PublicFormat")
 
 from ..messages import (
     HpkeAeadId,
@@ -164,7 +200,8 @@ class _P256Kem:
     N_PK = 65
     N_SK = 32
     _hash = hashlib.sha256
-    _curve = ec.SECP256R1()
+    # evaluated at class-definition time, so guarded by the import gate
+    _curve = ec.SECP256R1() if HAVE_CRYPTOGRAPHY else None
 
     @classmethod
     def _suite_id(cls) -> bytes:

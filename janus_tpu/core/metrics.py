@@ -17,6 +17,7 @@ try:
     from prometheus_client import (
         CollectorRegistry,
         Counter,
+        Gauge,
         Histogram,
         generate_latest,
     )
@@ -119,6 +120,45 @@ class Metrics:
             "VDAF prepare batch wall time by backend and phase",
             ["backend", "phase"],
             buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+
+        # Device executor (janus_tpu/executor/): continuous cross-job
+        # batching visibility per (circuit, aggregator-side, phase) bucket.
+        # flush_rows vs. the per-job submission size is the direct measure
+        # of cross-job coalescing; queue_rows + wait/launch seconds expose
+        # whether backpressure or the chip is the bottleneck.
+        self.executor_queue_rows = Gauge(
+            "janus_executor_queue_rows",
+            "Report rows queued or in flight per executor bucket",
+            ["bucket"],
+            registry=self.registry,
+        )
+        self.executor_flush_rows = Histogram(
+            "janus_executor_flush_rows",
+            "Mega-batch size (rows) per executor flush",
+            ["bucket"],
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536),
+            registry=self.registry,
+        )
+        self.executor_wait_seconds = Histogram(
+            "janus_executor_wait_duration_seconds",
+            "Submission wall time from enqueue to result by bucket",
+            ["bucket"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.executor_launch_seconds = Histogram(
+            "janus_executor_launch_duration_seconds",
+            "Device launch wall time per executor flush by bucket",
+            ["bucket"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.executor_rejections = Counter(
+            "janus_executor_rejections_total",
+            "Backpressure rejections by bucket and reason",
+            ["bucket", "reason"],
             registry=self.registry,
         )
 

@@ -13,8 +13,18 @@ import os
 import secrets
 from typing import List, Sequence
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - baked into the prod image
+    # Import gate for environments without the ``cryptography`` wheel
+    # (compute-only containers): the datastore package — and everything
+    # that imports it, e.g. the job drivers — stays importable; building
+    # an actual Crypter fails loudly below.
+    HAVE_CRYPTOGRAPHY = False
+    InvalidTag = AESGCM = None
 
 KEY_LEN = 16
 NONCE_LEN = 12
@@ -30,6 +40,11 @@ def generate_key() -> bytes:
 
 class Crypter:
     def __init__(self, keys: Sequence[bytes]):
+        if not HAVE_CRYPTOGRAPHY:
+            raise ModuleNotFoundError(
+                "the 'cryptography' package is required for datastore "
+                "column encryption"
+            )
         if not keys:
             raise CrypterError("Crypter requires at least one key")
         for k in keys:

@@ -1,0 +1,25 @@
+"""Device executor: process-wide continuous cross-job batching.
+
+See service.py for the design.  Importing this package does NOT import
+jax — control-plane processes can hold an ExecutorConfig (and the
+overload error type for retry classification) without pulling in the
+device stack.
+"""
+
+from .service import (
+    DeviceExecutor,
+    ExecutorConfig,
+    ExecutorOverloadedError,
+    bucket_label,
+    get_global_executor,
+    reset_global_executor,
+)
+
+__all__ = [
+    "DeviceExecutor",
+    "ExecutorConfig",
+    "ExecutorOverloadedError",
+    "bucket_label",
+    "get_global_executor",
+    "reset_global_executor",
+]

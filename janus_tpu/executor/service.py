@@ -1,0 +1,585 @@
+"""Process-wide device execution service: continuous cross-job batching.
+
+The chip is one wide pipeline; N concurrent aggregation jobs must not
+carve it into N narrow, contending ones.  Today each driver step
+coalesces only the jobs that happen to land inside its own gather window
+(aggregation_job_driver._coalesced_prep_init), so 16 concurrent tasks
+still issue many small launches and re-pay dispatch overhead per driver.
+This module is the scheduling layer between the protocol logic and the
+kernel pool — shaped like an inference-serving continuous batcher:
+
+* ``submit(shape_key, kind, payload) -> result``: every driver (and any
+  other producer of prepare work) enqueues into a process-wide service
+  that owns the device.
+* **Bucketed continuous batching**: submissions are grouped per
+  ``(vdaf_shape_key, kind, agg_id)`` bucket and flushed as ONE
+  pow2-padded mega-batch when the bucket reaches ``flush_max_rows`` or
+  its ``flush_window_s`` deadline expires — whichever comes first.
+* **Compiled-executable cache + warmup**: backends are shape-keyed and
+  shared by every submitter, so one compiled graph serves all tasks;
+  ``warmup_backend`` precompiles the configured mega-batch shapes before
+  traffic arrives (startup, not first-request, pays the compile).
+* **Double-buffered host->device staging**: marshal/device_put runs on a
+  dedicated staging thread while the previous mega-batch's launch
+  occupies the chip (stage k+1 overlaps launch k).
+* **Backpressure**: per-bucket queue depth is bounded; a submission that
+  would exceed it — or whose deadline expires while queued — is rejected
+  with ExecutorOverloadedError, which callers surface as a retryable
+  JobStepError (the lease machinery redelivers the job).
+
+Results are byte-identical to per-job launches: the mega-batch is the
+same concatenation ``TpuBackend.prep_init_multi`` already performs, with
+per-row verify keys (tests/test_multitask.py asserts oracle parity under
+concurrent submission).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("janus_tpu.executor")
+
+#: Submission kinds (the "phase" of the bucket key).
+KIND_PREP_INIT = "prep_init"
+KIND_COMBINE = "combine"
+
+
+class ExecutorOverloadedError(Exception):
+    """Bounded-queue or deadline rejection.
+
+    Retryable by construction: the report rows are still leased in the
+    datastore, so the caller maps this to JobStepError(retryable=True)
+    and the job is redelivered when the device catches up.
+    """
+
+
+@dataclass
+class ExecutorConfig:
+    """Tuning knobs; defaults favor throughput at ~5 ms added latency."""
+
+    enabled: bool = False
+    #: flush a bucket as soon as it holds this many rows
+    flush_max_rows: int = 16384
+    #: deadline from a bucket's first pending submission to its flush
+    flush_window_s: float = 0.005
+    #: per-bucket bound on queued + in-flight rows; beyond it, submit rejects
+    max_queue_rows: int = 131072
+    #: default per-submission deadline (queued past it -> rejected);
+    #: <= 0 disables deadline rejection
+    submit_timeout_s: float = 30.0
+    #: pow2 mega-batch size warmup compiles per (backend, agg_id); 0 = off
+    warmup_rows: int = 0
+
+
+@dataclass
+class _Submission:
+    payload: object
+    rows: int
+    future: asyncio.Future
+    loop: asyncio.AbstractEventLoop
+    enqueued: float
+    deadline: Optional[float]
+    #: set by _finish (under the executor lock) so depth accounting is
+    #: idempotent across the flush's normal/reject/exception paths
+    finished: bool = False
+
+
+class _Bucket:
+    """Pending submissions for one (shape_key, kind, agg_id)."""
+
+    def __init__(self, key: tuple, backend, kind: str, agg_id: int, label: str):
+        self.key = key
+        self.backend = backend
+        self.kind = kind
+        self.agg_id = agg_id
+        self.label = label
+        self.pending: List[_Submission] = []
+        self.queued_rows = 0
+        self.inflight_rows = 0
+        self.timer: Optional[asyncio.TimerHandle] = None
+        # plain-Python stats (usable without prometheus; bench reads these)
+        self.flushes = 0
+        self.flushed_rows = 0
+        self.flushed_jobs = 0
+        self.rejections = 0
+
+    @property
+    def depth_rows(self) -> int:
+        return self.queued_rows + self.inflight_rows
+
+    def mean_flush_rows(self) -> float:
+        return self.flushed_rows / self.flushes if self.flushes else 0.0
+
+
+def bucket_label(backend, kind: str, agg_id: int, shape_key: tuple = None) -> str:
+    """Compact metric label: circuit/aggregator-side/phase.
+
+    ``shape_key`` appends a stable digest so two parameterizations of the
+    same circuit (e.g. Histogram length=4 vs length=1024) never share a
+    label — stats() and the per-bucket gauges key on it."""
+    vdaf = getattr(backend, "vdaf", None)
+    valid = getattr(getattr(vdaf, "flp", None), "valid", None)
+    circuit = type(valid).__name__ if valid is not None else type(vdaf).__name__
+    label = f"{circuit}/a{agg_id}/{kind}"
+    if shape_key is not None:
+        import zlib
+
+        label += "#%06x" % (zlib.crc32(repr(shape_key).encode()) & 0xFFFFFF)
+    return label
+
+
+class DeviceExecutor:
+    """The continuous batcher.  One per process (get_global_executor)."""
+
+    def __init__(self, config: Optional[ExecutorConfig] = None):
+        self.config = config or ExecutorConfig()
+        self._buckets: Dict[tuple, _Bucket] = {}
+        self._backends: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self._stage_pool: Optional[ThreadPoolExecutor] = None
+        self._launch_pool: Optional[ThreadPoolExecutor] = None
+        # Strong refs to in-flight flush tasks: the event loop holds tasks
+        # weakly, and a GC'd flush would strand its detached submissions.
+        self._flush_tasks: set = set()
+        self._closed = False
+
+    # -- shape-keyed backend cache --------------------------------------
+    def backend_for(self, shape_key: tuple, factory):
+        """One backend instance (and its compiled graphs) per VDAF shape,
+        shared across every driver in the process.  Newly created backends
+        are warmed up (mega-batch executables compiled) when configured."""
+        created = False
+        with self._lock:
+            b = self._backends.get(shape_key)
+            if b is None:
+                b = factory()
+                self._backends[shape_key] = b
+                created = True
+        if created and self.config.warmup_rows:
+            try:
+                n = self.warmup_backend(b)
+                if n:
+                    logger.info(
+                        "warmed %d executable(s) for %s at %d rows",
+                        n,
+                        type(b).__name__,
+                        self.config.warmup_rows,
+                    )
+            except Exception:
+                logger.exception("executor warmup failed (serving cold)")
+        return b
+
+    # -- thread pools ----------------------------------------------------
+    def _pools(self) -> Tuple[ThreadPoolExecutor, ThreadPoolExecutor]:
+        # One staging + one launch thread: launches serialize on the chip
+        # by design; staging of the next mega-batch overlaps the current
+        # launch (double buffering).
+        with self._lock:
+            if self._stage_pool is None:
+                self._stage_pool = ThreadPoolExecutor(
+                    1, thread_name_prefix="janus-exec-stage"
+                )
+                self._launch_pool = ThreadPoolExecutor(
+                    1, thread_name_prefix="janus-exec-launch"
+                )
+            return self._stage_pool, self._launch_pool
+
+    # -- submission ------------------------------------------------------
+    async def submit(
+        self,
+        shape_key: tuple,
+        kind: str,
+        payload,
+        *,
+        backend,
+        agg_id: int = 0,
+        deadline_s: Optional[float] = None,
+    ):
+        """Enqueue prepare work; resolves when its mega-batch lands.
+
+        kind=KIND_PREP_INIT: payload is (verify_key, report_rows) and the
+        result is the per-row List[PrepOutcome].  kind=KIND_COMBINE:
+        payload is the prep-share rows and the result is the per-row
+        combine outcomes.  Raises ExecutorOverloadedError on backpressure.
+        """
+        if kind == KIND_PREP_INIT:
+            rows = len(payload[1])
+        elif kind == KIND_COMBINE:
+            rows = len(payload)
+        else:
+            raise ValueError(f"unknown submission kind {kind!r}")
+        if rows == 0:
+            return []
+        if self._closed:
+            raise ExecutorOverloadedError("executor is shut down")
+        loop = asyncio.get_running_loop()
+        now = time.monotonic()
+        timeout = self.config.submit_timeout_s if deadline_s is None else deadline_s
+        key = (shape_key, kind, agg_id)
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = _Bucket(
+                    key,
+                    backend,
+                    kind,
+                    agg_id,
+                    bucket_label(backend, kind, agg_id, shape_key),
+                )
+                self._buckets[key] = bucket
+            # Backpressure bounds the QUEUE, not the job: a submission
+            # larger than the bound is still admitted when nothing is
+            # ahead of it (the legacy per-job path handled any size, so
+            # rejecting it here would fail the job on every retry).
+            if bucket.depth_rows and bucket.depth_rows + rows > self.config.max_queue_rows:
+                bucket.rejections += 1
+                self._observe_rejection(bucket, "queue_full")
+                raise ExecutorOverloadedError(
+                    f"bucket {bucket.label}: {bucket.depth_rows} rows queued/"
+                    f"in flight, +{rows} exceeds max_queue_rows="
+                    f"{self.config.max_queue_rows}"
+                )
+            sub = _Submission(
+                payload=payload,
+                rows=rows,
+                future=loop.create_future(),
+                loop=loop,
+                enqueued=now,
+                # <= 0 disables the deadline (documented in config.py)
+                deadline=now + timeout if timeout and timeout > 0 else None,
+            )
+            bucket.pending.append(sub)
+            bucket.queued_rows += rows
+            self._observe_depth(bucket)
+            if bucket.queued_rows >= self.config.flush_max_rows:
+                subs = self._take_pending(bucket)
+            else:
+                subs = None
+                if bucket.timer is None:
+                    bucket.timer = loop.call_later(
+                        self.config.flush_window_s,
+                        lambda: self._spawn(self._deadline_flush(bucket)),
+                    )
+        if subs:
+            self._spawn(self._run_flush(bucket, subs, trigger="size"))
+        return await sub.future
+
+    def _spawn(self, coro) -> None:
+        """Schedule a flush coroutine, keeping a strong reference until done."""
+        task = asyncio.ensure_future(coro)
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
+
+    def _take_pending(self, bucket: _Bucket) -> List[_Submission]:
+        """Detach the bucket's pending set for a flush.  Lock held."""
+        subs, bucket.pending = bucket.pending, []
+        bucket.queued_rows = 0
+        for s in subs:
+            bucket.inflight_rows += s.rows
+        if bucket.timer is not None:
+            bucket.timer.cancel()
+            bucket.timer = None
+        return subs
+
+    async def _deadline_flush(self, bucket: _Bucket) -> None:
+        with self._lock:
+            bucket.timer = None
+            subs = self._take_pending(bucket)
+        if subs:
+            await self._run_flush(bucket, subs, trigger="deadline")
+
+    async def drain(self) -> None:
+        """Flush every pending bucket now and wait for results to settle
+        (shutdown / end-of-bench barrier) — including flush tasks that
+        were already in flight when drain was called."""
+        flushes = []
+        with self._lock:
+            for bucket in self._buckets.values():
+                subs = self._take_pending(bucket)
+                if subs:
+                    flushes.append((bucket, subs))
+        loop = asyncio.get_running_loop()
+        inflight = [t for t in self._flush_tasks if t.get_loop() is loop]
+        # cross-loop submissions resolve via call_soon_threadsafe on their
+        # own loop; gather here only what belongs to this one
+        waiters = [
+            s.future for _, subs in flushes for s in subs if s.loop is loop
+        ]
+        for bucket, subs in flushes:
+            await self._run_flush(bucket, subs, trigger="drain")
+        if inflight:
+            await asyncio.gather(*inflight, return_exceptions=True)
+        if waiters:
+            await asyncio.gather(*waiters, return_exceptions=True)
+
+    # -- the flush -------------------------------------------------------
+    async def _run_flush(
+        self, bucket: _Bucket, subs: List[_Submission], trigger: str
+    ) -> None:
+        from ..core.trace import trace_span
+
+        loop = asyncio.get_running_loop()
+        live = self._reject_expired(bucket, subs)
+        if not live:
+            return
+        rows = sum(s.rows for s in live)
+        stage_pool, launch_pool = self._pools()
+        try:
+            with trace_span(
+                "executor_flush",
+                cat="executor",
+                bucket=bucket.label,
+                rows=rows,
+                jobs=len(live),
+                trigger=trigger,
+            ):
+                if bucket.kind == KIND_PREP_INIT:
+                    requests = [s.payload for s in live]
+                    staged = await loop.run_in_executor(
+                        stage_pool,
+                        lambda: bucket.backend.stage_prep_init_multi(
+                            bucket.agg_id, requests
+                        ),
+                    )
+                    t_launch = time.monotonic()
+
+                    def launch():
+                        # Deadline re-check AFTER the launch-queue wait —
+                        # that queue (one flush at a time on the chip) is
+                        # where overload actually parks submissions.  If
+                        # every submission expired, skip the device work
+                        # entirely; a mixed batch launches as staged
+                        # (padding already covers the expired rows).
+                        if staged is None:
+                            return [[] for _ in live], live
+                        still = self._reject_expired(bucket, live)
+                        if not still:
+                            return None, []
+                        return (
+                            bucket.backend.launch_prep_init_multi(
+                                staged, requests
+                            ),
+                            still,
+                        )
+
+                    outs, still = await loop.run_in_executor(launch_pool, launch)
+                else:  # KIND_COMBINE: concatenate rows, launch once, slice
+                    concat = [row for s in live for row in s.payload]
+                    t_launch = time.monotonic()
+
+                    def launch():
+                        still = self._reject_expired(bucket, live)
+                        if not still:
+                            return None, []
+                        flat = bucket.backend.prep_shares_to_prep_batch(concat)
+                        outs, start = [], 0
+                        for s in live:
+                            outs.append(flat[start : start + s.rows])
+                            start += s.rows
+                        return outs, still
+
+                    outs, still = await loop.run_in_executor(launch_pool, launch)
+            if outs is None:
+                return
+            done = time.monotonic()
+            bucket.flushes += 1
+            bucket.flushed_rows += rows
+            bucket.flushed_jobs += len(live)
+            self._observe_flush(bucket, rows, done - t_launch)
+            still_set = set(id(s) for s in still)
+            for s, out in zip(live, outs):
+                if id(s) not in still_set:
+                    continue  # rejected at launch dequeue
+                self._finish(bucket, s, done)
+                self._observe_wait(bucket, done - s.enqueued)
+                self._resolve(s, result=out)
+        except Exception as e:  # surface the launch failure to every job
+            done = time.monotonic()
+            for s in live:
+                self._finish(bucket, s, done)
+                self._resolve(s, exc=e)
+
+    def _reject_expired(self, bucket: _Bucket, subs: List[_Submission]):
+        """Reject (retryably) every submission whose deadline has passed;
+        returns the still-live remainder.  Called when a flush starts and
+        again when it reaches the launch thread — the launch queue is
+        where submissions wait under chip overload."""
+        now = time.monotonic()
+        live: List[_Submission] = []
+        for s in subs:
+            if s.deadline is None or now <= s.deadline:
+                live.append(s)
+                continue
+            self._finish(bucket, s, now)
+            bucket.rejections += 1
+            self._observe_rejection(bucket, "deadline")
+            self._resolve(
+                s,
+                exc=ExecutorOverloadedError(
+                    f"bucket {bucket.label}: queued past its "
+                    f"{s.deadline - s.enqueued:.3f}s deadline"
+                ),
+            )
+        return live
+
+    def _finish(self, bucket: _Bucket, s: _Submission, now: float) -> None:
+        with self._lock:
+            if s.finished:
+                return
+            s.finished = True
+            bucket.inflight_rows -= s.rows
+            self._observe_depth(bucket)
+
+    @staticmethod
+    def _resolve(s: _Submission, result=None, exc: Optional[Exception] = None):
+        """Complete a submission future on ITS loop (cross-loop safe)."""
+
+        def do():
+            if s.future.done():
+                return
+            if exc is not None:
+                s.future.set_exception(exc)
+            else:
+                s.future.set_result(result)
+
+        try:
+            if s.loop is asyncio.get_running_loop():
+                do()
+                return
+        except RuntimeError:
+            pass
+        try:
+            s.loop.call_soon_threadsafe(do)
+        except RuntimeError:  # submitter's loop already closed
+            pass
+
+    # -- warmup ----------------------------------------------------------
+    def warmup_backend(self, backend, agg_ids=(0, 1), pad_to: Optional[int] = None) -> int:
+        """Precompile the mega-batch executable(s) for one backend.
+
+        Stages a couple of synthetic reports padded to ``pad_to`` (default
+        config.warmup_rows) and launches them, so the first real flush
+        replays a cached executable instead of paying XLA at peak traffic.
+        Returns the number of executables compiled (0 when warmup is off
+        or the backend has no device launch path).
+        """
+        pad_to = pad_to if pad_to is not None else self.config.warmup_rows
+        if not pad_to or not hasattr(backend, "stage_prep_init_multi"):
+            return 0
+        vdaf = backend.vdaf
+        meas = _synthetic_measurement(vdaf)
+        nonce = b"\x00" * vdaf.NONCE_SIZE
+        public, shares = vdaf.shard(meas, nonce, b"\x00" * vdaf.RAND_SIZE)
+        vk = b"\x00" * vdaf.VERIFY_KEY_SIZE
+        compiled = 0
+        for agg_id in agg_ids:
+            reports = [(nonce, public, shares[min(agg_id, len(shares) - 1)])]
+            staged = backend.stage_prep_init_multi(
+                agg_id, [(vk, reports)], pad_to=pad_to
+            )
+            backend.launch_prep_init_multi(staged, [(vk, reports)])
+            compiled += 1
+        return compiled
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> Dict[str, dict]:
+        """Per-bucket counters (plain Python; bench + tests read these)."""
+        with self._lock:
+            return {
+                b.label: {
+                    "flushes": b.flushes,
+                    "flushed_rows": b.flushed_rows,
+                    "flushed_jobs": b.flushed_jobs,
+                    "mean_flush_rows": round(b.mean_flush_rows(), 2),
+                    "rejections": b.rejections,
+                    "depth_rows": b.depth_rows,
+                }
+                for b in self._buckets.values()
+            }
+
+    def shutdown(self) -> None:
+        self._closed = True
+        with self._lock:
+            pools = [self._stage_pool, self._launch_pool]
+            self._stage_pool = self._launch_pool = None
+        for p in pools:
+            if p is not None:
+                p.shutdown(wait=False)
+
+    # -- metrics ---------------------------------------------------------
+    def _observe_depth(self, bucket: _Bucket) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.executor_queue_rows.labels(bucket=bucket.label).set(
+                bucket.depth_rows
+            )
+
+    def _observe_flush(self, bucket: _Bucket, rows: int, launch_s: float) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.executor_flush_rows.labels(bucket=bucket.label).observe(rows)
+            GLOBAL_METRICS.executor_launch_seconds.labels(
+                bucket=bucket.label
+            ).observe(launch_s)
+
+    def _observe_wait(self, bucket: _Bucket, wait_s: float) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.executor_wait_seconds.labels(bucket=bucket.label).observe(
+                wait_s
+            )
+
+    def _observe_rejection(self, bucket: _Bucket, reason: str) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.executor_rejections.labels(
+                bucket=bucket.label, reason=reason
+            ).inc()
+
+
+def _synthetic_measurement(vdaf):
+    """A valid all-zero measurement for warmup sharding: scalar circuits
+    (Count/Sum/Histogram) take 0; vector circuits take [0]*length."""
+    flp = vdaf.flp
+    try:
+        flp.encode(0)
+        return 0
+    except Exception:
+        length = getattr(flp.valid, "length", 1)
+        return [0] * length
+
+
+# -- process-wide instance ---------------------------------------------------
+
+_GLOBAL: Optional[DeviceExecutor] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_global_executor(config: Optional[ExecutorConfig] = None) -> DeviceExecutor:
+    """The one executor that owns this process's chip.  First caller's
+    config wins; later callers share the instance (all drivers feed one
+    batcher — that is the point)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = DeviceExecutor(config)
+        return _GLOBAL
+
+
+def reset_global_executor() -> None:
+    """Tests only: drop the process-wide instance."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.shutdown()
+        _GLOBAL = None

@@ -17,6 +17,7 @@ overflowed (``ok`` mask — astronomically rare, but exact).
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -33,6 +34,24 @@ from .prio3 import (
 
 #: A per-report prepare outcome: either a result or the error that rejected it.
 PrepOutcome = Union[Tuple[Prio3PrepareState, Prio3PrepareShare], VdafError]
+
+
+@dataclass
+class StagedPrepInit:
+    """Device-resident half of a prepare launch.
+
+    Produced by ``TpuBackend.stage_prep_init_multi`` (host marshal +
+    device_put), consumed by ``launch_prep_init_multi`` (compiled launch +
+    readback).  The split lets the device executor double-buffer: batch
+    k+1 stages on the host while batch k's launch occupies the chip.
+    """
+
+    agg_id: int
+    placed: Dict[str, object]
+    #: padded batch size the compiled executable was (or will be) built for
+    pad_to: int
+    #: real rows in the batch (readbacks slice to this)
+    rows: int
 
 
 def _observe_prepare(backend: str, phase: str, reports: int, seconds: float) -> None:
@@ -317,6 +336,71 @@ class TpuBackend:
                 results.append(None)
         return results
 
+    def stage_prep_init_multi(
+        self,
+        agg_id: int,
+        requests: Sequence[
+            Tuple[bytes, Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]]]
+        ],
+        pad_to: Optional[int] = None,
+    ) -> Optional[StagedPrepInit]:
+        """Host half of a multi-request launch: flatten, marshal, pow2-pad,
+        and commit to device.  Returns None when no request carries rows.
+
+        ``pad_to`` overrides the power-of-two bucket (the executor's warmup
+        uses it to compile a target mega-batch shape from a handful of
+        synthetic rows)."""
+        flat: List = []
+        vk_rows: List[np.ndarray] = []
+        for verify_key, reports in requests:
+            flat.extend(reports)
+            vk = np.frombuffer(verify_key, dtype=np.uint8)
+            vk_rows.extend([vk] * len(reports))
+        if not flat:
+            return None
+        B = len(flat)
+        pad_to = max(pad_to or 0, self._pad_to(B))
+        kw = self._marshal(agg_id, flat, pad_to)
+        vk_mat = np.stack(vk_rows)
+        kw["verify_key_u8"] = np.concatenate(
+            [vk_mat, np.repeat(vk_mat[-1:], pad_to - B, axis=0)]
+        )
+        return StagedPrepInit(
+            agg_id=agg_id, placed=self._place(kw), pad_to=pad_to, rows=B
+        )
+
+    def launch_prep_init_multi(
+        self,
+        staged: StagedPrepInit,
+        requests: Sequence[
+            Tuple[bytes, Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]]]
+        ],
+    ) -> List[List[PrepOutcome]]:
+        """Device half: run the compiled prepare on a staged batch, read
+        back once, and slice results per request."""
+        agg_id, B = staged.agg_id, staged.rows
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
+            GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
+        from ..core.trace import trace_span
+
+        t0 = time.monotonic()
+        with trace_span("prep_launch", cat="device", backend=self.name, batch=B):
+            out = self._prep_fn(agg_id)(staged.placed)
+            # One readback for the whole launch, then slice per request.
+            outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
+        _observe_prepare(self.name, "init", B, time.monotonic() - t0)
+        start = 0
+        results: List[List[PrepOutcome]] = []
+        for verify_key, reports in requests:
+            n = len(reports)
+            view = {k: v[start : start + n] for k, v in outputs.items()}
+            results.append(self._unmarshal_prep(verify_key, agg_id, reports, view))
+            start += n
+        return results
+
     def prep_init_multi(
         self,
         agg_id: int,
@@ -335,42 +419,10 @@ class TpuBackend:
         """
         if not requests:
             return []
-        flat: List = []
-        vk_rows: List[np.ndarray] = []
-        for verify_key, reports in requests:
-            flat.extend(reports)
-            vk = np.frombuffer(verify_key, dtype=np.uint8)
-            vk_rows.extend([vk] * len(reports))
-        if not flat:
+        staged = self.stage_prep_init_multi(agg_id, requests)
+        if staged is None:
             return [[] for _ in requests]
-        B = len(flat)
-        pad_to = self._pad_to(B)
-        kw = self._marshal(agg_id, flat, pad_to)
-        vk_mat = np.stack(vk_rows)
-        kw["verify_key_u8"] = np.concatenate(
-            [vk_mat, np.repeat(vk_mat[-1:], pad_to - B, axis=0)]
-        )
-        from ..core.metrics import GLOBAL_METRICS
-
-        if GLOBAL_METRICS.registry is not None:
-            GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
-            GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(B)
-        from ..core.trace import trace_span
-
-        t0 = time.monotonic()
-        with trace_span("prep_launch", cat="device", backend=self.name, batch=B):
-            out = self._prep_fn(agg_id)(self._place(kw))
-            # One readback for the whole launch, then slice per request.
-            outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
-        _observe_prepare(self.name, "init", B, time.monotonic() - t0)
-        start = 0
-        results: List[List[PrepOutcome]] = []
-        for verify_key, reports in requests:
-            n = len(reports)
-            view = {k: v[start : start + n] for k, v in outputs.items()}
-            results.append(self._unmarshal_prep(verify_key, agg_id, reports, view))
-            start += n
-        return results
+        return self.launch_prep_init_multi(staged, requests)
 
     def aggregate_batch(self, out_shares_limbs, mask) -> List[int]:
         """Masked out-share aggregation on-device.

@@ -1,0 +1,392 @@
+"""Device executor: continuous cross-job batching (janus_tpu/executor/).
+
+Scheduling-logic tests (bucketing, flush triggers, backpressure, deadline
+rejection) run against a fake backend — no jax, no compiles.  Parity
+tests (results byte-identical to the oracle under coalescing) use the
+real TpuBackend on the cheapest shape; the heavier multi-shape
+integration lives in tests/test_multitask.py.
+"""
+
+import asyncio
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from janus_tpu.executor import (
+    DeviceExecutor,
+    ExecutorConfig,
+    ExecutorOverloadedError,
+    bucket_label,
+    reset_global_executor,
+)
+from janus_tpu.fields import next_power_of_2
+from janus_tpu.utils.test_util import det_rng
+from janus_tpu.vdaf.instances import prio3_count
+
+
+def _run(coro, timeout=30.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+class _FakeVdaf:
+    pass
+
+
+class _FakeBackend:
+    """Stage/launch seam double: records mega-batches, touches no device."""
+
+    def __init__(self, launch_gate: threading.Event = None):
+        self.vdaf = _FakeVdaf()
+        self.launches = []  # rows-per-request of each mega-batch
+        self.staged_pads = []
+        self.combine_batches = []
+        self._gate = launch_gate
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        rows = sum(len(r) for _, r in requests)
+        if rows == 0:
+            return None
+        self.staged_pads.append(max(pad_to or 0, next_power_of_2(rows)))
+        return SimpleNamespace(
+            agg_id=agg_id, placed=None, pad_to=self.staged_pads[-1], rows=rows
+        )
+
+    def launch_prep_init_multi(self, staged, requests):
+        if self._gate is not None:
+            assert self._gate.wait(10), "test launch gate never opened"
+        self.launches.append([len(r) for _, r in requests])
+        return [
+            [("prep", vk, i) for i in range(len(reports))]
+            for vk, reports in requests
+        ]
+
+    def prep_shares_to_prep_batch(self, rows):
+        self.combine_batches.append(len(rows))
+        return [("combined", i) for i in range(len(rows))]
+
+
+# -- bucketing / padding -----------------------------------------------------
+
+
+def test_distinct_shape_kind_aggid_get_distinct_buckets():
+    backend = _FakeBackend()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.005, flush_max_rows=1024))
+
+    async def go():
+        await asyncio.gather(
+            ex.submit(("shapeA",), "prep_init", (b"k1", [1, 2]), backend=backend),
+            ex.submit(("shapeA",), "prep_init", (b"k2", [3]), backend=backend),
+            ex.submit(("shapeB",), "prep_init", (b"k3", [4]), backend=backend),
+            ex.submit(("shapeA",), "combine", [[1], [2]], backend=backend),
+            ex.submit(("shapeA",), "prep_init", (b"k4", [5]), backend=backend, agg_id=1),
+        )
+
+    _run(go())
+    ex.shutdown()
+    # same (shape, kind, agg_id) coalesce; anything else separates
+    assert len(ex._buckets) == 4
+    assert [sorted(l) for l in backend.launches].count([1, 2]) == 1
+    # pow2 padding: the 4-row shapeA/a0 mega-batch staged at pad 4
+    assert 4 in backend.staged_pads
+
+
+def test_pow2_padding_and_warmup_override():
+    backend = _FakeBackend()
+    backend.stage_prep_init_multi(0, [(b"k", [1, 2, 3])])
+    assert backend.staged_pads[-1] == 4
+    backend.stage_prep_init_multi(0, [(b"k", [1, 2, 3])], pad_to=16)
+    assert backend.staged_pads[-1] == 16
+
+
+def test_empty_submission_short_circuits():
+    backend = _FakeBackend()
+    ex = DeviceExecutor(ExecutorConfig())
+
+    async def go():
+        return await ex.submit(("s",), "prep_init", (b"k", []), backend=backend)
+
+    assert _run(go()) == []
+    ex.shutdown()
+    assert backend.launches == []
+
+
+# -- flush triggers ----------------------------------------------------------
+
+
+def test_deadline_flush_coalesces_concurrent_jobs():
+    backend = _FakeBackend()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.01, flush_max_rows=10_000))
+
+    async def go():
+        return await asyncio.gather(
+            ex.submit(("s",), "prep_init", (b"k1", [0, 1]), backend=backend),
+            ex.submit(("s",), "prep_init", (b"k2", [0, 1, 2]), backend=backend),
+        )
+
+    a, b = _run(go())
+    ex.shutdown()
+    assert backend.launches == [[2, 3]], "both jobs must ride ONE deadline flush"
+    assert len(a) == 2 and len(b) == 3
+    stats = next(iter(ex.stats().values()))
+    assert stats["flushes"] == 1 and stats["flushed_jobs"] == 2
+
+
+def test_size_flush_fires_without_waiting_for_window():
+    backend = _FakeBackend()
+    # window absurdly long: only the size trigger can flush in time
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=60.0, flush_max_rows=4))
+
+    async def go():
+        return await asyncio.gather(
+            ex.submit(("s",), "prep_init", (b"k1", [0, 1]), backend=backend),
+            ex.submit(("s",), "prep_init", (b"k2", [0, 1]), backend=backend),
+        )
+
+    t0 = time.monotonic()
+    a, b = _run(go(), timeout=10.0)
+    elapsed = time.monotonic() - t0
+    ex.shutdown()
+    assert backend.launches == [[2, 2]]
+    assert elapsed < 5.0, "size-triggered flush must not wait for the window"
+    assert len(a) == 2 and len(b) == 2
+
+
+def test_combine_kind_coalesces_and_slices():
+    backend = _FakeBackend()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.01, flush_max_rows=10_000))
+
+    async def go():
+        return await asyncio.gather(
+            ex.submit(("s",), "combine", [[1], [2]], backend=backend),
+            ex.submit(("s",), "combine", [[3]], backend=backend),
+        )
+
+    a, b = _run(go())
+    ex.shutdown()
+    assert backend.combine_batches == [3], "one concatenated combine launch"
+    assert a == [("combined", 0), ("combined", 1)] and b == [("combined", 2)]
+
+
+# -- backpressure ------------------------------------------------------------
+
+
+def test_oversized_submission_admitted_on_empty_bucket():
+    """A job larger than max_queue_rows must still run when nothing is
+    queued ahead of it — the legacy per-job path handled any size, so a
+    deterministic rejection would permanently fail the job."""
+    backend = _FakeBackend()
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=0.01, flush_max_rows=10_000, max_queue_rows=2)
+    )
+
+    async def go():
+        return await ex.submit(
+            ("s",), "prep_init", (b"k1", [0, 1, 2, 3, 4]), backend=backend
+        )
+
+    out = _run(go())
+    ex.shutdown()
+    assert len(out) == 5
+
+
+def test_backpressure_rejects_when_queue_bound_exceeded():
+    backend = _FakeBackend()
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=60.0, flush_max_rows=10_000, max_queue_rows=4)
+    )
+
+    async def go():
+        t1 = asyncio.ensure_future(
+            ex.submit(("s",), "prep_init", (b"k1", [0, 1, 2]), backend=backend)
+        )
+        await asyncio.sleep(0)  # let the first submission enqueue
+        with pytest.raises(ExecutorOverloadedError):
+            await ex.submit(("s",), "prep_init", (b"k2", [0, 1]), backend=backend)
+        t1.cancel()
+
+    _run(go())
+    ex.shutdown()
+    stats = next(iter(ex.stats().values()))
+    assert stats["rejections"] == 1
+
+
+def test_inflight_rows_count_against_the_bound():
+    gate = threading.Event()
+    backend = _FakeBackend(launch_gate=gate)
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=60.0, flush_max_rows=3, max_queue_rows=4)
+    )
+
+    async def go():
+        # 3 rows: size-flush immediately, launch blocks on the gate
+        t1 = asyncio.ensure_future(
+            ex.submit(("s",), "prep_init", (b"k1", [0, 1, 2]), backend=backend)
+        )
+        await asyncio.sleep(0.05)  # flush happened; rows now in flight
+        with pytest.raises(ExecutorOverloadedError):
+            await ex.submit(("s",), "prep_init", (b"k2", [0, 1]), backend=backend)
+        gate.set()
+        return await t1
+
+    out = _run(go())
+    ex.shutdown()
+    assert len(out) == 3
+
+
+def test_deadline_expired_submission_rejected_at_flush():
+    backend = _FakeBackend()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.05, flush_max_rows=10_000))
+
+    async def go():
+        # deadline far shorter than the flush window: expires while queued
+        with pytest.raises(ExecutorOverloadedError):
+            await ex.submit(
+                ("s",),
+                "prep_init",
+                (b"k1", [0]),
+                backend=backend,
+                deadline_s=1e-4,
+            )
+
+    _run(go())
+    ex.shutdown()
+    stats = next(iter(ex.stats().values()))
+    assert stats["rejections"] == 1 and stats["flushes"] == 0
+
+
+def test_driver_surfaces_overload_as_retryable_jobsteperror():
+    """The driver contract: executor backpressure -> JobStepError(retryable)
+    so the lease machinery redelivers the job."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+
+    reset_global_executor()
+    try:
+        driver = AggregationJobDriver(
+            datastore=None,
+            session_factory=None,
+            config=DriverConfig(
+                vdaf_backend="tpu",
+                device_executor=ExecutorConfig(
+                    enabled=True, max_queue_rows=2, flush_window_s=60.0
+                ),
+            ),
+        )
+        assert driver._executor is not None
+        backend = _FakeBackend()
+        # pre-fill the bucket (oversized jobs on an EMPTY bucket are
+        # admitted, so backpressure needs something queued ahead)
+        key = AggregationJobDriver._vdaf_shape_key(backend.vdaf)
+
+        async def go():
+            filler = asyncio.ensure_future(
+                driver._executor.submit(
+                    key, "prep_init", (b"vk0", [0, 1]), backend=backend
+                )
+            )
+            await asyncio.sleep(0)
+            with pytest.raises(JobStepError) as exc_info:
+                await driver._coalesced_prep_init(backend, b"vk", [0, 1, 2])
+            assert exc_info.value.retryable
+            filler.cancel()
+
+        _run(go())
+    finally:
+        reset_global_executor()
+
+
+# -- error propagation -------------------------------------------------------
+
+
+def test_launch_failure_propagates_to_every_job_in_the_flush():
+    class _ExplodingBackend(_FakeBackend):
+        def launch_prep_init_multi(self, staged, requests):
+            raise RuntimeError("device on fire")
+
+    backend = _ExplodingBackend()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.01, flush_max_rows=10_000))
+
+    async def go():
+        futs = await asyncio.gather(
+            ex.submit(("s",), "prep_init", (b"k1", [0]), backend=backend),
+            ex.submit(("s",), "prep_init", (b"k2", [0]), backend=backend),
+            return_exceptions=True,
+        )
+        return futs
+
+    a, b = _run(go())
+    ex.shutdown()
+    assert isinstance(a, RuntimeError) and isinstance(b, RuntimeError)
+
+
+# -- real-backend parity + warmup -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def count_backend():
+    from janus_tpu.vdaf.backend import TpuBackend
+
+    return TpuBackend(prio3_count())
+
+
+def _count_reports(vdaf, n, seed):
+    rng = det_rng(seed)
+    rows = []
+    for i in range(n):
+        nonce = rng(vdaf.NONCE_SIZE)
+        ps, shares = vdaf.shard(i % 2, nonce, rng(vdaf.RAND_SIZE))
+        rows.append((nonce, ps, shares[0]))
+    return rows
+
+
+def test_coalesced_results_byte_identical_to_oracle(count_backend):
+    from janus_tpu.vdaf.backend import OracleBackend
+
+    vdaf = count_backend.vdaf
+    oracle = OracleBackend(vdaf)
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=1024))
+    vk1, vk2 = b"\x01" * vdaf.VERIFY_KEY_SIZE, b"\x02" * vdaf.VERIFY_KEY_SIZE
+    r1 = _count_reports(vdaf, 3, "par1")
+    r2 = _count_reports(vdaf, 2, "par2")
+
+    async def go():
+        return await asyncio.gather(
+            ex.submit(("count",), "prep_init", (vk1, r1), backend=count_backend),
+            ex.submit(("count",), "prep_init", (vk2, r2), backend=count_backend),
+        )
+
+    a, b = _run(go(), timeout=120.0)
+    ex.shutdown()
+    stats = next(iter(ex.stats().values()))
+    assert stats["flushes"] == 1 and stats["flushed_jobs"] == 2
+    for got, (vk, rows) in zip((a, b), ((vk1, r1), (vk2, r2))):
+        want = oracle.prep_init_batch(vk, 0, rows)
+        for (gs, gsh), (ws, wsh) in zip(got, want):
+            assert gs.out_share == ws.out_share
+            assert gsh.verifiers_share == wsh.verifiers_share
+
+
+def test_warmup_compiles_prep_executables(count_backend):
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=4))
+    compiled = ex.warmup_backend(count_backend, agg_ids=(0, 1))
+    ex.shutdown()
+    assert compiled == 2
+    assert set(count_backend._prep_fns) == {0, 1}
+
+
+def test_bucket_label_is_compact():
+    from janus_tpu.vdaf.backend import OracleBackend
+
+    assert (
+        bucket_label(OracleBackend(prio3_count()), "prep_init", 0)
+        == "Count/a0/prep_init"
+    )

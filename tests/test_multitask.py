@@ -112,6 +112,59 @@ def test_driver_coalesces_concurrent_jobs_into_one_launch():
             assert gs.out_share == ws.out_share
 
 
+def test_executor_concurrent_submitters_two_shapes_match_oracle():
+    """DEVICE EXECUTOR integration (ISSUE 1 acceptance): N=8 concurrent
+    submitters over TWO distinct Prio3 shapes (Count/Field64 and
+    Histogram/Field128+joint-rand) through one process-wide executor
+    produce output shares byte-identical to OracleBackend, with cross-job
+    coalescing actually happening (fewer flushes than submissions)."""
+    from janus_tpu.executor import DeviceExecutor, ExecutorConfig
+
+    shapes = [
+        (prio3_count(), "count-shape"),
+        (prio3_histogram(length=2, chunk_length=1), "hist-shape"),
+    ]
+    backends = {key: TpuBackend(vdaf) for vdaf, key in shapes}
+    executor = DeviceExecutor(
+        ExecutorConfig(enabled=True, flush_window_s=0.02, flush_max_rows=4096)
+    )
+
+    # 8 submitters: 4 per shape, each one task with its own verify key
+    submitters = []
+    for vdaf, key in shapes:
+        for t in range(4):
+            (vk, reports), = _requests(vdaf, 1, 3, seed=f"ex-{key}-{t}")
+            submitters.append((key, vdaf, vk, reports))
+
+    async def submit_one(key, vdaf, vk, reports):
+        return await executor.submit(
+            (key,), "prep_init", (vk, reports), backend=backends[key], agg_id=0
+        )
+
+    async def flow():
+        return await asyncio.gather(
+            *[submit_one(*args) for args in submitters]
+        )
+
+    outs = asyncio.new_event_loop().run_until_complete(flow())
+    executor.shutdown()
+
+    for (key, vdaf, vk, reports), got in zip(submitters, outs):
+        want = OracleBackend(vdaf).prep_init_batch(vk, 0, reports)
+        for (gs, gsh), (ws, wsh) in zip(got, want):
+            assert gs.out_share == ws.out_share
+            assert gsh.verifiers_share == wsh.verifiers_share
+            assert gsh.joint_rand_part == wsh.joint_rand_part
+            assert gs.corrected_joint_rand_seed == ws.corrected_joint_rand_seed
+
+    stats = executor.stats()
+    assert len(stats) == 2, "one bucket per VDAF shape"
+    total_flushes = sum(s["flushes"] for s in stats.values())
+    assert total_flushes < len(submitters), "cross-job coalescing must happen"
+    for s in stats.values():
+        assert s["mean_flush_rows"] > 3, "mega-batch > one submitter's rows"
+
+
 def test_shape_keyed_backend_shared_across_tasks():
     """Tasks with the same VDAF shape share one backend instance (and its
     compiled graphs); different shapes do not."""
