@@ -19,6 +19,10 @@
 #   ./ci.sh tier1      the ROADMAP.md tier-1 command VERBATIM, gated on the
 #                      recorded DOTS_PASSED floor (tests/tier1_floor.txt):
 #                      fewer passing dots than the floor fails the gate.
+#   ./ci.sh chaos      fault-injection gate: tests/test_chaos.py with a FIXED
+#                      seed (JANUS_CHAOS_SEED, default 7) — registry/breaker/
+#                      budget units plus the 2-replica soak with every
+#                      injection point firing at p~=0.2.
 #   ./ci.sh dryrun     the driver's gates: multichip dryrun + entry compile.
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -88,6 +92,12 @@ case "$tier" in
     fi
     exit 0
     ;;
+  chaos)
+    # Fixed seed so the per-point fault decision sequences replay run to
+    # run; override JANUS_CHAOS_SEED to explore other schedules.
+    export JANUS_CHAOS_SEED="${JANUS_CHAOS_SEED:-7}"
+    exec python -m pytest tests/test_chaos.py -q -m "not slow"
+    ;;
   dryrun)
     python __graft_entry__.py 8
     exec python - <<'EOF'
@@ -99,7 +109,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|chaos|dryrun]" >&2
     exit 2
     ;;
 esac

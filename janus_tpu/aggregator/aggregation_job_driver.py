@@ -62,7 +62,20 @@ class JobStepError(Exception):
 @dataclass
 class DriverConfig:
     batch_aggregation_shard_count: int = 8
+    #: Delivery-count ceiling, checked at step ENTRY: bounds redeliveries
+    #: that never report back (crashed/timed-out holders whose lease
+    #: simply expired).  Reported retryable failures are bounded by
+    #: max_step_attempts below; both count lease_attempts, so the
+    #: effective bound is whichever fires first.
     maximum_attempts_before_failure: int = 10
+    #: Retryable-failure budget, checked when a step REPORTS
+    #: JobStepError(retryable=True): the lease is released with
+    #: exponential backoff until lease_attempts reaches this, then the
+    #: job is abandoned — it must not ping-pong forever.
+    max_step_attempts: int = 10
+    #: Lease-backoff curve for retryable failures (doubling per attempt).
+    retry_initial_delay_s: float = 1.0
+    retry_max_delay_s: float = 300.0
     vdaf_backend: str = "oracle"
     http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
     #: Gather window for coalescing same-shape jobs from DIFFERENT tasks
@@ -125,16 +138,37 @@ class AggregationJobDriver:
             try:
                 await self._step(lease)
             except JobStepError as e:
-                if e.retryable:
+                if e.retryable and lease.lease_attempts < self.config.max_step_attempts:
+                    from .job_driver import step_retry_delay
+
                     outcome = "retried"
-                    logger.warning("retryable step failure: %s", e)
+                    delay = step_retry_delay(
+                        lease.lease_attempts,
+                        self.config.retry_initial_delay_s,
+                        self.config.retry_max_delay_s,
+                    )
+                    logger.warning(
+                        "retryable step failure (attempt %d/%d, redeliver in %ds): %s",
+                        lease.lease_attempts,
+                        self.config.max_step_attempts,
+                        delay.seconds,
+                        e,
+                    )
                     await self.datastore.run_tx_async(
                         "release_agg_job",
-                        lambda tx: tx.release_aggregation_job(lease),
+                        lambda tx: tx.release_aggregation_job(lease, delay),
                     )
                 else:
                     outcome = "abandoned"
-                    logger.error("fatal step failure: %s", e)
+                    if e.retryable:
+                        logger.error(
+                            "retryable step failure exhausted its %d-attempt "
+                            "budget; abandoning: %s",
+                            self.config.max_step_attempts,
+                            e,
+                        )
+                    else:
+                        logger.error("fatal step failure: %s", e)
                     await self.abandon_aggregation_job(lease)
         if GLOBAL_METRICS.registry is not None:
             GLOBAL_METRICS.job_steps.labels(
@@ -283,7 +317,7 @@ class AggregationJobDriver:
         """
         loop = asyncio.get_running_loop()
         if self._executor is not None and hasattr(backend, "stage_prep_init_multi"):
-            from ..executor import ExecutorOverloadedError
+            from ..executor import CircuitOpenError, ExecutorOverloadedError
 
             try:
                 return await self._executor.submit(
@@ -293,25 +327,66 @@ class AggregationJobDriver:
                     backend=backend,
                     agg_id=0,
                 )
+            except CircuitOpenError as e:
+                # Device sick (K consecutive launch failures): degrade to
+                # the bit-exact CPU oracle for this job instead of burning
+                # the retry budget — the breaker's half-open probes restore
+                # device service without any action here.
+                return await self._oracle_fallback(backend, verify_key, prep_in, e)
             except ExecutorOverloadedError as e:
                 raise JobStepError(
                     f"device executor overloaded: {e}", retryable=True
                 )
+            except JobStepError:
+                raise
+            except Exception as e:
+                # Launch failure: the breaker counted it; the lease
+                # machinery redelivers (with backoff) until the breaker
+                # verdict flips this shape to the oracle path above.
+                raise JobStepError(f"device launch failed: {e}", retryable=True)
         window = self.config.multi_task_launch_window_s
-        if window <= 0 or not hasattr(backend, "prep_init_multi"):
-            return await loop.run_in_executor(
-                None, lambda: backend.prep_init_batch(verify_key, 0, prep_in)
-            )
-        key = id(backend)
-        fut = loop.create_future()
-        bucket = self._pending_prep.setdefault(key, [])
-        bucket.append((verify_key, prep_in, fut))
-        if len(bucket) == 1:
-            loop.call_later(
-                window,
-                lambda: asyncio.ensure_future(self._flush_prep(backend, key)),
-            )
-        return await fut
+        try:
+            if window <= 0 or not hasattr(backend, "prep_init_multi"):
+                return await loop.run_in_executor(
+                    None, lambda: backend.prep_init_batch(verify_key, 0, prep_in)
+                )
+            key = id(backend)
+            fut = loop.create_future()
+            bucket = self._pending_prep.setdefault(key, [])
+            bucket.append((verify_key, prep_in, fut))
+            if len(bucket) == 1:
+                loop.call_later(
+                    window,
+                    lambda: asyncio.ensure_future(self._flush_prep(backend, key)),
+                )
+            return await fut
+        except Exception as e:
+            # Per-row VDAF rejections come back as in-band PrepOutcomes; an
+            # exception here is infrastructure (device launch, thread pool)
+            # and the lease machinery owns the retry.
+            raise JobStepError(f"prepare launch failed: {e}", retryable=True)
+
+    async def _oracle_fallback(self, backend, verify_key: bytes, prep_in, cause):
+        """Serve one job's prepare on the CPU oracle (bit-exact with the
+        device path by the backend contract, tests/test_backend.py)."""
+        oracle = getattr(backend, "oracle", None)
+        if oracle is None:
+            raise JobStepError(f"device unavailable: {cause}", retryable=True)
+        vdaf_type = type(getattr(backend, "vdaf", None)).__name__
+        logger.warning(
+            "serving prepare on the CPU oracle (%d report(s)): %s",
+            len(prep_in),
+            cause,
+        )
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.vdaf_backend_fallbacks.labels(
+                vdaf_type=vdaf_type, reason="circuit_open"
+            ).inc()
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: oracle.prep_init_batch(verify_key, 0, prep_in)
+        )
 
     async def _flush_prep(self, backend, key: int) -> None:
         bucket = self._pending_prep.pop(key, [])

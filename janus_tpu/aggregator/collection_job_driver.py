@@ -53,8 +53,21 @@ from ..core.dp import NoDifferentialPrivacy, dp_strategy_from_dict  # noqa: E402
 @dataclass
 class CollectionDriverConfig:
     maximum_attempts_before_failure: int = 10
+    #: Uniform retryable-failure budget (mirrors DriverConfig
+    #: .max_step_attempts): a failed helper exchange releases the lease
+    #: with exponential backoff and abandons once lease_attempts reaches
+    #: this, instead of redelivering forever.
+    max_step_attempts: int = 10
+    #: Readiness-poll backoff: a NOT-READY job (reports still
+    #: aggregating) re-polls on this curve (reference RetryStrategy
+    #: :723-792).  Distinct from the failure backoff below — polling an
+    #: unready batch is normal operation, not a failure.
     retry_initial_delay: Duration = Duration(5)
     retry_max_delay: Duration = Duration(300)
+    #: Retryable-FAILURE backoff (helper exchange failed): the
+    #: aggregation driver's curve, shared via step_retry_delay.
+    step_retry_initial_delay: Duration = Duration(1)
+    step_retry_max_delay: Duration = Duration(300)
     http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
 
 
@@ -195,17 +208,13 @@ class CollectionJobDriver:
                 headers=headers,
                 policy=self.config.http_retry,
             )
-        except Exception:
-            logger.warning("helper aggregate-share request failed; releasing")
-            await self.datastore.run_tx_async(
-                "release_coll_job", lambda tx: tx.release_collection_job(lease)
-            )
+        except Exception as e:
+            logger.warning("helper aggregate-share request failed: %s", e)
+            await self._release_retryable(lease)
             return
         if status >= 400:
-            logger.warning("helper aggregate-share returned %d; releasing", status)
-            await self.datastore.run_tx_async(
-                "release_coll_job", lambda tx: tx.release_collection_job(lease)
-            )
+            logger.warning("helper aggregate-share returned %d", status)
+            await self._release_retryable(lease)
             return
         helper_share = AggregateShare.get_decoded(body)
 
@@ -235,6 +244,29 @@ class CollectionJobDriver:
         await self.datastore.run_tx_async("step_collection_job_2", tx2)
 
     # ------------------------------------------------------------------
+    async def _release_retryable(self, lease: Lease) -> None:
+        """Retryable-failure budget + exponential lease-backoff (the
+        aggregation driver's curve, shared via step_retry_delay): release
+        for redelivery, or abandon once the budget is spent."""
+        from .job_driver import step_retry_delay
+
+        if lease.lease_attempts >= self.config.max_step_attempts:
+            logger.error(
+                "collection step failure exhausted its %d-attempt budget; "
+                "abandoning",
+                self.config.max_step_attempts,
+            )
+            await self.abandon_collection_job(lease)
+            return
+        delay = step_retry_delay(
+            lease.lease_attempts,
+            self.config.step_retry_initial_delay.seconds,
+            self.config.step_retry_max_delay.seconds,
+        )
+        await self.datastore.run_tx_async(
+            "release_coll_job", lambda tx: tx.release_collection_job(lease, delay)
+        )
+
     def _ready(self, tx, task: AggregatorTask, job) -> bool:
         """Readiness gate (reference: :124-262): no unaggregated reports in
         scope and all created aggregation jobs terminated."""

@@ -23,6 +23,21 @@ from ..messages import Duration
 logger = logging.getLogger("janus_tpu.job_driver")
 
 
+def step_retry_delay(
+    attempts: int, initial_s: float, max_s: float, multiplier: float = 2.0
+) -> Duration:
+    """Exponential lease-backoff for a retryable step failure: attempt 1
+    waits ``initial_s``, doubling up to ``max_s``.  Shared by both job
+    drivers so every retryable failure redelivers on the same curve
+    (reference analog: collection_job_driver.rs RetryStrategy :723-792,
+    generalized to aggregation).  Clamped to >= 1s: Duration is integral
+    seconds, and truncating a sub-second delay to 0 would mean immediate
+    redelivery — the hot loop this backoff exists to prevent."""
+    return Duration(
+        max(1, int(min(initial_s * multiplier ** max(0, attempts - 1), max_s)))
+    )
+
+
 class JobDriver:
     def __init__(
         self,
@@ -71,6 +86,7 @@ class JobDriver:
             await asyncio.gather(*list(self._inflight), return_exceptions=True)
 
     async def _step(self, sem: asyncio.Semaphore, lease: Lease) -> None:
+        from ..core.metrics import GLOBAL_METRICS
         from ..core.trace import trace_span
 
         async with sem:
@@ -82,6 +98,9 @@ class JobDriver:
                 - self.clock.now().seconds
                 - self.worker_lease_clock_skew_allowance.seconds,
             )
+            # Per-outcome accounting: on wall time alone, a fleet spinning
+            # on timeouts/retries is indistinguishable from a healthy one.
+            outcome = "ok"
             with trace_span(
                 "job_step",
                 job_type=type(lease.leased).__name__,
@@ -90,6 +109,17 @@ class JobDriver:
                 try:
                     await asyncio.wait_for(self.stepper(lease), timeout=timeout)
                 except asyncio.TimeoutError:
+                    outcome = "timeout"
                     logger.warning("job step timed out; lease will expire naturally")
-                except Exception:
+                except Exception as e:
+                    # steppers normally classify internally; anything that
+                    # reaches here is either an escaped JobStepError (duck-
+                    # typed on .retryable) or an unclassified failure
+                    outcome = (
+                        "retryable" if getattr(e, "retryable", False) else "fatal"
+                    )
                     logger.exception("job step failed")
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.job_steps_total.labels(
+                    job_type=type(lease.leased).__name__, outcome=outcome
+                ).inc()

@@ -49,6 +49,46 @@ class DbConfig:
 
 
 @dataclass
+class FaultInjectionConfig:
+    """Deterministic fault injection (core/faults.py).  DEFAULT FULLY
+    OFF — when disabled nothing is sampled and every injection point is
+    a single boolean check.  Enabling arms named points with per-point
+    probability and mode, e.g.::
+
+        fault_injection:
+          enabled: true
+          seed: 7
+          points:
+            datastore.tx.begin: {mode: error, probability: 0.05}
+            http.request:
+              - {mode: error, probability: 0.1}
+              - {mode: delay, probability: 0.1, delay_s: 0.05}
+            executor.flush: {mode: error, probability: 0.2}
+            clock.skew: {mode: skew, probability: 0.2, skew_s: 30}
+
+    Point names and modes are documented in core/faults.py
+    (KNOWN_POINTS / MODES).
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    #: point name -> FaultSpec kwargs (one mapping or a list of them)
+    points: dict = field(default_factory=dict)
+
+    def install(self) -> None:
+        """Arm the process-wide registry (no-op when disabled)."""
+        from ..core import faults
+
+        if not self.enabled or not self.points:
+            return
+        specs = []
+        for point, opts in self.points.items():
+            for o in opts if isinstance(opts, list) else [opts]:
+                specs.append(faults.FaultSpec(point=point, **dict(o)))
+        faults.configure(specs, seed=self.seed)
+
+
+@dataclass
 class CommonConfig:
     """reference: config.rs:31 CommonConfig"""
 
@@ -79,6 +119,10 @@ class CommonConfig:
     #: jax.profiler server port for on-demand device captures (0 = off;
     #: reference analog: trace.rs:158-236 always-on tooling sockets).
     profiler_port: int = 0
+    #: Deterministic fault injection across the failure domains
+    #: (datastore tx, peer HTTP, executor/device launches, clock skew);
+    #: fully off by default.
+    fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
 
 
 @dataclass
@@ -102,6 +146,11 @@ class DeviceExecutorConfig:
     submit_timeout_s: float = 30.0
     #: mega-batch size to precompile per backend at startup (0 = off)
     warmup_rows: int = 0
+    #: consecutive launch failures per VDAF shape before its circuit
+    #: opens and the driver degrades to the CPU oracle (0 disables)
+    breaker_failure_threshold: int = 5
+    #: open-circuit dwell before a half-open probe launch tests the device
+    breaker_reset_timeout_s: float = 30.0
 
     def to_executor_config(self):
         """Build the runtime ExecutorConfig (jax-free import path)."""
@@ -114,6 +163,8 @@ class DeviceExecutorConfig:
             max_queue_rows=self.max_queue_rows,
             submit_timeout_s=self.submit_timeout_s,
             warmup_rows=self.warmup_rows,
+            breaker_failure_threshold=self.breaker_failure_threshold,
+            breaker_reset_timeout_s=self.breaker_reset_timeout_s,
         )
 
 
@@ -126,6 +177,12 @@ class JobDriverConfig:
     worker_lease_duration_s: int = 600
     worker_lease_clock_skew_allowance_s: int = 60
     maximum_attempts_before_failure: int = 10
+    #: retryable-failure budget: redeliveries (lease_attempts) a job gets
+    #: before a retryable step failure abandons it
+    max_step_attempts: int = 10
+    #: exponential lease-backoff curve between retryable redeliveries
+    retry_initial_delay_s: float = 1.0
+    retry_max_delay_s: float = 300.0
 
 
 @dataclass
@@ -183,7 +240,13 @@ def _merge_dataclass(cls, data: dict):
     # nested config classes by name.
     nested = {
         c.__name__: c
-        for c in (CommonConfig, DbConfig, JobDriverConfig, DeviceExecutorConfig)
+        for c in (
+            CommonConfig,
+            DbConfig,
+            JobDriverConfig,
+            DeviceExecutorConfig,
+            FaultInjectionConfig,
+        )
     }
     kwargs = {}
     for name, f in fields.items():

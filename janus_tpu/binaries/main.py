@@ -49,6 +49,17 @@ def _bootstrap(config_common):
     )
 
     install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
+    fault_cfg = getattr(config_common, "fault_injection", None)
+    if fault_cfg is not None and fault_cfg.enabled:
+        # Chaos mode: arm the deterministic fault registry.  Loud on
+        # purpose — a production replica must never run armed silently.
+        fault_cfg.install()
+        logger.warning(
+            "FAULT INJECTION ARMED (seed=%d, points=%s) — this replica "
+            "will deliberately fail",
+            fault_cfg.seed,
+            sorted(fault_cfg.points),
+        )
     if getattr(config_common, "distributed_coordinator", ""):
         # Gang-scheduled SPMD mode ONLY (see CommonConfig): join the
         # cluster BEFORE any backend touches jax.  initialize() blocks
@@ -83,6 +94,12 @@ def _bootstrap(config_common):
         if start_profiler_server(config_common.profiler_port):
             logger.info("jax profiler server on :%d", config_common.profiler_port)
     clock = RealClock()
+    if fault_cfg is not None and fault_cfg.enabled:
+        # clock-skew failure domain: armed replicas see a drifting clock
+        # wherever the registry's clock.skew point fires (no-op otherwise)
+        from ..core.faults import SkewedClock
+
+        clock = SkewedClock(clock)
     crypter = Crypter(datastore_keys_from_env())
     logger.info("datastore: %s", redact_database_url(config_common.database.path))
     datastore = Datastore(
@@ -289,6 +306,9 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             DriverConfig(
                 batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
                 maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
+                max_step_attempts=cfg.job_driver.max_step_attempts,
+                retry_initial_delay_s=cfg.job_driver.retry_initial_delay_s,
+                retry_max_delay_s=cfg.job_driver.retry_max_delay_s,
                 vdaf_backend=cfg.vdaf_backend,
                 device_executor=exec_cfg,
             ),
@@ -330,7 +350,22 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
 
         stepper = stepper_impl.step_aggregation_job
     else:
-        stepper_impl = CollectionJobDriver(datastore, aiohttp.ClientSession)
+        from ..aggregator.collection_job_driver import CollectionDriverConfig
+
+        stepper_impl = CollectionJobDriver(
+            datastore,
+            aiohttp.ClientSession,
+            CollectionDriverConfig(
+                maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
+                max_step_attempts=cfg.job_driver.max_step_attempts,
+                # the shared retry knobs configure the FAILURE backoff; the
+                # readiness-poll curve keeps its own (reference) defaults
+                step_retry_initial_delay=Duration(
+                    max(1, int(cfg.job_driver.retry_initial_delay_s))
+                ),
+                step_retry_max_delay=Duration(int(cfg.job_driver.retry_max_delay_s)),
+            ),
+        )
 
         async def acquirer(duration, limit):
             return await datastore.run_tx_async(

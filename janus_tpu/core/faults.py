@@ -1,0 +1,265 @@
+"""Process-wide deterministic fault injection.
+
+Janus inherits crash-tolerance from its lease machinery — an expired
+lease makes any job re-acquirable by any replica (SURVEY.md §5) — but
+the TPU port adds failure domains the reference never had: device
+launches can fail or hang, the executor can backpressure, and the
+datastore/HTTP seams sit under far more concurrent traffic.  This module
+makes failure a first-class, *testable* input: named injection points at
+every failure-domain boundary, driven by one seeded registry so a chaos
+run replays bit-for-bit.
+
+Injection points wired into the tree (the names are a public contract;
+tests/test_chaos.py cross-checks them):
+
+    ``datastore.tx.begin``   before BEGIN in ``Datastore.run_tx``
+    ``datastore.tx.commit``  after the tx body, before COMMIT
+    ``http.request``         before each attempt in ``retry_http_request``
+    ``executor.flush``       at the head of a DeviceExecutor flush
+    ``backend.launch``       in ``TpuBackend.launch_prep_init_multi``
+    ``backend.combine``      in ``TpuBackend.prep_shares_to_prep_batch``
+    ``clock.skew``           sampled by ``SkewedClock.now``
+
+Modes: ``error`` raises :class:`FaultInjectedError`, ``delay`` sleeps
+``delay_s``, ``hang`` sleeps ``hang_s`` (long enough to trip whatever
+timeout guards the call site), ``skew`` offsets a clock by up to
+``skew_s`` seconds in either direction.  Each point draws from its own
+``random.Random`` seeded by ``(seed, point)``, so per-point decision
+sequences are reproducible regardless of how threads interleave across
+points.
+
+Activation is config-only (``binaries/config.py`` ``fault_injection:``,
+default fully off) or programmatic (:func:`configure`, used by tests).
+When off, every hook is a module-call + one attribute check — nothing is
+sampled, nothing is allocated.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+#: The points wired into the tree today.  configure() accepts unknown
+#: names (new points must not require a lockstep edit here), but the
+#: chaos suite asserts this list stays in sync with the call sites.
+KNOWN_POINTS = (
+    "datastore.tx.begin",
+    "datastore.tx.commit",
+    "http.request",
+    "executor.flush",
+    "backend.launch",
+    "backend.combine",
+    "clock.skew",
+)
+
+MODES = ("error", "delay", "hang", "skew")
+
+
+class FaultInjectedError(Exception):
+    """An ``error``-mode injection fired.
+
+    Call sites treat it like the transient infrastructure failure it
+    impersonates: the datastore retry loop classifies it retryable, the
+    HTTP retry loop retries it, and the executor surfaces it as a launch
+    failure (counted by the circuit breaker).
+    """
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point}")
+        self.point = point
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: fire at ``point`` with ``probability`` per call."""
+
+    point: str
+    mode: str = "error"
+    probability: float = 1.0
+    #: delay-mode sleep
+    delay_s: float = 0.01
+    #: hang-mode sleep — size it against the call site's timeout guard
+    hang_s: float = 3600.0
+    #: skew-mode magnitude: offsets sampled uniformly in [-skew_s, +skew_s]
+    skew_s: int = 0
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown fault mode {self.mode!r} (one of {MODES})")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(f"probability {self.probability} outside [0, 1]")
+
+
+class FaultRegistry:
+    """Seeded spec store + the fire() sampling loop.  One per process."""
+
+    def __init__(self):
+        self.active = False
+        self._specs: Dict[str, List[FaultSpec]] = {}
+        self._rngs: Dict[str, "_PointRng"] = {}
+        self._seed = 0
+        self._lock = threading.Lock()
+        #: point -> number of faults actually injected (not calls checked)
+        self.hits: Dict[str, int] = {}
+
+    # -- arming ---------------------------------------------------------
+    def configure(self, specs: Sequence[FaultSpec], seed: int = 0) -> None:
+        """Arm ``specs``; replaces any previous configuration."""
+        with self._lock:
+            self._specs = {}
+            for spec in specs:
+                self._specs.setdefault(spec.point, []).append(spec)
+            self._seed = seed
+            self._rngs = {}
+            self.hits = {}
+            self.active = bool(self._specs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs = {}
+            self._rngs = {}
+            self.active = False
+
+    # -- sampling -------------------------------------------------------
+    def _decide(self, point: str) -> Optional[FaultSpec]:
+        """Roll each of the point's specs in order; first hit wins.
+        Per-point RNGs keyed by (seed, point) keep decision sequences
+        deterministic even when threads interleave across points."""
+        with self._lock:
+            specs = self._specs.get(point)
+            if not specs:
+                return None
+            rng = self._rngs.get(point)
+            if rng is None:
+                rng = _PointRng(self._seed, point)
+                self._rngs[point] = rng
+            for spec in specs:
+                if rng.roll() < spec.probability:
+                    self.hits[point] = self.hits.get(point, 0) + 1
+                    return spec
+            return None
+
+    def _record(self, spec: FaultSpec) -> None:
+        from .metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.faults_injected.labels(
+                point=spec.point, mode=spec.mode
+            ).inc()
+
+    def fire(self, point: str) -> None:
+        """Synchronous hook (thread contexts: datastore, launch pools)."""
+        spec = self._decide(point)
+        if spec is None:
+            return
+        self._record(spec)
+        if spec.mode == "error":
+            raise FaultInjectedError(point)
+        if spec.mode == "delay":
+            time.sleep(spec.delay_s)
+        elif spec.mode == "hang":
+            time.sleep(spec.hang_s)
+        # skew-mode specs only apply through skew(); firing one here is a no-op
+
+    async def fire_async(self, point: str) -> None:
+        """Event-loop hook: delay/hang must not block the loop's peers."""
+        spec = self._decide(point)
+        if spec is None:
+            return
+        self._record(spec)
+        if spec.mode == "error":
+            raise FaultInjectedError(point)
+        if spec.mode == "delay":
+            await asyncio.sleep(spec.delay_s)
+        elif spec.mode == "hang":
+            await asyncio.sleep(spec.hang_s)
+
+    def skew(self, point: str = "clock.skew") -> int:
+        """Sample a clock offset in seconds (0 when the point is quiet)."""
+        spec = self._decide(point)
+        if spec is None or spec.mode != "skew" or spec.skew_s <= 0:
+            return 0
+        self._record(spec)
+        with self._lock:
+            rng = self._rngs.get(point)  # None if reconfigured mid-call
+            return rng.offset(spec.skew_s) if rng is not None else 0
+
+
+class _PointRng:
+    """random.Random seeded stably from (seed, point-name)."""
+
+    def __init__(self, seed: int, point: str):
+        import random
+
+        self._r = random.Random((seed << 32) ^ zlib.crc32(point.encode()))
+
+    def roll(self) -> float:
+        return self._r.random()
+
+    def offset(self, magnitude: int) -> int:
+        return self._r.randint(-magnitude, magnitude)
+
+
+class SkewedClock:
+    """Clock wrapper applying registry-driven skew (the clock-skew
+    failure domain): each ``now()`` is offset by whatever the
+    ``clock.skew`` point samples.  Wrap exactly the replica whose clock
+    should drift; everything else keeps the base clock."""
+
+    def __init__(self, base, point: str = "clock.skew"):
+        self.base = base
+        self.point = point
+
+    def now(self):
+        from ..messages import Time
+
+        t = self.base.now()
+        offset = skew(self.point)
+        if offset == 0:
+            return t
+        return Time(max(0, t.seconds + offset))
+
+    def __getattr__(self, item):
+        # advance()/set() on a wrapped MockClock keep working
+        return getattr(self.base, item)
+
+
+# -- process-wide instance ---------------------------------------------------
+
+_REGISTRY = FaultRegistry()
+
+
+def registry() -> FaultRegistry:
+    return _REGISTRY
+
+
+def configure(specs: Sequence[FaultSpec], seed: int = 0) -> None:
+    _REGISTRY.configure(specs, seed=seed)
+
+
+def clear() -> None:
+    _REGISTRY.clear()
+
+
+def active() -> bool:
+    return _REGISTRY.active
+
+
+def fire(point: str) -> None:
+    """Sync injection hook; no-op (one bool check) when faults are off."""
+    if _REGISTRY.active:
+        _REGISTRY.fire(point)
+
+
+async def fire_async(point: str) -> None:
+    """Async injection hook; no-op when faults are off."""
+    if _REGISTRY.active:
+        await _REGISTRY.fire_async(point)
+
+
+def skew(point: str = "clock.skew") -> int:
+    return _REGISTRY.skew(point) if _REGISTRY.active else 0

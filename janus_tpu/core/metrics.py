@@ -76,6 +76,15 @@ class Metrics:
             ["vdaf_type", "reason"],
             registry=self.registry,
         )
+        # Per-outcome step counter at the JobDriver layer: a stuck fleet
+        # (timeouts / retryable churn) and a healthy one look identical on
+        # wall-time alone (ISSUE 2 satellite); this splits them.
+        self.job_steps_total = Counter(
+            "janus_job_steps_total",
+            "Job driver step outcomes by job type",
+            ["job_type", "outcome"],
+            registry=self.registry,
+        )
         # reference: job_driver.rs:102-113 acquire/step timing
         self.job_steps = Histogram(
             "janus_job_step_duration_seconds",
@@ -159,6 +168,29 @@ class Metrics:
             "janus_executor_rejections_total",
             "Backpressure rejections by bucket and reason",
             ["bucket", "reason"],
+            registry=self.registry,
+        )
+        # Per-shape circuit breaker (executor/service.py): a sick device
+        # path must be visible the moment it trips, and again when the
+        # half-open probe restores it.
+        self.circuit_state = Gauge(
+            "janus_executor_circuit_state",
+            "Device circuit state per VDAF shape (0=closed 1=open 2=half-open)",
+            ["circuit"],
+            registry=self.registry,
+        )
+        self.circuit_transitions = Counter(
+            "janus_executor_circuit_transitions_total",
+            "Device circuit state transitions per VDAF shape",
+            ["circuit", "state"],
+            registry=self.registry,
+        )
+        # Fault injection (core/faults.py): every injected fault is counted
+        # so a chaos run's pressure is itself observable.
+        self.faults_injected = Counter(
+            "janus_faults_injected_total",
+            "Injected faults by point and mode",
+            ["point", "mode"],
             registry=self.registry,
         )
 

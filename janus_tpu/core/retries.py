@@ -10,8 +10,11 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
+
+from . import faults
 
 
 def is_retryable_http_status(status: int) -> bool:
@@ -42,17 +45,25 @@ async def retry_http_request(
     headers: Optional[dict] = None,
     policy: Optional[HttpRetryPolicy] = None,
 ) -> Tuple[int, bytes, dict]:
-    """Issue a request, retrying retryable outcomes.  Returns
-    (status, body, headers); raises the last connection error if every
-    attempt failed at the transport layer."""
+    """Issue a request, retrying retryable outcomes.
+
+    Returns (status, body, headers) — on exhaustion, the last retryable
+    response.  Raises the last transport-layer error if the final attempt
+    failed before producing a response; never returns ``None``.
+    ``max_elapsed`` bounds TOTAL wall time — request duration included,
+    not just the backoff sleeps (a peer that burns 29s per hung attempt
+    must not get ten of them).
+    """
     import aiohttp
 
     policy = policy or HttpRetryPolicy()
     interval = policy.initial_interval
-    elapsed = 0.0
+    start = time.monotonic()
+    last: Optional[Tuple[int, bytes, dict]] = None
     last_exc: Optional[BaseException] = None
-    for attempt in range(policy.max_attempts):
+    for attempt in range(max(1, policy.max_attempts)):
         try:
+            await faults.fire_async("http.request")
             async with session.request(
                 method, url, data=data, headers=headers
             ) as resp:
@@ -61,15 +72,19 @@ async def retry_http_request(
                     return resp.status, body, dict(resp.headers)
                 last_exc = None
                 last = (resp.status, body, dict(resp.headers))
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        except (
+            aiohttp.ClientError,
+            asyncio.TimeoutError,
+            faults.FaultInjectedError,
+        ) as e:
             last_exc = e
-            last = None
+        elapsed = time.monotonic() - start
         if elapsed >= policy.max_elapsed or attempt == policy.max_attempts - 1:
             break
         sleep = interval * (0.5 + random.random())
         await asyncio.sleep(sleep)
-        elapsed += sleep
         interval = min(interval * policy.multiplier, policy.max_interval)
     if last_exc is not None:
         raise last_exc
+    assert last is not None  # loop ran >= 1 attempt and didn't raise
     return last

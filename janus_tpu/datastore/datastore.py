@@ -34,6 +34,7 @@ import threading
 import time as _time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
 
+from ..core import faults
 from ..core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
 from ..core.hpke import HpkeKeypair
 from ..core.time import Clock
@@ -254,9 +255,13 @@ class Datastore:
         for attempt in range(self.max_transaction_retries):
             conn = self._conn()
             try:
+                # Failure-domain boundary: an injected begin fault is
+                # indistinguishable from lock contention and retries the
+                # same way (core/faults.py; off by default).
+                faults.fire("datastore.tx.begin")
                 conn.execute(self.backend.begin_sql)
             except Exception as e:
-                if not self.backend.is_retryable(e):
+                if not self._is_retryable(e):
                     # Non-retryable BEGIN failure usually means the cached
                     # connection is dead (server restart on a network
                     # backend): reconnect before surfacing the error.
@@ -271,6 +276,9 @@ class Datastore:
             tx = Transaction(self, conn)
             try:
                 result = fn(tx)
+                # Commit-boundary fault: rolls back and re-runs fn, exactly
+                # like a serialization failure at COMMIT would.
+                faults.fire("datastore.tx.commit")
                 conn.commit()
                 _metrics_tx(name, "committed")
                 return result
@@ -281,13 +289,18 @@ class Datastore:
                     # Never mask the original error with a rollback failure
                     # on a broken connection; reconnect next attempt.
                     self._evict_conn()
-                if self.backend.is_retryable(e):
+                if self._is_retryable(e):
                     last_err = e
                     _time.sleep(min(0.05 * (attempt + 1), 0.5))
                     continue
                 raise
         _metrics_tx(name, "exhausted")
         raise DatastoreError(f"transaction {name!r} exhausted retries: {last_err}")
+
+    def _is_retryable(self, e: BaseException) -> bool:
+        """Backend retry classification, plus injected faults — which
+        impersonate transient infrastructure failures by contract."""
+        return isinstance(e, faults.FaultInjectedError) or self.backend.is_retryable(e)
 
     async def run_tx_async(self, name: str, fn: Callable[["Transaction"], T]) -> T:
         """Async wrapper: runs the (synchronous) transaction in a worker

@@ -2,24 +2,30 @@
 
 See service.py for the design.  Importing this package does NOT import
 jax — control-plane processes can hold an ExecutorConfig (and the
-overload error type for retry classification) without pulling in the
-device stack.
+overload / circuit-breaker error types for retry classification) without
+pulling in the device stack.
 """
 
 from .service import (
+    CircuitBreaker,
+    CircuitOpenError,
     DeviceExecutor,
     ExecutorConfig,
     ExecutorOverloadedError,
     bucket_label,
     get_global_executor,
     reset_global_executor,
+    shape_label,
 )
 
 __all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
     "DeviceExecutor",
     "ExecutorConfig",
     "ExecutorOverloadedError",
     "bucket_label",
     "get_global_executor",
     "reset_global_executor",
+    "shape_label",
 ]

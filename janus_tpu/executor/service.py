@@ -43,6 +43,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..core import faults
+
 logger = logging.getLogger("janus_tpu.executor")
 
 #: Submission kinds (the "phase" of the bucket key).
@@ -57,6 +59,22 @@ class ExecutorOverloadedError(Exception):
     datastore, so the caller maps this to JobStepError(retryable=True)
     and the job is redelivered when the device catches up.
     """
+
+
+class CircuitOpenError(Exception):
+    """The shape's device circuit is open: K consecutive launches failed
+    and the breaker has not yet half-open-probed its way back.
+
+    NOT a retryable-overload signal — the device is sick, not busy.  The
+    caller's contract is graceful degradation: serve the submission on
+    the bit-exact CPU oracle instead (AggregationJobDriver does), so
+    aggregation keeps running while the breaker probes for recovery.
+    """
+
+
+#: Circuit states (exported via the janus_executor_circuit_state gauge).
+CIRCUIT_CLOSED, CIRCUIT_OPEN, CIRCUIT_HALF_OPEN = 0, 1, 2
+_CIRCUIT_STATE_NAMES = {0: "closed", 1: "open", 2: "half_open"}
 
 
 @dataclass
@@ -75,6 +93,101 @@ class ExecutorConfig:
     submit_timeout_s: float = 30.0
     #: pow2 mega-batch size warmup compiles per (backend, agg_id); 0 = off
     warmup_rows: int = 0
+    #: consecutive launch failures per VDAF shape before its circuit
+    #: opens (submits raise CircuitOpenError -> oracle fallback); 0 = off
+    breaker_failure_threshold: int = 5
+    #: how long an open circuit waits before letting one half-open probe
+    #: launch through to test the device
+    breaker_reset_timeout_s: float = 30.0
+
+
+class CircuitBreaker:
+    """Per-shape-key device health: closed -> (K consecutive launch
+    failures) -> open -> (reset timeout) -> half-open, one probe in
+    flight -> closed on success, straight back to open on failure.
+
+    Thread-safe: allow() runs on submitter event loops, record_*() on
+    flush tasks / the launch thread.
+    """
+
+    def __init__(self, label: str, failure_threshold: int, reset_timeout_s: float):
+        self.label = label
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.state = CIRCUIT_CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        """May a new submission enter the device path right now?"""
+        if self.failure_threshold <= 0:
+            return True
+        with self._lock:
+            if self.state == CIRCUIT_CLOSED:
+                return True
+            if self.state == CIRCUIT_OPEN:
+                if time.monotonic() - self._opened_at < self.reset_timeout_s:
+                    return False
+                self._set_state(CIRCUIT_HALF_OPEN)
+                self._probing = True
+                return True
+            # HALF_OPEN: exactly one probe in flight at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def probe_aborted(self) -> None:
+        """A flush resolved without touching the device (every submission
+        expired in queue): no health signal either way, but the probe slot
+        must free up or a half-open breaker wedges."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            self._probing = False
+            if self.state != CIRCUIT_CLOSED:
+                logger.info("device circuit %s closed (probe succeeded)", self.label)
+                self._set_state(CIRCUIT_CLOSED)
+
+    def record_failure(self) -> None:
+        if self.failure_threshold <= 0:
+            return
+        with self._lock:
+            self.consecutive_failures += 1
+            self._probing = False
+            should_open = self.state == CIRCUIT_HALF_OPEN or (
+                self.state == CIRCUIT_CLOSED
+                and self.consecutive_failures >= self.failure_threshold
+            )
+            if should_open or self.state == CIRCUIT_OPEN:
+                self._opened_at = time.monotonic()
+            if should_open:
+                self.trips += 1
+                logger.warning(
+                    "device circuit %s OPEN after %d consecutive launch "
+                    "failure(s); falling back to the CPU oracle for %.1fs",
+                    self.label,
+                    self.consecutive_failures,
+                    self.reset_timeout_s,
+                )
+                self._set_state(CIRCUIT_OPEN)
+
+    def _set_state(self, state: int) -> None:
+        """Lock held.  Metrics are best-effort (no registry -> no-op)."""
+        self.state = state
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.circuit_state.labels(circuit=self.label).set(state)
+            GLOBAL_METRICS.circuit_transitions.labels(
+                circuit=self.label, state=_CIRCUIT_STATE_NAMES[state]
+            ).inc()
 
 
 @dataclass
@@ -93,12 +206,16 @@ class _Submission:
 class _Bucket:
     """Pending submissions for one (shape_key, kind, agg_id)."""
 
-    def __init__(self, key: tuple, backend, kind: str, agg_id: int, label: str):
+    def __init__(
+        self, key: tuple, backend, kind: str, agg_id: int, label: str, breaker=None
+    ):
         self.key = key
         self.backend = backend
         self.kind = kind
         self.agg_id = agg_id
         self.label = label
+        #: shared per-shape CircuitBreaker (None when breakers are off)
+        self.breaker = breaker
         self.pending: List[_Submission] = []
         self.queued_rows = 0
         self.inflight_rows = 0
@@ -128,10 +245,22 @@ def bucket_label(backend, kind: str, agg_id: int, shape_key: tuple = None) -> st
     circuit = type(valid).__name__ if valid is not None else type(vdaf).__name__
     label = f"{circuit}/a{agg_id}/{kind}"
     if shape_key is not None:
-        import zlib
-
-        label += "#%06x" % (zlib.crc32(repr(shape_key).encode()) & 0xFFFFFF)
+        label += "#" + _shape_digest(shape_key)
     return label
+
+
+def _shape_digest(shape_key: tuple) -> str:
+    import zlib
+
+    return "%06x" % (zlib.crc32(repr(shape_key).encode()) & 0xFFFFFF)
+
+
+def shape_label(backend, shape_key: tuple) -> str:
+    """Per-shape label (no kind/agg_id): the circuit breaker's identity."""
+    vdaf = getattr(backend, "vdaf", None)
+    valid = getattr(getattr(vdaf, "flp", None), "valid", None)
+    circuit = type(valid).__name__ if valid is not None else type(vdaf).__name__
+    return f"{circuit}#{_shape_digest(shape_key)}"
 
 
 class DeviceExecutor:
@@ -141,6 +270,7 @@ class DeviceExecutor:
         self.config = config or ExecutorConfig()
         self._buckets: Dict[tuple, _Bucket] = {}
         self._backends: Dict[tuple, object] = {}
+        self._breakers: Dict[tuple, CircuitBreaker] = {}
         self._lock = threading.Lock()
         self._stage_pool: Optional[ThreadPoolExecutor] = None
         self._launch_pool: Optional[ThreadPoolExecutor] = None
@@ -218,6 +348,12 @@ class DeviceExecutor:
             return []
         if self._closed:
             raise ExecutorOverloadedError("executor is shut down")
+        breaker = self._breaker_for(shape_key, backend)
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpenError(
+                f"device circuit {breaker.label} is open after "
+                f"{breaker.consecutive_failures} consecutive launch failure(s)"
+            )
         loop = asyncio.get_running_loop()
         now = time.monotonic()
         timeout = self.config.submit_timeout_s if deadline_s is None else deadline_s
@@ -231,6 +367,7 @@ class DeviceExecutor:
                     kind,
                     agg_id,
                     bucket_label(backend, kind, agg_id, shape_key),
+                    breaker=breaker,
                 )
                 self._buckets[key] = bucket
             # Backpressure bounds the QUEUE, not the job: a submission
@@ -269,6 +406,23 @@ class DeviceExecutor:
         if subs:
             self._spawn(self._run_flush(bucket, subs, trigger="size"))
         return await sub.future
+
+    def _breaker_for(self, shape_key: tuple, backend) -> Optional[CircuitBreaker]:
+        """One CircuitBreaker per VDAF shape (None when disabled): every
+        bucket of the shape — both aggregator sides, both kinds — shares
+        the health verdict, because they share the sick device."""
+        if self.config.breaker_failure_threshold <= 0:
+            return None
+        with self._lock:
+            br = self._breakers.get(shape_key)
+            if br is None:
+                br = CircuitBreaker(
+                    shape_label(backend, shape_key),
+                    self.config.breaker_failure_threshold,
+                    self.config.breaker_reset_timeout_s,
+                )
+                self._breakers[shape_key] = br
+            return br
 
     def _spawn(self, coro) -> None:
         """Schedule a flush coroutine, keeping a strong reference until done."""
@@ -327,10 +481,15 @@ class DeviceExecutor:
         loop = asyncio.get_running_loop()
         live = self._reject_expired(bucket, subs)
         if not live:
+            if bucket.breaker is not None:
+                bucket.breaker.probe_aborted()
             return
         rows = sum(s.rows for s in live)
         stage_pool, launch_pool = self._pools()
         try:
+            # Failure-domain boundary: an injected flush fault is a launch
+            # failure to every job in the mega-batch — and to the breaker.
+            await faults.fire_async("executor.flush")
             with trace_span(
                 "executor_flush",
                 cat="executor",
@@ -386,7 +545,11 @@ class DeviceExecutor:
 
                     outs, still = await loop.run_in_executor(launch_pool, launch)
             if outs is None:
+                if bucket.breaker is not None:
+                    bucket.breaker.probe_aborted()
                 return
+            if bucket.breaker is not None:
+                bucket.breaker.record_success()
             done = time.monotonic()
             bucket.flushes += 1
             bucket.flushed_rows += rows
@@ -400,6 +563,8 @@ class DeviceExecutor:
                 self._observe_wait(bucket, done - s.enqueued)
                 self._resolve(s, result=out)
         except Exception as e:  # surface the launch failure to every job
+            if bucket.breaker is not None:
+                bucket.breaker.record_failure()
             done = time.monotonic()
             for s in live:
                 self._finish(bucket, s, done)
@@ -501,6 +666,18 @@ class DeviceExecutor:
                     "depth_rows": b.depth_rows,
                 }
                 for b in self._buckets.values()
+            }
+
+    def circuit_stats(self) -> Dict[str, dict]:
+        """Per-shape breaker state (plain Python; chaos tests read this)."""
+        with self._lock:
+            return {
+                br.label: {
+                    "state": _CIRCUIT_STATE_NAMES[br.state],
+                    "trips": br.trips,
+                    "consecutive_failures": br.consecutive_failures,
+                }
+                for br in self._breakers.values()
             }
 
     def shutdown(self) -> None:

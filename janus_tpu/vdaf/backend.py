@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..core import faults
 from ..fields import next_power_of_2
 from ..xof import XofTurboShake128
 from .prio3 import (
@@ -279,6 +280,7 @@ class TpuBackend:
     ) -> List[Union[Optional[bytes], VdafError]]:
         if not prep_shares:
             return []
+        faults.fire("backend.combine")
         vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
         S = vdaf.num_shares
         # Rows with the wrong share count must fail exactly like the oracle
@@ -378,6 +380,11 @@ class TpuBackend:
     ) -> List[List[PrepOutcome]]:
         """Device half: run the compiled prepare on a staged batch, read
         back once, and slice results per request."""
+        # Failure-domain boundary: an injected launch fault impersonates
+        # XLA OOM / plugin loss; callers (executor breaker, driver retry
+        # budget) must degrade gracefully.  The oracle has no such point —
+        # it is the fallback truth.
+        faults.fire("backend.launch")
         agg_id, B = staged.agg_id, staged.rows
         from ..core.metrics import GLOBAL_METRICS
 

@@ -1,0 +1,846 @@
+"""Fault-injection harness + failure-domain hardening (ISSUE 2).
+
+Layers, cheapest first:
+
+* registry semantics: default-off, seeded determinism, every KNOWN_POINT
+  actually wired into the tree;
+* ``retry_http_request`` exhaustion contract (raises, counts request
+  duration against ``max_elapsed``);
+* executor circuit breaker: trip after K consecutive launch failures,
+  half-open probe, recovery; driver degradation to the CPU oracle;
+* retryable-failure budget: exponential lease-backoff, abandon at
+  ``max_step_attempts``;
+* the CHAOS SOAK: a 2-replica, 2-task leader+helper run with every
+  injection point firing at p~=0.2, asserting every job reaches a
+  terminal state, the breaker trip+recovery is observable in the
+  /metrics payload, and aggregates are byte-identical to what the CPU
+  oracle computes (Prio3 aggregation is exact, so equality with the
+  true sums IS oracle parity).
+
+Seeded via JANUS_CHAOS_SEED (./ci.sh chaos pins it) so CI replays the
+same per-point fault sequences.
+"""
+
+import asyncio
+import os
+import pathlib
+import sqlite3
+
+import pytest
+
+from janus_tpu.core import faults
+from janus_tpu.core.faults import FaultInjectedError, FaultSpec, SkewedClock
+from janus_tpu.core.retries import HttpRetryPolicy, retry_http_request
+from janus_tpu.core.time import MockClock
+from janus_tpu.executor import (
+    CircuitOpenError,
+    DeviceExecutor,
+    ExecutorConfig,
+    ExecutorOverloadedError,
+    reset_global_executor,
+)
+from janus_tpu.messages import Duration, Time
+
+SEED = int(os.environ.get("JANUS_CHAOS_SEED", "7"))
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+#: The datastore's lease SQL uses RETURNING (SQLite >= 3.35); dev
+#: containers with an older libsqlite skip the end-to-end chaos tests
+#: (they run in the CI image, like the rest of the datastore suite).
+NEEDS_RETURNING = pytest.mark.skipif(
+    sqlite3.sqlite_version_info < (3, 35),
+    reason="datastore lease SQL needs SQLite RETURNING (>= 3.35)",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Never leak an armed registry (or a tripped global executor) into
+    the rest of the suite."""
+    faults.clear()
+    yield
+    faults.clear()
+    reset_global_executor()
+
+
+def _run(coro, timeout=300.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_faults_default_off_and_cleared():
+    assert not faults.active()
+    faults.fire("http.request")  # no-op, no raise
+    faults.configure([FaultSpec("http.request", "error", 1.0)], seed=SEED)
+    assert faults.active()
+    with pytest.raises(FaultInjectedError):
+        faults.fire("http.request")
+    faults.clear()
+    faults.fire("http.request")  # off again
+    assert faults.registry().hits["http.request"] == 1
+
+
+def test_fault_decisions_are_seeded_deterministic():
+    """Two identically-seeded registries make identical per-point decision
+    sequences; a different seed diverges."""
+
+    def sequence(seed):
+        r = faults.FaultRegistry()
+        r.configure([FaultSpec("backend.launch", "error", 0.5)], seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                r.fire("backend.launch")
+                out.append(0)
+            except FaultInjectedError:
+                out.append(1)
+        return out
+
+    a, b, c = sequence(SEED), sequence(SEED), sequence(SEED + 1)
+    assert a == b
+    assert a != c
+    assert sum(a) > 0 and sum(a) < 64  # p=0.5 actually fires sometimes
+
+
+def test_every_known_point_is_wired():
+    """The KNOWN_POINTS contract: each name appears at its call site (a
+    renamed point must fail here, not silently stop injecting)."""
+    wiring = {
+        "datastore.tx.begin": "janus_tpu/datastore/datastore.py",
+        "datastore.tx.commit": "janus_tpu/datastore/datastore.py",
+        "http.request": "janus_tpu/core/retries.py",
+        "executor.flush": "janus_tpu/executor/service.py",
+        "backend.launch": "janus_tpu/vdaf/backend.py",
+        "backend.combine": "janus_tpu/vdaf/backend.py",
+        "clock.skew": "janus_tpu/core/faults.py",
+    }
+    assert set(wiring) == set(faults.KNOWN_POINTS)
+    for point, rel in wiring.items():
+        assert f'"{point}"' in (REPO / rel).read_text(), (point, rel)
+
+
+def test_skewed_clock_applies_registry_offsets():
+    base = MockClock(Time(1_600_000_000))
+    clock = SkewedClock(base)
+    assert clock.now().seconds == 1_600_000_000  # faults off: no skew
+    faults.configure([FaultSpec("clock.skew", "skew", 1.0, skew_s=30)], seed=SEED)
+    seen = {clock.now().seconds - base.now().seconds for _ in range(32)}
+    assert seen - {0}, "skew must fire at p=1"
+    assert all(-30 <= s <= 30 for s in seen)
+    clock.advance(Duration(60))  # delegation to the wrapped MockClock
+    assert base.now().seconds == 1_600_000_060
+
+
+def test_fault_injection_config_yaml_round_trip():
+    from janus_tpu.binaries.config import JobDriverBinaryConfig, load_config
+
+    cfg = load_config(
+        JobDriverBinaryConfig,
+        text="""
+common:
+  fault_injection:
+    enabled: true
+    seed: 3
+    points:
+      http.request: {mode: error, probability: 1.0}
+      clock.skew: [{mode: skew, probability: 0.5, skew_s: 10}]
+""",
+    )
+    assert cfg.common.fault_injection.enabled
+    cfg.common.fault_injection.install()
+    try:
+        assert faults.active()
+        with pytest.raises(FaultInjectedError):
+            faults.fire("http.request")
+    finally:
+        faults.clear()
+
+
+# -- retry_http_request (satellite fix) --------------------------------------
+
+
+class _FailingSession:
+    """Every attempt fails at the transport layer after ``delay_s``."""
+
+    def __init__(self, delay_s=0.0):
+        self.calls = 0
+        self.delay_s = delay_s
+
+    def request(self, method, url, data=None, headers=None):
+        self.calls += 1
+        sess = self
+
+        class _Ctx:
+            async def __aenter__(self):
+                import aiohttp
+
+                if sess.delay_s:
+                    await asyncio.sleep(sess.delay_s)
+                raise aiohttp.ClientConnectionError("connection refused")
+
+            async def __aexit__(self, *exc):
+                return False
+
+        return _Ctx()
+
+
+def test_retry_exhaustion_after_transport_failure_raises():
+    """Exhausting attempts on transport errors must RAISE the last error,
+    never return None (the old code's max_elapsed path did)."""
+    import aiohttp
+
+    session = _FailingSession()
+    with pytest.raises(aiohttp.ClientConnectionError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://unreachable.invalid/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 10.0, 3),
+            )
+        )
+    assert session.calls == 3
+
+
+def test_retry_max_elapsed_counts_request_duration():
+    """A peer that burns wall time per hung attempt exhausts max_elapsed
+    even though almost nothing is spent sleeping between attempts."""
+    import aiohttp
+
+    session = _FailingSession(delay_s=0.05)
+    with pytest.raises(aiohttp.ClientConnectionError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://unreachable.invalid/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 0.06, 10),
+            )
+        )
+    assert session.calls <= 3, "request duration must count against max_elapsed"
+
+
+def test_injected_http_faults_are_retried_then_surfaced():
+    class _NeverCalled:
+        def request(self, *a, **kw):  # pragma: no cover
+            raise AssertionError("transport reached despite injected fault")
+
+    faults.configure([FaultSpec("http.request", "error", 1.0)], seed=SEED)
+    with pytest.raises(FaultInjectedError):
+        _run(
+            retry_http_request(
+                _NeverCalled(),
+                "GET",
+                "http://x.invalid/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 1.0, 2),
+            )
+        )
+    assert faults.registry().hits["http.request"] == 2, "each attempt re-rolls"
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class _FlakyBackend:
+    """Launches fail while .fail is True; minimal stage/launch seam."""
+
+    class _V:
+        pass
+
+    def __init__(self, fail=True):
+        self.vdaf = self._V()
+        self.fail = fail
+        self.launches = 0
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        from types import SimpleNamespace
+
+        rows = sum(len(r) for _, r in requests)
+        return SimpleNamespace(agg_id=agg_id, placed=None, pad_to=rows, rows=rows)
+
+    def launch_prep_init_multi(self, staged, requests):
+        self.launches += 1
+        if self.fail:
+            raise RuntimeError("device on fire")
+        return [[("ok", i) for i in range(len(r))] for _, r in requests]
+
+
+def _breaker_config(**kw):
+    base = dict(
+        flush_window_s=0.005,
+        flush_max_rows=10_000,
+        breaker_failure_threshold=2,
+        breaker_reset_timeout_s=0.15,
+    )
+    base.update(kw)
+    return ExecutorConfig(**base)
+
+
+def test_breaker_trips_after_k_failures_and_half_open_probe_recovers():
+    backend = _FlakyBackend(fail=True)
+    ex = DeviceExecutor(_breaker_config())
+
+    async def go():
+        for _ in range(2):  # K=2 consecutive launch failures
+            with pytest.raises(RuntimeError):
+                await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        # open: fail fast without touching the device
+        launches = backend.launches
+        with pytest.raises(CircuitOpenError):
+            await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        assert backend.launches == launches
+        (st,) = ex.circuit_stats().values()
+        assert st["state"] == "open" and st["trips"] == 1
+        # past the reset timeout the single half-open probe goes through
+        await asyncio.sleep(0.2)
+        backend.fail = False
+        out = await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        assert out == [("ok", 0)]
+        (st,) = ex.circuit_stats().values()
+        assert st["state"] == "closed" and st["consecutive_failures"] == 0
+
+    _run(go())
+    ex.shutdown()
+
+
+def test_failed_half_open_probe_reopens():
+    backend = _FlakyBackend(fail=True)
+    ex = DeviceExecutor(_breaker_config())
+
+    async def go():
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        await asyncio.sleep(0.2)
+        with pytest.raises(RuntimeError):  # the probe itself fails...
+            await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        with pytest.raises(CircuitOpenError):  # ...and the circuit re-opens
+            await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        (st,) = ex.circuit_stats().values()
+        assert st["state"] == "open" and st["trips"] == 2
+
+    _run(go())
+    ex.shutdown()
+
+
+def test_injected_flush_faults_count_toward_breaker():
+    backend = _FlakyBackend(fail=False)
+    ex = DeviceExecutor(_breaker_config())
+    faults.configure([FaultSpec("executor.flush", "error", 1.0)], seed=SEED)
+
+    async def go():
+        for _ in range(2):
+            with pytest.raises(FaultInjectedError):
+                await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+        with pytest.raises(CircuitOpenError):
+            await ex.submit(("sh",), "prep_init", (b"k", [0]), backend=backend)
+
+    _run(go())
+    ex.shutdown()
+    assert backend.launches == 0, "flush fault fires before the device"
+
+
+def test_driver_degrades_to_oracle_while_circuit_open():
+    """The graceful-degradation contract: CircuitOpenError -> the job is
+    served by the backend's bit-exact CPU oracle, not failed."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+
+    reset_global_executor()
+    backend = _FlakyBackend(fail=True)
+
+    class _Oracle:
+        def prep_init_batch(self, vk, agg_id, rows):
+            return [("oracle", vk, i) for i in range(len(rows))]
+
+    backend.oracle = _Oracle()
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu",
+            device_executor=_breaker_config(
+                enabled=True, breaker_failure_threshold=1, breaker_reset_timeout_s=60.0
+            ),
+        ),
+    )
+
+    async def go():
+        # first delivery: launch fails -> retryable (breaker counts it)
+        with pytest.raises(JobStepError) as exc_info:
+            await driver._coalesced_prep_init(backend, b"vk", [0, 1])
+        assert exc_info.value.retryable
+        # redelivery: circuit open -> oracle serves the job
+        out = await driver._coalesced_prep_init(backend, b"vk", [0, 1])
+        assert out == [("oracle", b"vk", 0), ("oracle", b"vk", 1)]
+
+    _run(go())
+
+
+# -- retryable-failure budget ------------------------------------------------
+
+
+def test_step_retry_delay_curve():
+    from janus_tpu.aggregator.job_driver import step_retry_delay
+
+    delays = [step_retry_delay(a, 1.0, 300.0).seconds for a in range(1, 12)]
+    assert delays[:5] == [1, 2, 4, 8, 16]
+    assert delays[-1] == 300  # capped
+
+
+def test_retryable_budget_releases_with_backoff_then_abandons():
+    """JobStepError(retryable=True) counts against max_step_attempts via
+    lease.lease_attempts: under budget -> release (redeliver later); at
+    budget -> abandon."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+    from janus_tpu.datastore.models import AcquiredAggregationJob, Lease, LeaseToken
+    from janus_tpu.messages import AggregationJobId, TaskId
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            return None
+
+    def make_lease(attempts):
+        return Lease(
+            leased=AcquiredAggregationJob(
+                task_id=TaskId.random(),
+                aggregation_job_id=AggregationJobId.random(),
+                query_type="TimeInterval",
+                vdaf={"type": "Prio3Count"},
+            ),
+            lease_expiry=Time(1_600_000_600),
+            lease_token=LeaseToken(b"\x01" * 16),
+            lease_attempts=attempts,
+        )
+
+    ds = _StubDatastore()
+    driver = AggregationJobDriver(ds, None, DriverConfig(max_step_attempts=3))
+
+    async def failing_step(lease):
+        raise JobStepError("injected", retryable=True)
+
+    driver._step = failing_step
+
+    _run(driver.step_aggregation_job(make_lease(attempts=1)))
+    assert ds.tx_names == ["release_agg_job"], "under budget: released"
+
+    ds.tx_names.clear()
+    _run(driver.step_aggregation_job(make_lease(attempts=3)))
+    assert ds.tx_names == ["abandon_agg_job"], "budget spent: abandoned"
+
+
+def test_collection_budget_releases_with_backoff_then_abandons():
+    from janus_tpu.aggregator.collection_job_driver import (
+        CollectionDriverConfig,
+        CollectionJobDriver,
+    )
+    from janus_tpu.datastore.models import AcquiredCollectionJob, Lease, LeaseToken
+    from janus_tpu.messages import CollectionJobId, TaskId
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            return None
+
+    def make_lease(attempts):
+        return Lease(
+            leased=AcquiredCollectionJob(
+                task_id=TaskId.random(),
+                collection_job_id=CollectionJobId.random(),
+                query_type="TimeInterval",
+                vdaf={"type": "Prio3Count"},
+                step_attempts=0,
+            ),
+            lease_expiry=Time(1_600_000_600),
+            lease_token=LeaseToken(b"\x02" * 16),
+            lease_attempts=attempts,
+        )
+
+    ds = _StubDatastore()
+    driver = CollectionJobDriver(ds, None, CollectionDriverConfig(max_step_attempts=3))
+
+    _run(driver._release_retryable(make_lease(attempts=1)))
+    assert ds.tx_names == ["release_coll_job"]
+
+    ds.tx_names.clear()
+    _run(driver._release_retryable(make_lease(attempts=3)))
+    assert ds.tx_names == ["abandon_collection_job"]
+
+
+def test_injected_tx_faults_are_absorbed_by_run_tx():
+    """Transaction-boundary faults at p=0.5 look like lock contention:
+    every transaction still commits (run_tx's retry loop absorbs them)."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.datastore.test_util import EphemeralDatastore
+
+    eph = EphemeralDatastore()
+    try:
+        faults.configure(
+            [
+                FaultSpec("datastore.tx.begin", "error", 0.5),
+                FaultSpec("datastore.tx.commit", "error", 0.5),
+            ],
+            seed=SEED,
+        )
+        for i in range(20):
+            got = eph.datastore.run_tx("chaos_tx", lambda tx, i=i: i)
+            assert got == i
+        hits = faults.registry().hits
+        assert hits.get("datastore.tx.begin", 0) + hits.get(
+            "datastore.tx.commit", 0
+        ) > 0
+    finally:
+        faults.clear()
+        eph.cleanup()
+
+
+# -- the soak ----------------------------------------------------------------
+
+NOW = Time(1_600_002_000)
+TIME_PRECISION = Duration(3600)
+
+
+class ChaosHarness:
+    """Leader + helper aggregators over real HTTP, N Prio3Count tasks,
+    stepped by TWO driver replicas sharing the process-wide executor —
+    tests/test_integration_pair.py's InProcessPair generalized to
+    multi-task + chaos."""
+
+    N_REPORTS = 4
+
+    def __init__(self, n_tasks=2):
+        import aiohttp
+
+        from janus_tpu.aggregator import Aggregator, Config
+        from janus_tpu.aggregator.aggregation_job_driver import (
+            AggregationJobDriver,
+            DriverConfig,
+        )
+        from janus_tpu.core.auth_tokens import AuthenticationToken
+        from janus_tpu.core.hpke import HpkeKeypair
+        from janus_tpu.datastore.test_util import EphemeralDatastore
+
+        self.n_tasks = n_tasks
+        self.clock = MockClock(NOW)
+        # clock-skew failure domain: the leader datastore's view drifts
+        self.leader_ds = EphemeralDatastore(SkewedClock(self.clock))
+        self.helper_ds = EphemeralDatastore(self.clock)
+        cfg = Config(vdaf_backend="oracle", max_upload_batch_write_delay=0.02)
+        self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, cfg)
+        self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, cfg)
+        self.agg_token = AuthenticationToken.new_bearer("agg-token-chaos")
+        self.col_token = AuthenticationToken.new_bearer("col-token-chaos")
+        self.collector_keys = HpkeKeypair.generate(9)
+        self.tasks = []  # (task_id, leader_task, helper_task)
+        self.exec_cfg = ExecutorConfig(
+            enabled=True,
+            flush_window_s=0.02,
+            flush_max_rows=4096,
+            breaker_failure_threshold=2,
+            breaker_reset_timeout_s=0.3,
+        )
+        # 2 replicas: distinct driver instances, one shared global executor
+        self.drivers = [
+            AggregationJobDriver(
+                self.leader_ds.datastore,
+                aiohttp.ClientSession,
+                DriverConfig(
+                    vdaf_backend="tpu",
+                    device_executor=self.exec_cfg,
+                    http_retry=HttpRetryPolicy(0.001, 0.01, 2.0, 0.5, 3),
+                    # parity soak: jobs must survive chaos, never abandon
+                    maximum_attempts_before_failure=10_000,
+                    max_step_attempts=10_000,
+                    retry_initial_delay_s=1.0,
+                    retry_max_delay_s=8.0,
+                ),
+            )
+            for _ in range(2)
+        ]
+
+    async def start(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from janus_tpu.aggregator import aggregator_app
+        from janus_tpu.datastore import AggregatorTask, TaskQueryType
+        from janus_tpu.messages import Role, TaskId
+
+        self.leader_client = TestClient(TestServer(aggregator_app(self.leader_agg)))
+        self.helper_client = TestClient(TestServer(aggregator_app(self.helper_agg)))
+        await self.leader_client.start_server()
+        await self.helper_client.start_server()
+        self.leader_url = str(self.leader_client.make_url("/"))
+        helper_url = str(self.helper_client.make_url("/"))
+        from janus_tpu.core.hpke import HpkeKeypair
+
+        for t in range(self.n_tasks):
+            task_id = TaskId.random()
+            common = dict(
+                task_id=task_id,
+                query_type=TaskQueryType.time_interval(),
+                vdaf={"type": "Prio3Count"},
+                vdaf_verify_key=bytes([0x30 + t]) * 16,
+                min_batch_size=3,
+                time_precision=TIME_PRECISION,
+                collector_hpke_config=self.collector_keys.config,
+            )
+            leader_task = AggregatorTask(
+                peer_aggregator_endpoint=helper_url,
+                role=Role.LEADER,
+                aggregator_auth_token=self.agg_token,
+                collector_auth_token_hash=self.col_token.hash(),
+                hpke_keys=[HpkeKeypair.generate(1)],
+                **common,
+            )
+            helper_task = AggregatorTask(
+                peer_aggregator_endpoint=self.leader_url,
+                role=Role.HELPER,
+                aggregator_auth_token_hash=self.agg_token.hash(),
+                hpke_keys=[HpkeKeypair.generate(2)],
+                **common,
+            )
+            self.leader_ds.datastore.run_tx(
+                "put", lambda tx, lt=leader_task: tx.put_aggregator_task(lt)
+            )
+            self.helper_ds.datastore.run_tx(
+                "put", lambda tx, ht=helper_task: tx.put_aggregator_task(ht)
+            )
+            self.tasks.append((task_id, leader_task, helper_task))
+
+    async def stop(self):
+        for d in self.drivers:
+            await d.close()
+        await self.leader_agg.shutdown()
+        await self.helper_agg.shutdown()
+        await self.leader_client.close()
+        await self.helper_client.close()
+        self.leader_ds.cleanup()
+        self.helper_ds.cleanup()
+
+    async def upload(self, task_idx, measurement):
+        from janus_tpu.client import prepare_report
+
+        task_id, leader_task, helper_task = self.tasks[task_idx]
+        report = prepare_report(
+            leader_task.vdaf_instance(),
+            task_id,
+            leader_task.hpke_keys[0].config,
+            helper_task.hpke_keys[0].config,
+            TIME_PRECISION,
+            measurement,
+            time=NOW,
+        )
+        resp = await self.leader_client.put(
+            f"/tasks/{task_id}/reports", data=report.get_encoded()
+        )
+        assert resp.status == 201, await resp.text()
+
+    async def create_jobs(self):
+        from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
+
+        creator = AggregationJobCreator(
+            self.leader_ds.datastore,
+            CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=100),
+        )
+        await creator.run_once()
+
+    async def drive_round(self):
+        """One discovery+step round on BOTH replicas concurrently; raw
+        stepper escapes are tolerated mid-chaos (the lease machinery owns
+        recovery) but counted."""
+
+        async def replica(driver):
+            leases = await self.leader_ds.datastore.run_tx_async(
+                "acquire",
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(60), 4),
+            )
+            for lease in leases:
+                try:
+                    await driver.step_aggregation_job(lease)
+                except Exception:
+                    pass  # lease expires; redelivered next round
+
+        await asyncio.gather(*(replica(d) for d in self.drivers))
+        self.clock.advance(Duration(61))
+
+    def agg_job_states(self):
+        states = []
+        for task_id, _, _ in self.tasks:
+            jobs = self.leader_ds.datastore.run_tx(
+                "jobs", lambda tx, t=task_id: tx.get_aggregation_jobs_for_task(t)
+            )
+            states.extend(j.state.value for j in jobs)
+        return states
+
+    async def collect_task(self, task_idx):
+        import aiohttp
+
+        from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+        from janus_tpu.collector import Collector
+        from janus_tpu.messages import Interval, Query
+
+        task_id, leader_task, _ = self.tasks[task_idx]
+        collector = Collector(
+            task_id=task_id,
+            leader_endpoint=self.leader_url,
+            vdaf=leader_task.vdaf_instance(),
+            auth_token=self.col_token,
+            hpke_keypair=self.collector_keys,
+            poll_interval=0.05,
+            max_poll_time=20.0,
+        )
+        driver = CollectionJobDriver(self.leader_ds.datastore, aiohttp.ClientSession)
+
+        async def drive():
+            for _ in range(20):
+                await asyncio.sleep(0.1)
+                leases = await self.leader_ds.datastore.run_tx_async(
+                    "acquire_coll",
+                    lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 4),
+                )
+                for lease in leases:
+                    await driver.step_collection_job(lease)
+                self.clock.advance(Duration(61))
+
+        result, _ = await asyncio.gather(
+            collector.collect(
+                Query.new_time_interval(Interval(NOW, TIME_PRECISION)), session=None
+            ),
+            drive(),
+        )
+        await driver.close()
+        return result
+
+
+def _soak_fault_specs():
+    """Every injection point firing at p~=0.2 (the ISSUE 2 acceptance
+    shape); delays/hangs sized against the soak's timeout guards."""
+    return [
+        FaultSpec("datastore.tx.begin", "error", 0.2),
+        FaultSpec("datastore.tx.commit", "error", 0.1),
+        FaultSpec("http.request", "error", 0.2),
+        FaultSpec("http.request", "delay", 0.1, delay_s=0.01),
+        FaultSpec("http.request", "hang", 0.05, hang_s=0.1),
+        FaultSpec("executor.flush", "error", 0.2),
+        FaultSpec("backend.launch", "error", 0.2),
+        FaultSpec("backend.combine", "error", 0.2),
+        FaultSpec("clock.skew", "skew", 0.2, skew_s=5),
+    ]
+
+
+@NEEDS_RETURNING
+def test_chaos_soak_two_replicas_multitask():
+    """THE ACCEPTANCE SOAK: all injection points at p~=0.2 over a
+    2-replica 2-task run; every job terminal, breaker trip AND recovery
+    observable in the /metrics payload, aggregates exactly the oracle's."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.core.metrics import GLOBAL_METRICS
+
+    reset_global_executor()
+    harness = ChaosHarness(n_tasks=2)
+    measurements = {0: [1, 0, 1, 1], 1: [1, 1, 0, 1]}
+
+    async def flow():
+        await harness.start()
+        try:
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)  # report batcher flush
+            await harness.create_jobs()
+
+            # Phase 1 — guaranteed breaker trip: every executor flush AND
+            # every peer request fails, so the circuit opens while no job
+            # can slip through to Finished before the steady-state phase.
+            faults.configure(
+                [
+                    FaultSpec("executor.flush", "error", 1.0),
+                    FaultSpec("http.request", "error", 1.0),
+                ],
+                seed=SEED,
+            )
+            ex = harness.drivers[0]._executor
+            for _ in range(8):
+                await harness.drive_round()
+                if any(
+                    s["state"] == "open" for s in ex.circuit_stats().values()
+                ):
+                    break
+            circuits = ex.circuit_stats()
+            assert any(s["trips"] >= 1 for s in circuits.values()), circuits
+            phase1_hits = dict(faults.registry().hits)
+            assert phase1_hits.get("executor.flush", 0) > 0
+            assert phase1_hits.get("http.request", 0) > 0
+
+            # Phase 2 — steady-state chaos: every point at p~=0.2.
+            faults.configure(_soak_fault_specs(), seed=SEED)
+            for _ in range(60):
+                await harness.drive_round()
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert len(states) >= 2, "both tasks must have aggregation jobs"
+            assert all(s == "Finished" for s in states), states
+
+            phase2_hits = dict(faults.registry().hits)
+            faults.clear()
+            assert phase2_hits.get("datastore.tx.begin", 0) > 0, phase2_hits
+
+            # Phase 3 — recovery: with faults off, a probe submit closes
+            # any still-open circuit (half-open -> success -> closed).
+            if any(s["state"] != "closed" for s in ex.circuit_stats().values()):
+                await asyncio.sleep(0.35)  # past breaker_reset_timeout_s
+                driver = next(d for d in harness.drivers if d._backends)
+                (shape_key, backend), = list(driver._backends.items())
+                vdaf = harness.tasks[0][1].vdaf_instance()
+                nonce = b"\x00" * vdaf.NONCE_SIZE
+                public, shares = vdaf.shard(0, nonce, b"\x00" * vdaf.RAND_SIZE)
+                await ex.submit(
+                    shape_key,
+                    "prep_init",
+                    (b"\x2a" * 16, [(nonce, public, shares[0])]),
+                    backend=backend,
+                )
+            circuits = ex.circuit_stats()
+            assert all(s["state"] == "closed" for s in circuits.values()), circuits
+
+            # trip AND recovery observable on the /metrics payload
+            metrics_text = GLOBAL_METRICS.export().decode()
+            assert 'janus_executor_circuit_transitions_total' in metrics_text
+            assert 'state="open"' in metrics_text
+            assert 'state="closed"' in metrics_text
+            assert "janus_faults_injected_total" in metrics_text
+
+            # Collection under a quiet sky: aggregates == the oracle's
+            # exact sums, with every report accounted for.
+            for t, ms in measurements.items():
+                result = await harness.collect_task(t)
+                assert result.report_count == len(ms), (t, result)
+                assert result.aggregate_result == sum(ms), (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    _run(flow(), timeout=280.0)
+    reset_global_executor()

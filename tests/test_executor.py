@@ -260,6 +260,39 @@ def test_deadline_expired_submission_rejected_at_flush():
     assert stats["rejections"] == 1 and stats["flushes"] == 0
 
 
+def test_deadline_expiry_between_take_pending_and_flush_rejects_retryably():
+    """RACE (ISSUE 2 satellite): a submission whose deadline expires AFTER
+    the size-trigger detached it from the bucket (_take_pending) but BEFORE
+    its flush coroutine runs must be retryably rejected — never silently
+    dropped (future unresolved) and never launched past its deadline."""
+    backend = _FakeBackend()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=60.0, flush_max_rows=10_000))
+
+    async def go():
+        fut = asyncio.ensure_future(
+            ex.submit(
+                ("s",), "prep_init", (b"k1", [0]), backend=backend, deadline_s=0.02
+            )
+        )
+        await asyncio.sleep(0)  # submission enqueued; window timer armed
+        with ex._lock:
+            bucket = next(iter(ex._buckets.values()))
+            subs = ex._take_pending(bucket)  # the size-flush side of the race
+        assert subs, "submission must have been detached"
+        await asyncio.sleep(0.05)  # deadline passes while the flush is queued
+        await ex._run_flush(bucket, subs, trigger="size")
+        with pytest.raises(ExecutorOverloadedError):
+            await fut
+
+    _run(go())
+    ex.shutdown()
+    stats = next(iter(ex.stats().values()))
+    # retryable rejection, accounted (queue drains), and nothing launched
+    assert stats["rejections"] == 1
+    assert stats["depth_rows"] == 0
+    assert backend.launches == []
+
+
 def test_driver_surfaces_overload_as_retryable_jobsteperror():
     """The driver contract: executor backpressure -> JobStepError(retryable)
     so the lease machinery redelivers the job."""
