@@ -262,6 +262,128 @@ def run_executor_config(args, scaled: bool) -> dict:
     }
 
 
+def run_accumulator_config(args, scaled: bool) -> dict:
+    """The ``accum16`` row: the executor16 shape with the DEVICE-RESIDENT
+    ACCUMULATOR STORE attached (janus_tpu/executor/accumulator.py).  Every
+    flush keeps its out-share mega-batch on device (ResidentRefs back to
+    the submitters, zero out-share readback — asserted), each submitter
+    commits its rows into a per-task bucket, and one commit-time drain per
+    bucket spills a single field vector.  Reported: aggregate reports/s
+    plus the flush-readback bytes the resident path avoided vs what the
+    legacy readback path would have moved.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from janus_tpu.executor import (
+        AccumulatorConfig,
+        DeviceAccumulatorStore,
+        DeviceExecutor,
+        ExecutorConfig,
+        ResidentRef,
+    )
+    from janus_tpu.vdaf.backend import OracleBackend, TpuBackend
+    from janus_tpu.vdaf.instances import prio3_histogram
+
+    n_tasks = 16
+    if scaled:
+        vdaf = prio3_histogram(length=4, chunk_length=2)
+        per, rounds = 8, 2
+        desc = "16 tasks x Prio3Histogram len=4 (resident accumulator, scaled)"
+    else:
+        vdaf = prio3_histogram(length=1024, chunk_length=316)
+        per, rounds = 32, 4
+        desc = "16 tasks x Prio3Histogram len=1024 (resident accumulator)"
+
+    backend = TpuBackend(vdaf)
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    executor = DeviceExecutor(
+        ExecutorConfig(
+            enabled=True, flush_max_rows=n_tasks * per, flush_window_s=0.005
+        )
+    )
+    executor.accumulator = store
+    shape_key = ("bench-accum", type(vdaf.flp.valid).__name__)
+    field = vdaf.flp.field
+
+    rng = np.random.default_rng(7)
+    tasks = []
+    for t in range(n_tasks):
+        vk = rng.integers(0, 256, vdaf.VERIFY_KEY_SIZE, dtype=np.uint8).tobytes()
+        nonce = rng.integers(0, 256, vdaf.NONCE_SIZE, dtype=np.uint8).tobytes()
+        rand = rng.integers(0, 256, vdaf.RAND_SIZE, dtype=np.uint8).tobytes()
+        public, shares = vdaf.shard(t % vdaf.flp.valid.length, nonce, rand)
+        tasks.append((t, vk, [(nonce, public, shares[0])] * per))
+
+    drained = {}
+
+    async def submitter(t, vk, reports):
+        for r in range(rounds):
+            out = await executor.submit(
+                shape_key,
+                "prep_init",
+                (vk, reports),
+                backend=backend,
+                agg_id=0,
+                retain_out_shares=True,
+            )
+            refs = [state.out_share for state, _ in out]
+            assert all(isinstance(x, ResidentRef) for x in refs)
+            # commit-time spill: one device psum + one O(OUT) readback
+            bucket = ("task", t)
+            store.commit_rows(
+                bucket,
+                backend,
+                refs,
+                job_token=b"job%d-%d" % (t, r),
+                report_ids=[b"%d-%d-%d" % (t, r, i) for i in range(len(refs))],
+            )
+            vec, _rids = store.drain(bucket, field)
+            prev = drained.get(t)
+            drained[t] = vec if prev is None else field.vec_add(prev, vec)
+
+    async def drive():
+        await asyncio.gather(*[submitter(*task) for task in tasks])
+        await executor.drain()
+
+    asyncio.run(drive())  # warmup compile pass
+    drained.clear()
+    backend.outshare_readback_rows = 0
+    spills_before = store.spills
+    t0 = time.monotonic()
+    asyncio.run(drive())
+    elapsed = time.monotonic() - t0
+    executor.shutdown()
+
+    # parity spot-check: task 0's accumulated vector == the oracle's sum
+    t0_, vk0, reports0 = tasks[0]
+    want = vdaf.aggregate(
+        [
+            state.out_share
+            for state, _ in OracleBackend(vdaf).prep_init_batch(vk0, 0, reports0)
+        ]
+        * rounds
+    )
+    assert drained[t0_] == want, "resident accumulation must match the oracle"
+
+    total = n_tasks * per * rounds
+    out_len, nlimbs = vdaf.flp.OUTPUT_LEN, backend.bp.jf.n
+    legacy_bytes = total * out_len * nlimbs * 4
+    resident_bytes = (store.spills - spills_before) * out_len * nlimbs * 4
+    return {
+        "config": desc,
+        "value": round(total / elapsed, 1),
+        "unit": "reports/s",
+        "submitters": n_tasks,
+        "per_submitter_rows": per,
+        "flush_readback_rows": backend.outshare_readback_rows,
+        "legacy_readback_bytes": legacy_bytes,
+        "resident_readback_bytes": resident_bytes,
+        "readback_reduction": round(legacy_bytes / max(1, resident_bytes), 1),
+    }
+
+
 CONFIGS = {
     # BASELINE.md rows; histogram1024 is the north-star config.
     "count": ("Prio3Count", "prio3_count", {}),
@@ -429,9 +551,10 @@ def main() -> int:
     parser.add_argument(
         "--config",
         default="all",
-        choices=["all"] + list(CONFIGS) + ["executor16"],
+        choices=["all"] + list(CONFIGS) + ["executor16", "accum16"],
         help="one config, or 'all' for every BASELINE.md row (default); "
-        "executor16 is the device-executor concurrent-task row",
+        "executor16 is the device-executor concurrent-task row, accum16 "
+        "the same shape with the device-resident accumulator store",
     )
     parser.add_argument(
         "--side",
@@ -487,7 +610,8 @@ def main() -> int:
                     "shape takes minutes to hours"
                 }
     run_executor_row = args.config in ("all", "executor16")
-    names = [n for n in names if n != "executor16"]
+    run_accum_row = args.config in ("all", "accum16")
+    names = [n for n in names if n not in ("executor16", "accum16")]
     # Leader-side rows for the configs whose explicit-share inputs fit the
     # tunnel comfortably; sumvec100k's leader would ship ~1.6 GB of host
     # limbs per staged input, and multitask16's leader is histogram1024's.
@@ -514,6 +638,14 @@ def main() -> int:
         except Exception as e:
             sys.stderr.write(f"executor16 failed: {type(e).__name__}: {e}\n")
             results["executor16"] = {"error": f"{type(e).__name__}: {e}"}
+    if run_accum_row:
+        # Same shape with device-resident accumulation: aggregate
+        # reports/s + resident-vs-readback flush bytes (ISSUE 3).
+        try:
+            results["accum16"] = run_accumulator_config(args, scaled=scaled)
+        except Exception as e:
+            sys.stderr.write(f"accum16 failed: {type(e).__name__}: {e}\n")
+            results["accum16"] = {"error": f"{type(e).__name__}: {e}"}
 
     # Headline: the north-star config when measured, else the first row
     # that produced a number (a skipped/errored headline must not zero out

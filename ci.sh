@@ -82,8 +82,11 @@ case "$tier" in
     bash -c "$cmd" 2>&1 | tee /tmp/_t1_gate.log
     rc=${PIPESTATUS[0]}
     set -e
-    # the command itself emits the canonical count; parse, don't recompute
-    dots=$(sed -n 's/^DOTS_PASSED=//p' /tmp/_t1_gate.log | tail -n1)
+    # the command itself emits the canonical count; parse, don't recompute.
+    # Match anywhere in the line: when the timeout kills pytest mid-line,
+    # the marker is appended to a partial dots line (no leading newline),
+    # and an anchored match would read a passing run as 0.
+    dots=$(grep -ao 'DOTS_PASSED=[0-9]*' /tmp/_t1_gate.log | tail -n1 | cut -d= -f2)
     dots=${dots:-0}
     echo "tier1: DOTS_PASSED=$dots floor=$floor rc=$rc"
     if [ "$dots" -lt "$floor" ]; then
@@ -94,9 +97,12 @@ case "$tier" in
     ;;
   chaos)
     # Fixed seed so the per-point fault decision sequences replay run to
-    # run; override JANUS_CHAOS_SEED to explore other schedules.
+    # run; override JANUS_CHAOS_SEED to explore other schedules.  The
+    # accumulator suite rides along: the soak now runs with the
+    # device-resident store enabled (spill/evict faults firing) and
+    # test_accumulator.py covers the store/scheduler/replay units.
     export JANUS_CHAOS_SEED="${JANUS_CHAOS_SEED:-7}"
-    exec python -m pytest tests/test_chaos.py -q -m "not slow"
+    exec python -m pytest tests/test_chaos.py tests/test_accumulator.py -q -m "not slow"
     ;;
   dryrun)
     python __graft_entry__.py 8

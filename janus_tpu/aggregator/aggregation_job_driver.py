@@ -226,34 +226,12 @@ class AggregationJobDriver:
     # ------------------------------------------------------------------
     @staticmethod
     def _vdaf_shape_key(vdaf) -> tuple:
-        """Key backends by the FULL VDAF parameterization: tasks sharing it
-        share one backend instance — and therefore one set of compiled
-        device graphs (verify_key is a traced input, so one compilation
-        serves every task; reference contrast: per-task rayon dispatch at
-        aggregator.rs:180-209 config knobs).  Every scalar circuit
-        parameter participates — derived lengths alone are ambiguous
-        (SumVec(length=100, bits=2) and SumVec(length=200, bits=1) share
-        MEAS_LEN but not truncate/OUTPUT_LEN)."""
-        flp = getattr(vdaf, "flp", None)
-        valid = getattr(flp, "valid", None)
-        circuit_params = None
-        if valid is not None:
-            circuit_params = tuple(
-                sorted(
-                    (k, v if isinstance(v, (int, str, bool)) else getattr(v, "__name__", str(v)))
-                    for k, v in vars(valid).items()
-                    if not k.startswith("_") and not isinstance(v, (list, dict))
-                )
-            )
-        return (
-            type(vdaf).__name__,
-            type(valid).__name__ if valid is not None else None,
-            circuit_params,
-            getattr(vdaf, "algorithm_id", None),
-            getattr(vdaf, "num_shares", None),
-            getattr(vdaf, "num_proofs", None),
-            getattr(getattr(vdaf, "xof", None), "__name__", None),
-        )
+        """Backend/bucket key (vdaf_shape_key in vdaf/backend.py — shared
+        with the helper aggregator so both protocol sides land in the same
+        executor buckets and breaker domains)."""
+        from ..vdaf.backend import vdaf_shape_key
+
+        return vdaf_shape_key(vdaf)
 
     def _backend_for(self, task: AggregatorTask, vdaf):
         key = self._vdaf_shape_key(vdaf)
@@ -319,13 +297,27 @@ class AggregationJobDriver:
         if self._executor is not None and hasattr(backend, "stage_prep_init_multi"):
             from ..executor import CircuitOpenError, ExecutorOverloadedError
 
+            shape_key = self._vdaf_shape_key(backend.vdaf)
+            # Breaker-aware routing (ISSUE 3 satellite): an open circuit is
+            # known BEFORE submitting — consult the breaker peek (the
+            # programmatic face of circuit_stats()) and serve this job on
+            # the oracle directly instead of paying a
+            # submit-then-CircuitOpenError round trip per job.
+            if self._executor.circuit_open(shape_key):
+                return await self._oracle_fallback(
+                    backend,
+                    verify_key,
+                    prep_in,
+                    f"circuit for shape {shape_key[0]}/{shape_key[1]} is open",
+                )
             try:
                 return await self._executor.submit(
-                    self._vdaf_shape_key(backend.vdaf),
+                    shape_key,
                     "prep_init",
                     (verify_key, prep_in),
                     backend=backend,
                     agg_id=0,
+                    retain_out_shares=self._executor.accumulator is not None,
                 )
             except CircuitOpenError as e:
                 # Device sick (K consecutive launch failures): degrade to
@@ -493,6 +485,38 @@ class AggregationJobDriver:
 
     async def _step_init(self, lease, task, vdaf, job, all_ras, start_ras):
         outcomes = await self._leader_prep_init(task, vdaf, job, start_ras)
+        try:
+            await self._step_init_with_outcomes(
+                lease, task, vdaf, job, all_ras, start_ras, outcomes
+            )
+        except BaseException:
+            # A failure between prep and commit (helper HTTP, tx, anything)
+            # must not pin the flush matrices the step's ResidentRefs hold:
+            # redelivery will mint fresh refs.  Release is idempotent, so
+            # refs already consumed by a partial commit are unaffected.
+            self._release_resident_outcomes(outcomes)
+            raise
+
+    def _release_resident_outcomes(self, outcomes) -> None:
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None:
+            return
+        from ..executor.accumulator import ResidentRef
+
+        refs = []
+        for outcome in outcomes.values():
+            if isinstance(outcome, PrepareError):
+                continue
+            state, _msg = outcome
+            ref = getattr(getattr(state, "prep_state", None), "out_share", None)
+            if isinstance(ref, ResidentRef):
+                refs.append(ref)
+        if refs:
+            store.release_refs(refs)
+
+    async def _step_init_with_outcomes(
+        self, lease, task, vdaf, job, all_ras, start_ras, outcomes
+    ):
         prepare_inits = []
         states: Dict[bytes, pp.PingPongContinued] = {}
         failed: Dict[bytes, PrepareError] = {}
@@ -662,12 +686,22 @@ class AggregationJobDriver:
             else AggregationJobState.FINISHED
         )
 
+        # Device-resident out shares: commit the finished rows' ResidentRefs
+        # into per-batch resident accumulators and drain them NOW (the
+        # commit-time spill: one O(OUT) readback per batch bucket instead of
+        # O(rows x OUT) per flush), BEFORE the transaction — a tx retry must
+        # never replay a device psum.
+        accumulator_deltas = await self._commit_resident_shares(
+            task, vdaf, job, all_ras, states, out_shares
+        )
+
         writer = AggregationJobWriter(
             task,
             vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=False,
             backend=self._backend_for(task, vdaf),
+            accumulator_deltas=accumulator_deltas,
         )
         writer.put(job, new_ras, out_shares)
 
@@ -675,7 +709,212 @@ class AggregationJobDriver:
             writer.write(tx)
             tx.release_aggregation_job(lease)
 
-        await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
+        from ..executor.accumulator import StaleAccumulatorDelta
+
+        try:
+            await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
+        except StaleAccumulatorDelta as e:
+            # A report was failed in-tx (batch collected under our feet)
+            # AFTER its row was drained.  The tx aborted with nothing
+            # merged; redelivery re-prepares the job and the in-tx check
+            # fails the report properly — exactly-once either way.
+            raise JobStepError(
+                f"resident delta invalidated in-tx: {e}", retryable=True
+            )
+
+    async def _commit_resident_shares(
+        self, task, vdaf, job, all_ras, states, out_shares
+    ) -> Optional[Dict[bytes, Tuple[Sequence[int], frozenset]]]:
+        """Accumulator-store commit path (no-op when the store is off or no
+        finished report carries a ResidentRef).
+
+        Per batch bucket: psum the finished rows into the resident
+        accumulator (one device launch, no readback), journal the delta,
+        then drain it to ONE host field vector for the writer's sharded
+        merge.  On AccumulatorUnavailable (launch failure / poisoned bucket
+        / injected spill fault) the journaled reports are replayed through
+        the bit-exact CPU oracle — host vectors replace the dead refs in
+        ``out_shares`` and the poisoned device delta is discarded, so
+        accumulation never double-counts or drops.  Leftover refs (reports
+        the helper failed) are released so their flush matrices free."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None:
+            return None
+        from ..datastore.query_type import strategy_for
+        from ..executor.accumulator import AccumulatorUnavailable, ResidentRef
+
+        resident = {
+            rid: v for rid, v in out_shares.items() if isinstance(v, ResidentRef)
+        }
+        # release the never-finished rows' refs regardless of outcome below
+        leftover = []
+        for rid, st in states.items():
+            if rid in out_shares:
+                continue
+            ref = getattr(getattr(st, "prep_state", None), "out_share", None)
+            if isinstance(ref, ResidentRef):
+                leftover.append(ref)
+        if leftover:
+            store.release_refs(leftover)
+        if not resident:
+            return None
+
+        ra_by_rid = {ra.report_id.data: ra for ra in all_ras}
+        strategy = strategy_for(task)
+
+        def ident_for(ra):
+            if job.partial_batch_identifier is not None:
+                return job.partial_batch_identifier.get_encoded()
+            return strategy.to_batch_identifier(task, ra.time)
+
+        by_ident: Dict[bytes, List[bytes]] = {}
+        for rid in resident:
+            by_ident.setdefault(ident_for(ra_by_rid[rid]), []).append(rid)
+
+        backend = self._backend_for(task, vdaf)
+        shape_key = self._vdaf_shape_key(vdaf)
+        field = vdaf.field_for_agg_param(
+            vdaf.decode_agg_param(job.aggregation_parameter)
+        )
+        loop = asyncio.get_running_loop()
+
+        # Pre-tx collected check: reports aimed at an already-collected
+        # batch will be FAILED inside the writer tx, so accumulating them
+        # now would guarantee a delta/tx mismatch on every redelivery.
+        # Route those batches through host vectors instead (the writer
+        # pops them harmlessly).  The residual race (collection commits
+        # between this check and our tx) still aborts cleanly via
+        # StaleAccumulatorDelta -> retryable redelivery.
+        collected: set = set()
+        if self.datastore is not None and by_ident:
+            from ..datastore import BatchAggregationState
+
+            def check(tx):
+                out = set()
+                for ident in by_ident:
+                    bas = tx.get_batch_aggregations_for_batch(
+                        task.task_id, ident, job.aggregation_parameter
+                    )
+                    if any(
+                        ba.state != BatchAggregationState.AGGREGATING for ba in bas
+                    ):
+                        out.add(ident)
+                return out
+
+            collected = await self.datastore.run_tx_async(
+                "accum_collected_check", check
+            )
+
+        deltas: Dict[bytes, Tuple[Sequence[int], frozenset]] = {}
+        for ident, rids in by_ident.items():
+            # job id in the key: with drain-at-commit the resident window
+            # is one step, so scoping buckets per job costs nothing and
+            # keeps two replicas (or a lease-overlap redelivery) from ever
+            # committing into each other's delta; the store's closed-flag
+            # guard covers the residual same-job overlap race.
+            bucket_key = (
+                task.task_id.data,
+                shape_key,
+                ident,
+                job.aggregation_job_id.data,
+            )
+            refs = [resident[rid] for rid in rids]
+
+            async def replay(rids, refs, cause):
+                """Exactly-once recovery: the device delta (whole or
+                partial) is discarded FIRST, then the journaled reports are
+                recomputed on the bit-exact CPU oracle."""
+                journal = store.discard(bucket_key)
+                store.release_refs(refs)
+                replay_rids = set(rids)
+                for _job_token, ids in journal:
+                    replay_rids |= set(ids)
+                unknown = replay_rids - set(ra_by_rid)
+                if unknown:
+                    # journal entries from a job this step cannot recompute
+                    # (should not happen with drain-at-commit; fail loudly
+                    # and retryably rather than silently dropping shares)
+                    raise JobStepError(
+                        f"accumulator journal names {len(unknown)} report(s) "
+                        f"outside this job; cannot replay: {cause}",
+                        retryable=True,
+                    )
+                if cause is not None:
+                    logger.warning(
+                        "resident accumulator unavailable for %d report(s); "
+                        "replaying through the CPU oracle: %s",
+                        len(replay_rids),
+                        cause,
+                    )
+                replayed = await loop.run_in_executor(
+                    None,
+                    lambda rids=sorted(replay_rids): self._oracle_out_shares(
+                        task, vdaf, backend, [ra_by_rid[r] for r in rids]
+                    ),
+                )
+                out_shares.update(replayed)
+
+            if ident in collected:
+                await replay(rids, refs, None)
+                continue
+
+            def commit_and_drain(bucket_key=bucket_key, refs=refs, rids=rids):
+                store.commit_rows(
+                    bucket_key,
+                    backend,
+                    refs,
+                    job_token=job.aggregation_job_id.data,
+                    report_ids=rids,
+                )
+                return store.drain(bucket_key, field)
+
+            try:
+                drained = await loop.run_in_executor(None, commit_and_drain)
+            except JobStepError:
+                raise
+            except Exception as e:
+                # AccumulatorUnavailable, an injected fault, or anything
+                # else device-shaped: the same discard-then-replay recovery
+                # (a partial commit must never survive to double-count)
+                if not isinstance(e, AccumulatorUnavailable):
+                    logger.exception("accumulator commit/drain failed")
+                await replay(rids, refs, e)
+                continue
+            if drained is None:
+                continue
+            vector, drained_rids = drained
+            deltas[ident] = (vector, frozenset(drained_rids))
+        return deltas or None
+
+    def _oracle_out_shares(self, task, vdaf, backend, ras):
+        """Bit-exact CPU replay of finished reports' out shares (backend
+        contract: oracle == device, tests/test_backend.py)."""
+        oracle = getattr(backend, "oracle", None)
+        if oracle is None:
+            from ..vdaf.backend import OracleBackend
+
+            oracle = OracleBackend(vdaf)
+        rows = []
+        for ra in ras:
+            rows.append(
+                (
+                    ra.report_id.data,
+                    vdaf.decode_public_share(ra.public_share or b""),
+                    vdaf.decode_input_share(0, ra.leader_input_share),
+                )
+            )
+        out = {}
+        for ra, outcome in zip(
+            ras, oracle.prep_init_batch(task.vdaf_verify_key, 0, rows)
+        ):
+            if isinstance(outcome, VdafError):  # cannot happen for a report
+                raise JobStepError(  # that already prepared successfully
+                    f"oracle replay rejected report {ra.report_id}: {outcome}",
+                    retryable=True,
+                )
+            state, _share = outcome
+            out[ra.report_id.data] = state.out_share
+        return out
 
     # ------------------------------------------------------------------
     async def abandon_aggregation_job(self, lease: Lease) -> None:

@@ -52,6 +52,9 @@ class AggregationJobWriter:
         batch_aggregation_shard_count: int = 8,
         initial_write: bool = True,
         backend=None,
+        accumulator_deltas: Optional[
+            Dict[bytes, Tuple[Sequence[int], frozenset]]
+        ] = None,
     ):
         self.task = task
         self.vdaf = vdaf
@@ -60,6 +63,14 @@ class AggregationJobWriter:
         #: Device backend (TpuBackend/MeshBackend) for on-device out-share
         #: accumulation; None falls back to host field adds.
         self.backend = backend
+        #: Pre-drained device-resident deltas (executor/accumulator.py):
+        #: batch identifier -> (field vector, report ids it covers).  Rows
+        #: whose out_share is a ResidentRef are summed by these instead of
+        #: host vectors; the rid set is checked against the reports that
+        #: survive the in-tx BatchCollected gate (mismatch raises
+        #: StaleAccumulatorDelta — the delta must never merge a report the
+        #: tx is failing).
+        self.accumulator_deltas = accumulator_deltas or {}
         self._jobs: List[
             Tuple[AggregationJob, List[ReportAggregation], Dict[bytes, Sequence[int]]]
         ] = []
@@ -142,6 +153,28 @@ class AggregationJobWriter:
         return acc
 
     # ------------------------------------------------------------------
+    def _resolve_shares(self, field, ident, shares, rids) -> List[int]:
+        """Sum one batch's finished shares, mixing host vectors with a
+        pre-drained device-resident delta (ResidentRef rows)."""
+        from ..executor.accumulator import ResidentRef, StaleAccumulatorDelta
+
+        host_rows = [s for s in shares if not isinstance(s, ResidentRef)]
+        ref_rids = {
+            rid for rid, s in zip(rids, shares) if isinstance(s, ResidentRef)
+        }
+        if not ref_rids:
+            return self._sum_shares(field, host_rows)
+        delta, covered = self.accumulator_deltas.get(ident, (None, frozenset()))
+        if delta is None or set(covered) != ref_rids:
+            raise StaleAccumulatorDelta(
+                f"batch {ident!r}: drained delta covers {len(covered)} "
+                f"report(s), tx needs exactly {len(ref_rids)}"
+            )
+        if not host_rows:
+            return list(delta)
+        return field.vec_add(list(delta), self._sum_shares(field, host_rows))
+
+    # ------------------------------------------------------------------
     def _accumulate(self, tx, job, ras, out_shares, ident_for) -> None:
         """Merge finished out-shares into per-batch shard accumulators and
         update the created/terminated job counters the collection readiness
@@ -188,8 +221,9 @@ class AggregationJobWriter:
                     time_to_batch_interval(ra.time, self.task.time_precision),
                 )
             if finished:
-                agg_share = self._sum_shares(
-                    field, [out_shares[ra.report_id.data] for ra in finished]
+                agg_share = self._resolve_shares(
+                    field, ident, [out_shares[ra.report_id.data] for ra in finished],
+                    [ra.report_id.data for ra in finished],
                 )
             delta = BatchAggregation(
                 task_id=self.task.task_id,

@@ -113,6 +113,12 @@ class Config:
     #: "mesh" (SPMD over a device mesh).
     vdaf_backend: str = "oracle"
     collection_job_retry_after: int = 10
+    #: Process-wide device executor (executor.ExecutorConfig): when set and
+    #: enabled, the HELPER's Prio3 prep_init/combine launches submit
+    #: through the same continuous batcher the drivers feed, so the
+    #: circuit breaker (and its oracle degradation) guards the helper path
+    #: too.  None/disabled = per-request launches (legacy).
+    device_executor: Optional[object] = None
 
 
 class TaskAggregator:
@@ -175,6 +181,14 @@ class Aggregator:
             max_batch_write_delay=self.config.max_upload_batch_write_delay,
             counter_shard_count=self.config.task_counter_shard_count,
         )
+        # Helper-side executor routing: share the process-wide continuous
+        # batcher (and its per-shape circuit breakers) with the drivers.
+        self._executor = None
+        exec_cfg = self.config.device_executor
+        if exec_cfg is not None and getattr(exec_cfg, "enabled", False):
+            from ..executor import get_global_executor
+
+            self._executor = get_global_executor(exec_cfg)
 
     async def shutdown(self) -> None:
         """Cancel the config-cache refresh loops (call on service teardown)."""
@@ -435,9 +449,20 @@ class Aggregator:
         except VdafError:
             raise InvalidMessage("bad aggregation parameter")
         loop = asyncio.get_running_loop()
-        results = await loop.run_in_executor(
-            None, lambda: self._helper_prepare_batch(ta, decoded, agg_param)
-        )
+        if (
+            self._executor is not None
+            and isinstance(ta.vdaf, Prio3)
+            and hasattr(ta.backend, "stage_prep_init_multi")
+        ):
+            # Helper-side executor routing (ROADMAP item): prep_init and
+            # combine submit through the process-wide continuous batcher,
+            # so helper requests coalesce with driver traffic and the
+            # circuit breaker guards this path too.
+            results = await self._helper_prepare_batch_prio3_executor(ta, decoded)
+        else:
+            results = await loop.run_in_executor(
+                None, lambda: self._helper_prepare_batch(ta, decoded, agg_param)
+            )
 
         # Assemble responses + report aggregations in request order.
         ras: List[ReportAggregation] = []
@@ -678,9 +703,10 @@ class Aggregator:
                 )
         return results
 
-    def _helper_prepare_batch_prio3(self, ta: TaskAggregator, decoded):
-        """The north-star path: one batched launch for prep + combine."""
-        vdaf = ta.vdaf
+    @staticmethod
+    def _helper_decode_leader_shares(vdaf, decoded):
+        """Decode the leader's round-0 prepare shares; returns
+        (per-index errors so far, surviving rows)."""
         results: Dict[int, object] = {}
         rows = []
         for idx, (nonce, public_parts, input_share, leader_msg) in decoded:
@@ -692,21 +718,11 @@ class Aggregator:
                 results[idx] = PrepareError.VDAF_PREP_ERROR
                 continue
             rows.append((idx, nonce, public_parts, input_share, leader_share))
-        if not rows:
-            return results
+        return results, rows
 
-        prep_in = [(nonce, public, share) for (_, nonce, public, share, _) in rows]
-        prep_out = ta.backend.prep_init_batch(ta.task.vdaf_verify_key, 1, prep_in)
-        combine_rows = []
-        for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
-            if isinstance(outcome, VdafError):
-                results[idx] = PrepareError.VDAF_PREP_ERROR
-                continue
-            state, helper_share = outcome
-            combine_rows.append((idx, state, leader_share, helper_share))
-        combined = ta.backend.prep_shares_to_prep_batch(
-            [[ls, hs] for (_, _, ls, hs) in combine_rows]
-        )
+    @staticmethod
+    def _helper_finish_prio3(vdaf, results, combine_rows, combined):
+        """Evaluate the combined prepare messages into finished outcomes."""
         for (idx, state, _ls, hs), prep_msg in zip(combine_rows, combined):
             if isinstance(prep_msg, VdafError):
                 results[idx] = PrepareError.VDAF_PREP_ERROR
@@ -721,6 +737,106 @@ class Aggregator:
             )
             results[idx] = ("finished", out_share, outbound)
         return results
+
+    def _helper_prepare_batch_prio3(self, ta: TaskAggregator, decoded, backend=None):
+        """The north-star path: one batched launch for prep + combine.
+
+        ``backend`` overrides ``ta.backend`` (the executor routing passes
+        the bit-exact CPU oracle here while a shape's circuit is open)."""
+        backend = backend if backend is not None else ta.backend
+        results, rows = self._helper_decode_leader_shares(ta.vdaf, decoded)
+        return self._helper_prep_rows_prio3(ta, backend, results, rows)
+
+    def _helper_prep_rows_prio3(self, ta: TaskAggregator, backend, results, rows):
+        """Prep + combine + finish over already-decoded rows (the executor
+        path's mid-flight oracle fallback re-enters here so the per-report
+        wire decode is never paid twice)."""
+        vdaf = ta.vdaf
+        if not rows:
+            return results
+        prep_in = [(nonce, public, share) for (_, nonce, public, share, _) in rows]
+        prep_out = backend.prep_init_batch(ta.task.vdaf_verify_key, 1, prep_in)
+        combine_rows = []
+        for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
+            if isinstance(outcome, VdafError):
+                results[idx] = PrepareError.VDAF_PREP_ERROR
+                continue
+            state, helper_share = outcome
+            combine_rows.append((idx, state, leader_share, helper_share))
+        combined = backend.prep_shares_to_prep_batch(
+            [[ls, hs] for (_, _, ls, hs) in combine_rows]
+        )
+        return self._helper_finish_prio3(vdaf, results, combine_rows, combined)
+
+    async def _helper_prepare_batch_prio3_executor(self, ta: TaskAggregator, decoded):
+        """Helper prep through the process-wide device executor: prep_init
+        (agg_id=1 buckets) and combine submissions coalesce with every
+        other producer's, and the per-shape circuit breaker guards this
+        path — CircuitOpenError (or a breaker-peek hit before submitting)
+        degrades the request to the bit-exact CPU oracle, executor
+        backpressure surfaces as a retryable 503 to the leader."""
+        from ..executor import (
+            KIND_COMBINE,
+            KIND_PREP_INIT,
+        )
+        from ..executor.service import CircuitOpenError, ExecutorOverloadedError
+        from ..vdaf.backend import vdaf_shape_key
+
+        vdaf = ta.vdaf
+        backend = ta.backend
+        shape_key = vdaf_shape_key(vdaf)
+        loop = asyncio.get_running_loop()
+
+        def oracle_path():
+            oracle = getattr(backend, "oracle", None) or backend
+            return self._helper_prepare_batch_prio3(ta, decoded, backend=oracle)
+
+        if self._executor.circuit_open(shape_key):
+            return await loop.run_in_executor(None, oracle_path)
+
+        results, rows = await loop.run_in_executor(
+            None, lambda: self._helper_decode_leader_shares(vdaf, decoded)
+        )
+        if not rows:
+            return results
+        prep_in = [(nonce, public, share) for (_, nonce, public, share, _) in rows]
+        try:
+            prep_out = await self._executor.submit(
+                shape_key,
+                KIND_PREP_INIT,
+                (ta.task.vdaf_verify_key, prep_in),
+                backend=backend,
+                agg_id=1,
+            )
+            combine_rows = []
+            for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
+                if isinstance(outcome, VdafError):
+                    results[idx] = PrepareError.VDAF_PREP_ERROR
+                    continue
+                state, helper_share = outcome
+                combine_rows.append((idx, state, leader_share, helper_share))
+            combined = await self._executor.submit(
+                shape_key,
+                KIND_COMBINE,
+                [[ls, hs] for (_, _, ls, hs) in combine_rows],
+                backend=backend,
+                agg_id=1,
+            )
+        except CircuitOpenError:
+            # re-enter past the decode: (results, rows) are already built
+            oracle = getattr(backend, "oracle", None) or backend
+            return await loop.run_in_executor(
+                None,
+                lambda: self._helper_prep_rows_prio3(ta, oracle, results, rows),
+            )
+        except ExecutorOverloadedError as e:
+            from .error import ServiceUnavailable
+
+            raise ServiceUnavailable(f"device executor overloaded: {e}")
+        return await loop.run_in_executor(
+            None,
+            lambda: self._helper_finish_prio3(vdaf, results, combine_rows, combined),
+        )
 
     async def _stored_job_resp(
         self, task_id: TaskId, aggregation_job_id: AggregationJobId

@@ -23,6 +23,14 @@ class AggregatorError(Exception):
         self.detail = detail
 
 
+class ServiceUnavailable(AggregatorError):
+    """Transient capacity exhaustion (device executor backpressure): the
+    peer should retry — 503 lands in the leader's retryable (>= 500)
+    classification, so the lease machinery redelivers the job."""
+
+    status = 503
+
+
 class UnrecognizedTask(AggregatorError):
     problem = DapProblemType.UNRECOGNIZED_TASK
     status = 404

@@ -12,6 +12,7 @@ import logging
 from dataclasses import dataclass
 from typing import Optional
 
+from ..core import faults
 from ..core.time import time_sub
 from ..datastore import Datastore
 from ..messages import Role
@@ -49,6 +50,9 @@ class GarbageCollector:
         return deleted
 
     def _gc_task(self, tx, task) -> int:
+        # Failure-domain boundary: a GC pass dying mid-task must stay
+        # contained (run_once's per-task try logs and moves on).
+        faults.fire("gc.run")
         now = self.datastore.now()
         if now.seconds <= task.report_expiry_age.seconds:
             return 0

@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass
 
+from ..core import faults
 from ..core.hpke import HpkeKeypair
 from ..datastore.datastore import Datastore, TxConflict
 from ..datastore.models import HpkeKeyState
@@ -78,6 +79,10 @@ class HpkeKeyRotator:
         raise RuntimeError("all 256 HPKE config ids in use")
 
     def _tick(self, tx) -> None:
+        # Failure-domain boundary: a rotator tick dying mid-transition must
+        # roll back atomically (every transition is clock-driven and
+        # idempotent, so the next tick simply redoes it).
+        faults.fire("key_rotator.run")
         now = self.datastore.clock.now().seconds
         cfg = self.config
         keypairs = tx.get_global_hpke_keypairs()

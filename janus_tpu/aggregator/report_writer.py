@@ -108,9 +108,14 @@ class ReportWriteBatcher:
                     outcomes.append(None)
             return outcomes
 
+        from ..core import faults
         from ..core.metrics import GLOBAL_METRICS
 
         try:
+            # Failure-domain boundary: an injected flush fault impersonates
+            # a batch-commit failure — fanned to every waiting upload
+            # handler exactly like a real one (clients retry the upload).
+            await faults.fire_async("report_writer.flush")
             outcomes = await self.datastore.run_tx_async("upload_batch", tx_fn)
         except Exception as e:  # commit failed: fan the error to every waiter
             for _report, futs in unique:

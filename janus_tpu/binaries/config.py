@@ -126,6 +126,24 @@ class CommonConfig:
 
 
 @dataclass
+class AccumulatorStoreConfig:
+    """Device-resident accumulator store (``device_executor.accumulator.*``,
+    janus_tpu/executor/accumulator.py).  DEFAULT OFF — enabling keeps each
+    flush's out shares resident on device and spills ONE field vector per
+    batch bucket at job commit instead of reading every mega-batch back."""
+
+    enabled: bool = False
+    #: resident-byte cap (flush matrices + bucket buffers); LRU state
+    #: spills to host mirrors beyond it.  <= 0 disables eviction.
+    byte_budget: int = 256 << 20
+
+    def to_accumulator_config(self):
+        from ..executor.accumulator import AccumulatorConfig
+
+        return AccumulatorConfig(enabled=self.enabled, byte_budget=self.byte_budget)
+
+
+@dataclass
 class DeviceExecutorConfig:
     """Process-wide device executor (janus_tpu/executor/): continuous
     cross-job batching of Prio3 prepare.  Default OFF — the per-driver
@@ -151,6 +169,13 @@ class DeviceExecutorConfig:
     breaker_failure_threshold: int = 5
     #: open-circuit dwell before a half-open probe launch tests the device
     breaker_reset_timeout_s: float = 30.0
+    #: starvation-free flush scheduling (deficit round-robin across
+    #: buckets, deadline-earliest within one); False = legacy FIFO
+    fair_flush: bool = True
+    #: deficit-round-robin quantum in rows
+    fair_quota_rows: int = 16384
+    #: device-resident accumulator store (default off)
+    accumulator: AccumulatorStoreConfig = field(default_factory=AccumulatorStoreConfig)
 
     def to_executor_config(self):
         """Build the runtime ExecutorConfig (jax-free import path)."""
@@ -165,6 +190,11 @@ class DeviceExecutorConfig:
             warmup_rows=self.warmup_rows,
             breaker_failure_threshold=self.breaker_failure_threshold,
             breaker_reset_timeout_s=self.breaker_reset_timeout_s,
+            fair_flush=self.fair_flush,
+            fair_quota_rows=self.fair_quota_rows,
+            accumulator=self.accumulator.to_accumulator_config()
+            if self.accumulator.enabled
+            else None,
         )
 
 
@@ -195,6 +225,10 @@ class AggregatorConfig:
     task_counter_shard_count: int = 8
     #: "tpu" routes whole-job prepare through one batched device launch.
     vdaf_backend: str = "tpu"
+    #: Helper-side executor routing (default off): the helper's Prio3
+    #: prep_init/combine submit through the process-wide device executor,
+    #: sharing its continuous batching + circuit breaker with the drivers.
+    device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
     garbage_collection_interval_s: Optional[float] = None
     #: Global-HPKE key rotation loop (reference: binaries/aggregator.rs:31-150
     #: runs the maintenance loops beside the server); None disables.
@@ -245,6 +279,7 @@ def _merge_dataclass(cls, data: dict):
             DbConfig,
             JobDriverConfig,
             DeviceExecutorConfig,
+            AccumulatorStoreConfig,
             FaultInjectionConfig,
         )
     }

@@ -179,6 +179,9 @@ def run_aggregator(config_path: Optional[str]) -> None:
             batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
             task_counter_shard_count=cfg.task_counter_shard_count,
             vdaf_backend=cfg.vdaf_backend,
+            device_executor=cfg.device_executor.to_executor_config()
+            if cfg.device_executor.enabled
+            else None,
         ),
     )
 

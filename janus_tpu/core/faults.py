@@ -19,6 +19,11 @@ tests/test_chaos.py cross-checks them):
     ``backend.launch``       in ``TpuBackend.launch_prep_init_multi``
     ``backend.combine``      in ``TpuBackend.prep_shares_to_prep_batch``
     ``clock.skew``           sampled by ``SkewedClock.now``
+    ``report_writer.flush``  before a ReportWriteBatcher batch commit
+    ``gc.run``               per-task GC pass (GarbageCollector._gc_task)
+    ``key_rotator.run``      at the head of an HpkeKeyRotator tick
+    ``accumulator.spill``    before an accumulator bucket's drain readback
+    ``accumulator.evict``    before an LRU eviction spills state to host
 
 Modes: ``error`` raises :class:`FaultInjectedError`, ``delay`` sleeps
 ``delay_s``, ``hang`` sleeps ``hang_s`` (long enough to trip whatever
@@ -54,6 +59,15 @@ KNOWN_POINTS = (
     "backend.launch",
     "backend.combine",
     "clock.skew",
+    # maintenance loops (ISSUE 3 satellite: ROADMAP chaos follow-on)
+    "report_writer.flush",
+    "gc.run",
+    "key_rotator.run",
+    # device-resident accumulator store (executor/accumulator.py): fired
+    # at the commit-time/eviction spill boundaries so ./ci.sh chaos
+    # exercises mid-spill failures (oracle replay, no double count)
+    "accumulator.spill",
+    "accumulator.evict",
 )
 
 MODES = ("error", "delay", "hang", "skew")

@@ -185,6 +185,30 @@ class Metrics:
             ["circuit", "state"],
             registry=self.registry,
         )
+        # Device-resident accumulator store (executor/accumulator.py): a
+        # budgeted cache — occupancy, spill and eviction rates are what an
+        # operator tunes byte_budget against.
+        self.accumulator_resident_bytes = Gauge(
+            "janus_accumulator_resident_bytes",
+            "Bytes of out-share state resident on device (flush matrices + bucket buffers)",
+            registry=self.registry,
+        )
+        self.accumulator_buckets = Gauge(
+            "janus_accumulator_buckets",
+            "Live (task, shape, batch-bucket) resident accumulators",
+            registry=self.registry,
+        )
+        self.accumulator_spills = Counter(
+            "janus_accumulator_spills_total",
+            "Accumulator drains by reason (commit, discard)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.accumulator_evictions = Counter(
+            "janus_accumulator_evictions_total",
+            "LRU/memory-pressure evictions of resident accumulator state",
+            registry=self.registry,
+        )
         # Fault injection (core/faults.py): every injected fault is counted
         # so a chaos run's pressure is itself observable.
         self.faults_injected = Counter(

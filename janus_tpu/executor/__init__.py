@@ -6,7 +6,17 @@ overload / circuit-breaker error types for retry classification) without
 pulling in the device stack.
 """
 
+from .accumulator import (
+    AccumulatorConfig,
+    AccumulatorError,
+    AccumulatorUnavailable,
+    DeviceAccumulatorStore,
+    ResidentRef,
+    StaleAccumulatorDelta,
+)
 from .service import (
+    KIND_COMBINE,
+    KIND_PREP_INIT,
     CircuitBreaker,
     CircuitOpenError,
     DeviceExecutor,
@@ -19,11 +29,19 @@ from .service import (
 )
 
 __all__ = [
+    "AccumulatorConfig",
+    "AccumulatorError",
+    "AccumulatorUnavailable",
     "CircuitBreaker",
     "CircuitOpenError",
+    "DeviceAccumulatorStore",
     "DeviceExecutor",
     "ExecutorConfig",
     "ExecutorOverloadedError",
+    "KIND_COMBINE",
+    "KIND_PREP_INIT",
+    "ResidentRef",
+    "StaleAccumulatorDelta",
     "bucket_label",
     "get_global_executor",
     "reset_global_executor",

@@ -99,6 +99,18 @@ class ExecutorConfig:
     #: how long an open circuit waits before letting one half-open probe
     #: launch through to test the device
     breaker_reset_timeout_s: float = 30.0
+    #: starvation-free flush scheduling: ready flushes dispatch in deficit
+    #: round-robin across buckets (deadline-earliest within a bucket)
+    #: instead of arrival order, so one hot bucket cannot monopolize the
+    #: chip while others hold pending work.  False = legacy FIFO.
+    fair_flush: bool = True
+    #: deficit-round-robin quantum (rows a bucket may flush per scheduling
+    #: round before yielding); a flush larger than the quantum still
+    #: dispatches, paying the overshoot out of future rounds
+    fair_quota_rows: int = 16384
+    #: device-resident accumulator store (accumulator.AccumulatorConfig);
+    #: None or .enabled=False = out shares read back per flush (legacy)
+    accumulator: Optional[object] = None
 
 
 class CircuitBreaker:
@@ -146,6 +158,16 @@ class CircuitBreaker:
         must free up or a half-open breaker wedges."""
         with self._lock:
             self._probing = False
+
+    def is_open_peek(self) -> bool:
+        """Side-effect-free open check: True while the circuit is open and
+        still inside its reset dwell.  Returns False once the dwell has
+        elapsed so the next real submission runs the half-open probe (the
+        dwell test mirrors allow(); keep them together)."""
+        with self._lock:
+            return self.state == CIRCUIT_OPEN and (
+                time.monotonic() - self._opened_at < self.reset_timeout_s
+            )
 
     def record_success(self) -> None:
         with self._lock:
@@ -201,6 +223,10 @@ class _Submission:
     #: set by _finish (under the executor lock) so depth accounting is
     #: idempotent across the flush's normal/reject/exception paths
     finished: bool = False
+    #: caller opted into device-resident out shares (accumulator store):
+    #: the flush keeps the out-share matrix on device and hands back
+    #: ResidentRefs instead of limb vectors
+    retain: bool = False
 
 
 class _Bucket:
@@ -278,6 +304,26 @@ class DeviceExecutor:
         # weakly, and a GC'd flush would strand its detached submissions.
         self._flush_tasks: set = set()
         self._closed = False
+        # Fair flush scheduler state: per-loop ready queues of detached
+        # flushes, dispatched deficit-round-robin across buckets.
+        self._ready: Dict[object, Dict[tuple, list]] = {}
+        self._ready_seq = 0
+        self._rr_cursor: Dict[object, int] = {}
+        self._deficit: Dict[tuple, float] = {}
+        self._dispatchers: Dict[object, object] = {}
+        self._slots: Dict[object, asyncio.Semaphore] = {}
+        #: dispatched-but-unfinished flushes per loop: the loop's slot
+        #: semaphore may only be pruned when this reaches zero, or a new
+        #: dispatcher generation would mint fresh permits and break the
+        #: two-in-flight double-buffering bound
+        self._slot_inflight: Dict[object, int] = {}
+        # Device-resident accumulator store (out-share residency).
+        acc_cfg = self.config.accumulator
+        self.accumulator = None
+        if acc_cfg is not None and getattr(acc_cfg, "enabled", False):
+            from .accumulator import DeviceAccumulatorStore
+
+            self.accumulator = DeviceAccumulatorStore(acc_cfg)
 
     # -- shape-keyed backend cache --------------------------------------
     def backend_for(self, shape_key: tuple, factory):
@@ -330,6 +376,7 @@ class DeviceExecutor:
         backend,
         agg_id: int = 0,
         deadline_s: Optional[float] = None,
+        retain_out_shares: bool = False,
     ):
         """Enqueue prepare work; resolves when its mega-batch lands.
 
@@ -390,6 +437,7 @@ class DeviceExecutor:
                 enqueued=now,
                 # <= 0 disables the deadline (documented in config.py)
                 deadline=now + timeout if timeout and timeout > 0 else None,
+                retain=retain_out_shares and self.accumulator is not None,
             )
             bucket.pending.append(sub)
             bucket.queued_rows += rows
@@ -404,7 +452,7 @@ class DeviceExecutor:
                         lambda: self._spawn(self._deadline_flush(bucket)),
                     )
         if subs:
-            self._spawn(self._run_flush(bucket, subs, trigger="size"))
+            self._enqueue_ready(bucket, subs, trigger="size")
         return await sub.future
 
     def _breaker_for(self, shape_key: tuple, backend) -> Optional[CircuitBreaker]:
@@ -446,19 +494,149 @@ class DeviceExecutor:
             bucket.timer = None
             subs = self._take_pending(bucket)
         if subs:
-            await self._run_flush(bucket, subs, trigger="deadline")
+            self._enqueue_ready(bucket, subs, trigger="deadline")
+
+    # -- fair flush scheduling -------------------------------------------
+    def _enqueue_ready(self, bucket: _Bucket, subs: List[_Submission], trigger: str):
+        """Queue a detached flush for dispatch.  The dispatcher serves
+        ready flushes deficit-round-robin ACROSS buckets (one hot bucket
+        cannot monopolize the chip) and deadline-earliest WITHIN a bucket;
+        a per-loop two-slot semaphore keeps stage k+1 overlapping launch k
+        (the double buffering the FIFO path had)."""
+        loop = asyncio.get_running_loop()
+        min_deadline = min(
+            (s.deadline for s in subs if s.deadline is not None), default=float("inf")
+        )
+        with self._lock:
+            ready = self._ready.setdefault(loop, {})
+            self._ready_seq += 1
+            ready.setdefault(bucket.key, []).append(
+                (min_deadline, self._ready_seq, bucket, subs, trigger)
+            )
+            if loop in self._dispatchers:
+                return
+            task = asyncio.ensure_future(self._dispatch_loop())
+            self._dispatchers[loop] = task
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
+
+    def _pick_next_locked(self, loop):
+        """Next ready flush for this loop.  Lock held."""
+        ready = self._ready.get(loop)
+        if not ready:
+            return None
+        if not self.config.fair_flush:
+            # true legacy FIFO: globally arrival-ordered across buckets
+            # (serving dict-first would let a busy first bucket starve the
+            # rest, which arrival order never did)
+            key = min(ready, key=lambda k: min(e[1] for e in ready[k]))
+            entries = ready[key]
+            entries.sort(key=lambda e: e[1])
+            entry = entries.pop(0)
+            if not entries:
+                del ready[key]
+            if not ready:
+                del self._ready[loop]
+            return entry[2], entry[3], entry[4]
+        quota = max(1, self.config.fair_quota_rows)
+        keys = list(ready.keys())
+        cursor = self._rr_cursor.get(loop, 0) % len(keys)
+        for final_pass in (False, True):
+            for i in range(len(keys)):
+                key = keys[(cursor + i) % len(keys)]
+                entries = ready.get(key)
+                if not entries:
+                    continue
+                entries.sort(key=lambda e: (e[0], e[1]))  # deadline-earliest
+                rows = sum(s.rows for s in entries[0][3])
+                # a bucket in deficit debt yields its turn — unless every
+                # bucket is in debt, in which case the round refills below
+                # and the earliest-cursor bucket proceeds (progress
+                # guarantee; the overshoot stays on its tab)
+                if final_pass or self._deficit.get(key, quota) >= min(rows, quota):
+                    entry = entries.pop(0)
+                    if not entries:
+                        del ready[key]
+                    if not ready:
+                        del self._ready[loop]
+                    self._deficit[key] = self._deficit.get(key, quota) - rows
+                    self._rr_cursor[loop] = (cursor + i + 1) % len(keys)
+                    return entry[2], entry[3], entry[4]
+            for k in keys:  # full round found only debtors: refill
+                self._deficit[k] = min(quota, self._deficit.get(k, 0) + quota)
+        return None
+
+    async def _dispatch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        me = asyncio.current_task()
+        with self._lock:
+            sem = self._slots.get(loop)
+            if sem is None:
+                # two slots: one flush staging while the previous launches
+                sem = self._slots[loop] = asyncio.Semaphore(2)
+        try:
+            while True:
+                # slot FIRST, then pick: choosing a flush before a slot is
+                # free would pin the scheduling decision while later (and
+                # possibly more urgent) buckets become ready
+                await sem.acquire()
+                with self._lock:
+                    item = self._pick_next_locked(loop)
+                    if item is None:
+                        # exit + deregister atomically: an enqueue that saw
+                        # this dispatcher alive must not strand its entry
+                        if self._dispatchers.get(loop) is me:
+                            del self._dispatchers[loop]
+                            self._rr_cursor.pop(loop, None)
+                            # the semaphore may only be pruned once no
+                            # dispatched flush still holds a permit — a
+                            # successor generation must inherit it, not
+                            # mint two fresh slots on top of in-flight work
+                            if not self._slot_inflight.get(loop):
+                                self._slots.pop(loop, None)
+                                self._slot_inflight.pop(loop, None)
+                        sem.release()
+                        return
+                    self._slot_inflight[loop] = (
+                        self._slot_inflight.get(loop, 0) + 1
+                    )
+                bucket, subs, trigger = item
+                task = asyncio.ensure_future(self._run_flush(bucket, subs, trigger))
+                self._flush_tasks.add(task)
+
+                def _done(t, sem=sem, loop=loop):
+                    self._flush_tasks.discard(t)
+                    with self._lock:
+                        left = self._slot_inflight.get(loop, 1) - 1
+                        self._slot_inflight[loop] = left
+                        if left <= 0 and loop not in self._dispatchers:
+                            self._slots.pop(loop, None)
+                            self._slot_inflight.pop(loop, None)
+                    sem.release()
+
+                task.add_done_callback(_done)
+        finally:
+            with self._lock:
+                # identity check: never unseat a successor dispatcher that
+                # registered after this one deregistered itself
+                if self._dispatchers.get(loop) is me:
+                    del self._dispatchers[loop]
 
     async def drain(self) -> None:
         """Flush every pending bucket now and wait for results to settle
         (shutdown / end-of-bench barrier) — including flush tasks that
         were already in flight when drain was called."""
         flushes = []
+        loop = asyncio.get_running_loop()
         with self._lock:
+            # ready-but-undispatched flushes for THIS loop drain directly
+            for entries in self._ready.pop(loop, {}).values():
+                for _dl, _seq, bucket, subs, _trigger in entries:
+                    flushes.append((bucket, subs))
             for bucket in self._buckets.values():
                 subs = self._take_pending(bucket)
                 if subs:
                     flushes.append((bucket, subs))
-        loop = asyncio.get_running_loop()
         inflight = [t for t in self._flush_tasks if t.get_loop() is loop]
         # cross-loop submissions resolve via call_soon_threadsafe on their
         # own loop; gather here only what belongs to this one
@@ -486,6 +664,7 @@ class DeviceExecutor:
             return
         rows = sum(s.rows for s in live)
         stage_pool, launch_pool = self._pools()
+        retain = None
         try:
             # Failure-domain boundary: an injected flush fault is a launch
             # failure to every job in the mega-batch — and to the breaker.
@@ -500,6 +679,18 @@ class DeviceExecutor:
             ):
                 if bucket.kind == KIND_PREP_INIT:
                     requests = [s.payload for s in live]
+                    # Device-resident out shares: engaged only when EVERY
+                    # submission in the mega-batch opted in (a mixed batch
+                    # must not hand ResidentRefs to a caller expecting limb
+                    # vectors) and the backend supports retention.
+                    if (
+                        self.accumulator is not None
+                        and all(s.retain for s in live)
+                        and getattr(
+                            bucket.backend, "supports_resident_out_shares", False
+                        )
+                    ):
+                        retain = self.accumulator
                     staged = await loop.run_in_executor(
                         stage_pool,
                         lambda: bucket.backend.stage_prep_init_multi(
@@ -520,6 +711,13 @@ class DeviceExecutor:
                         still = self._reject_expired(bucket, live)
                         if not still:
                             return None, []
+                        if retain is not None:
+                            return (
+                                bucket.backend.launch_prep_init_multi(
+                                    staged, requests, retain_store=retain
+                                ),
+                                still,
+                            )
                         return (
                             bucket.backend.launch_prep_init_multi(
                                 staged, requests
@@ -558,7 +756,12 @@ class DeviceExecutor:
             still_set = set(id(s) for s in still)
             for s, out in zip(live, outs):
                 if id(s) not in still_set:
-                    continue  # rejected at launch dequeue
+                    # rejected at launch dequeue: its result is dropped, so
+                    # any ResidentRefs minted for its rows must be released
+                    # or the retained flush matrix never frees
+                    if retain is not None and out:
+                        self._release_dropped_refs(retain, out)
+                    continue
                 self._finish(bucket, s, done)
                 self._observe_wait(bucket, done - s.enqueued)
                 self._resolve(s, result=out)
@@ -569,6 +772,22 @@ class DeviceExecutor:
             for s in live:
                 self._finish(bucket, s, done)
                 self._resolve(s, exc=e)
+
+    @staticmethod
+    def _release_dropped_refs(store, outcomes) -> None:
+        """Release the ResidentRefs inside a dropped submission's prepare
+        outcomes (each is (state, share) or a VdafError)."""
+        from .accumulator import ResidentRef
+
+        refs = []
+        for o in outcomes:
+            if not isinstance(o, tuple) or not o:
+                continue
+            ref = getattr(o[0], "out_share", None)
+            if isinstance(ref, ResidentRef):
+                refs.append(ref)
+        if refs:
+            store.release_refs(refs)
 
     def _reject_expired(self, bucket: _Bucket, subs: List[_Submission]):
         """Reject (retryably) every submission whose deadline has passed;
@@ -668,6 +887,18 @@ class DeviceExecutor:
                 for b in self._buckets.values()
             }
 
+    def circuit_open(self, shape_key: tuple) -> bool:
+        """PEEK at a shape's circuit without the allow() side effects:
+        True while the circuit is open and still inside its reset dwell.
+        Job drivers consult this at step entry (alongside circuit_stats())
+        to route straight to the CPU oracle instead of paying a
+        submit-then-CircuitOpenError round trip per job.  Returns False
+        once the dwell has elapsed so the next real submission runs the
+        half-open probe that can close the circuit."""
+        with self._lock:
+            br = self._breakers.get(shape_key)
+        return br is not None and br.is_open_peek()
+
     def circuit_stats(self) -> Dict[str, dict]:
         """Per-shape breaker state (plain Python; chaos tests read this)."""
         with self._lock:
@@ -682,6 +913,14 @@ class DeviceExecutor:
 
     def shutdown(self) -> None:
         self._closed = True
+        if self.accumulator is not None:
+            # shutdown teardown: un-spilled deltas belong to jobs whose tx
+            # never committed (redelivery re-derives them), so drop them
+            # loudly without paying a readback per bucket
+            try:
+                self.accumulator.discard_all()
+            except Exception:
+                logger.exception("accumulator shutdown teardown failed")
         with self._lock:
             pools = [self._stage_pool, self._launch_pool]
             self._stage_pool = self._launch_pool = None

@@ -108,6 +108,9 @@ class TpuBackend:
     """Batched device prepare: one XLA launch per aggregation job."""
 
     name = "tpu"
+    #: this backend can keep a flush's out shares resident on device and
+    #: hand back ResidentRefs (executor/accumulator.py) instead of limbs
+    supports_resident_out_shares = True
 
     def __init__(self, vdaf: Prio3):
         if vdaf.xof is not XofTurboShake128:
@@ -123,6 +126,11 @@ class TpuBackend:
         self._prep_fns: Dict[int, object] = {}
         self._combine_fn = None
         self._agg_fn = None
+        self._accum_fn = None
+        #: out-share rows transferred device->host by prepare launches —
+        #: the flush-readback counter the accumulator acceptance tests
+        #: assert stays 0 in the device-resident steady state
+        self.outshare_readback_rows = 0
 
     # -- jit caches ------------------------------------------------------
     #: Gate for the limb-planar fast path.  Pallas custom calls do not
@@ -245,12 +253,22 @@ class TpuBackend:
             return []
         return self.prep_init_multi(agg_id, [(verify_key, reports)])[0]
 
-    def _unmarshal_prep(self, verify_key, agg_id, reports, out) -> List[PrepOutcome]:
+    def _unmarshal_prep(
+        self, verify_key, agg_id, reports, out, resident=None
+    ) -> List[PrepOutcome]:
+        """``resident=(flush_id, start_row)`` means the out-share matrix
+        stayed on device (accumulator store): states carry ResidentRefs
+        instead of limb vectors and no out-share bytes cross the PCIe."""
         flp, jf = self.vdaf.flp, self.bp.jf
         B = len(reports)
         ok = np.asarray(out["ok"])[:B]
         verifiers = np.asarray(out["verifiers"])[:B]
-        out_shares = np.asarray(out["out_share"])[:B]
+        if resident is None:
+            out_shares = np.asarray(out["out_share"])[:B]
+        else:
+            from ..executor.accumulator import ResidentRef
+
+            flush_id, start_row = resident
         has_jr = flp.JOINT_RAND_LEN > 0
         if has_jr:
             parts = np.asarray(out["joint_rand_part"])[:B]
@@ -265,7 +283,9 @@ class TpuBackend:
                 )
                 continue
             state = Prio3PrepareState(
-                out_share=jf.from_limbs(out_shares[b]),
+                out_share=jf.from_limbs(out_shares[b])
+                if resident is None
+                else ResidentRef(flush_id, start_row + b),
                 corrected_joint_rand_seed=corrected[b].tobytes() if has_jr else None,
             )
             share = Prio3PrepareShare(
@@ -377,9 +397,17 @@ class TpuBackend:
         requests: Sequence[
             Tuple[bytes, Sequence[Tuple[bytes, Optional[List[bytes]], Prio3InputShare]]]
         ],
+        retain_store=None,
     ) -> List[List[PrepOutcome]]:
         """Device half: run the compiled prepare on a staged batch, read
-        back once, and slice results per request."""
+        back once, and slice results per request.
+
+        ``retain_store`` (a DeviceAccumulatorStore) is the accumulate-into-
+        buffer variant: the (pad, OUT, n) out-share matrix stays RESIDENT on
+        device (adopted by the store) and each ok row's state carries a
+        ResidentRef; only the small verdict outputs (ok / verifiers /
+        joint-rand) are read back, so the flush pays zero out-share
+        readback."""
         # Failure-domain boundary: an injected launch fault impersonates
         # XLA OOM / plugin loss; callers (executor breaker, driver retry
         # budget) must degrade gracefully.  The oracle has no such point —
@@ -394,18 +422,57 @@ class TpuBackend:
         from ..core.trace import trace_span
 
         t0 = time.monotonic()
-        with trace_span("prep_launch", cat="device", backend=self.name, batch=B):
-            out = self._prep_fn(agg_id)(staged.placed)
-            # One readback for the whole launch, then slice per request.
-            outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
-        _observe_prepare(self.name, "init", B, time.monotonic() - t0)
-        start = 0
-        results: List[List[PrepOutcome]] = []
-        for verify_key, reports in requests:
-            n = len(reports)
-            view = {k: v[start : start + n] for k, v in outputs.items()}
-            results.append(self._unmarshal_prep(verify_key, agg_id, reports, view))
-            start += n
+        resident = None
+        try:
+            with trace_span("prep_launch", cat="device", backend=self.name, batch=B):
+                out = dict(self._prep_fn(agg_id)(staged.placed))
+                if retain_store is not None:
+                    matrix = out.pop("out_share")
+                    nbytes = int(np.prod(matrix.shape)) * 4
+                    flush_id = retain_store.retain_flush(self, matrix, B, nbytes)
+                    resident = (flush_id, 0)
+                else:
+                    self.outshare_readback_rows += B
+                # One readback for the whole launch, then slice per request.
+                outputs = {k: np.asarray(v)[:B] for k, v in out.items()}
+            _observe_prepare(self.name, "init", B, time.monotonic() - t0)
+            start = 0
+            results: List[List[PrepOutcome]] = []
+            for verify_key, reports in requests:
+                n = len(reports)
+                view = {k: v[start : start + n] for k, v in outputs.items()}
+                results.append(
+                    self._unmarshal_prep(
+                        verify_key,
+                        agg_id,
+                        reports,
+                        view,
+                        resident=None
+                        if resident is None
+                        else (resident[0], start),
+                    )
+                )
+                start += n
+        except Exception:
+            if resident is not None:
+                # a failure after the store adopted the matrix (verdict
+                # readback, unmarshal) must not strand the flush: release
+                # every row so it frees (release is idempotent)
+                from ..executor.accumulator import ResidentRef
+
+                retain_store.release_refs(
+                    [ResidentRef(resident[0], r) for r in range(B)]
+                )
+            raise
+        if resident is not None:
+            # rows the oracle fallback served (device margin overflow)
+            # never minted a ref; release them so the flush can free
+            from ..executor.accumulator import ResidentRef
+
+            ok_all = np.asarray(outputs["ok"])
+            dead = [ResidentRef(resident[0], r) for r in range(B) if not ok_all[r]]
+            if dead:
+                retain_store.release_refs(dead)
         return results
 
     def prep_init_multi(
@@ -430,6 +497,31 @@ class TpuBackend:
         if staged is None:
             return [[] for _ in requests]
         return self.launch_prep_init_multi(staged, requests)
+
+    # -- device-resident accumulation (executor/accumulator.py) ----------
+    def accumulate_rows(self, buffer, matrix, mask: np.ndarray):
+        """Accumulate-into-buffer launch: psum the ``mask``-selected rows
+        of a resident (pad, OUT, n) out-share matrix into ``buffer`` (an
+        (OUT, n) limb accumulator; None starts one).  Pure device work —
+        no readback; the result is the new resident buffer."""
+        if self._accum_fn is None:
+            jnp = self._jax.numpy
+            jf = self.bp.jf
+
+            def accum(buf, m, msk):
+                masked = jnp.where(msk[:, None, None], m, jnp.zeros_like(m))
+                delta = jf.sum(masked, axis=0)
+                return jf.add(buf, delta)
+
+            self._accum_fn = self._jax.jit(accum)
+        if buffer is None:
+            jf = self.bp.jf
+            buffer = np.zeros((self.vdaf.flp.OUTPUT_LEN, jf.n), dtype=np.uint32)
+        return self._accum_fn(buffer, matrix, mask)
+
+    def read_accum_buffer(self, buffer) -> List[int]:
+        """Spill readback: ONE (OUT,) field vector — the commit-time drain."""
+        return self.bp.jf.from_limbs(np.asarray(buffer))
 
     def aggregate_batch(self, out_shares_limbs, mask) -> List[int]:
         """Masked out-share aggregation on-device.
@@ -770,6 +862,37 @@ class Poplar1Backend:
 
 
 BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend, "mesh": MeshBackend}
+
+
+def vdaf_shape_key(vdaf) -> tuple:
+    """Key a VDAF by its FULL parameterization: tasks sharing it share one
+    backend instance — and therefore one set of compiled device graphs
+    (verify_key is a traced input, so one compilation serves every task).
+    Every scalar circuit parameter participates — derived lengths alone
+    are ambiguous (SumVec(length=100, bits=2) and SumVec(length=200,
+    bits=1) share MEAS_LEN but not truncate/OUTPUT_LEN).  Shared by the
+    driver and the helper aggregator so both sides of the protocol land in
+    the same executor buckets and breaker domains."""
+    flp = getattr(vdaf, "flp", None)
+    valid = getattr(flp, "valid", None)
+    circuit_params = None
+    if valid is not None:
+        circuit_params = tuple(
+            sorted(
+                (k, v if isinstance(v, (int, str, bool)) else getattr(v, "__name__", str(v)))
+                for k, v in vars(valid).items()
+                if not k.startswith("_") and not isinstance(v, (list, dict))
+            )
+        )
+    return (
+        type(vdaf).__name__,
+        type(valid).__name__ if valid is not None else None,
+        circuit_params,
+        getattr(vdaf, "algorithm_id", None),
+        getattr(vdaf, "num_shares", None),
+        getattr(vdaf, "num_proofs", None),
+        getattr(getattr(vdaf, "xof", None), "__name__", None),
+    )
 
 
 # Circuits with a device twin in ops/prepare.py _device_circuit.  Kept as a

@@ -1,0 +1,709 @@
+"""Device-resident accumulator store (ISSUE 3, janus_tpu/executor/accumulator.py).
+
+Layers, cheapest first:
+
+* store semantics against a fake numpy backend — commit/drain round trip,
+  flush-matrix lifecycle, LRU eviction under a tiny byte budget, poisoned-
+  bucket discard (the mirror-delta journal's exactly-once contract),
+  injected mid-spill faults;
+* the fair flush scheduler: a hot bucket cannot starve a second bucket's
+  flush past its deadline slot;
+* writer-side delta resolution: StaleAccumulatorDelta on any mismatch
+  between the drained delta and the reports surviving the tx;
+* the real-backend acceptance path (TpuBackend on Prio3Count): executor
+  flushes with the store attached perform ZERO device->host out-share
+  readbacks (``outshare_readback_rows`` stays 0), commit-time spill is
+  bit-exact vs the CPU oracle, and the breaker/launch-failure replay
+  re-derives the journaled reports on the oracle without double-counting.
+
+The end-to-end chaos condition (spill/evict faults firing during a 2-replica
+soak, aggregates exact) rides tests/test_chaos.py's soak, which now runs
+with the accumulator enabled and a 256-byte budget.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from janus_tpu.core import faults
+from janus_tpu.core.faults import FaultSpec
+from janus_tpu.executor import (
+    AccumulatorConfig,
+    AccumulatorUnavailable,
+    DeviceAccumulatorStore,
+    DeviceExecutor,
+    ExecutorConfig,
+    ResidentRef,
+    StaleAccumulatorDelta,
+    reset_global_executor,
+)
+from janus_tpu.utils.test_util import det_rng
+from janus_tpu.vdaf.instances import prio3_count
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+    reset_global_executor()
+
+
+def _run(coro, timeout=120.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+# -- fake backend ------------------------------------------------------------
+
+
+class _Field:
+    """Tiny exact field double: plain integer adds (values stay small)."""
+
+    @staticmethod
+    def vec_add(a, b):
+        return [x + y for x, y in zip(a, b)]
+
+
+class _FakeFlp:
+    OUTPUT_LEN = 2
+    field = _Field
+
+
+class _FakeVdaf:
+    flp = _FakeFlp
+
+
+class _AccumBackend:
+    """Store seam double: numpy matrices, integer sums, no jax."""
+
+    supports_resident_out_shares = True
+
+    def __init__(self):
+        self.vdaf = _FakeVdaf()
+        self.accum_launches = 0
+        self.fail_accumulate = False
+        self.fail_read = False
+
+    def accumulate_rows(self, buffer, matrix, mask):
+        self.accum_launches += 1
+        if self.fail_accumulate:
+            raise RuntimeError("device on fire")
+        delta = np.asarray(matrix)[mask].sum(axis=0)
+        return delta if buffer is None else buffer + delta
+
+    def read_accum_buffer(self, buffer):
+        if self.fail_read:
+            raise RuntimeError("device on fire")
+        return [int(x) for x in np.asarray(buffer)]
+
+
+def _matrix(rows, width=2, base=1):
+    """Row r holds [base*(r+1), base*(r+1)*10] — distinct, easy sums."""
+    return np.array(
+        [[base * (r + 1), base * (r + 1) * 10] for r in range(rows)], dtype=np.int64
+    )
+
+
+# -- store semantics ---------------------------------------------------------
+
+
+def test_commit_drain_round_trip_and_flush_lifecycle():
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    backend = _AccumBackend()
+    m = _matrix(4)
+    fid = store.retain_flush(backend, m, rows=4, nbytes=m.nbytes)
+
+    store.commit_rows(
+        ("bucket-a",),
+        backend,
+        [ResidentRef(fid, 0), ResidentRef(fid, 2)],
+        job_token=b"job1",
+        report_ids=[b"r0", b"r2"],
+    )
+    # rows 1 and 3 never finish: released, which frees the flush matrix
+    store.release_refs([ResidentRef(fid, 1), ResidentRef(fid, 3)])
+    assert store.stats()["flushes_resident"] == 0
+
+    vector, rids = store.drain(("bucket-a",), _Field)
+    assert vector == [1 + 3, 10 + 30]
+    assert rids == {b"r0", b"r2"}
+    assert store.stats()["buckets"] == 0
+    # a second drain has nothing: the delta can never merge twice
+    assert store.drain(("bucket-a",), _Field) is None
+
+
+def test_cross_flush_residency_accumulates_across_commits():
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    backend = _AccumBackend()
+    f1 = store.retain_flush(backend, _matrix(2), rows=2, nbytes=32)
+    f2 = store.retain_flush(backend, _matrix(2, base=100), rows=2, nbytes=32)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(f1, 0)], job_token=b"j1", report_ids=[b"a"]
+    )
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(f2, 1)], job_token=b"j2", report_ids=[b"b"]
+    )
+    store.release_refs([ResidentRef(f1, 1), ResidentRef(f2, 0)])
+    vector, rids = store.drain(("b",), _Field)
+    assert vector == [1 + 200, 10 + 2000]
+    assert rids == {b"a", b"b"}
+
+
+def test_eviction_under_tiny_byte_budget_stays_exact():
+    """LRU eviction spills flush matrices and bucket buffers to host
+    mirrors; sums stay exact and the eviction counter moves."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True, byte_budget=40))
+    backend = _AccumBackend()
+    m1 = _matrix(2)
+    f1 = store.retain_flush(backend, m1, rows=2, nbytes=32)
+    f2 = store.retain_flush(backend, _matrix(2, base=100), rows=2, nbytes=32)
+    # the budget is now blown; the next store op evicts LRU state (f1's
+    # matrix spills to host) BEFORE mutating anything
+    store.commit_rows(
+        ("b",),
+        backend,
+        [ResidentRef(f1, 0), ResidentRef(f1, 1)],
+        job_token=b"j1",
+        report_ids=[b"a", b"b"],
+    )
+    assert store.evictions >= 1
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(f2, 0)], job_token=b"j2", report_ids=[b"c"]
+    )
+    store.release_refs([ResidentRef(f2, 1)])
+    vector, rids = store.drain(("b",), _Field)
+    assert vector == [1 + 2 + 100, 10 + 20 + 1000]
+    assert rids == {b"a", b"b", b"c"}
+
+
+def test_bucket_buffer_eviction_merges_host_and_device_state():
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True, byte_budget=0))
+    backend = _AccumBackend()
+    f1 = store.retain_flush(backend, _matrix(1), rows=1, nbytes=8)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(f1, 0)], job_token=b"j1", report_ids=[b"a"]
+    )
+    bucket = store._buckets[("b",)]
+    store._evict(bucket)  # force the buffer to its host mirror
+    assert bucket.buffer is None and bucket.spilled_host == [1, 10]
+    f2 = store.retain_flush(backend, _matrix(1, base=7), rows=1, nbytes=8)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(f2, 0)], job_token=b"j2", report_ids=[b"b"]
+    )
+    vector, rids = store.drain(("b",), _Field)
+    assert vector == [1 + 7, 10 + 70]
+    assert rids == {b"a", b"b"}
+
+
+def test_poisoned_bucket_discard_returns_journal_exactly_once():
+    """The mirror-delta journal contract: a failed accumulate poisons the
+    bucket; discard() hands back the journaled (job, rids) ONCE and drops
+    the device delta so nothing can double-count."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    backend = _AccumBackend()
+    fid = store.retain_flush(backend, _matrix(2), rows=2, nbytes=32)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(fid, 0)], job_token=b"j1", report_ids=[b"a"]
+    )
+    backend.fail_accumulate = True
+    with pytest.raises(AccumulatorUnavailable):
+        store.commit_rows(
+            ("b",), backend, [ResidentRef(fid, 1)], job_token=b"j2", report_ids=[b"b"]
+        )
+    # the bucket is poisoned: drains refuse rather than return a half sum
+    with pytest.raises(AccumulatorUnavailable):
+        store.drain(("b",), _Field)
+    journal = store.discard(("b",))
+    assert [(tok, set(ids)) for tok, ids in journal] == [(b"j1", {b"a"})]
+    assert store.discard(("b",)) == []  # exactly once
+    assert store.stats()["buckets"] == 0
+
+
+def test_injected_spill_fault_surfaces_as_unavailable():
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    backend = _AccumBackend()
+    fid = store.retain_flush(backend, _matrix(1), rows=1, nbytes=8)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(fid, 0)], job_token=b"j", report_ids=[b"a"]
+    )
+    faults.configure([FaultSpec("accumulator.spill", "error", 1.0)], seed=7)
+    with pytest.raises(AccumulatorUnavailable):
+        store.drain(("b",), _Field)
+    faults.clear()
+    # recovery path: discard + journal replay (no partial drain escaped)
+    journal = store.discard(("b",))
+    assert [set(ids) for _tok, ids in journal] == [{b"a"}]
+
+
+def test_injected_evict_fault_fires_before_any_mutation():
+    """An eviction fault must leave the commit cleanly un-applied (no
+    journal entry, no half-updated buffer) — exactly-once recovery
+    depends on failures never firing after state mutated."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True, byte_budget=8))
+    backend = _AccumBackend()
+    fid = store.retain_flush(backend, _matrix(2), rows=2, nbytes=32)
+    faults.configure([FaultSpec("accumulator.evict", "error", 1.0)], seed=7)
+    with pytest.raises(faults.FaultInjectedError):
+        store.commit_rows(
+            ("b",), backend, [ResidentRef(fid, 0)], job_token=b"j", report_ids=[b"a"]
+        )
+    faults.clear()
+    assert ("b",) not in store._buckets, "failed commit must not journal"
+    assert store._flushes[fid].consumed == set(), "refs must stay live"
+
+
+# -- fair flush scheduling ---------------------------------------------------
+
+
+class _GatedPrepBackend:
+    """test_executor-style stage/launch double with a launch gate and an
+    order log, for scheduler-order assertions."""
+
+    class _V:
+        pass
+
+    def __init__(self, gate):
+        self.vdaf = self._V()
+        self.gate = gate
+        self.launch_order = []
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        from types import SimpleNamespace
+
+        rows = sum(len(r) for _, r in requests)
+        if rows == 0:
+            return None
+        return SimpleNamespace(agg_id=agg_id, placed=None, pad_to=rows, rows=rows)
+
+    def launch_prep_init_multi(self, staged, requests):
+        assert self.gate.wait(10), "test launch gate never opened"
+        self.launch_order.append(requests[0][0])
+        return [
+            [("prep", vk, i) for i in range(len(reports))]
+            for vk, reports in requests
+        ]
+
+
+def test_fair_scheduler_hot_bucket_cannot_starve_cold_flush():
+    """Four hot-bucket flushes are ready before the cold bucket's one; FIFO
+    would launch the cold flush LAST, the deficit round-robin must
+    interleave it ahead of the hot tail."""
+    gate = threading.Event()
+    backend = _GatedPrepBackend(gate)
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=60.0, flush_max_rows=2, fair_quota_rows=4)
+    )
+
+    async def go():
+        hot = [
+            asyncio.ensure_future(
+                ex.submit(
+                    ("hot",), "prep_init", (b"h%d" % i, [0, 1]), backend=backend
+                )
+            )
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.05)  # all four hot size-flushes are ready
+        cold = asyncio.ensure_future(
+            ex.submit(("cold",), "prep_init", (b"c0", [0, 1]), backend=backend)
+        )
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(*hot, cold)
+
+    _run(go())
+    ex.shutdown()
+    order = backend.launch_order
+    assert len(order) == 5
+    assert order.index(b"c0") < len(order) - 1, (
+        f"cold flush starved to the back of the line: {order}"
+    )
+
+
+def test_legacy_fifo_mode_still_available():
+    gate = threading.Event()
+    gate.set()
+    backend = _GatedPrepBackend(gate)
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=0.005, flush_max_rows=1024, fair_flush=False)
+    )
+
+    async def go():
+        return await ex.submit(("s",), "prep_init", (b"k", [0, 1]), backend=backend)
+
+    out = _run(go())
+    ex.shutdown()
+    assert len(out) == 2
+
+
+# -- writer-side delta resolution -------------------------------------------
+
+
+def test_writer_resolves_delta_and_rejects_stale_sets():
+    from janus_tpu.aggregator.aggregation_job_writer import AggregationJobWriter
+
+    writer = AggregationJobWriter(
+        task=None,
+        vdaf=None,
+        accumulator_deltas={b"ident": ([5, 50], frozenset({b"r1", b"r2"}))},
+    )
+    refs = [ResidentRef(0, 0), ResidentRef(0, 1)]
+    got = writer._resolve_shares(_Field, b"ident", refs, [b"r1", b"r2"])
+    assert got == [5, 50]
+    # mixed host + resident rows: delta and host vectors add
+    got = writer._resolve_shares(
+        _Field, b"ident", refs + [[1, 1]], [b"r1", b"r2", b"r3"]
+    )
+    assert got == [6, 51]
+    # a report failed in-tx after its row was drained -> abort the tx
+    with pytest.raises(StaleAccumulatorDelta):
+        writer._resolve_shares(_Field, b"ident", [refs[0]], [b"r1"])
+    # unknown batch ident -> no delta at all
+    with pytest.raises(StaleAccumulatorDelta):
+        writer._resolve_shares(_Field, b"other", refs, [b"r1", b"r2"])
+
+
+# -- real backend: zero-readback flushes + bit-exact spill + oracle replay ---
+
+
+@pytest.fixture(scope="module")
+def count_backend():
+    from janus_tpu.vdaf.backend import TpuBackend
+
+    return TpuBackend(prio3_count())
+
+
+def _count_reports(vdaf, n, seed):
+    rng = det_rng(seed)
+    rows = []
+    for i in range(n):
+        nonce = rng(vdaf.NONCE_SIZE)
+        ps, shares = vdaf.shard(i % 2, nonce, rng(vdaf.RAND_SIZE))
+        rows.append((nonce, ps, shares[0]))
+    return rows
+
+
+def test_resident_flush_zero_readback_and_bit_exact_drain(count_backend):
+    """THE ACCEPTANCE PATH: with the store attached, executor flushes read
+    back zero out-share rows; the commit-time spill equals the CPU
+    oracle's field sum exactly."""
+    from janus_tpu.vdaf.backend import OracleBackend
+
+    vdaf = count_backend.vdaf
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=1024))
+    ex.accumulator = store
+    vk = b"\x2a" * vdaf.VERIFY_KEY_SIZE
+    reports = _count_reports(vdaf, 5, "resident")
+    count_backend.outshare_readback_rows = 0
+
+    async def go():
+        return await ex.submit(
+            ("count",),
+            "prep_init",
+            (vk, reports),
+            backend=count_backend,
+            retain_out_shares=True,
+        )
+
+    out = _run(go())
+    assert count_backend.outshare_readback_rows == 0, (
+        "device-resident flush must not read out shares back"
+    )
+    refs = [state.out_share for state, _share in out]
+    assert all(isinstance(r, ResidentRef) for r in refs)
+
+    rids = [r[0] for r in reports]
+    store.commit_rows(
+        ("bucket",), count_backend, refs, job_token=b"job", report_ids=rids
+    )
+    field = vdaf.flp.field
+    vector, drained_rids = store.drain(("bucket",), field)
+    ex.shutdown()
+    want = vdaf.aggregate(
+        [
+            state.out_share
+            for state, _ in OracleBackend(vdaf).prep_init_batch(vk, 0, reports)
+        ]
+    )
+    assert vector == want, "spill-on-commit must be bit-exact vs the oracle"
+    assert drained_rids == set(rids)
+    assert store.stats()["flushes_resident"] == 0
+
+
+def test_driver_breaker_replay_recovers_via_oracle(count_backend):
+    """Launch-failure recovery at the DRIVER layer: commit_rows dies, the
+    journal replays through the CPU oracle, out_shares become host
+    vectors, and nothing is left resident to double-count."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+    from janus_tpu.datastore import (
+        AggregationJob,
+        AggregationJobState,
+        ReportAggregation,
+        ReportAggregationState,
+    )
+    from janus_tpu.datastore.task import AggregatorTask, TaskQueryType
+    from janus_tpu.messages import (
+        AggregationJobId,
+        AggregationJobStep,
+        Duration,
+        Interval,
+        ReportId,
+        Role,
+        TaskId,
+        Time,
+    )
+    from janus_tpu.vdaf import pingpong as pp
+    from janus_tpu.vdaf.backend import OracleBackend
+
+    vdaf = count_backend.vdaf
+    reset_global_executor()
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu",
+            device_executor=ExecutorConfig(
+                enabled=True,
+                flush_window_s=0.02,
+                flush_max_rows=1024,
+                accumulator=AccumulatorConfig(enabled=True),
+            ),
+        ),
+    )
+    store = driver._executor.accumulator
+    assert store is not None
+    key = AggregationJobDriver._vdaf_shape_key(vdaf)
+    driver._backends[key] = count_backend
+
+    task = AggregatorTask(
+        task_id=TaskId.random(),
+        peer_aggregator_endpoint="http://helper.invalid/",
+        query_type=TaskQueryType.time_interval(),
+        vdaf={"type": "Prio3Count"},
+        role=Role.LEADER,
+        vdaf_verify_key=b"\x2a" * 16,
+        min_batch_size=1,
+        time_precision=Duration(3600),
+    )
+    now = Time(1_600_000_000)
+    job = AggregationJob(
+        task_id=task.task_id,
+        aggregation_job_id=AggregationJobId.random(),
+        aggregation_parameter=b"",
+        partial_batch_identifier=None,
+        client_timestamp_interval=Interval(now, Duration(3600)),
+        state=AggregationJobState.IN_PROGRESS,
+        step=AggregationJobStep(1),
+    )
+    reports = _count_reports(vdaf, 3, "replay")
+    ras = [
+        ReportAggregation(
+            task_id=task.task_id,
+            aggregation_job_id=job.aggregation_job_id,
+            report_id=ReportId(nonce),
+            time=now,
+            ord=i,
+            state=ReportAggregationState.START_LEADER,
+            public_share=vdaf.encode_public_share(ps),
+            leader_input_share=share.encode(vdaf),
+        )
+        for i, (nonce, ps, share) in enumerate(reports)
+    ]
+
+    async def go():
+        prep_in = [(ra.report_id.data, ps, share) for ra, (_n, ps, share) in zip(ras, reports)]
+        out = await driver._coalesced_prep_init(
+            count_backend, task.vdaf_verify_key, prep_in
+        )
+        assert count_backend.outshare_readback_rows == 0
+        states, out_shares = {}, {}
+        for ra, (state, _share) in zip(ras, out):
+            assert isinstance(state.out_share, ResidentRef)
+            states[ra.report_id.data] = pp.PingPongContinued(state, 0)
+            out_shares[ra.report_id.data] = state.out_share
+
+        # the device dies between flush and commit
+        orig = count_backend.accumulate_rows
+        count_backend.accumulate_rows = lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("device on fire")
+        )
+        try:
+            deltas = await driver._commit_resident_shares(
+                task, vdaf, job, ras, states, out_shares
+            )
+        finally:
+            count_backend.accumulate_rows = orig
+        return deltas, out_shares
+
+    count_backend.outshare_readback_rows = 0
+    deltas, out_shares = _run(go())
+    assert deltas is None, "replay path yields host vectors, not deltas"
+    want = {
+        ra.report_id.data: state.out_share
+        for ra, (state, _) in zip(
+            ras,
+            OracleBackend(vdaf).prep_init_batch(
+                task.vdaf_verify_key, 0, reports
+            ),
+        )
+    }
+    assert out_shares == want, "oracle replay must be bit-exact"
+    assert store.stats()["buckets"] == 0, "discarded delta must never drain"
+    assert store.stats()["flushes_resident"] == 0
+    reset_global_executor()
+
+
+# -- helper-side executor routing (satellite) --------------------------------
+
+
+def _helper_decoded_rows(vdaf, n, seed):
+    """(idx, (nonce, public, helper_share, leader INITIALIZE msg)) rows,
+    exactly what handle_aggregate_init hands _helper_prepare_batch."""
+    from janus_tpu.vdaf import pingpong as pp
+
+    vk = b"\x2a" * vdaf.VERIFY_KEY_SIZE
+    rng = det_rng(seed)
+    decoded = []
+    for i in range(n):
+        nonce = rng(vdaf.NONCE_SIZE)
+        public, shares = vdaf.shard(i % 2, nonce, rng(vdaf.RAND_SIZE))
+        _state, l_share = vdaf.prep_init(vk, 0, nonce, public, shares[0])
+        msg = pp.PingPongMessage(
+            pp.PingPongMessage.INITIALIZE,
+            prep_share=vdaf.ping_pong_encode_prep_share(l_share),
+        )
+        decoded.append((i, (nonce, public, shares[1], msg)))
+    return vk, decoded
+
+
+class _AggStub:
+    """Just the Aggregator surface the helper prep path touches."""
+
+    from janus_tpu.aggregator.aggregator import Aggregator as _A
+
+    _helper_decode_leader_shares = staticmethod(_A._helper_decode_leader_shares)
+    _helper_finish_prio3 = staticmethod(_A._helper_finish_prio3)
+    _helper_prepare_batch_prio3 = _A._helper_prepare_batch_prio3
+    _helper_prep_rows_prio3 = _A._helper_prep_rows_prio3
+    _helper_prepare_batch_prio3_executor = _A._helper_prepare_batch_prio3_executor
+
+    def __init__(self, executor):
+        self._executor = executor
+
+
+def test_helper_prep_routes_through_executor_and_matches_oracle(count_backend):
+    """aggregator.py's prep_init_batch / prep_shares_to_prep_batch calls
+    submit through the executor (prep_init a1 + combine buckets) and the
+    outcomes match the direct oracle path bit for bit."""
+    from types import SimpleNamespace
+
+    from janus_tpu.vdaf.backend import OracleBackend
+
+    vdaf = count_backend.vdaf
+    vk, decoded = _helper_decoded_rows(vdaf, 3, "helper-route")
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=1024))
+    agg = _AggStub(ex)
+    ta = SimpleNamespace(
+        vdaf=vdaf, backend=count_backend, task=SimpleNamespace(vdaf_verify_key=vk)
+    )
+
+    got = _run(agg._helper_prepare_batch_prio3_executor(ta, decoded))
+    ex.shutdown()
+    want = agg._helper_prepare_batch_prio3(
+        ta, decoded, backend=OracleBackend(vdaf)
+    )
+    assert set(got) == set(want)
+    for idx in want:
+        gk, g_out, g_msg = got[idx]
+        wk, w_out, w_msg = want[idx]
+        assert (gk, g_out) == (wk, w_out)
+        assert (g_msg.variant, g_msg.prep_msg) == (w_msg.variant, w_msg.prep_msg)
+    stats = ex.stats()
+    assert any("/a1/prep_init" in k for k in stats), stats
+    assert any("/a1/combine" in k for k in stats), stats
+
+
+def test_helper_prep_degrades_to_oracle_when_circuit_open(count_backend):
+    """Breaker-aware helper path: an open circuit skips the executor
+    entirely (no submissions) and serves the request on the oracle."""
+    from types import SimpleNamespace
+
+    vdaf = count_backend.vdaf
+    vk, decoded = _helper_decoded_rows(vdaf, 2, "helper-breaker")
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=1024))
+    ex.circuit_open = lambda shape_key: True  # breaker peek says: open
+    agg = _AggStub(ex)
+    ta = SimpleNamespace(
+        vdaf=vdaf, backend=count_backend, task=SimpleNamespace(vdaf_verify_key=vk)
+    )
+    got = _run(agg._helper_prepare_batch_prio3_executor(ta, decoded))
+    ex.shutdown()
+    assert ex.stats() == {}, "open circuit must not submit to the device"
+    assert all(v[0] == "finished" for v in got.values())
+
+
+def test_driver_precheck_skips_submit_when_circuit_open():
+    """Breaker-aware acquisition on the DRIVER side: circuit_open short-
+    circuits to the oracle with no submission and no CircuitOpenError."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+
+    reset_global_executor()
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu", device_executor=ExecutorConfig(enabled=True)
+        ),
+    )
+    driver._executor.circuit_open = lambda shape_key: True
+
+    class _Oracle:
+        def prep_init_batch(self, vk, agg_id, rows):
+            return [("oracle", vk, i) for i in range(len(rows))]
+
+    class _B:
+        class _V:
+            pass
+
+        vdaf = _V()
+        oracle = _Oracle()
+
+        def stage_prep_init_multi(self, *a, **kw):  # pragma: no cover
+            raise AssertionError("device path reached despite open circuit")
+
+    out = _run(driver._coalesced_prep_init(_B(), b"vk", [0, 1]))
+    assert out == [("oracle", b"vk", 0), ("oracle", b"vk", 1)]
+    assert driver._executor.stats() == {}
+    reset_global_executor()
+
+
+def test_accumulator_config_yaml_round_trip():
+    from janus_tpu.binaries.config import JobDriverBinaryConfig, load_config
+
+    cfg = load_config(
+        JobDriverBinaryConfig,
+        text="""
+device_executor:
+  enabled: true
+  fair_quota_rows: 4096
+  accumulator:
+    enabled: true
+    byte_budget: 1048576
+""",
+    )
+    ec = cfg.device_executor.to_executor_config()
+    assert ec.fair_quota_rows == 4096
+    assert ec.accumulator is not None and ec.accumulator.byte_budget == 1048576

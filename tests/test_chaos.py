@@ -119,6 +119,11 @@ def test_every_known_point_is_wired():
         "backend.launch": "janus_tpu/vdaf/backend.py",
         "backend.combine": "janus_tpu/vdaf/backend.py",
         "clock.skew": "janus_tpu/core/faults.py",
+        "report_writer.flush": "janus_tpu/aggregator/report_writer.py",
+        "gc.run": "janus_tpu/aggregator/garbage_collector.py",
+        "key_rotator.run": "janus_tpu/aggregator/key_rotator.py",
+        "accumulator.spill": "janus_tpu/executor/accumulator.py",
+        "accumulator.evict": "janus_tpu/executor/accumulator.py",
     }
     assert set(wiring) == set(faults.KNOWN_POINTS)
     for point, rel in wiring.items():
@@ -552,12 +557,18 @@ class ChaosHarness:
         self.col_token = AuthenticationToken.new_bearer("col-token-chaos")
         self.collector_keys = HpkeKeypair.generate(9)
         self.tasks = []  # (task_id, leader_task, helper_task)
+        from janus_tpu.executor import AccumulatorConfig
+
         self.exec_cfg = ExecutorConfig(
             enabled=True,
             flush_window_s=0.02,
             flush_max_rows=4096,
             breaker_failure_threshold=2,
             breaker_reset_timeout_s=0.3,
+            # ISSUE 3 acceptance: the soak runs with device-resident
+            # accumulation ON and a byte budget tiny enough that LRU
+            # evictions fire constantly — aggregates must still be exact.
+            accumulator=AccumulatorConfig(enabled=True, byte_budget=256),
         )
         # 2 replicas: distinct driver instances, one shared global executor
         self.drivers = [
@@ -745,6 +756,11 @@ def _soak_fault_specs():
         FaultSpec("backend.launch", "error", 0.2),
         FaultSpec("backend.combine", "error", 0.2),
         FaultSpec("clock.skew", "skew", 0.2, skew_s=5),
+        # mid-spill failures: drains fall back to the CPU-oracle replay,
+        # evictions abort the flush (breaker counts it) — aggregates must
+        # come out exact either way (ISSUE 3 acceptance)
+        FaultSpec("accumulator.spill", "error", 0.2),
+        FaultSpec("accumulator.evict", "error", 0.2),
     ]
 
 
