@@ -59,6 +59,12 @@ class JobStepError(Exception):
         self.retryable = retryable
 
 
+class _JournalRowMissing(Exception):
+    """A deferred drain lost the race with a crash-recovery replay for one
+    of its journal rows; the drained vector must not merge (non-retryable
+    by construction: run_tx propagates it out of the drain tx)."""
+
+
 @dataclass
 class DriverConfig:
     batch_aggregation_shard_count: int = 8
@@ -112,6 +118,14 @@ class AggregationJobDriver:
             from ..executor import get_global_executor
 
             self._executor = get_global_executor(exec_cfg)
+            if (
+                self._executor.accumulator is not None
+                and self.datastore is not None
+            ):
+                # Durable spill target for graceful shutdown: committed-
+                # but-unspilled deferred deltas drain through the journal
+                # transaction instead of being discarded.
+                self._executor.set_spill_sink(self._spill_sink)
 
     def _get_session(self):
         """One shared connection-pooled session per driver (the analog of the
@@ -687,13 +701,33 @@ class AggregationJobDriver:
         )
 
         # Device-resident out shares: commit the finished rows' ResidentRefs
-        # into per-batch resident accumulators and drain them NOW (the
-        # commit-time spill: one O(OUT) readback per batch bucket instead of
-        # O(rows x OUT) per flush), BEFORE the transaction — a tx retry must
-        # never replay a device psum.
-        accumulator_deltas = await self._commit_resident_shares(
+        # into per-batch resident accumulators BEFORE the transaction — a
+        # tx retry must never replay a device psum.  Drain-at-commit mode
+        # spills the delta NOW (one O(OUT) readback per batch bucket);
+        # deferred mode leaves it resident and persists a journal row in
+        # the tx instead (crash recovery replays from the datastore).
+        (
+            accumulator_deltas,
+            journal_entries,
+            touched_buckets,
+        ) = await self._commit_resident_shares(
             task, vdaf, job, all_ras, states, out_shares
         )
+
+        if journal_entries:
+            # Deferred drains retain the StartLeader payloads on the
+            # FINISHED rows: they are the journal's oracle-replay window —
+            # a survivor re-derives the out shares from these columns
+            # after this process dies with the delta still on device.
+            ra_by_rid = {ra.report_id.data: ra for ra in all_ras}
+            journaled_rids = set().union(*journal_entries.values())
+            new_ras = [
+                self._finished_with_payload(ra_by_rid[ra.report_id.data], ra)
+                if ra.report_id.data in journaled_rids
+                and ra.state == ReportAggregationState.FINISHED
+                else ra
+                for ra in new_ras
+            ]
 
         writer = AggregationJobWriter(
             task,
@@ -702,6 +736,7 @@ class AggregationJobDriver:
             initial_write=False,
             backend=self._backend_for(task, vdaf),
             accumulator_deltas=accumulator_deltas,
+            journal_entries=journal_entries,
         )
         writer.put(job, new_ras, out_shares)
 
@@ -715,31 +750,90 @@ class AggregationJobDriver:
             await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
         except StaleAccumulatorDelta as e:
             # A report was failed in-tx (batch collected under our feet)
-            # AFTER its row was drained.  The tx aborted with nothing
-            # merged; redelivery re-prepares the job and the in-tx check
-            # fails the report properly — exactly-once either way.
+            # AFTER its row was drained/journaled.  The tx aborted with
+            # nothing merged; redelivery re-prepares the job and the in-tx
+            # check fails the report properly — exactly-once either way.
+            self._discard_touched_buckets(touched_buckets)
             raise JobStepError(
                 f"resident delta invalidated in-tx: {e}", retryable=True
             )
+        except BaseException:
+            # Deferred mode: the bucket now holds THIS job's rows but its
+            # journal row never committed — a later drain would merge rows
+            # that redelivery will re-prepare (double count).  Discard the
+            # bucket; other jobs' persisted journal rows stay replayable.
+            self._discard_touched_buckets(touched_buckets)
+            raise
+        if journal_entries:
+            from ..core.metrics import GLOBAL_METRICS
+
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.accumulator_journal_entries.inc(len(journal_entries))
+            await self._maybe_drain_due()
+
+    @staticmethod
+    def _finished_with_payload(orig, finished_ra):
+        """FINISHED, but keeping exactly the columns the oracle replay
+        reads (public share + leader input share — the deferred journal's
+        replay window); the helper's ciphertext has no replay reader and
+        is dropped like any other FINISHED row's.  GC reclaims the rest
+        with the job, once its journal row is consumed."""
+        return orig.with_state(
+            ReportAggregationState.FINISHED,
+            public_share=orig.public_share,
+            leader_input_share=orig.leader_input_share,
+        ).with_last_prep_resp(finished_ra.last_prep_resp)
+
+    def _discard_touched_buckets(self, touched_buckets) -> None:
+        """Drop the device deltas of buckets this step committed into
+        (deferred mode, after its tx failed).  Journal entries belonging
+        to OTHER jobs survive in the datastore and are replayed from
+        there; this job's rows redeliver and re-prepare."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None or not touched_buckets:
+            return
+        for key in touched_buckets:
+            journal = store.discard(key)
+            if journal:
+                logger.warning(
+                    "discarded bucket %r with %d journaled job(s) after a "
+                    "failed tx; persisted journal rows will be oracle-"
+                    "replayed from the datastore",
+                    key,
+                    len(journal),
+                )
 
     async def _commit_resident_shares(
         self, task, vdaf, job, all_ras, states, out_shares
-    ) -> Optional[Dict[bytes, Tuple[Sequence[int], frozenset]]]:
+    ) -> Tuple[
+        Optional[Dict[bytes, Tuple[Sequence[int], frozenset]]],
+        Optional[Dict[bytes, frozenset]],
+        List[tuple],
+    ]:
         """Accumulator-store commit path (no-op when the store is off or no
         finished report carries a ResidentRef).
 
         Per batch bucket: psum the finished rows into the resident
-        accumulator (one device launch, no readback), journal the delta,
-        then drain it to ONE host field vector for the writer's sharded
-        merge.  On AccumulatorUnavailable (launch failure / poisoned bucket
-        / injected spill fault) the journaled reports are replayed through
+        accumulator (one device launch, no readback).  Drain-at-commit
+        mode (default) then drains it to ONE host field vector for the
+        writer's sharded merge; deferred mode (drain_interval_s > 0)
+        leaves the delta resident and hands back journal entries the
+        writer persists in its tx (the cadence drain — or, after a crash,
+        the collection-time oracle replay — merges the shares later).
+        On AccumulatorUnavailable (launch failure / poisoned bucket /
+        injected spill fault) the journaled reports are replayed through
         the bit-exact CPU oracle — host vectors replace the dead refs in
         ``out_shares`` and the poisoned device delta is discarded, so
         accumulation never double-counts or drops.  Leftover refs (reports
-        the helper failed) are released so their flush matrices free."""
+        the helper failed) are released so their flush matrices free.
+
+        Returns ``(accumulator_deltas, journal_entries, touched_buckets)``
+        — touched_buckets names the deferred buckets this step committed
+        into, so a failed tx can discard them (their journal rows never
+        committed)."""
         store = self._executor.accumulator if self._executor is not None else None
         if store is None:
-            return None
+            return None, None, []
         from ..datastore.query_type import strategy_for
         from ..executor.accumulator import AccumulatorUnavailable, ResidentRef
 
@@ -757,7 +851,7 @@ class AggregationJobDriver:
         if leftover:
             store.release_refs(leftover)
         if not resident:
-            return None
+            return None, None, []
 
         ra_by_rid = {ra.report_id.data: ra for ra in all_ras}
         strategy = strategy_for(task)
@@ -805,35 +899,73 @@ class AggregationJobDriver:
                 "accum_collected_check", check
             )
 
+        deferred = getattr(store.config, "deferred", False)
         deltas: Dict[bytes, Tuple[Sequence[int], frozenset]] = {}
+        journal_entries: Dict[bytes, frozenset] = {}
+        touched: List[tuple] = []
+        # Drain-at-commit scopes buckets per STEP ATTEMPT (job id + a
+        # fresh nonce): two driver replicas sharing one process (and one
+        # store) can deliver the same job concurrently after a lease
+        # expiry, and a shared bucket would let both commits land before
+        # either drain — a doubled vector whose rid set still matches, so
+        # StaleAccumulatorDelta cannot catch it and the surviving lease
+        # holder would merge it.  The bucket lives only within this step,
+        # so per-attempt uniqueness costs nothing.  Deferred drains
+        # accumulate ACROSS jobs by design — there the persisted journal
+        # row is the fence (the drain tx only merges if it consumes every
+        # contributing row exactly once).
+        import secrets as _secrets
+
+        step_nonce = _secrets.token_bytes(8)
         for ident, rids in by_ident.items():
-            # job id in the key: with drain-at-commit the resident window
-            # is one step, so scoping buckets per job costs nothing and
-            # keeps two replicas (or a lease-overlap redelivery) from ever
-            # committing into each other's delta; the store's closed-flag
-            # guard covers the residual same-job overlap race.
-            bucket_key = (
-                task.task_id.data,
-                shape_key,
-                ident,
-                job.aggregation_job_id.data,
-            )
+            if deferred:
+                bucket_key = (
+                    "leader",
+                    task.task_id.data,
+                    shape_key,
+                    ident,
+                    job.aggregation_parameter,
+                )
+            else:
+                bucket_key = (
+                    "leader",
+                    task.task_id.data,
+                    shape_key,
+                    ident,
+                    job.aggregation_parameter,
+                    job.aggregation_job_id.data,
+                    step_nonce,
+                )
             refs = [resident[rid] for rid in rids]
 
-            async def replay(rids, refs, cause):
+            async def replay(rids, refs, cause, bucket_key=bucket_key):
                 """Exactly-once recovery: the device delta (whole or
                 partial) is discarded FIRST, then the journaled reports are
-                recomputed on the bit-exact CPU oracle."""
+                recomputed on the bit-exact CPU oracle.  Deferred entries
+                from OTHER jobs have committed journal rows — they are NOT
+                replayed here (the datastore replay path owns them)."""
                 journal = store.discard(bucket_key)
                 store.release_refs(refs)
                 replay_rids = set(rids)
-                for _job_token, ids in journal:
-                    replay_rids |= set(ids)
+                other_jobs = 0
+                for job_token, ids in journal:
+                    if job_token == job.aggregation_job_id.data:
+                        replay_rids |= set(ids)
+                    else:
+                        other_jobs += 1
+                if other_jobs:
+                    logger.warning(
+                        "discarded bucket %r still journaled %d other "
+                        "job(s); their persisted journal rows will be "
+                        "oracle-replayed from the datastore",
+                        bucket_key,
+                        other_jobs,
+                    )
                 unknown = replay_rids - set(ra_by_rid)
                 if unknown:
-                    # journal entries from a job this step cannot recompute
-                    # (should not happen with drain-at-commit; fail loudly
-                    # and retryably rather than silently dropping shares)
+                    # this job's rows must always be recomputable from the
+                    # step's loaded report aggregations; fail loudly and
+                    # retryably rather than silently dropping shares
                     raise JobStepError(
                         f"accumulator journal names {len(unknown)} report(s) "
                         f"outside this job; cannot replay: {cause}",
@@ -866,6 +998,8 @@ class AggregationJobDriver:
                     job_token=job.aggregation_job_id.data,
                     report_ids=rids,
                 )
+                if deferred:
+                    return None  # stays resident; the journal row covers it
                 return store.drain(bucket_key, field)
 
             try:
@@ -880,11 +1014,15 @@ class AggregationJobDriver:
                     logger.exception("accumulator commit/drain failed")
                 await replay(rids, refs, e)
                 continue
+            if deferred:
+                journal_entries[ident] = frozenset(rids)
+                touched.append(bucket_key)
+                continue
             if drained is None:
                 continue
             vector, drained_rids = drained
             deltas[ident] = (vector, frozenset(drained_rids))
-        return deltas or None
+        return deltas or None, journal_entries or None, touched
 
     def _oracle_out_shares(self, task, vdaf, backend, ras):
         """Bit-exact CPU replay of finished reports' out shares (backend
@@ -915,6 +1053,155 @@ class AggregationJobDriver:
             state, _share = outcome
             out[ra.report_id.data] = state.out_share
         return out
+
+    # ------------------------------------------------------------------
+    # deferred-drain plumbing (accumulator.drain_interval_s > 0)
+
+    async def _maybe_drain_due(self) -> None:
+        """Cadence scan: drain every deferred bucket whose oldest delta is
+        older than drain_interval_s, merging ONE share-only vector per
+        bucket into batch_aggregations and consuming its journal rows."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None or not getattr(store.config, "deferred", False):
+            return
+        # the shared store may also hold 7-tuple drain-at-commit keys
+        # (helper requests in the same process); only this driver's
+        # 5-tuple deferred keys are cadence-drainable
+        keys = [k for k in store.due_buckets(store.config.drain_interval_s) if len(k) == 5]
+        if not keys:
+            return
+        loop = asyncio.get_running_loop()
+        for key in keys:
+            try:
+                await loop.run_in_executor(None, self._drain_due_bucket, key)
+            except Exception:
+                # the step's own tx already committed — a drain failure
+                # (e.g. the drain tx exhausting retries under contention)
+                # must not fail the step or strand its lease; whatever was
+                # not merged stays journaled for the datastore replay
+                logger.exception("deferred cadence drain failed for %r", key)
+
+    def _drain_due_bucket(self, key: tuple) -> None:
+        store = self._executor.accumulator
+        from ..executor.accumulator import AccumulatorError
+
+        task, field = self._task_field_for_bucket(key)
+        if task is None:
+            return
+        try:
+            out = store.drain_with_journal(key, field)
+        except AccumulatorError as e:
+            journal = store.discard(key)
+            logger.warning(
+                "deferred drain failed for bucket %r; %d journal row(s) "
+                "stay persisted for the datastore oracle replay: %s",
+                key,
+                len(journal),
+                e,
+            )
+            return
+        if out is not None:
+            self._merge_drained(task, field, key, out[0], out[1])
+
+    def _task_field_for_bucket(self, key: tuple):
+        """(task, field) for a deferred bucket key
+        ``(role, task_id, shape_key, batch_identifier, agg_param)``."""
+        from ..messages import TaskId
+
+        _role, task_id_b, _shape, _ident, param = key
+        task = self.datastore.run_tx(
+            "accum_drain_task",
+            lambda tx: tx.get_aggregator_task(TaskId(task_id_b)),
+        )
+        if task is None:
+            logger.warning("bucket %r names an unknown task; dropping", key)
+            return None, None
+        vdaf = task.vdaf_instance()
+        return task, vdaf.field_for_agg_param(vdaf.decode_agg_param(param))
+
+    def _merge_drained(self, task, field, key: tuple, vector, journal) -> None:
+        """The deferred-drain transaction: consume every contributing
+        job's journal row, then merge the drained vector as a share-only
+        batch-aggregation delta.  A missing row means a crash-recovery
+        replay already merged that job's shares from the datastore — the
+        vector can no longer be applied (it cannot be split per job), so
+        the whole drain aborts and the SURVIVING rows stay journaled for
+        the same replay path.  Either path merges each row exactly once."""
+        from ..messages import AggregationJobId
+        from .aggregation_job_writer import merge_share_delta
+
+        _role, _task_id_b, _shape, ident, param = key
+
+        def tx_fn(tx):
+            for job_token, _rids in journal:
+                if not tx.delete_accumulator_journal_entry(
+                    task.task_id, ident, param, AggregationJobId(job_token)
+                ):
+                    raise _JournalRowMissing(job_token)
+            merge_share_delta(
+                tx,
+                task,
+                field,
+                ident,
+                param,
+                vector,
+                shard_count=self.config.batch_aggregation_shard_count,
+            )
+
+        try:
+            self.datastore.run_tx("accumulator_drain", tx_fn)
+        except _JournalRowMissing as e:
+            logger.warning(
+                "bucket %r journal row %s already consumed (replayed by a "
+                "survivor); dropping the drained vector — remaining rows "
+                "stay journaled for the datastore replay",
+                key,
+                e,
+            )
+            return
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.accumulator_journal_consumed.labels(path="drain").inc(
+                len(journal)
+            )
+
+    def _spill_sink(self, key: tuple, vector, journal) -> None:
+        """shutdown(drain=True) target: spill one committed-but-unspilled
+        bucket durably.  Only deferred buckets (5-tuple keys) with
+        persisted journal rows are mergeable; job-scoped drain-at-commit
+        buckets still resident at shutdown belong to transactions that
+        never committed — merging them would double-count after the
+        lease redelivers, so they are dropped loudly instead."""
+        if len(key) != 5 or not journal:
+            logger.warning(
+                "dropping un-journaled resident delta for bucket %r "
+                "(%d job(s)); lease redelivery re-derives it",
+                key,
+                len(journal),
+            )
+            return
+        task, field = self._task_field_for_bucket(key)
+        if task is None:
+            return
+        self._merge_drained(task, field, key, vector, journal)
+
+    async def shutdown(self) -> None:
+        """Graceful teardown (SIGTERM path): flush the executor's pending
+        mega-batches, spill committed-but-unspilled deferred deltas to the
+        datastore through the journal transaction, then stop intake.  The
+        crash path is ``executor.shutdown(drain=False)`` — everything it
+        drops is re-derived by lease redelivery or the journal replay."""
+        if self._executor is not None:
+            try:
+                await self._executor.drain()
+            except Exception:
+                logger.exception("executor drain failed during shutdown")
+            ex = self._executor
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: ex.shutdown(drain=True)
+            )
+        await self.close()
 
     # ------------------------------------------------------------------
     async def abandon_aggregation_job(self, lease: Lease) -> None:

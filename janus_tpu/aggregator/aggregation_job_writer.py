@@ -55,6 +55,7 @@ class AggregationJobWriter:
         accumulator_deltas: Optional[
             Dict[bytes, Tuple[Sequence[int], frozenset]]
         ] = None,
+        journal_entries: Optional[Dict[bytes, frozenset]] = None,
     ):
         self.task = task
         self.vdaf = vdaf
@@ -71,6 +72,13 @@ class AggregationJobWriter:
         #: StaleAccumulatorDelta — the delta must never merge a report the
         #: tx is failing).
         self.accumulator_deltas = accumulator_deltas or {}
+        #: Deferred drains (accumulator.drain_interval_s > 0): batch
+        #: identifier -> report ids whose out shares STAY resident on
+        #: device past this tx.  The writer persists one accumulator-
+        #: journal row per (job, identifier) in the same transaction and
+        #: merges NO share for those rows now — the cadence drain (or a
+        #: crash-recovery replay) merges them later against the row.
+        self.journal_entries = journal_entries or {}
         self._jobs: List[
             Tuple[AggregationJob, List[ReportAggregation], Dict[bytes, Sequence[int]]]
         ] = []
@@ -129,8 +137,33 @@ class AggregationJobWriter:
                 for ra in ras:
                     tx.update_report_aggregation(ra)
 
+            if self.journal_entries:
+                self._write_journal(tx, job, failures)
             self._accumulate(tx, job, ras, out_shares, ident_for)
         return failures
+
+    def _write_journal(self, tx, job, failures) -> None:
+        """Persist the deferred-drain journal rows IN this transaction.
+        A journaled report that was failed by the in-tx collected check
+        would leave the resident delta counting a report the tx rejects —
+        abort via StaleAccumulatorDelta (the caller discards the bucket
+        and the step redelivers, exactly like the drained-delta race)."""
+        from ..executor.accumulator import StaleAccumulatorDelta
+
+        for ident, rids in self.journal_entries.items():
+            dropped = [r for r in rids if r in failures]
+            if dropped:
+                raise StaleAccumulatorDelta(
+                    f"batch {ident!r}: {len(dropped)} journaled report(s) "
+                    "failed in-tx (batch collected)"
+                )
+            tx.put_accumulator_journal_entry(
+                self.task.task_id,
+                ident,
+                job.aggregation_parameter,
+                job.aggregation_job_id,
+                sorted(rids),
+            )
 
     # ------------------------------------------------------------------
     def _sum_shares(self, field, shares: List[Sequence[int]]) -> List[int]:
@@ -153,22 +186,28 @@ class AggregationJobWriter:
         return acc
 
     # ------------------------------------------------------------------
-    def _resolve_shares(self, field, ident, shares, rids) -> List[int]:
+    def _resolve_shares(self, field, ident, shares, rids) -> Optional[List[int]]:
         """Sum one batch's finished shares, mixing host vectors with a
-        pre-drained device-resident delta (ResidentRef rows)."""
+        pre-drained device-resident delta (ResidentRef rows).  Rows named
+        by a deferred-drain journal entry contribute NOTHING here (their
+        delta stays on device; the journal row written in this tx is what
+        guarantees it is merged later).  Returns None when every share is
+        deferred — the batch row carries count/checksum only for now."""
         from ..executor.accumulator import ResidentRef, StaleAccumulatorDelta
 
         host_rows = [s for s in shares if not isinstance(s, ResidentRef)]
         ref_rids = {
             rid for rid, s in zip(rids, shares) if isinstance(s, ResidentRef)
         }
-        if not ref_rids:
-            return self._sum_shares(field, host_rows)
+        journaled = ref_rids & set(self.journal_entries.get(ident, frozenset()))
+        need_drained = ref_rids - journaled
+        if not need_drained:
+            return self._sum_shares(field, host_rows) if host_rows else None
         delta, covered = self.accumulator_deltas.get(ident, (None, frozenset()))
-        if delta is None or set(covered) != ref_rids:
+        if delta is None or set(covered) != need_drained:
             raise StaleAccumulatorDelta(
                 f"batch {ident!r}: drained delta covers {len(covered)} "
-                f"report(s), tx needs exactly {len(ref_rids)}"
+                f"report(s), tx needs exactly {len(need_drained)}"
             )
         if not host_rows:
             return list(delta)
@@ -242,21 +281,56 @@ class AggregationJobWriter:
                 if (not self.initial_write and terminal)
                 else 0,
             )
-            existing = tx.get_batch_aggregation(
-                self.task.task_id, ident, job.aggregation_parameter, shard
-            )
-            if existing is not None:
-                tx.update_batch_aggregation(merge_batch_aggregations(field, existing, delta))
-            else:
-                try:
-                    tx.put_batch_aggregation(delta)
-                except TxConflict:
-                    fresh = tx.get_batch_aggregation(
-                        self.task.task_id, ident, job.aggregation_parameter, shard
-                    )
-                    tx.update_batch_aggregation(
-                        merge_batch_aggregations(field, fresh, delta)
-                    )
+            upsert_batch_aggregation(tx, field, delta)
+
+
+def upsert_batch_aggregation(tx: Transaction, field, delta: BatchAggregation) -> None:
+    """Merge ``delta`` into its shard row, creating it if absent (the one
+    upsert shared by the writer's accumulate path and the deferred-drain /
+    journal-replay share merges — they must never diverge)."""
+    existing = tx.get_batch_aggregation(
+        delta.task_id, delta.batch_identifier, delta.aggregation_parameter, delta.ord
+    )
+    if existing is not None:
+        tx.update_batch_aggregation(merge_batch_aggregations(field, existing, delta))
+        return
+    try:
+        tx.put_batch_aggregation(delta)
+    except TxConflict:
+        fresh = tx.get_batch_aggregation(
+            delta.task_id, delta.batch_identifier, delta.aggregation_parameter, delta.ord
+        )
+        tx.update_batch_aggregation(merge_batch_aggregations(field, fresh, delta))
+
+
+def merge_share_delta(
+    tx: Transaction,
+    task: AggregatorTask,
+    field,
+    batch_identifier: bytes,
+    aggregation_parameter: bytes,
+    vector: Sequence[int],
+    shard_count: int = 8,
+) -> None:
+    """Merge a share-ONLY delta into one random shard of a batch's
+    accumulator — the deferred-drain / journal-replay write: the covered
+    reports' count, checksum and interval were already committed by their
+    jobs' writer transactions; only the aggregate share was left resident
+    on device."""
+    delta = BatchAggregation(
+        task_id=task.task_id,
+        batch_identifier=batch_identifier,
+        aggregation_parameter=aggregation_parameter,
+        ord=random.randrange(shard_count),
+        state=BatchAggregationState.AGGREGATING,
+        aggregate_share=field.encode_vec(list(vector)),
+        report_count=0,
+        checksum=ReportIdChecksum.zero(),
+        client_timestamp_interval=Interval.EMPTY,
+        aggregation_jobs_created=0,
+        aggregation_jobs_terminated=0,
+    )
+    upsert_batch_aggregation(tx, field, delta)
 
 
 def merge_batch_aggregations(

@@ -543,12 +543,24 @@ class Aggregator:
             last_request_hash=request_hash,
         )
 
+        # Helper-side retention (ISSUE 4 satellite): finished rows carrying
+        # ResidentRefs psum into per-batch device accumulators and drain to
+        # ONE vector per batch here, BEFORE the tx — closing the PR 3 gap
+        # where the helper read its out shares back per flush.
+        decoded_by_rid = {item[0]: item for _idx, item in decoded}
+        accumulator_deltas = await self._commit_helper_resident_shares(
+            ta, job, ras, out_shares, decoded_by_rid
+        )
+
+        from ..executor.accumulator import ResidentRef, StaleAccumulatorDelta
+
         writer = AggregationJobWriter(
             task,
             ta.vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=True,
             backend=ta.backend,
+            accumulator_deltas=accumulator_deltas,
         )
         writer.put(job, ras, out_shares)
 
@@ -560,6 +572,35 @@ class Aggregator:
         except TxConflict:
             # racing identical request: return the stored response
             return await self._stored_job_resp(task_id, aggregation_job_id)
+        except StaleAccumulatorDelta:
+            # A batch was collected between the drain and the tx: the
+            # drained delta no longer matches the rows surviving the in-tx
+            # check.  The tx aborted with nothing merged; retry ONCE with
+            # oracle host vectors — the writer then fails the collected
+            # rows properly (BatchCollected) and merges only survivors.
+            loop = asyncio.get_running_loop()
+            stale = sorted(
+                rid for rid, v in out_shares.items() if isinstance(v, ResidentRef)
+            )
+            replayed = await loop.run_in_executor(
+                None,
+                lambda: self._helper_oracle_out_shares(ta, stale, decoded_by_rid),
+            )
+            out_shares.update(replayed)
+            writer = AggregationJobWriter(
+                task,
+                ta.vdaf,
+                batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+                initial_write=True,
+                backend=ta.backend,
+            )
+            writer.put(job, ras, out_shares)
+            try:
+                failures = await self.datastore.run_tx_async(
+                    "agg_init_write", lambda tx: writer.write(tx)
+                )
+            except TxConflict:
+                return await self._stored_job_resp(task_id, aggregation_job_id)
         if failures:
             resps = [
                 PrepareResp(r.report_id, PrepareStepResult.reject(failures[r.report_id.data]))
@@ -800,6 +841,7 @@ class Aggregator:
         if not rows:
             return results
         prep_in = [(nonce, public, share) for (_, nonce, public, share, _) in rows]
+        prep_out = None
         try:
             prep_out = await self._executor.submit(
                 shape_key,
@@ -807,6 +849,11 @@ class Aggregator:
                 (ta.task.vdaf_verify_key, prep_in),
                 backend=backend,
                 agg_id=1,
+                # Helper-side retention (ISSUE 4 satellite): with the
+                # accumulator store attached, the helper's out shares stay
+                # ON DEVICE and the writer consumes a drained delta
+                # instead of reading every row back.
+                retain_out_shares=self._executor.accumulator is not None,
             )
             combine_rows = []
             for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
@@ -822,8 +869,14 @@ class Aggregator:
                 backend=backend,
                 agg_id=1,
             )
+            results = await loop.run_in_executor(
+                None,
+                lambda: self._helper_finish_prio3(vdaf, results, combine_rows, combined),
+            )
         except CircuitOpenError:
-            # re-enter past the decode: (results, rows) are already built
+            # re-enter past the decode: (results, rows) are already built;
+            # any refs the prep submission minted must free first
+            self._release_helper_refs(prep_out)
             oracle = getattr(backend, "oracle", None) or backend
             return await loop.run_in_executor(
                 None,
@@ -832,11 +885,178 @@ class Aggregator:
         except ExecutorOverloadedError as e:
             from .error import ServiceUnavailable
 
+            self._release_helper_refs(prep_out)
             raise ServiceUnavailable(f"device executor overloaded: {e}")
-        return await loop.run_in_executor(
-            None,
-            lambda: self._helper_finish_prio3(vdaf, results, combine_rows, combined),
+        except BaseException:
+            # anything else — a cancelled request mid-combine, an
+            # unclassified executor failure — must not strand the minted
+            # refs, or the retained flush matrix never frees (release is
+            # idempotent, so rows a flush already released are unaffected)
+            self._release_helper_refs(prep_out)
+            raise
+        # rows whose combine/finish failed keep no out share: release their
+        # refs so the retained flush matrix can free
+        self._release_unfinished_helper_refs(results, combine_rows)
+        return results
+
+    def _release_helper_refs(self, prep_out) -> None:
+        from ..executor.accumulator import ResidentRef
+
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None or not prep_out:
+            return
+        refs = [
+            o[0].out_share
+            for o in prep_out
+            if isinstance(o, tuple) and isinstance(o[0].out_share, ResidentRef)
+        ]
+        if refs:
+            store.release_refs(refs)
+
+    def _release_unfinished_helper_refs(self, results, combine_rows) -> None:
+        from ..executor.accumulator import ResidentRef
+
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None:
+            return
+        refs = []
+        for idx, state, _ls, _hs in combine_rows:
+            out = results.get(idx)
+            if isinstance(out, tuple) and out[0] == "finished":
+                continue  # its ref lives on in out_shares; committed later
+            ref = getattr(state, "out_share", None)
+            if isinstance(ref, ResidentRef):
+                refs.append(ref)
+        if refs:
+            store.release_refs(refs)
+
+    async def _commit_helper_resident_shares(
+        self, ta: TaskAggregator, job, ras, out_shares, decoded_by_rid
+    ):
+        """Helper mirror of the driver's accumulator commit (drain-at-
+        commit only: the helper's writer runs in this request, so there is
+        no cross-job residency to defer).  On any store/device failure the
+        journaled reports are recomputed on the bit-exact CPU oracle from
+        the request's decoded shares — host vectors replace the dead refs
+        and the poisoned delta is discarded, exactly-once either way."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None:
+            return None
+        from ..datastore.query_type import strategy_for
+        from ..executor.accumulator import AccumulatorUnavailable, ResidentRef
+        from ..vdaf.backend import vdaf_shape_key
+
+        resident = {
+            rid: v for rid, v in out_shares.items() if isinstance(v, ResidentRef)
+        }
+        if not resident:
+            return None
+        task = ta.task
+        vdaf = ta.vdaf
+        shape_key = vdaf_shape_key(vdaf)
+        strategy = strategy_for(task)
+        ra_by_rid = {ra.report_id.data: ra for ra in ras}
+        field = vdaf.field_for_agg_param(
+            vdaf.decode_agg_param(job.aggregation_parameter)
         )
+
+        def ident_for(ra):
+            if job.partial_batch_identifier is not None:
+                return job.partial_batch_identifier.get_encoded()
+            return strategy.to_batch_identifier(task, ra.time)
+
+        by_ident: Dict[bytes, List[bytes]] = {}
+        for rid in resident:
+            by_ident.setdefault(ident_for(ra_by_rid[rid]), []).append(rid)
+
+        loop = asyncio.get_running_loop()
+        deltas: Dict[bytes, tuple] = {}
+        # Per-REQUEST nonce in the key, not just the job id: two identical
+        # init requests for one job can be in flight concurrently (a
+        # leader replica redelivers while the first delivery's request is
+        # still being served).  Sharing a bucket would let both commits
+        # land before either drain — a doubled vector whose report-id set
+        # still matches, which the StaleAccumulatorDelta check cannot
+        # catch and (unlike the leader) no lease-token fence aborts.  The
+        # bucket lives only within this request, so uniqueness costs
+        # nothing.
+        import secrets as _secrets
+
+        request_nonce = _secrets.token_bytes(8)
+        for ident, rids in by_ident.items():
+            bucket_key = (
+                "helper",
+                task.task_id.data,
+                shape_key,
+                ident,
+                job.aggregation_parameter,
+                job.aggregation_job_id.data,
+                request_nonce,
+            )
+            refs = [resident[rid] for rid in rids]
+
+            def commit_and_drain(bucket_key=bucket_key, refs=refs, rids=rids):
+                store.commit_rows(
+                    bucket_key,
+                    ta.backend,
+                    refs,
+                    job_token=job.aggregation_job_id.data,
+                    report_ids=rids,
+                )
+                return store.drain(bucket_key, field)
+
+            try:
+                drained = await loop.run_in_executor(None, commit_and_drain)
+            except Exception as e:
+                if not isinstance(e, AccumulatorUnavailable):
+                    logger.exception("helper accumulator commit/drain failed")
+                journal = store.discard(bucket_key)
+                store.release_refs(refs)
+                replay_rids = set(rids)
+                for _job_token, ids in journal:
+                    replay_rids |= set(ids)
+                logger.warning(
+                    "helper resident accumulator unavailable for %d "
+                    "report(s); replaying through the CPU oracle: %s",
+                    len(replay_rids),
+                    e,
+                )
+                replayed = await loop.run_in_executor(
+                    None,
+                    lambda rids=sorted(replay_rids): self._helper_oracle_out_shares(
+                        ta, rids, decoded_by_rid
+                    ),
+                )
+                out_shares.update(replayed)
+                continue
+            if drained is None:
+                continue
+            vector, drained_rids = drained
+            deltas[ident] = (vector, frozenset(drained_rids))
+        return deltas or None
+
+    def _helper_oracle_out_shares(self, ta: TaskAggregator, rids, decoded_by_rid):
+        """Bit-exact CPU recompute of the helper's out shares from the
+        request's already-decoded input shares (backend contract: oracle
+        == device, tests/test_backend.py)."""
+        from ..vdaf.backend import OracleBackend
+
+        oracle = getattr(ta.backend, "oracle", None) or OracleBackend(ta.vdaf)
+        rows = []
+        for rid in rids:
+            _rid, public_parts, input_share, _msg = decoded_by_rid[rid]
+            rows.append((rid, public_parts, input_share))
+        out = {}
+        for rid, outcome in zip(
+            rids, oracle.prep_init_batch(ta.task.vdaf_verify_key, 1, rows)
+        ):
+            if isinstance(outcome, VdafError):  # cannot happen for a report
+                raise AggregatorError(  # that already prepared successfully
+                    f"oracle replay rejected report {rid.hex()}"
+                )
+            state, _share = outcome
+            out[rid] = state.out_share
+        return out
 
     async def _stored_job_resp(
         self, task_id: TaskId, aggregation_job_id: AggregationJobId

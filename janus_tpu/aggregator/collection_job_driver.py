@@ -69,6 +69,9 @@ class CollectionDriverConfig:
     step_retry_initial_delay: Duration = Duration(1)
     step_retry_max_delay: Duration = Duration(300)
     http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
+    #: shard layout for journal-replay share merges — must match the
+    #: writers' batch_aggregation_shard_count
+    batch_aggregation_shard_count: int = 8
 
 
 class CollectionJobDriver:
@@ -100,6 +103,20 @@ class CollectionJobDriver:
         acq = lease.leased
         if lease.lease_attempts > self.config.maximum_attempts_before_failure:
             await self.abandon_collection_job(lease)
+            return
+
+        # Guaranteed drain-before-collection: outstanding accumulator-
+        # journal rows name FINISHED reports whose out shares are still
+        # resident in some (possibly dead) replica's device accumulator.
+        # Re-derive them on the bit-exact CPU oracle from the retained
+        # report_aggregations payloads and merge them now — the readiness
+        # gate below refuses to collect while any row is outstanding, so
+        # an aggregate can never be computed without these shares.
+        try:
+            await self._replay_outstanding_journal(acq)
+        except Exception as e:
+            logger.warning("accumulator journal replay failed: %s", e)
+            await self._release_retryable(lease)
             return
 
         def tx1(tx):
@@ -244,6 +261,146 @@ class CollectionJobDriver:
         await self.datastore.run_tx_async("step_collection_job_2", tx2)
 
     # ------------------------------------------------------------------
+    async def _replay_outstanding_journal(self, acq) -> None:
+        """Consume every accumulator-journal row covering this collection's
+        batches: oracle-recompute the named reports' out shares from their
+        retained report_aggregations payloads and merge ONE vector per row
+        into the batch's shard accumulators.  Row deletion and the merge
+        share a transaction, so a row is merged exactly once even when the
+        owning replica's cadence drain races this replay (the loser of the
+        DELETE drops its vector)."""
+        # cheap pre-check first: in the common (non-deferred) deployment
+        # the journal is always empty, and this one indexed COUNT is all
+        # the hot path pays — the task/job reload below runs only when
+        # there is actually something to replay
+        if not await self.datastore.run_tx_async(
+            "collect_journal_probe",
+            lambda tx: tx.count_accumulator_journal_entries(acq.task_id),
+        ):
+            return
+
+        def load(tx):
+            task = tx.get_aggregator_task(acq.task_id)
+            job = tx.get_collection_job(
+                acq.task_id, acq.collection_job_id, acq.query_type
+            )
+            if task is None or job is None:
+                return None
+            strategy = strategy_for(task)
+            entries = []
+            for ident in strategy.batch_identifiers_for_collection_identifier(
+                task, job.batch_identifier
+            ):
+                entries.extend(
+                    e
+                    for e in tx.get_accumulator_journal_entries(acq.task_id, ident)
+                    if e.aggregation_parameter == job.aggregation_parameter
+                )
+            return task, entries
+
+        loaded = await self.datastore.run_tx_async("collect_journal_scan", load)
+        if loaded is None or not loaded[1]:
+            return
+        task, entries = loaded
+        vdaf = task.vdaf_instance()
+        for entry in entries:
+            await self._replay_journal_entry(task, vdaf, entry)
+
+    async def _replay_journal_entry(self, task, vdaf, entry) -> None:
+        from ..core import faults
+        from ..vdaf.backend import OracleBackend
+        from .aggregation_job_writer import merge_share_delta
+
+        await faults.fire_async("accumulator.replay")
+        ras = await self.datastore.run_tx_async(
+            "replay_load_ras",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                task.task_id, entry.aggregation_job_id
+            ),
+        )
+        by_rid = {ra.report_id.data: ra for ra in ras}
+        rows = []
+        for rid in entry.report_ids:
+            ra = by_rid.get(rid)
+            if ra is None or ra.leader_input_share is None:
+                # the replay window was violated (payload scrubbed or row
+                # GC'd under an outstanding journal entry) — shares are
+                # unrecoverable; fail LOUDLY, never silently drop
+                raise RuntimeError(
+                    f"journal entry for job {entry.aggregation_job_id} names "
+                    f"report {rid.hex()} without a replayable payload"
+                )
+            rows.append(ra)
+        field = vdaf.field_for_agg_param(
+            vdaf.decode_agg_param(entry.aggregation_parameter)
+        )
+
+        def recompute():
+            oracle = OracleBackend(vdaf)
+            prep_in = [
+                (
+                    ra.report_id.data,
+                    vdaf.decode_public_share(ra.public_share or b""),
+                    vdaf.decode_input_share(0, ra.leader_input_share),
+                )
+                for ra in rows
+            ]
+            total = None
+            for outcome in oracle.prep_init_batch(
+                task.vdaf_verify_key, 0, prep_in
+            ):
+                if not isinstance(outcome, tuple):
+                    # a report that already prepared successfully cannot
+                    # re-reject on the bit-exact oracle; treat as data loss
+                    raise RuntimeError(f"oracle replay rejected a report: {outcome}")
+                state, _share = outcome
+                total = (
+                    list(state.out_share)
+                    if total is None
+                    else field.vec_add(total, state.out_share)
+                )
+            return total
+
+        total = await asyncio.get_running_loop().run_in_executor(None, recompute)
+
+        def tx_fn(tx):
+            # exactly-once hinges on the DELETE: whoever consumes the row
+            # merges the shares, in the same transaction
+            if not tx.delete_accumulator_journal_entry(
+                task.task_id,
+                entry.batch_identifier,
+                entry.aggregation_parameter,
+                entry.aggregation_job_id,
+            ):
+                return False
+            if total is not None:
+                merge_share_delta(
+                    tx,
+                    task,
+                    field,
+                    entry.batch_identifier,
+                    entry.aggregation_parameter,
+                    total,
+                    shard_count=self.config.batch_aggregation_shard_count,
+                )
+            return True
+
+        merged = await self.datastore.run_tx_async("journal_replay", tx_fn)
+        if merged:
+            logger.warning(
+                "oracle-replayed %d report(s) of job %s from the datastore "
+                "journal (owner never drained — crashed or raced)",
+                len(entry.report_ids),
+                entry.aggregation_job_id,
+            )
+            from ..core.metrics import GLOBAL_METRICS
+
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.accumulator_journal_consumed.labels(
+                    path="replay"
+                ).inc()
+
+    # ------------------------------------------------------------------
     async def _release_retryable(self, lease: Lease) -> None:
         """Retryable-failure budget + exponential lease-backoff (the
         aggregation driver's curve, shared via step_retry_delay): release
@@ -286,6 +443,16 @@ class CollectionJobDriver:
         for ident in strategy.batch_identifiers_for_collection_identifier(
             task, job.batch_identifier
         ):
+            # Deferred-drain fence: an outstanding accumulator-journal row
+            # means counted reports whose shares are not yet merged —
+            # collecting now would compute a wrong aggregate.  The
+            # pre-step replay consumes these; re-checking INSIDE the
+            # readiness transaction closes the race with a job committing
+            # a new row between the replay and this step.
+            if tx.count_accumulator_journal_entries_for_batch(
+                task.task_id, ident, job.aggregation_parameter
+            ):
+                return False
             # counters are sharded: a job's created/terminated increments may
             # land on different shards, so compare per-batch sums
             # (reference: models.rs:1421 counters summed over shards)

@@ -49,6 +49,9 @@ class JobDriver:
         max_concurrent_job_workers: int = 10,
         worker_lease_duration: Duration = Duration(600),
         worker_lease_clock_skew_allowance: Duration = Duration(60),
+        reaper: Optional[Callable[[], Awaitable[int]]] = None,
+        lease_reap_interval: float = 10.0,
+        job_type: str = "job",
     ):
         self.clock = clock
         self.acquirer = acquirer
@@ -57,13 +60,53 @@ class JobDriver:
         self.max_concurrent_job_workers = max_concurrent_job_workers
         self.worker_lease_duration = worker_lease_duration
         self.worker_lease_clock_skew_allowance = worker_lease_clock_skew_allowance
+        #: Expired-lease reaper (crash recovery): an async callable that
+        #: clears the lease tokens of jobs whose lease expired WITHOUT
+        #: release (their holder died or wedged) and returns the count —
+        #: each one is counted into janus_job_leases_expired_total.  The
+        #: jobs were already re-acquirable (acquisition scans on expiry);
+        #: reaping makes the death visible and the redelivery prompt.
+        self.reaper = reaper
+        self.lease_reap_interval = lease_reap_interval
+        self.job_type = job_type
+        self._last_reap = 0.0
         self._inflight: set = set()
+
+    async def _maybe_reap(self) -> None:
+        import time as _time
+
+        if self.reaper is None:
+            return
+        now = _time.monotonic()
+        if now - self._last_reap < self.lease_reap_interval:
+            return
+        self._last_reap = now
+        try:
+            count = await self.reaper()
+        except Exception:
+            logger.exception("lease reaper pass failed")
+            return
+        if not count:
+            return
+        logger.warning(
+            "reaped %d expired %s lease(s) (holder died or wedged); "
+            "redelivering",
+            count,
+            self.job_type,
+        )
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.job_leases_expired.labels(job_type=self.job_type).inc(
+                count
+            )
 
     async def run(self, stop: asyncio.Event) -> None:
         """Run until ``stop`` is set, then drain in-flight steppers
         (reference: job_driver.rs:100-149)."""
         sem = asyncio.Semaphore(self.max_concurrent_job_workers)
         while not stop.is_set():
+            await self._maybe_reap()
             free = self.max_concurrent_job_workers - len(self._inflight)
             leases: List[Lease] = []
             if free > 0:

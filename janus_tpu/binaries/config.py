@@ -136,11 +136,22 @@ class AccumulatorStoreConfig:
     #: resident-byte cap (flush matrices + bucket buffers); LRU state
     #: spills to host mirrors beyond it.  <= 0 disables eviction.
     byte_budget: int = 256 << 20
+    #: Deferred drains: 0 (default) drains every bucket at job commit;
+    #: > 0 accumulates across jobs and drains buckets once they are this
+    #: old.  Each contributing job persists an accumulator_journal row in
+    #: its commit transaction, so a crashed replica's un-drained deltas
+    #: are re-derived from the datastore by the collection-time oracle
+    #: replay (guaranteed drain-before-collection).
+    drain_interval_s: float = 0.0
 
     def to_accumulator_config(self):
         from ..executor.accumulator import AccumulatorConfig
 
-        return AccumulatorConfig(enabled=self.enabled, byte_budget=self.byte_budget)
+        return AccumulatorConfig(
+            enabled=self.enabled,
+            byte_budget=self.byte_budget,
+            drain_interval_s=self.drain_interval_s,
+        )
 
 
 @dataclass
@@ -213,6 +224,10 @@ class JobDriverConfig:
     #: exponential lease-backoff curve between retryable redeliveries
     retry_initial_delay_s: float = 1.0
     retry_max_delay_s: float = 300.0
+    #: expired-lease reaper cadence (crash recovery): clears lease tokens
+    #: whose holder died without releasing, counting each into
+    #: janus_job_leases_expired_total; <= 0 disables the reaper
+    lease_reap_interval_s: float = 10.0
 
 
 @dataclass

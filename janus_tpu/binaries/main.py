@@ -238,6 +238,19 @@ def run_aggregator(config_path: Optional[str]) -> None:
         for t in tasks:
             t.cancel()
         await agg.shutdown()
+        if cfg.device_executor.enabled:
+            # This binary owns the process-wide executor: flush pending
+            # mega-batches, then spill any resident accumulator state
+            # before teardown (graceful path; crashes take discard+replay).
+            from ..executor import peek_global_executor
+
+            ex = peek_global_executor()
+            if ex is not None:
+                try:
+                    await ex.drain()
+                except Exception:
+                    logger.exception("executor drain failed during shutdown")
+                ex.shutdown(drain=True)
         await runner.cleanup()
         await health.cleanup()
 
@@ -351,7 +364,14 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 lambda tx: tx.acquire_incomplete_aggregation_jobs(duration, limit),
             )
 
+        async def reaper():
+            return await datastore.run_tx_async(
+                "reap_agg_leases",
+                lambda tx: tx.reap_expired_aggregation_job_leases(),
+            )
+
         stepper = stepper_impl.step_aggregation_job
+        job_type = "aggregation"
     else:
         from ..aggregator.collection_job_driver import CollectionDriverConfig
 
@@ -361,6 +381,7 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             CollectionDriverConfig(
                 maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
                 max_step_attempts=cfg.job_driver.max_step_attempts,
+                batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
                 # the shared retry knobs configure the FAILURE backoff; the
                 # readiness-poll curve keeps its own (reference) defaults
                 step_retry_initial_delay=Duration(
@@ -376,7 +397,14 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 lambda tx: tx.acquire_incomplete_collection_jobs(duration, limit),
             )
 
+        async def reaper():
+            return await datastore.run_tx_async(
+                "reap_coll_leases",
+                lambda tx: tx.reap_expired_collection_job_leases(),
+            )
+
         stepper = stepper_impl.step_collection_job
+        job_type = "collection"
 
     driver = JobDriver(
         clock,
@@ -388,6 +416,9 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
         worker_lease_clock_skew_allowance=Duration(
             cfg.job_driver.worker_lease_clock_skew_allowance_s
         ),
+        reaper=reaper if cfg.job_driver.lease_reap_interval_s > 0 else None,
+        lease_reap_interval=cfg.job_driver.lease_reap_interval_s,
+        job_type=job_type,
     )
 
     async def main():
@@ -395,6 +426,15 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
         stop = _stop_event_on_signals(loop)
         health = await _serve_health(cfg.common.health_check_listen_address)
         await driver.run(stop)
+        # Graceful teardown (SIGTERM): in-flight steps have drained and
+        # released their leases in-tx; now flush the executor's pending
+        # mega-batches and spill committed-but-unspilled accumulator
+        # deltas durably (the journal transaction), so ONLY a genuine
+        # crash ever takes the discard-and-replay path.
+        if kind == "aggregation":
+            await stepper_impl.shutdown()
+        else:
+            await stepper_impl.close()
         await health.cleanup()
 
     asyncio.run(main())

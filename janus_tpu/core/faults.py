@@ -24,6 +24,7 @@ tests/test_chaos.py cross-checks them):
     ``key_rotator.run``      at the head of an HpkeKeyRotator tick
     ``accumulator.spill``    before an accumulator bucket's drain readback
     ``accumulator.evict``    before an LRU eviction spills state to host
+    ``accumulator.replay``   before a collection-time journal replay
 
 Modes: ``error`` raises :class:`FaultInjectedError`, ``delay`` sleeps
 ``delay_s``, ``hang`` sleeps ``hang_s`` (long enough to trip whatever
@@ -68,6 +69,10 @@ KNOWN_POINTS = (
     # exercises mid-spill failures (oracle replay, no double count)
     "accumulator.spill",
     "accumulator.evict",
+    # collection-time journal replay (collection_job_driver.py): a
+    # survivor re-deriving a dead replica's un-drained shares must itself
+    # be crash-safe (the replay tx is the exactly-once point)
+    "accumulator.replay",
 )
 
 MODES = ("error", "delay", "hang", "skew")

@@ -209,6 +209,30 @@ class Metrics:
             "LRU/memory-pressure evictions of resident accumulator state",
             registry=self.registry,
         )
+        # Crash recovery: leases that expired WITHOUT release are holders
+        # that died or wedged — the reaper (job_driver.py) clears them so
+        # redelivery is prompt and the death is visible on a dashboard.
+        self.job_leases_expired = Counter(
+            "janus_job_leases_expired_total",
+            "Job leases that expired without release (holder died/wedged), by job type",
+            ["job_type"],
+            registry=self.registry,
+        )
+        # Deferred-drain journal (datastore accumulator_journal table):
+        # persisted entries per outcome — 'drain' is the owner's cadence/
+        # shutdown spill consuming its own rows, 'replay' is a survivor
+        # re-deriving a dead replica's rows on the CPU oracle.
+        self.accumulator_journal_entries = Counter(
+            "janus_accumulator_journal_entries_total",
+            "Accumulator journal rows written (deferred resident drains)",
+            registry=self.registry,
+        )
+        self.accumulator_journal_consumed = Counter(
+            "janus_accumulator_journal_consumed_total",
+            "Accumulator journal rows consumed, by path (drain|replay)",
+            ["path"],
+            registry=self.registry,
+        )
         # Fault injection (core/faults.py): every injected fault is counted
         # so a chaos run's pressure is itself observable.
         self.faults_injected = Counter(

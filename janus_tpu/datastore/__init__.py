@@ -15,6 +15,7 @@ from .datastore import (
     TxConflict,
 )
 from .models import (
+    AccumulatorJournalEntry,
     AcquiredAggregationJob,
     AcquiredCollectionJob,
     AggregateShareJob,

@@ -150,7 +150,14 @@ class SqliteBackend:
     table_exists_sql = "SELECT 1 FROM sqlite_master WHERE type='table' AND name = ?"
 
     def __init__(self, path: str):
+        import sqlite3
+
         self.path = path
+        #: RETURNING needs SQLite >= 3.35; older libs (Debian bullseye
+        #: ships 3.34) take the select-then-mutate fallback paths in
+        #: datastore.py — equivalent under BEGIN IMMEDIATE's single
+        #: writer, just two statements instead of one.
+        self.supports_returning = sqlite3.sqlite_version_info >= (3, 35)
 
     def connect(self):
         import sqlite3
@@ -226,6 +233,8 @@ class PostgresBackend:
     table_exists_sql = (
         "SELECT 1 FROM pg_tables WHERE schemaname = 'public' AND tablename = ?"
     )
+    #: Postgres has supported RETURNING since 8.2.
+    supports_returning = True
 
     def __init__(self, dsn: str):
         self.dsn = dsn

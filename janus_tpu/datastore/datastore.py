@@ -63,6 +63,7 @@ from ..messages import (
 from ..messages.codec import Decoder, Encoder
 from .crypter import Crypter
 from .models import (
+    AccumulatorJournalEntry,
     AcquiredAggregationJob,
     AcquiredCollectionJob,
     AggregateShareJob,
@@ -353,6 +354,7 @@ class Transaction:
                 "aggregator_auth_token",
                 task.aggregator_auth_token.as_bytes(),
             )
+        returning = self.ds.backend.supports_returning
         try:
             cur = self.conn.execute(
                 """INSERT INTO tasks (task_id, aggregator_role,
@@ -362,8 +364,8 @@ class Transaction:
                     aggregator_auth_token_type, aggregator_auth_token,
                     aggregator_auth_token_hash, collector_auth_token_hash,
                     created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)
-                   RETURNING id""",
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"""
+                + (" RETURNING id" if returning else ""),
                 (
                     task.task_id.data,
                     task.role.name.capitalize() if isinstance(task.role, Role) else str(task.role),
@@ -392,9 +394,10 @@ class Transaction:
             )
         except self.ds.backend.integrity_errors as e:
             raise TxConflict(f"task {task.task_id} already exists") from e
-        # RETURNING id works on both dialects; cursor.lastrowid does not
-        # (psycopg has no usable lastrowid for PG tables).
-        pk = cur.fetchone()[0]
+        # RETURNING id works on both dialects (cursor.lastrowid does not:
+        # psycopg has no usable lastrowid for PG tables); pre-3.35 SQLite
+        # lacks RETURNING but its lastrowid is reliable.
+        pk = cur.fetchone()[0] if returning else cur.lastrowid
         for kp in task.hpke_keys:
             enc_sk = self.crypter.encrypt(
                 "task_hpke_keys", task.task_id.data, "private_key", kp.private_key
@@ -581,15 +584,30 @@ class Transaction:
         index).  Claimed reports must be assigned to jobs or released via
         ``mark_reports_unaggregated``."""
         pk = self._task_pk(task_id)
-        rows = self.conn.execute(
-            """UPDATE client_reports SET aggregation_started = 1
-               WHERE id IN (
-                   SELECT id FROM client_reports
+        if self.ds.backend.supports_returning:
+            rows = self.conn.execute(
+                """UPDATE client_reports SET aggregation_started = 1
+                   WHERE id IN (
+                       SELECT id FROM client_reports
+                       WHERE task_id = ? AND aggregation_started = 0
+                       ORDER BY client_timestamp LIMIT ?)
+                   RETURNING report_id, client_timestamp""",
+                (pk, limit),
+            ).fetchall()
+        else:
+            # select-then-mutate fallback (pre-3.35 SQLite): atomic under
+            # BEGIN IMMEDIATE's single writer
+            picked = self.conn.execute(
+                """SELECT id, report_id, client_timestamp FROM client_reports
                    WHERE task_id = ? AND aggregation_started = 0
-                   ORDER BY client_timestamp LIMIT ?)
-               RETURNING report_id, client_timestamp""",
-            (pk, limit),
-        ).fetchall()
+                   ORDER BY client_timestamp LIMIT ?""",
+                (pk, limit),
+            ).fetchall()
+            self.conn.executemany(
+                "UPDATE client_reports SET aggregation_started = 1 WHERE id = ?",
+                [(r[0],) for r in picked],
+            )
+            rows = [(r[1], r[2]) for r in picked]
         return [ReportMetadata(ReportId(r[0]), Time(r[1])) for r in rows]
 
     def mark_reports_unaggregated(
@@ -811,17 +829,33 @@ class Transaction:
         now = self._now_s()
         expiry = now + lease_duration.seconds
         token = secrets.token_bytes(16)
-        rows = self.conn.execute(
-            """UPDATE aggregation_jobs
-               SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
-                   updated_at = ?
-               WHERE id IN (
-                   SELECT id FROM aggregation_jobs
+        if self.ds.backend.supports_returning:
+            rows = self.conn.execute(
+                """UPDATE aggregation_jobs
+                   SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
+                       updated_at = ?
+                   WHERE id IN (
+                       SELECT id FROM aggregation_jobs
+                       WHERE state = 'InProgress' AND lease_expiry <= ?
+                       ORDER BY id LIMIT ? /*skip-locked*/)
+                   RETURNING task_id, aggregation_job_id, lease_attempts""",
+                (expiry, token, now, now, limit),
+            ).fetchall()
+        else:
+            picked = self.conn.execute(
+                """SELECT id, task_id, aggregation_job_id, lease_attempts
+                   FROM aggregation_jobs
                    WHERE state = 'InProgress' AND lease_expiry <= ?
-                   ORDER BY id LIMIT ? /*skip-locked*/)
-               RETURNING task_id, aggregation_job_id, lease_attempts""",
-            (expiry, token, now, now, limit),
-        ).fetchall()
+                   ORDER BY id LIMIT ?""",
+                (now, limit),
+            ).fetchall()
+            self.conn.executemany(
+                """UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = ?,
+                     lease_attempts = lease_attempts + 1, updated_at = ?
+                   WHERE id = ?""",
+                [(expiry, token, now, r[0]) for r in picked],
+            )
+            rows = [(r[1], r[2], r[3] + 1) for r in picked]
         leases = []
         for task_pk, job_id, attempts in rows:
             trow = self.conn.execute(
@@ -1386,12 +1420,28 @@ class Transaction:
         self, task_id: TaskId, collection_job_id: CollectionJobId
     ) -> int:
         pk = self._task_pk(task_id)
-        row = self.conn.execute(
-            """UPDATE collection_jobs SET step_attempts = step_attempts + 1
-               WHERE task_id = ? AND collection_job_id = ?
-               RETURNING step_attempts""",
-            (pk, collection_job_id.data),
-        ).fetchone()
+        if self.ds.backend.supports_returning:
+            row = self.conn.execute(
+                """UPDATE collection_jobs SET step_attempts = step_attempts + 1
+                   WHERE task_id = ? AND collection_job_id = ?
+                   RETURNING step_attempts""",
+                (pk, collection_job_id.data),
+            ).fetchone()
+        else:
+            cur = self.conn.execute(
+                """UPDATE collection_jobs SET step_attempts = step_attempts + 1
+                   WHERE task_id = ? AND collection_job_id = ?""",
+                (pk, collection_job_id.data),
+            )
+            row = (
+                self.conn.execute(
+                    "SELECT step_attempts FROM collection_jobs"
+                    " WHERE task_id = ? AND collection_job_id = ?",
+                    (pk, collection_job_id.data),
+                ).fetchone()
+                if cur.rowcount
+                else None
+            )
         if row is None:
             raise DatastoreError(f"no collection job {collection_job_id}")
         return row[0]
@@ -1403,17 +1453,33 @@ class Transaction:
         now = self._now_s()
         expiry = now + lease_duration.seconds
         token = secrets.token_bytes(16)
-        rows = self.conn.execute(
-            """UPDATE collection_jobs
-               SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
-                   updated_at = ?
-               WHERE id IN (
-                   SELECT id FROM collection_jobs
+        if self.ds.backend.supports_returning:
+            rows = self.conn.execute(
+                """UPDATE collection_jobs
+                   SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
+                       updated_at = ?
+                   WHERE id IN (
+                       SELECT id FROM collection_jobs
+                       WHERE state = 'Start' AND lease_expiry <= ?
+                       ORDER BY id LIMIT ? /*skip-locked*/)
+                   RETURNING task_id, collection_job_id, lease_attempts, step_attempts""",
+                (expiry, token, now, now, limit),
+            ).fetchall()
+        else:
+            picked = self.conn.execute(
+                """SELECT id, task_id, collection_job_id, lease_attempts, step_attempts
+                   FROM collection_jobs
                    WHERE state = 'Start' AND lease_expiry <= ?
-                   ORDER BY id LIMIT ? /*skip-locked*/)
-               RETURNING task_id, collection_job_id, lease_attempts, step_attempts""",
-            (expiry, token, now, now, limit),
-        ).fetchall()
+                   ORDER BY id LIMIT ?""",
+                (now, limit),
+            ).fetchall()
+            self.conn.executemany(
+                """UPDATE collection_jobs SET lease_expiry = ?, lease_token = ?,
+                     lease_attempts = lease_attempts + 1, updated_at = ?
+                   WHERE id = ?""",
+                [(expiry, token, now, r[0]) for r in picked],
+            )
+            rows = [(r[1], r[2], r[3] + 1, r[4]) for r in picked]
         leases = []
         for task_pk, job_id, attempts, step_attempts in rows:
             trow = self.conn.execute(
@@ -1622,19 +1688,38 @@ class Transaction:
         self, task_id: TaskId, expiry: Time, limit: int
     ) -> int:
         """Delete aggregation jobs (and their report aggregations, via
-        cascade) whose entire client-timestamp interval is before expiry."""
+        cascade) whose entire client-timestamp interval is before expiry.
+        Jobs with an OUTSTANDING accumulator-journal row are skipped:
+        their FINISHED rows' retained payloads are the only material the
+        journal replay can re-derive the missing shares from — deleting
+        them would either wedge the batch's readiness gate (row kept) or
+        silently corrupt its aggregate (row dropped with the count
+        already committed).  The replay consumes the row, and the next
+        GC pass collects the job."""
         pk = self._task_pk(task_id)
         cur = self.conn.execute(
             """DELETE FROM aggregation_jobs WHERE id IN (
-                 SELECT id FROM aggregation_jobs
-                 WHERE task_id = ?
-                   AND client_timestamp_interval_start
-                       + client_timestamp_interval_duration < ?
-                   AND state != 'InProgress'
+                 SELECT j.id FROM aggregation_jobs j
+                 WHERE j.task_id = ?
+                   AND j.client_timestamp_interval_start
+                       + j.client_timestamp_interval_duration < ?
+                   AND j.state != 'InProgress'
+                   AND NOT EXISTS (
+                     SELECT 1 FROM accumulator_journal aj
+                     WHERE aj.task_id = j.task_id
+                       AND aj.aggregation_job_id = j.aggregation_job_id)
                  LIMIT ?)""",
             (pk, expiry.seconds, limit),
         )
         return cur.rowcount
+
+    def count_accumulator_journal_entries(self, task_id: TaskId) -> int:
+        """Task-wide outstanding-row count (one indexed COUNT — the
+        collection driver's cheap pre-replay probe)."""
+        pk = self._task_pk(task_id)
+        return self.conn.execute(
+            "SELECT COUNT(*) FROM accumulator_journal WHERE task_id = ?", (pk,)
+        ).fetchone()[0]
 
     def delete_expired_collection_artifacts(
         self, task_id: TaskId, expiry: Time, limit: int
@@ -1843,6 +1928,139 @@ class Transaction:
         )
         if cur.rowcount == 0:
             raise DatastoreError("no such taskprov peer")
+
+    # ------------------------------------------------------------------
+    # lease reaping (crash recovery: a killed replica's leases expire and
+    # are re-acquirable anyway, but reaping makes the redelivery PROMPT
+    # and — more importantly — observable: each reaped row is a lease that
+    # expired without release, i.e. a holder that died or wedged)
+
+    def reap_expired_aggregation_job_leases(self) -> int:
+        """Clear the lease token of every InProgress aggregation job whose
+        lease expired without being released (the holder never came back).
+        Returns the number of reaped leases.  ``lease_attempts`` is left
+        untouched — it was incremented at acquire time, so the
+        delivery-count budgets survive the holder's death."""
+        cur = self.conn.execute(
+            """UPDATE aggregation_jobs SET lease_token = NULL, lease_expiry = 0
+               WHERE state = 'InProgress' AND lease_token IS NOT NULL
+                 AND lease_expiry <= ?""",
+            (self._now_s(),),
+        )
+        return cur.rowcount
+
+    def reap_expired_collection_job_leases(self) -> int:
+        cur = self.conn.execute(
+            """UPDATE collection_jobs SET lease_token = NULL, lease_expiry = 0
+               WHERE state = 'Start' AND lease_token IS NOT NULL
+                 AND lease_expiry <= ?""",
+            (self._now_s(),),
+        )
+        return cur.rowcount
+
+    # ------------------------------------------------------------------
+    # accumulator journal (deferred device-resident drains; see
+    # executor/accumulator.py and schema.py _ACCUMULATOR_JOURNAL_SCHEMA)
+
+    def put_accumulator_journal_entry(
+        self,
+        task_id: TaskId,
+        batch_identifier: bytes,
+        aggregation_parameter: bytes,
+        aggregation_job_id: AggregationJobId,
+        report_ids: Sequence[bytes],
+    ) -> None:
+        """Record one job's un-drained resident delta.  Must run in the
+        SAME transaction as the writer commit that records these reports
+        Finished — the journal row and the FINISHED states are one fact."""
+        pk = self._task_pk(task_id)
+        try:
+            self.conn.execute(
+                """INSERT INTO accumulator_journal (task_id, batch_identifier,
+                    aggregation_param, aggregation_job_id, report_ids, created_at)
+                   VALUES (?,?,?,?,?,?)""",
+                (
+                    pk,
+                    batch_identifier,
+                    aggregation_parameter,
+                    aggregation_job_id.data,
+                    b"".join(report_ids),
+                    self._now_s(),
+                ),
+            )
+        except self.ds.backend.integrity_errors as e:
+            raise TxConflict(
+                f"accumulator journal entry for job {aggregation_job_id} exists"
+            ) from e
+
+    def get_accumulator_journal_entries(
+        self, task_id: TaskId, batch_identifier: Optional[bytes] = None
+    ) -> List[AccumulatorJournalEntry]:
+        pk = self._task_pk(task_id)
+        sql = """SELECT batch_identifier, aggregation_param, aggregation_job_id,
+                        report_ids, created_at
+                 FROM accumulator_journal WHERE task_id = ?"""
+        args: List[Any] = [pk]
+        if batch_identifier is not None:
+            sql += " AND batch_identifier = ?"
+            args.append(batch_identifier)
+        sql += " ORDER BY id"
+        out = []
+        for ident, param, job_id, rids_b, created in self.conn.execute(sql, args):
+            out.append(
+                AccumulatorJournalEntry(
+                    task_id=task_id,
+                    batch_identifier=ident,
+                    aggregation_parameter=param,
+                    aggregation_job_id=AggregationJobId(job_id),
+                    report_ids=tuple(
+                        rids_b[i : i + 16] for i in range(0, len(rids_b), 16)
+                    ),
+                    created_at=Time(created),
+                )
+            )
+        return out
+
+    def count_accumulator_journal_entries_for_batch(
+        self,
+        task_id: TaskId,
+        batch_identifier: bytes,
+        aggregation_parameter: Optional[bytes] = None,
+    ) -> int:
+        """Collection readiness input: >0 means counted reports whose
+        shares are not yet merged into batch_aggregations.  Filter by
+        aggregation parameter when gating ONE parameter's collection —
+        another parameter's outstanding rows do not affect its
+        accumulators (and the replay only consumes matching rows)."""
+        pk = self._task_pk(task_id)
+        sql = (
+            "SELECT COUNT(*) FROM accumulator_journal"
+            " WHERE task_id = ? AND batch_identifier = ?"
+        )
+        args: List[Any] = [pk, batch_identifier]
+        if aggregation_parameter is not None:
+            sql += " AND aggregation_param = ?"
+            args.append(aggregation_parameter)
+        return self.conn.execute(sql, args).fetchone()[0]
+
+    def delete_accumulator_journal_entry(
+        self,
+        task_id: TaskId,
+        batch_identifier: bytes,
+        aggregation_parameter: bytes,
+        aggregation_job_id: AggregationJobId,
+    ) -> bool:
+        """Consume one journal row; returns False when it was already
+        consumed (a drain and a crash-recovery replay raced — the loser
+        MUST NOT merge its vector, or the delta double-counts)."""
+        pk = self._task_pk(task_id)
+        cur = self.conn.execute(
+            """DELETE FROM accumulator_journal
+               WHERE task_id = ? AND batch_identifier = ?
+                 AND aggregation_param = ? AND aggregation_job_id = ?""",
+            (pk, batch_identifier, aggregation_parameter, aggregation_job_id.data),
+        )
+        return cur.rowcount > 0
 
     # ------------------------------------------------------------------
     # upload counters (reference: datastore.rs:5326-5429)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import enum
 import secrets
 from dataclasses import dataclass, field, replace
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 from ..messages import (
     AggregationJobId,
@@ -389,3 +389,27 @@ class TaskUploadCounter:
         "report_too_early",
         "task_expired",
     )
+
+
+# --------------------------------------------------------------------------
+# Accumulator journal (deferred device-resident drains)
+
+
+@dataclass(frozen=True)
+class AccumulatorJournalEntry:
+    """One aggregation job's contribution to a device-resident accumulator
+    bucket that has not been drained into ``batch_aggregations`` yet.
+
+    Persisted in the same transaction that records the reports Finished
+    (aggregation_job_writer.py), so after a process death the surviving
+    replicas can enumerate exactly which counted reports still lack their
+    share merge and re-derive them on the bit-exact CPU oracle from the
+    retained ``report_aggregations`` payloads (collection_job_driver.py
+    replay path)."""
+
+    task_id: TaskId
+    batch_identifier: bytes
+    aggregation_parameter: bytes
+    aggregation_job_id: AggregationJobId
+    report_ids: Tuple[bytes, ...]
+    created_at: Time

@@ -234,9 +234,34 @@ CREATE TABLE IF NOT EXISTS task_upload_counters (
 );
 """
 
+_ACCUMULATOR_JOURNAL_SCHEMA = """
+-- Device-resident accumulator journal (executor/accumulator.py): one row
+-- per (aggregation job, batch) whose FINISHED reports' out shares are
+-- still resident in some replica's device accumulator (deferred drains).
+-- Written in the SAME transaction as the AggregationJobWriter commit that
+-- records the reports Finished; deleted by the drain transaction that
+-- merges the resident delta into batch_aggregations, or by the
+-- collection-time oracle replay that re-derives the shares from the
+-- retained report_aggregations payloads after the owning process died.
+-- An outstanding row therefore means exactly: "these reports are counted
+-- but their shares are not yet in batch_aggregations".
+CREATE TABLE IF NOT EXISTS accumulator_journal (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    batch_identifier BLOB NOT NULL,
+    aggregation_param BLOB NOT NULL,
+    aggregation_job_id BLOB NOT NULL,
+    report_ids BLOB NOT NULL,                   -- concatenated 16-byte ids
+    created_at INTEGER NOT NULL,
+    UNIQUE(task_id, batch_identifier, aggregation_param, aggregation_job_id)
+);
+CREATE INDEX IF NOT EXISTS accumulator_journal_by_batch
+    ON accumulator_journal(task_id, batch_identifier);
+"""
+
 #: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
 #: edit an entry that has shipped (existing stores have already applied it).
-MIGRATIONS = [_INITIAL_SCHEMA]
+MIGRATIONS = [_INITIAL_SCHEMA, _ACCUMULATOR_JOURNAL_SCHEMA]
 
 SCHEMA_VERSION = len(MIGRATIONS)
 

@@ -24,6 +24,7 @@ from .service import (
     ExecutorOverloadedError,
     bucket_label,
     get_global_executor,
+    peek_global_executor,
     reset_global_executor,
     shape_label,
 )
@@ -44,6 +45,7 @@ __all__ = [
     "StaleAccumulatorDelta",
     "bucket_label",
     "get_global_executor",
+    "peek_global_executor",
     "reset_global_executor",
     "shape_label",
 ]

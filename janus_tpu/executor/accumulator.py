@@ -98,6 +98,18 @@ class AccumulatorConfig:
     #: resident-byte cap across flush matrices + bucket buffers; beyond it
     #: LRU state spills to host mirrors.  <= 0 disables eviction.
     byte_budget: int = 256 << 20
+    #: Deferred drains: 0 (default) drains every bucket at its job's
+    #: commit (residency window = one step, nothing survives the tx).
+    #: > 0 lets a bucket accumulate across jobs and drains it once it is
+    #: this old — each contributing job persists an accumulator-journal
+    #: row in its commit tx (datastore ``accumulator_journal``), so a
+    #: crash between commit and drain is recoverable: survivors replay
+    #: the journaled reports through the CPU oracle from the datastore.
+    drain_interval_s: float = 0.0
+
+    @property
+    def deferred(self) -> bool:
+        return self.drain_interval_s > 0
 
 
 class _Flush:
@@ -138,6 +150,10 @@ class _Bucket:
         #: land rows in a buffer that has already been read
         self.closed = False
         self.last_used = time.monotonic()
+        #: first-commit time: deferred drains fire once the bucket is
+        #: drain_interval_s old (age of the OLDEST un-drained delta, so no
+        #: journal row waits longer than one interval under steady traffic)
+        self.created_at = time.monotonic()
         #: serializes device ops against this bucket's buffer (a commit
         #: racing an eviction or drain must never double- or under-count)
         self.oplock = threading.Lock()
@@ -284,8 +300,28 @@ class DeviceAccumulatorStore:
         """Commit-time spill: read back the bucket's resident sum as ONE
         field vector, clear the bucket + journal, and return
         ``(vector, journaled report ids)``.  Returns None when the bucket
-        holds nothing.  The named fault point ``accumulator.spill`` fires
-        here so chaos runs exercise mid-spill failures."""
+        holds nothing."""
+        out = self.drain_with_journal(bucket_key, field)
+        if out is None:
+            return None
+        vector, journal = out
+        rids: Set[bytes] = set()
+        for _job, ids in journal:
+            rids |= ids
+        return vector, rids
+
+    def drain_with_journal(
+        self, bucket_key: tuple, field
+    ) -> Optional[Tuple[List[int], List[Tuple[object, frozenset]]]]:
+        """Like :meth:`drain`, but returns the per-job journal entries
+        ``[(job_token, frozenset(report_ids)), ...]`` instead of the flat
+        id set — the deferred-drain transaction consumes the persisted
+        ``accumulator_journal`` rows at job granularity, and may only
+        merge the vector if EVERY entry's row is still present (a missing
+        row means a crash-recovery replay already merged that job's
+        shares; merging the vector then would double-count them).
+        The named fault point ``accumulator.spill`` fires here so chaos
+        runs exercise mid-spill failures."""
         with self._lock:
             bucket = self._buckets.pop(bucket_key, None)
             if bucket is not None:
@@ -319,15 +355,12 @@ class DeviceAccumulatorStore:
                     self.resident_bytes += bucket.buffer_nbytes
                 raise AccumulatorUnavailable(f"spill readback failed: {e}") from e
             journal = list(bucket.journal)
-        rids: Set[bytes] = set()
-        for _job, ids in journal:
-            rids |= ids
         with self._lock:
             self.spills += 1
         self._observe(spill_reason="commit")
         if vector is None:
             return None
-        return vector, rids
+        return vector, journal
 
     def discard(self, bucket_key: tuple) -> List[Tuple[object, frozenset]]:
         """Drop a (typically poisoned) bucket's device state WITHOUT
@@ -419,10 +452,27 @@ class DeviceAccumulatorStore:
         self._observe(evicted=True)
 
     # -- lifecycle / introspection --------------------------------------
+    def due_buckets(self, max_age_s: float) -> List[tuple]:
+        """Keys of buckets whose oldest un-drained delta is older than
+        ``max_age_s`` — the deferred-drain cadence scan."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                b.key
+                for b in self._buckets.values()
+                if now - b.created_at >= max_age_s
+            ]
+
+    def bucket_keys(self) -> List[tuple]:
+        with self._lock:
+            return list(self._buckets)
+
     def drain_all(self, sink) -> None:
-        """Drain every bucket into ``sink(key, vector, rids)`` (callers
-        that can merge the vectors somewhere durable); buckets whose drain
-        fails are discarded with a warning."""
+        """Drain every bucket into ``sink(key, vector, journal_entries)``
+        (callers that can merge the vectors somewhere durable — the
+        graceful-shutdown spill); buckets whose drain OR sink fails are
+        discarded with a warning — their persisted journal rows, if any,
+        make the loss recoverable via the collection-time replay."""
         with self._lock:
             keys = list(self._buckets)
         for key in keys:
@@ -431,11 +481,13 @@ class DeviceAccumulatorStore:
                     backend = self._buckets[key].backend if key in self._buckets else None
                 if backend is None:
                     continue
-                out = self.drain(key, backend.vdaf.flp.field)
+                out = self.drain_with_journal(key, backend.vdaf.flp.field)
                 if out is not None:
                     sink(key, out[0], out[1])
-            except AccumulatorError:
-                logger.warning("drain_all failed for bucket %r; discarding", key)
+            except Exception:
+                logger.warning(
+                    "drain_all failed for bucket %r; discarding", key, exc_info=True
+                )
                 self.discard(key)
 
     def discard_all(self) -> None:

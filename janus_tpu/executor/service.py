@@ -320,10 +320,21 @@ class DeviceExecutor:
         # Device-resident accumulator store (out-share residency).
         acc_cfg = self.config.accumulator
         self.accumulator = None
+        #: durable spill target for shutdown(drain=True): called as
+        #: sink(bucket_key, vector, journal_entries); registered by the
+        #: component that can write the datastore (the job driver).  None
+        #: means there is nowhere durable to spill — shutdown falls back
+        #: to the logged discard (redelivery / journal replay re-derives).
+        self._spill_sink = None
         if acc_cfg is not None and getattr(acc_cfg, "enabled", False):
             from .accumulator import DeviceAccumulatorStore
 
             self.accumulator = DeviceAccumulatorStore(acc_cfg)
+
+    def set_spill_sink(self, sink) -> None:
+        """Register the durable drain target used by shutdown(drain=True)
+        (and any explicit drain_accumulator() call)."""
+        self._spill_sink = sink
 
     # -- shape-keyed backend cache --------------------------------------
     def backend_for(self, shape_key: tuple, factory):
@@ -911,12 +922,26 @@ class DeviceExecutor:
                 for br in self._breakers.values()
             }
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop intake and tear down.  ``drain=True`` (the default — the
+        graceful path) first spills every healthy bucket's committed-but-
+        unspilled delta through the registered spill sink, so a SIGTERM
+        loses nothing; ``drain=False`` is the crash-shaped teardown —
+        deltas are dropped loudly and redelivery (un-committed jobs) or
+        the persisted journal's oracle replay (committed, deferred-drain
+        jobs) re-derives them."""
         self._closed = True
         if self.accumulator is not None:
-            # shutdown teardown: un-spilled deltas belong to jobs whose tx
-            # never committed (redelivery re-derives them), so drop them
-            # loudly without paying a readback per bucket
+            if drain and self._spill_sink is not None:
+                try:
+                    self.accumulator.drain_all(self._spill_sink)
+                except Exception:
+                    logger.exception("accumulator shutdown drain failed")
+            # whatever remains (poisoned buckets, failed sink writes, or
+            # drain=False): un-spilled deltas either belong to jobs whose
+            # tx never committed (redelivery re-derives them) or carry
+            # persisted journal rows (survivors replay them), so drop
+            # them loudly without paying a readback per bucket
             try:
                 self.accumulator.discard_all()
             except Exception:
@@ -992,10 +1017,17 @@ def get_global_executor(config: Optional[ExecutorConfig] = None) -> DeviceExecut
         return _GLOBAL
 
 
+def peek_global_executor() -> Optional[DeviceExecutor]:
+    """The process-wide instance if one exists, WITHOUT creating it —
+    shutdown paths must never mint an executor just to tear it down."""
+    with _GLOBAL_LOCK:
+        return _GLOBAL
+
+
 def reset_global_executor() -> None:
     """Tests only: drop the process-wide instance."""
     global _GLOBAL
     with _GLOBAL_LOCK:
         if _GLOBAL is not None:
-            _GLOBAL.shutdown()
+            _GLOBAL.shutdown(drain=False)
         _GLOBAL = None

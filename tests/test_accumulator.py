@@ -538,7 +538,7 @@ def test_driver_breaker_replay_recovers_via_oracle(count_backend):
             RuntimeError("device on fire")
         )
         try:
-            deltas = await driver._commit_resident_shares(
+            deltas, _journal, _touched = await driver._commit_resident_shares(
                 task, vdaf, job, ras, states, out_shares
             )
         finally:
@@ -596,6 +596,8 @@ class _AggStub:
     _helper_prepare_batch_prio3 = _A._helper_prepare_batch_prio3
     _helper_prep_rows_prio3 = _A._helper_prep_rows_prio3
     _helper_prepare_batch_prio3_executor = _A._helper_prepare_batch_prio3_executor
+    _release_helper_refs = _A._release_helper_refs
+    _release_unfinished_helper_refs = _A._release_unfinished_helper_refs
 
     def __init__(self, executor):
         self._executor = executor
@@ -707,3 +709,227 @@ device_executor:
     ec = cfg.device_executor.to_executor_config()
     assert ec.fair_quota_rows == 4096
     assert ec.accumulator is not None and ec.accumulator.byte_budget == 1048576
+
+
+# -- deferred drains (ISSUE 4): journal-granular drain + shutdown spill ------
+
+
+def test_drain_with_journal_returns_per_job_entries():
+    """Deferred drains consume persisted journal rows at JOB granularity:
+    the store must hand back the per-job entry list, not just the flat
+    report-id set."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    backend = _AccumBackend()
+    m = _matrix(4)
+    fid = store.retain_flush(backend, m, rows=4, nbytes=m.nbytes)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(fid, 0)], job_token=b"jobA", report_ids=[b"r0"]
+    )
+    store.commit_rows(
+        ("b",),
+        backend,
+        [ResidentRef(fid, 1), ResidentRef(fid, 2)],
+        job_token=b"jobB",
+        report_ids=[b"r1", b"r2"],
+    )
+    store.release_refs([ResidentRef(fid, 3)])
+    vector, journal = store.drain_with_journal(("b",), _Field)
+    assert vector == [1 + 2 + 3, 10 + 20 + 30]
+    assert journal == [
+        (b"jobA", frozenset({b"r0"})),
+        (b"jobB", frozenset({b"r1", b"r2"})),
+    ]
+
+
+def test_due_buckets_age_scan():
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True, drain_interval_s=30))
+    backend = _AccumBackend()
+    m = _matrix(1)
+    fid = store.retain_flush(backend, m, rows=1, nbytes=m.nbytes)
+    store.commit_rows(
+        ("b",), backend, [ResidentRef(fid, 0)], job_token=b"j", report_ids=[b"r"]
+    )
+    assert store.due_buckets(3600.0) == []  # too young
+    assert store.due_buckets(0.0) == [("b",)]  # everything is due at age 0
+    assert AccumulatorConfig(enabled=True, drain_interval_s=30).deferred
+    assert not AccumulatorConfig(enabled=True).deferred
+
+
+def test_shutdown_drain_spills_through_sink_exactly_once():
+    """SIGTERM path (ISSUE 4 satellite): shutdown(drain=True) — the
+    default — spills committed-but-unspilled deltas through the
+    registered sink before discarding; the sink sees the vector AND the
+    per-job journal so it can consume the persisted rows."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True, drain_interval_s=60))
+    ex = DeviceExecutor(ExecutorConfig())
+    ex.accumulator = store
+    backend = _AccumBackend()
+    m = _matrix(2)
+    fid = store.retain_flush(backend, m, rows=2, nbytes=m.nbytes)
+    store.commit_rows(
+        ("bucket",),
+        backend,
+        [ResidentRef(fid, 0), ResidentRef(fid, 1)],
+        job_token=b"jobA",
+        report_ids=[b"r0", b"r1"],
+    )
+    spilled = []
+    ex.set_spill_sink(lambda key, vector, journal: spilled.append((key, vector, journal)))
+    ex.shutdown()  # drain=True is the default
+    assert spilled == [
+        (("bucket",), [1 + 2, 10 + 20], [(b"jobA", frozenset({b"r0", b"r1"}))])
+    ]
+    assert store.stats()["buckets"] == 0
+    # drained exactly once: nothing left for a second teardown to spill
+    spilled.clear()
+    ex.shutdown()
+    assert spilled == []
+
+
+def test_undrained_shutdown_discards_and_redelivery_rederives():
+    """Regression (ISSUE 4 satellite): shutdown(drain=False) — the crash
+    shape — discards the delta WITHOUT spilling; the journaled reports
+    are still rederivable (here: recomputing the same rows into a fresh
+    store yields the identical vector, which is what lease redelivery /
+    the datastore replay does with real report shares)."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    ex = DeviceExecutor(ExecutorConfig())
+    ex.accumulator = store
+    backend = _AccumBackend()
+    m = _matrix(2)
+    fid = store.retain_flush(backend, m, rows=2, nbytes=m.nbytes)
+    store.commit_rows(
+        ("bucket",),
+        backend,
+        [ResidentRef(fid, 0), ResidentRef(fid, 1)],
+        job_token=b"jobA",
+        report_ids=[b"r0", b"r1"],
+    )
+    spilled = []
+    ex.set_spill_sink(lambda *a: spilled.append(a))
+    ex.shutdown(drain=False)
+    assert spilled == [] and store.stats()["buckets"] == 0
+    # "redelivery": the same rows recommit into a fresh store, bit-exact
+    store2 = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    m2 = _matrix(2)
+    fid2 = store2.retain_flush(backend, m2, rows=2, nbytes=m2.nbytes)
+    store2.commit_rows(
+        ("bucket",),
+        backend,
+        [ResidentRef(fid2, 0), ResidentRef(fid2, 1)],
+        job_token=b"jobA",
+        report_ids=[b"r0", b"r1"],
+    )
+    vector, rids = store2.drain(("bucket",), _Field)
+    assert vector == [3, 30] and rids == {b"r0", b"r1"}
+
+
+def test_writer_journal_entries_defer_shares_and_persist_rows():
+    """Deferred mode at the writer: journaled rows contribute NO share in
+    this tx (None when everything is deferred) and one journal row per
+    (job, ident) is persisted; a journaled report failed in-tx aborts."""
+    from types import SimpleNamespace
+
+    from janus_tpu.aggregator.aggregation_job_writer import AggregationJobWriter
+
+    writer = AggregationJobWriter(
+        task=None,
+        vdaf=None,
+        journal_entries={b"ident": frozenset({b"r1", b"r2"})},
+    )
+    refs = [ResidentRef(0, 0), ResidentRef(0, 1)]
+    # all resident rows journaled: no delta required, no share merged now
+    assert writer._resolve_shares(_Field, b"ident", refs, [b"r1", b"r2"]) is None
+    # mixed: host rows still merge
+    assert writer._resolve_shares(
+        _Field, b"ident", refs + [[1, 1]], [b"r1", b"r2", b"r3"]
+    ) == [1, 1]
+    # resident rows NOT covered by the journal still need a drained delta
+    with pytest.raises(StaleAccumulatorDelta):
+        writer._resolve_shares(_Field, b"other-ident", refs, [b"r1", b"r2"])
+
+    calls = []
+    tx = SimpleNamespace(
+        put_accumulator_journal_entry=lambda *a: calls.append(a)
+    )
+    task = SimpleNamespace(task_id=b"task")
+    writer.task = task
+    job = SimpleNamespace(aggregation_parameter=b"", aggregation_job_id=b"job")
+    writer._write_journal(tx, job, failures={})
+    assert calls == [(b"task", b"ident", b"", b"job", [b"r1", b"r2"])]
+    with pytest.raises(StaleAccumulatorDelta):
+        writer._write_journal(tx, job, failures={b"r2": "collected"})
+
+
+def test_concurrent_same_job_deliveries_use_disjoint_buckets():
+    """Regression (found by the crash soak): two CONCURRENT deliveries of
+    one aggregation job (helper: a leader redelivers while the first
+    request is still being served; leader: two in-process driver replicas
+    overlap on an expired lease) must never share a drain-at-commit
+    bucket — both commits landing before either drain yields a DOUBLED
+    vector whose report-id set still matches, which StaleAccumulatorDelta
+    cannot catch.  Keys carry a per-delivery nonce, so interleaved
+    commit/commit/drain/drain stays exact."""
+    from types import SimpleNamespace
+
+    from janus_tpu.aggregator.aggregator import Aggregator
+
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    backend = _AccumBackend()
+
+    commit_keys = []
+    orig_commit = store.commit_rows
+
+    def recording_commit(key, *a, **kw):
+        commit_keys.append(key)
+        return orig_commit(key, *a, **kw)
+
+    store.commit_rows = recording_commit
+
+    from janus_tpu.datastore import TaskQueryType
+    from janus_tpu.messages import AggregationJobId, TaskId, Time
+    from janus_tpu.vdaf.instances import prio3_count
+
+    vdaf = prio3_count()
+    task = SimpleNamespace(
+        task_id=TaskId.random(),
+        query_type=TaskQueryType.time_interval(),
+        time_precision=__import__("janus_tpu.messages", fromlist=["Duration"]).Duration(3600),
+    )
+    job = SimpleNamespace(
+        aggregation_parameter=b"",
+        aggregation_job_id=AggregationJobId.random(),
+        partial_batch_identifier=None,
+    )
+    ta = SimpleNamespace(task=task, vdaf=vdaf, backend=backend)
+    agg = SimpleNamespace(
+        _executor=SimpleNamespace(accumulator=store),
+        datastore=None,
+    )
+
+    def deliver():
+        m = _matrix(2)
+        fid = store.retain_flush(backend, m, rows=2, nbytes=m.nbytes)
+        ras = [
+            SimpleNamespace(report_id=SimpleNamespace(data=bytes([i]) * 16), time=Time(0))
+            for i in range(2)
+        ]
+        out_shares = {
+            ras[0].report_id.data: ResidentRef(fid, 0),
+            ras[1].report_id.data: ResidentRef(fid, 1),
+        }
+        return _run(
+            Aggregator._commit_helper_resident_shares(
+                agg, ta, job, ras, out_shares, decoded_by_rid={}
+            )
+        )
+
+    d1 = deliver()
+    d2 = deliver()
+    # each delivery drains exactly its OWN rows — no doubling
+    (v1, rids1), = d1.values()
+    (v2, rids2), = d2.values()
+    assert v1 == [1 + 2, 10 + 20] and v2 == [1 + 2, 10 + 20]
+    # the fence: bucket keys differ per delivery even for the same job
+    assert len(commit_keys) == 2
+    assert commit_keys[0] != commit_keys[1]

@@ -44,13 +44,9 @@ from janus_tpu.messages import Duration, Time
 SEED = int(os.environ.get("JANUS_CHAOS_SEED", "7"))
 REPO = pathlib.Path(__file__).resolve().parents[1]
 
-#: The datastore's lease SQL uses RETURNING (SQLite >= 3.35); dev
-#: containers with an older libsqlite skip the end-to-end chaos tests
-#: (they run in the CI image, like the rest of the datastore suite).
-NEEDS_RETURNING = pytest.mark.skipif(
-    sqlite3.sqlite_version_info < (3, 35),
-    reason="datastore lease SQL needs SQLite RETURNING (>= 3.35)",
-)
+# (The lease SQL's RETURNING requirement — and the skipif gate it needed
+# on pre-3.35 SQLite — is gone: the datastore carries select-then-mutate
+# fallbacks, backend_sql.SqliteBackend.supports_returning.)
 
 
 @pytest.fixture(autouse=True)
@@ -124,6 +120,7 @@ def test_every_known_point_is_wired():
         "key_rotator.run": "janus_tpu/aggregator/key_rotator.py",
         "accumulator.spill": "janus_tpu/executor/accumulator.py",
         "accumulator.evict": "janus_tpu/executor/accumulator.py",
+        "accumulator.replay": "janus_tpu/aggregator/collection_job_driver.py",
     }
     assert set(wiring) == set(faults.KNOWN_POINTS)
     for point, rel in wiring.items():
@@ -550,13 +547,6 @@ class ChaosHarness:
         # clock-skew failure domain: the leader datastore's view drifts
         self.leader_ds = EphemeralDatastore(SkewedClock(self.clock))
         self.helper_ds = EphemeralDatastore(self.clock)
-        cfg = Config(vdaf_backend="oracle", max_upload_batch_write_delay=0.02)
-        self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, cfg)
-        self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, cfg)
-        self.agg_token = AuthenticationToken.new_bearer("agg-token-chaos")
-        self.col_token = AuthenticationToken.new_bearer("col-token-chaos")
-        self.collector_keys = HpkeKeypair.generate(9)
-        self.tasks = []  # (task_id, leader_task, helper_task)
         from janus_tpu.executor import AccumulatorConfig
 
         self.exec_cfg = ExecutorConfig(
@@ -570,6 +560,22 @@ class ChaosHarness:
             # evictions fire constantly — aggregates must still be exact.
             accumulator=AccumulatorConfig(enabled=True, byte_budget=256),
         )
+        cfg = Config(vdaf_backend="oracle", max_upload_batch_write_delay=0.02)
+        # Helper-side chaos parity (ISSUE 4 satellite / ROADMAP): the
+        # HELPER serves prepare on the device backend THROUGH the shared
+        # executor (and, with the store enabled, retains its out shares on
+        # device) — the same failure domains the leader drivers face.
+        helper_cfg = Config(
+            vdaf_backend="tpu",
+            max_upload_batch_write_delay=0.02,
+            device_executor=self.exec_cfg,
+        )
+        self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, cfg)
+        self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, helper_cfg)
+        self.agg_token = AuthenticationToken.new_bearer("agg-token-chaos")
+        self.col_token = AuthenticationToken.new_bearer("col-token-chaos")
+        self.collector_keys = HpkeKeypair.generate(9)
+        self.tasks = []  # (task_id, leader_task, helper_task)
         # 2 replicas: distinct driver instances, one shared global executor
         self.drivers = [
             AggregationJobDriver(
@@ -764,7 +770,6 @@ def _soak_fault_specs():
     ]
 
 
-@NEEDS_RETURNING
 def test_chaos_soak_two_replicas_multitask():
     """THE ACCEPTANCE SOAK: all injection points at p~=0.2 over a
     2-replica 2-task run; every job terminal, breaker trip AND recovery
@@ -802,6 +807,14 @@ def test_chaos_soak_two_replicas_multitask():
                     s["state"] == "open" for s in ex.circuit_stats().values()
                 ):
                     break
+            # with the circuit open, prepare degrades to the oracle and
+            # the step reaches the helper over HTTP — where the request
+            # fault fires (a fast trip would otherwise end phase 1 before
+            # any HTTP attempt)
+            for _ in range(8):
+                if faults.registry().hits.get("http.request", 0) > 0:
+                    break
+                await harness.drive_round()
             circuits = ex.circuit_stats()
             assert any(s["trips"] >= 1 for s in circuits.values()), circuits
             phase1_hits = dict(faults.registry().hits)

@@ -1,0 +1,636 @@
+"""Process-level crash/restart chaos (ISSUE 4 tentpole).
+
+PR 2/3's chaos harness injects faults *in-process*; these tests kill and
+restart whole replica PROCESSES, exercising the recovery machinery the
+in-process soak cannot reach: lease expiry under real process death, the
+lease reaper's prompt redelivery (``janus_job_leases_expired_total``),
+graceful SIGTERM teardown (accumulator spill through the journal
+transaction), and the datastore-persisted accumulator journal's
+collection-time oracle replay for deltas that died resident on a
+SIGKILLed replica's device.
+
+Layers:
+
+* ``test_killed_lease_holder_redelivers_with_attempts_preserved`` — a
+  worker process acquires a lease and dies without releasing; after
+  expiry the reaper counts it and a survivor reacquires with the
+  ``lease_attempts`` accounting intact (the ``max_step_attempts`` budget
+  survives holder death).
+* ``test_crash_restart_soak_exactly_once`` (slow) — THE ACCEPTANCE SOAK:
+  a helper aggregator binary plus two aggregation-job-driver binaries
+  (device executor + accumulator store in DEFERRED drain mode, device
+  backend on a pinned CPU platform) share one datastore; replicas are
+  SIGKILLed at seeded random points mid-step and restarted (>= 3
+  cycles, ending with a double kill that guarantees a stranded lease);
+  after convergence one replica exits via SIGTERM (graceful spill, exit
+  code 0) and the other is SIGKILLed (orphaning journal rows), then the
+  collection driver replays the orphans from the datastore and every
+  seeded report is counted exactly once with aggregates bit-exact
+  against the CPU oracle's sums.
+
+Seeded via JANUS_CHAOS_SEED (./ci.sh chaos crash pins it).  The process
+soak runs wherever ``cryptography`` is importable — the datastore's
+pre-3.35-SQLite fallback paths (backend_sql.py) removed the RETURNING
+requirement.
+"""
+
+from __future__ import annotations
+
+import base64
+import multiprocessing as mp
+import os
+import pathlib
+import random
+import signal
+import socket
+import sqlite3
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+pytest.importorskip("cryptography")
+
+from janus_tpu.core.hpke import HpkeApplicationInfo, HpkeKeypair, Label, open_
+from janus_tpu.core.time import RealClock
+from janus_tpu.datastore import (
+    AggregatorTask,
+    CollectionJob,
+    CollectionJobState,
+    Crypter,
+    Datastore,
+    LeaderStoredReport,
+    TaskQueryType,
+    generate_key,
+)
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.messages import (
+    AggregationJobId,
+    AggregationJobStep,
+    BatchSelector,
+    CollectionJobId,
+    Duration,
+    Interval,
+    PlaintextInputShare,
+    Query,
+    Role,
+    TaskId,
+    Time,
+)
+
+SEED = int(os.environ.get("JANUS_CHAOS_SEED", "7"))
+REPO = pathlib.Path(__file__).resolve().parents[1]
+TIME_PRECISION = Duration(3600)
+
+#: -c bootstrap for replica binaries: pin jax to CPU exactly the way
+#: conftest.py does (an ambient out-of-process TPU plugin may win the
+#: platform election over the env var alone), then enter the real
+#: multi-call entry point.  One TPU cannot be shared by three processes,
+#: and CPU-vs-device parity is the backend contract anyway.
+_BOOT = (
+    "import os, sys;"
+    "os.environ['JAX_PLATFORMS'] = 'cpu';"
+    "import jax; jax.config.update('jax_platforms', 'cpu');"
+    "from janus_tpu.binaries.main import main;"
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# lease-expiry redelivery across process death (ISSUE 4 satellite)
+
+
+def _hold_lease_and_die(path: str, key: bytes) -> None:
+    """Acquire a short lease, then die WITHOUT releasing (SIGKILL shape:
+    os._exit skips every finally/atexit, like a kill -9 mid-step)."""
+    ds = Datastore(path, Crypter([key]), RealClock())
+    leases = ds.run_tx(
+        "acquire",
+        lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(2), 1),
+    )
+    os._exit(0 if len(leases) == 1 else 3)
+
+
+def test_killed_lease_holder_redelivers_with_attempts_preserved(tmp_path):
+    from tests.test_datastore import make_task
+
+    key = generate_key()
+    path = str(tmp_path / "lease.sqlite3")
+    ds = Datastore(path, Crypter([key]), RealClock())
+    task = make_task()
+    ds.run_tx("put-task", lambda tx: tx.put_aggregator_task(task))
+    from janus_tpu.datastore import AggregationJob, AggregationJobState
+
+    job = AggregationJob(
+        task_id=task.task_id,
+        aggregation_job_id=AggregationJobId.random(),
+        aggregation_parameter=b"",
+        partial_batch_identifier=None,
+        client_timestamp_interval=Interval(Time(0), Duration(1)),
+        state=AggregationJobState.IN_PROGRESS,
+        step=AggregationJobStep(0),
+    )
+    ds.run_tx("put-job", lambda tx: tx.put_aggregation_job(job))
+
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_hold_lease_and_die, args=(path, key))
+    p.start()
+    p.join(timeout=60)
+    assert p.exitcode == 0
+
+    # while the dead holder's lease is still valid, nothing to reap or acquire
+    assert ds.run_tx("reap0", lambda tx: tx.reap_expired_aggregation_job_leases()) == 0
+    assert (
+        ds.run_tx(
+            "acq0", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(2), 1)
+        )
+        == []
+    )
+    time.sleep(2.5)  # past the 2s lease
+    # the survivor's reaper counts exactly the expired-without-release lease
+    assert ds.run_tx("reap1", lambda tx: tx.reap_expired_aggregation_job_leases()) == 1
+    (lease,) = ds.run_tx(
+        "acq1", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+    )
+    # delivery accounting survives the holder's death: this is attempt 2,
+    # so the max_step_attempts budget keeps counting across the crash
+    assert lease.lease_attempts == 2
+    assert lease.leased.aggregation_job_id == job.aggregation_job_id
+    ds.close()
+
+
+# ---------------------------------------------------------------------------
+# THE SOAK
+
+
+class _Replicas:
+    """Spawn/kill/restart the replica binaries of one soak run."""
+
+    def __init__(self, env, driver_cfgs, helper_cfg, log_dir):
+        self.env = env
+        self.driver_cfgs = driver_cfgs
+        self.helper_cfg = helper_cfg
+        self.log_dir = log_dir
+        self.drivers = [None, None]
+        self.helper = None
+        self._log_seq = 0
+
+    def _spawn(self, binary, cfg_path, tag):
+        self._log_seq += 1
+        log = open(self.log_dir / f"{tag}-{self._log_seq}.log", "wb")
+        return subprocess.Popen(
+            [sys.executable, "-c", _BOOT, binary, "--config-file", str(cfg_path)],
+            env=self.env,
+            cwd=str(REPO),
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def start_helper(self):
+        self.helper = self._spawn("aggregator", self.helper_cfg, "helper")
+
+    def start_driver(self, i):
+        self.drivers[i] = self._spawn(
+            "aggregation_job_driver", self.driver_cfgs[i], f"driver{i}"
+        )
+
+    def kill_driver(self, i):
+        p = self.drivers[i]
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+
+    def terminate_all(self):
+        for p in self.drivers + [self.helper]:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def _wait_http(url: str, deadline_s: float) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError(f"{url} never came up")
+
+
+def _scrape(port: int) -> str:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            return r.read().decode()
+    except Exception:
+        return ""
+
+
+def _metric_total(text: str, name: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def _sql(path: str, query: str):
+    conn = sqlite3.connect(path, timeout=10.0)
+    try:
+        return conn.execute(query).fetchall()
+    finally:
+        conn.close()
+
+
+@pytest.mark.slow
+def test_crash_restart_soak_exactly_once(tmp_path):
+    from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
+    from janus_tpu.client import prepare_report
+    from janus_tpu.messages import InputShareAad
+
+    rng = random.Random(SEED)
+    key = generate_key()
+    leader_db = str(tmp_path / "leader.sqlite3")
+    helper_db = str(tmp_path / "helper.sqlite3")
+    helper_port = _free_port()
+    helper_health = _free_port()
+    driver_health = [_free_port(), _free_port()]
+
+    # -- seed both stores ---------------------------------------------------
+    clock = RealClock()
+    leader_ds = Datastore(leader_db, Crypter([key]), clock)
+    helper_ds = Datastore(helper_db, Crypter([key]), clock)
+    agg_token = AuthenticationToken.new_bearer("agg-token-crash")
+    collector_keys = HpkeKeypair.generate(9)
+    now = clock.now()
+    report_time = Time(now.seconds - now.seconds % TIME_PRECISION.seconds)
+    interval = Interval(report_time, TIME_PRECISION)
+
+    n_tasks = 2
+    measurements = {t: [(i + t) % 2 for i in range(12)] for t in range(n_tasks)}
+    #: field sum of every seeded report's LEADER out share, straight off
+    #: the CPU oracle — the collection's leader aggregate share must be
+    #: bit-exact against this no matter which recovery paths fired
+    expected_leader_shares = {}
+    tasks = []
+    keypairs = []
+    for t in range(n_tasks):
+        task_id = TaskId.random()
+        common = dict(
+            task_id=task_id,
+            query_type=TaskQueryType.time_interval(),
+            vdaf={"type": "Prio3Count"},
+            vdaf_verify_key=bytes([0x40 + t]) * 16,
+            min_batch_size=3,
+            time_precision=TIME_PRECISION,
+            collector_hpke_config=collector_keys.config,
+        )
+        leader_kp, helper_kp = HpkeKeypair.generate(1), HpkeKeypair.generate(2)
+        leader_task = AggregatorTask(
+            peer_aggregator_endpoint=f"http://127.0.0.1:{helper_port}/",
+            role=Role.LEADER,
+            aggregator_auth_token=agg_token,
+            hpke_keys=[leader_kp],
+            **common,
+        )
+        helper_task = AggregatorTask(
+            peer_aggregator_endpoint="http://127.0.0.1:1/",  # never called
+            role=Role.HELPER,
+            aggregator_auth_token_hash=agg_token.hash(),
+            hpke_keys=[helper_kp],
+            **common,
+        )
+        leader_ds.run_tx("putl", lambda tx, lt=leader_task: tx.put_aggregator_task(lt))
+        helper_ds.run_tx("puth", lambda tx, ht=helper_task: tx.put_aggregator_task(ht))
+        tasks.append((task_id, leader_task, helper_task))
+        keypairs.append((leader_kp, helper_kp))
+        expected_leader_shares[t] = None
+
+    from janus_tpu.vdaf.backend import OracleBackend
+
+    def seed_report(t, m):
+        task_id, leader_task, _h = tasks[t]
+        leader_kp, helper_kp = keypairs[t]
+        vdaf = leader_task.vdaf_instance()
+        report = prepare_report(
+            vdaf,
+            task_id,
+            leader_kp.config,
+            helper_kp.config,
+            TIME_PRECISION,
+            m,
+            time=report_time,
+        )
+        # store the leader share the way handle_upload does: HPKE-open
+        # our own ciphertext, keep the helper's sealed
+        aad = InputShareAad(task_id, report.metadata, report.public_share).get_encoded()
+        info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+        plain = PlaintextInputShare.get_decoded(
+            open_(leader_kp, info, report.leader_encrypted_input_share, aad)
+        )
+        stored = LeaderStoredReport(
+            task_id=task_id,
+            metadata=report.metadata,
+            public_share=report.public_share,
+            leader_extensions=[],
+            leader_input_share=plain.payload,
+            helper_encrypted_input_share=report.helper_encrypted_input_share,
+        )
+        leader_ds.run_tx("putr", lambda tx, r=stored: tx.put_client_report(r))
+        (outcome,) = OracleBackend(vdaf).prep_init_batch(
+            leader_task.vdaf_verify_key,
+            0,
+            [
+                (
+                    report.metadata.report_id.data,
+                    vdaf.decode_public_share(report.public_share),
+                    vdaf.decode_input_share(0, plain.payload),
+                )
+            ],
+        )
+        field = vdaf.field_for_agg_param(vdaf.decode_agg_param(b""))
+        prev = expected_leader_shares[t]
+        expected_leader_shares[t] = (
+            list(outcome[0].out_share)
+            if prev is None
+            else field.vec_add(prev, outcome[0].out_share)
+        )
+
+    for t in range(n_tasks):
+        for m in measurements[t]:
+            seed_report(t, m)
+
+    import asyncio
+
+    creator = AggregationJobCreator(
+        leader_ds,
+        CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=3),
+    )
+    n_jobs = asyncio.run(creator.run_once())
+    assert n_jobs >= 2 * n_tasks, n_jobs
+
+    # -- replica configs ----------------------------------------------------
+    def driver_yaml(i):
+        return f"""
+common:
+  database: {{path: {leader_db}}}
+  health_check_listen_address: 127.0.0.1:{driver_health[i]}
+job_driver:
+  job_discovery_interval_s: 0.2
+  max_concurrent_job_workers: 4
+  worker_lease_duration_s: 5
+  worker_lease_clock_skew_allowance_s: 1
+  maximum_attempts_before_failure: 100000
+  max_step_attempts: 100000
+  retry_initial_delay_s: 1.0
+  retry_max_delay_s: 2.0
+  lease_reap_interval_s: 0.1
+vdaf_backend: tpu
+device_executor:
+  enabled: true
+  flush_window_ms: 20
+  flush_max_rows: 4096
+  breaker_failure_threshold: 0
+  accumulator:
+    enabled: true
+    byte_budget: 256
+    drain_interval_s: 3600
+"""
+
+    helper_yaml = f"""
+common:
+  database: {{path: {helper_db}}}
+  health_check_listen_address: 127.0.0.1:{helper_health}
+listen_address: 127.0.0.1:{helper_port}
+vdaf_backend: tpu
+device_executor:
+  enabled: true
+  flush_window_ms: 20
+  flush_max_rows: 4096
+  breaker_failure_threshold: 0
+  accumulator:
+    enabled: true
+    byte_budget: 256
+"""
+    cfg_paths = []
+    for i in range(2):
+        p = tmp_path / f"driver{i}.yaml"
+        p.write_text(driver_yaml(i))
+        cfg_paths.append(p)
+    helper_cfg = tmp_path / "helper.yaml"
+    helper_cfg.write_text(helper_yaml)
+
+    env = dict(os.environ)
+    env["DATASTORE_KEYS"] = base64.urlsafe_b64encode(key).decode().rstrip("=")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    reps = _Replicas(env, cfg_paths, helper_cfg, tmp_path)
+    try:
+        reps.start_helper()
+        _wait_http(f"http://127.0.0.1:{helper_health}/healthz", 120)
+        for i in range(2):
+            reps.start_driver(i)
+        for i in range(2):
+            _wait_http(f"http://127.0.0.1:{driver_health[i]}/healthz", 120)
+
+        def leased_count():
+            return _sql(
+                leader_db,
+                "SELECT COUNT(*) FROM aggregation_jobs"
+                " WHERE lease_token IS NOT NULL AND state = 'InProgress'",
+            )[0][0]
+
+        def unfinished_count():
+            return _sql(
+                leader_db,
+                "SELECT COUNT(*) FROM aggregation_jobs WHERE state = 'InProgress'",
+            )[0][0]
+
+        def wait_for_lease(deadline_s=120):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if leased_count() > 0:
+                    return True
+                if unfinished_count() == 0:
+                    return False  # converged before a lease appeared
+                time.sleep(0.05)
+            raise TimeoutError("no lease ever appeared")
+
+        # -- >= 3 seeded SIGKILL/restart cycles mid-step --------------------
+        kills = 0
+        for cycle in range(2):
+            time.sleep(rng.uniform(0.3, 1.2))
+            if not wait_for_lease():
+                break
+            victim = rng.randrange(2)
+            reps.kill_driver(victim)
+            kills += 1
+            reps.start_driver(victim)
+        # final cycle: a DOUBLE kill with a lease outstanding guarantees
+        # the holder died mid-step — the restarted replicas' reaper must
+        # observe at least one expired-without-release lease
+        if wait_for_lease():
+            reps.kill_driver(0)
+            reps.kill_driver(1)
+            kills += 2
+            reps.start_driver(0)
+            reps.start_driver(1)
+        assert kills >= 3, f"only {kills} kill/restart cycles ran"
+        for i in range(2):
+            _wait_http(f"http://127.0.0.1:{driver_health[i]}/healthz", 120)
+
+        # -- convergence: every job terminal --------------------------------
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            if unfinished_count() == 0:
+                break
+            time.sleep(0.5)
+        states = _sql(leader_db, "SELECT state, COUNT(*) FROM aggregation_jobs GROUP BY state")
+        assert dict(states).get("InProgress", 0) == 0, states
+        assert dict(states).get("Finished", 0) == n_jobs, (states, n_jobs)
+
+        # acceptance: at least one expired-lease reacquisition observed
+        expired = sum(
+            _metric_total(_scrape(driver_health[i]), "janus_job_leases_expired_total")
+            for i in range(2)
+        )
+        assert expired > 0, "no expired-lease reacquisition observed"
+
+        # deferred drains (interval 1h) never fired: the journal must hold
+        # outstanding rows for the committed-but-unspilled resident deltas
+        journal_before = _sql(leader_db, "SELECT COUNT(*) FROM accumulator_journal")[0][0]
+        assert journal_before > 0, "no outstanding journal rows to replay"
+
+        # -- teardown: graceful SIGTERM (spill), then a GUARANTEED orphan ---
+        reps.drivers[0].send_signal(signal.SIGTERM)
+        assert reps.drivers[0].wait(timeout=120) == 0, "SIGTERM exit must be clean"
+
+        # second wave: only driver1 remains, so every wave-2 job's journal
+        # row is owned by driver1's live store — SIGKILLing it afterwards
+        # deterministically orphans rows for the collection replay
+        for t in range(n_tasks):
+            for m in [1, 1, 0]:
+                measurements[t].append(m)
+                seed_report(t, m)
+        n_jobs += asyncio.run(creator.run_once())
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if unfinished_count() == 0:
+                break
+            time.sleep(0.5)
+        assert unfinished_count() == 0, "wave-2 jobs never converged"
+        reps.kill_driver(1)
+        journal_after = _sql(leader_db, "SELECT COUNT(*) FROM accumulator_journal")[0][0]
+        assert journal_after > 0, "the SIGKILLed replica must orphan journal rows"
+    except BaseException:
+        reps.terminate_all()
+        raise
+
+    # -- collection: replay the orphans, then exactness ---------------------
+    import aiohttp
+
+    from janus_tpu.aggregator.collection_job_driver import (
+        CollectionDriverConfig,
+        CollectionJobDriver,
+    )
+
+    async def collect():
+        results = {}
+        driver = CollectionJobDriver(
+            leader_ds,
+            aiohttp.ClientSession,
+            CollectionDriverConfig(retry_initial_delay=Duration(1)),
+        )
+        try:
+            for t, (task_id, leader_task, _h) in enumerate(tasks):
+                job = CollectionJob(
+                    task_id=task_id,
+                    collection_job_id=CollectionJobId.random(),
+                    query=Query.new_time_interval(interval),
+                    aggregation_parameter=b"",
+                    batch_identifier=interval.get_encoded(),
+                    state=CollectionJobState.START,
+                )
+                leader_ds.run_tx("putc", lambda tx, j=job: tx.put_collection_job(j))
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    leases = await leader_ds.run_tx_async(
+                        "acqc",
+                        lambda tx: tx.acquire_incomplete_collection_jobs(
+                            Duration(600), 4
+                        ),
+                    )
+                    for lease in leases:
+                        await driver.step_collection_job(lease)
+                    got = leader_ds.run_tx(
+                        "getc",
+                        lambda tx, j=job: tx.get_collection_job(
+                            j.task_id, j.collection_job_id, "TimeInterval"
+                        ),
+                    )
+                    if got.state == CollectionJobState.FINISHED:
+                        results[t] = got
+                        break
+                    await asyncio.sleep(0.3)
+                else:
+                    raise TimeoutError(f"collection for task {t} never finished")
+        finally:
+            await driver.close()
+        return results
+
+    try:
+        results = asyncio.run(collect())
+
+        from janus_tpu.messages import AggregateShareAad
+
+        for t, (task_id, leader_task, _h) in enumerate(tasks):
+            got = results[t]
+            vdaf = leader_task.vdaf_instance()
+            agg_param = vdaf.decode_agg_param(b"")
+            field = vdaf.field_for_agg_param(agg_param)
+            leader_share = field.decode_vec(got.leader_aggregate_share)
+            aad = AggregateShareAad(
+                task_id, b"", BatchSelector.new_time_interval(interval)
+            ).get_encoded()
+            info = HpkeApplicationInfo.new(
+                Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR
+            )
+            helper_share = field.decode_vec(
+                open_(collector_keys, info, got.helper_aggregate_share, aad)
+            )
+            result = vdaf.unshard_with_param(
+                agg_param, [leader_share, helper_share], got.report_count
+            )
+            # exactly-once: Prio3Count aggregation is exact, so equality
+            # with the true count and sum IS the no-double/no-drop proof;
+            # the leader share is additionally checked BIT-EXACT against
+            # the CPU oracle's field sum (splits a leader-side recovery
+            # bug from a helper-side one on failure)
+            assert got.report_count == len(measurements[t]), (t, got.report_count)
+            assert leader_share == expected_leader_shares[t], (
+                t,
+                "leader share deviates from the CPU oracle sum",
+                leader_share,
+                expected_leader_shares[t],
+            )
+            assert result == sum(measurements[t]), (t, result, "helper side")
+
+        # every orphaned journal row was consumed by the replay
+        assert _sql(leader_db, "SELECT COUNT(*) FROM accumulator_journal")[0][0] == 0
+    finally:
+        reps.terminate_all()
+        leader_ds.close()
+        helper_ds.close()

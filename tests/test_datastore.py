@@ -718,7 +718,10 @@ class TestSchemaMigrations:
         m2 = "CREATE TABLE IF NOT EXISTS migration_probe (id INTEGER PRIMARY KEY);"
         ds2 = self._open(path, clock, _migrations_override=list(MIGRATIONS) + [m2])
         conn = ds2._conn()
-        assert conn.execute("SELECT version FROM schema_version").fetchone()[0] == 2
+        assert (
+            conn.execute("SELECT version FROM schema_version").fetchone()[0]
+            == len(MIGRATIONS) + 1
+        )
         conn.execute("INSERT INTO migration_probe (id) VALUES (1)")
         # v1 data survives the upgrade
         got = ds2.run_tx("get", lambda tx: tx.get_aggregator_task(task.task_id))
@@ -753,3 +756,234 @@ class TestSchemaMigrations:
         ds = self._open(str(tmp_path / "g2.sqlite3"), clock, migrate_on_open=False)
         ds.run_tx("noop", lambda tx: None)
         ds.close()
+
+
+class TestLeaseReaper:
+    """Expired-without-release leases (a dead holder's) are reaped —
+    counted and cleared — while healthy and released leases are not."""
+
+    def test_reap_only_expired_unreleased(self, ds):
+        clock: MockClock = ds.clock
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        dead_job = put_job(ds, task)
+        live_job = put_job(ds, task)
+        released_job = put_job(ds, task)
+
+        # dead: leased for 10s, holder never comes back
+        (dead,) = ds.run_tx(
+            "acq_dead",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(10), 1),
+        )
+        assert dead.leased.aggregation_job_id == dead_job.aggregation_job_id
+        # live: long lease, still valid at reap time
+        (live,) = ds.run_tx(
+            "acq_live",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1),
+        )
+        # released: acquired then released cleanly (token already NULL)
+        (rel,) = ds.run_tx(
+            "acq_rel",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1),
+        )
+        ds.run_tx("rel", lambda tx: tx.release_aggregation_job(rel))
+
+        clock.advance(Duration(11))
+        assert (
+            ds.run_tx("reap", lambda tx: tx.reap_expired_aggregation_job_leases())
+            == 1
+        )
+        # idempotent: nothing left to reap
+        assert (
+            ds.run_tx("reap2", lambda tx: tx.reap_expired_aggregation_job_leases())
+            == 0
+        )
+        # the dead job is promptly re-acquirable, attempts accounting intact
+        leases = ds.run_tx(
+            "reacq",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10),
+        )
+        by_job = {l.leased.aggregation_job_id: l for l in leases}
+        assert dead_job.aggregation_job_id in by_job
+        assert by_job[dead_job.aggregation_job_id].lease_attempts == 2
+        # the released job is re-acquirable too (that was always true);
+        # the LIVE lease must not have been stolen
+        assert released_job.aggregation_job_id in by_job
+        assert live_job.aggregation_job_id not in by_job
+        ds.run_tx("rel_live", lambda tx: tx.release_aggregation_job(live))
+
+    def test_reap_collection_leases(self, ds):
+        clock: MockClock = ds.clock
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        interval = Interval(Time(0), Duration(3600))
+        job = CollectionJob(
+            task_id=task.task_id,
+            collection_job_id=CollectionJobId.random(),
+            query=Query.new_time_interval(interval),
+            aggregation_parameter=b"",
+            batch_identifier=interval.get_encoded(),
+            state=CollectionJobState.START,
+        )
+        ds.run_tx("putc", lambda tx: tx.put_collection_job(job))
+        (lease,) = ds.run_tx(
+            "acq",
+            lambda tx: tx.acquire_incomplete_collection_jobs(Duration(10), 1),
+        )
+        clock.advance(Duration(11))
+        assert (
+            ds.run_tx("reap", lambda tx: tx.reap_expired_collection_job_leases())
+            == 1
+        )
+        (lease2,) = ds.run_tx(
+            "reacq",
+            lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 1),
+        )
+        assert lease2.lease_attempts == 2
+
+
+class TestAccumulatorJournal:
+    """Deferred-drain journal rows: same-tx write with the job commit,
+    per-batch scans, and the exactly-once DELETE."""
+
+    def _entry_args(self, task, job, rids):
+        return (
+            task.task_id,
+            b"batch-1",
+            b"",
+            job.aggregation_job_id,
+            rids,
+        )
+
+    def test_round_trip_and_consume(self, ds):
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job = put_job(ds, task)
+        rids = [bytes([i]) * 16 for i in range(3)]
+        ds.run_tx(
+            "j_put",
+            lambda tx: tx.put_accumulator_journal_entry(*self._entry_args(task, job, rids)),
+        )
+        entries = ds.run_tx(
+            "j_get",
+            lambda tx: tx.get_accumulator_journal_entries(task.task_id, b"batch-1"),
+        )
+        assert len(entries) == 1
+        e = entries[0]
+        assert e.aggregation_job_id == job.aggregation_job_id
+        assert list(e.report_ids) == rids
+        assert (
+            ds.run_tx(
+                "j_count",
+                lambda tx: tx.count_accumulator_journal_entries_for_batch(
+                    task.task_id, b"batch-1"
+                ),
+            )
+            == 1
+        )
+        assert (
+            ds.run_tx(
+                "j_count2",
+                lambda tx: tx.count_accumulator_journal_entries_for_batch(
+                    task.task_id, b"other"
+                ),
+            )
+            == 0
+        )
+        # duplicate (job redelivery re-committing) is a conflict, not a
+        # silent second row
+        with pytest.raises(TxConflict):
+            ds.run_tx(
+                "j_dup",
+                lambda tx: tx.put_accumulator_journal_entry(
+                    *self._entry_args(task, job, rids)
+                ),
+            )
+        # exactly-once consumption: first delete wins, second reports it
+        assert ds.run_tx(
+            "j_del",
+            lambda tx: tx.delete_accumulator_journal_entry(
+                task.task_id, b"batch-1", b"", job.aggregation_job_id
+            ),
+        )
+        assert not ds.run_tx(
+            "j_del2",
+            lambda tx: tx.delete_accumulator_journal_entry(
+                task.task_id, b"batch-1", b"", job.aggregation_job_id
+            ),
+        )
+
+    def test_tx_abort_rolls_back_entry(self, ds):
+        """The journal row and the job commit are one fact: an aborted tx
+        leaves no row behind."""
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job = put_job(ds, task)
+
+        class Boom(Exception):
+            pass
+
+        def tx_fn(tx):
+            tx.put_accumulator_journal_entry(
+                *self._entry_args(task, job, [b"\x01" * 16])
+            )
+            raise Boom()
+
+        with pytest.raises(Boom):
+            ds.run_tx("j_abort", tx_fn)
+        assert (
+            ds.run_tx(
+                "j_count",
+                lambda tx: tx.count_accumulator_journal_entries_for_batch(
+                    task.task_id, b"batch-1"
+                ),
+            )
+            == 0
+        )
+
+    def test_gc_skips_jobs_with_outstanding_journal_rows(self, ds):
+        """GC must not reap a job whose journal row is outstanding: its
+        FINISHED rows' retained payloads are the only material the
+        replay can re-derive the missing shares from.  Once the row is
+        consumed, the next pass collects the job."""
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        job = put_job(ds, task)
+        ds.run_tx(
+            "finish",
+            lambda tx: tx.update_aggregation_job(
+                job.with_state(AggregationJobState.FINISHED)
+            ),
+        )
+        ds.run_tx(
+            "j_put",
+            lambda tx: tx.put_accumulator_journal_entry(
+                *self._entry_args(task, job, [b"\x07" * 16])
+            ),
+        )
+        assert (
+            ds.run_tx(
+                "gc",
+                lambda tx: tx.delete_expired_aggregation_artifacts(
+                    task.task_id, Time(1_700_000_000), 10
+                ),
+            )
+            == 0
+        ), "outstanding journal row must fence the job from GC"
+        # replay consumes the row -> the job becomes collectable
+        ds.run_tx(
+            "j_del",
+            lambda tx: tx.delete_accumulator_journal_entry(
+                task.task_id, b"batch-1", b"", job.aggregation_job_id
+            ),
+        )
+        assert (
+            ds.run_tx(
+                "gc2",
+                lambda tx: tx.delete_expired_aggregation_artifacts(
+                    task.task_id, Time(1_700_000_000), 10
+                ),
+            )
+            == 1
+        )
+        assert ds.run_tx("cnt", lambda tx: tx.count_accumulator_journal_entries(task.task_id)) == 0

@@ -263,11 +263,13 @@ class TestSqlDialectGuards:
         )
         from janus_tpu.datastore.schema import MIGRATIONS
 
-        for mig in MIGRATIONS:
+        for i, mig in enumerate(MIGRATIONS):
             stmts = split_sql_statements(translate_schema_to_postgres(mig))
             assert all(
                 s.upper().lstrip("-— \n").startswith(("CREATE", "--", "ALTER", "DROP", "INSERT", "UPDATE"))
                 or s.startswith("--")
                 for s in stmts
             ), stmts
-            assert len(stmts) >= 10
+            # the initial schema is the whole world; later migrations are
+            # incremental and may be a single table + index
+            assert len(stmts) >= (10 if i == 0 else 1)
