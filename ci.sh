@@ -27,6 +27,12 @@
 #                      (tests/test_crash_chaos.py, slow-marked so tier-1
 #                      timing is unaffected) — real replica binaries killed
 #                      mid-step, lease reaper + journal replay verified.
+#   ./ci.sh obs        observability gate: tests/test_observability.py —
+#                      trace-context propagation, the metrics fallback, the
+#                      health server's zpages (/statusz included), and the
+#                      golden metric-name/label manifest
+#                      (tests/metric_manifest.txt) that catches silent
+#                      metric renames.
 #   ./ci.sh dryrun     the driver's gates: multichip dryrun + entry compile.
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -115,6 +121,13 @@ case "$tier" in
     fi
     exec python -m pytest tests/test_chaos.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
     ;;
+  obs)
+    # Observability gate (ISSUE 5): runs everywhere — the pure-Python
+    # metrics fallback keeps the metric assertions meaningful even where
+    # prometheus_client is absent; datastore-backed cases skip without
+    # `cryptography`.
+    exec python -m pytest tests/test_observability.py -q
+    ;;
   dryrun)
     python __graft_entry__.py 8
     exec python - <<'EOF'
@@ -126,7 +139,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|chaos|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|chaos|obs|dryrun]" >&2
     exit 2
     ;;
 esac

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..core.time import time_to_batch_interval_start
+from ..core.trace import new_trace_id
 from ..datastore import (
     AggregationJob,
     AggregationJobState,
@@ -117,6 +118,11 @@ class AggregationJobCreator:
                 client_timestamp_interval=Interval(Time(start), Duration(end - start)),
                 state=AggregationJobState.IN_PROGRESS,
                 step=AggregationJobStep(0),
+                # Trace mint point (ISSUE 5): the job's whole cross-process
+                # pipeline — every driver step on any replica, the helper's
+                # handling, log lines and chrome-trace spans — joins on
+                # this persisted id.
+                trace_id=new_trace_id(),
             )
             ras = []
             for ord_, meta in enumerate(group):

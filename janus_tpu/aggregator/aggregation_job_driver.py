@@ -1252,6 +1252,11 @@ class AggregationJobDriver:
         if task.aggregator_auth_token is not None:
             name, value = task.aggregator_auth_token.request_authentication()
             headers[name] = value
+        # Cross-process trace propagation: the helper binds this request's
+        # trace id so both aggregators' spans/logs join one timeline.
+        from ..core.trace import inject_traceparent
+
+        inject_traceparent(headers)
         try:
             status, resp_body, _ = await retry_http_request(
                 self._get_session(),

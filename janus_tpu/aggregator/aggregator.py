@@ -524,6 +524,8 @@ class Aggregator:
                 )
             resps.append(resp)
 
+        from ..core.trace import current_trace
+
         job = AggregationJob(
             task_id=task_id,
             aggregation_job_id=aggregation_job_id,
@@ -541,6 +543,9 @@ class Aggregator:
             else AggregationJobState.IN_PROGRESS,
             step=AggregationJobStep(0),
             last_request_hash=request_hash,
+            # cross-process correlation: the leader driver's traceparent
+            # (bound by the HTTP layer) persists on the helper's job row
+            trace_id=current_trace().get("trace_id"),
         )
 
         # Helper-side retention (ISSUE 4 satellite): finished rows carrying
@@ -1260,6 +1265,14 @@ class Aggregator:
         if err is not None:
             raise BatchInvalid(err)
 
+        # Trace mint point: the collection pipeline (readiness polls,
+        # journal replays, helper share exchange) joins on this id.
+        # Resolved OUTSIDE the tx closure — contextvars do not cross the
+        # datastore's executor thread.
+        from ..core.trace import current_trace, new_trace_id
+
+        trace_id = current_trace().get("trace_id") or new_trace_id()
+
         def tx_fn(tx):
             existing = tx.get_collection_job(
                 task_id, collection_job_id, task.query_type.kind
@@ -1305,6 +1318,7 @@ class Aggregator:
                     aggregation_parameter=req.aggregation_parameter,
                     batch_identifier=ident,
                     state=CollectionJobState.START,
+                    trace_id=trace_id,
                 )
             )
             if getattr(ta.vdaf, "REQUIRES_AGG_PARAM", False):
@@ -1313,12 +1327,19 @@ class Aggregator:
                 # are created here, re-reading the (never scrubbed) client
                 # reports for each level (the reference gates the analogous
                 # path behind test-util, aggregation_job_creator.rs:741).
-                self._create_agg_param_jobs(tx, ta, ident, req.aggregation_parameter)
+                self._create_agg_param_jobs(
+                    tx, ta, ident, req.aggregation_parameter, trace_id=trace_id
+                )
 
         await self.datastore.run_tx_async("create_collection_job", tx_fn)
 
     def _create_agg_param_jobs(
-        self, tx, ta: TaskAggregator, collection_identifier: bytes, agg_param: bytes
+        self,
+        tx,
+        ta: TaskAggregator,
+        collection_identifier: bytes,
+        agg_param: bytes,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Create aggregation jobs for one (batch, aggregation parameter)."""
         from .aggregation_job_writer import AggregationJobWriter
@@ -1366,6 +1387,8 @@ class Aggregator:
                 ),
                 state=AggregationJobState.IN_PROGRESS,
                 step=AggregationJobStep(0),
+                # collection-driven jobs inherit the collection's trace id
+                trace_id=trace_id,
             )
             ras = [
                 ReportAggregation(

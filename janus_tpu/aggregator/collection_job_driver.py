@@ -216,6 +216,9 @@ class CollectionJobDriver:
         if task.aggregator_auth_token is not None:
             name, value = task.aggregator_auth_token.request_authentication()
             headers[name] = value
+        from ..core.trace import inject_traceparent
+
+        inject_traceparent(headers)
         try:
             status, body, _ = await retry_http_request(
                 self._get_session(),
@@ -259,6 +262,16 @@ class CollectionJobDriver:
             tx.release_collection_job(lease)
 
         await self.datastore.run_tx_async("step_collection_job_2", tx2)
+
+        # Pipeline-freshness SLO: end-to-end age of the collected batch —
+        # collection finish minus its earliest client timestamp, the "how
+        # old is a report by the time it lands in an aggregate" histogram.
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None and interval is not None:
+            GLOBAL_METRICS.collection_e2e.observe(
+                max(0.0, float(self.datastore.now().seconds - interval.start.seconds))
+            )
 
     # ------------------------------------------------------------------
     async def _replay_outstanding_journal(self, acq) -> None:

@@ -106,18 +106,28 @@ async def _maybe_taskprov(request: web.Request, task_id: TaskId) -> None:
 
 def _route(handler):
     """Wrap a handler: task-id parsing, error → problem-document mapping,
-    and per-route request metrics (reference: http_handlers.rs error mapping
-    + instrumented spans + :225-281 route counters)."""
+    per-route request metrics, and trace-context adoption — the peer's
+    ``traceparent`` header (W3C trace id, sent by the leader's drivers) is
+    bound for the request so helper-side logs and chrome-trace spans join
+    the job's cross-process timeline (reference: http_handlers.rs error
+    mapping + instrumented spans + :225-281 route counters)."""
     import time as _time
 
     from ..core.metrics import GLOBAL_METRICS
+    from ..core.trace import parse_traceparent, trace_scope, trace_span
 
     async def wrapped(request: web.Request) -> web.Response:
         t0 = _time.monotonic()
-        resp = await _wrapped_inner(request)
         route = request.match_info.route.resource
+        route_name = route.canonical if route else request.path
+        with trace_scope(
+            trace_id=parse_traceparent(request.headers.get("traceparent"))
+        ), trace_span(
+            "http_request", cat="http", method=request.method, route=route_name
+        ):
+            resp = await _wrapped_inner(request)
         GLOBAL_METRICS.observe_http(
-            route.canonical if route else request.path,
+            route_name,
             resp.status,
             _time.monotonic() - t0,
         )
@@ -133,6 +143,9 @@ def _route(handler):
                     from .error import InvalidMessage
 
                     raise InvalidMessage("malformed task id")
+                from ..core.trace import bind_trace
+
+                bind_trace(task_id=task_id)
                 # in-band task provisioning (reference: aggregator.rs:722)
                 await _maybe_taskprov(request, task_id)
             return await handler(request, task_id)

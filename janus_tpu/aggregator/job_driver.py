@@ -130,7 +130,7 @@ class JobDriver:
 
     async def _step(self, sem: asyncio.Semaphore, lease: Lease) -> None:
         from ..core.metrics import GLOBAL_METRICS
-        from ..core.trace import trace_span
+        from ..core.trace import trace_scope, trace_span
 
         async with sem:
             # per-job timeout: remaining lease minus skew allowance
@@ -141,12 +141,27 @@ class JobDriver:
                 - self.clock.now().seconds
                 - self.worker_lease_clock_skew_allowance.seconds,
             )
+            leased = lease.leased
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.job_age_at_acquire.labels(
+                    job_type=self.job_type
+                ).observe(getattr(leased, "age_seconds", 0.0))
+            job_id = getattr(leased, "aggregation_job_id", None) or getattr(
+                leased, "collection_job_id", None
+            )
             # Per-outcome accounting: on wall time alone, a fleet spinning
             # on timeouts/retries is indistinguishable from a healthy one.
             outcome = "ok"
-            with trace_span(
+            # Bind the job's persisted trace context for the whole step:
+            # every log line, chrome-trace span, and outbound traceparent
+            # from this replica joins the job's cross-process timeline.
+            with trace_scope(
+                trace_id=getattr(leased, "trace_id", None),
+                task_id=leased.task_id,
+                job_id=job_id,
+            ), trace_span(
                 "job_step",
-                job_type=type(lease.leased).__name__,
+                job_type=type(leased).__name__,
                 attempts=lease.lease_attempts,
             ):
                 try:

@@ -123,11 +123,17 @@ class ReportWriteBatcher:
                     if not fut.done():
                         fut.set_exception(e)
             return
-        if GLOBAL_METRICS.registry is not None:
-            GLOBAL_METRICS.upload_outcomes.labels(decision="accepted").inc(
-                sum(1 for o in outcomes if o is None)
-            )
+        have_metrics = GLOBAL_METRICS.registry is not None
+        now_s = self.datastore.now().seconds if have_metrics else 0
+        accepted = 0
         for (report, futs), outcome in zip(unique, outcomes):
+            if outcome is None and have_metrics:
+                accepted += 1
+                # Freshness SLO input: report age at commit (client
+                # timestamp -> writer commit) per accepted report.
+                GLOBAL_METRICS.report_commit_age.observe(
+                    max(0.0, float(now_s - report.time.seconds))
+                )
             for fut in futs:
                 if fut.done():
                     continue
@@ -135,3 +141,5 @@ class ReportWriteBatcher:
                     fut.set_result(None)
                 else:
                     fut.set_exception(outcome)
+        if have_metrics:
+            GLOBAL_METRICS.upload_outcomes.labels(decision="accepted").inc(accepted)

@@ -123,6 +123,13 @@ class CommonConfig:
     #: (datastore tx, peer HTTP, executor/device launches, clock skew);
     #: fully off by default.
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+    #: Status sampler cadence (core/statusz.py): publishes the sampled
+    #: queue-depth/freshness gauges (acquirable jobs, outstanding journal
+    #: rows + oldest age) and retires idle executor buckets.  <= 0 disables.
+    status_sample_interval_s: float = 5.0
+    #: Idle threshold for executor-bucket gauge retirement (cardinality
+    #: cap); <= 0 keeps every bucket's series forever (pre-ISSUE-5 shape).
+    executor_bucket_idle_s: float = 600.0
 
 
 @dataclass

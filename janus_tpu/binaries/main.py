@@ -122,12 +122,14 @@ def _stop_event_on_signals(loop) -> asyncio.Event:
     return stop
 
 
-async def _serve_health(listen_address: str):
-    """Health + zpages server: /healthz, /metrics, PUT /traceconfigz
-    (reference: binary_utils.rs:398-456)."""
+async def _serve_health(listen_address: str, datastore: Optional[Datastore] = None):
+    """Health + zpages server: /healthz, /metrics, PUT /traceconfigz, and
+    the GET /statusz introspection plane (reference: binary_utils.rs:398-456
+    + the reference's zpages; core/statusz.py builds the snapshot)."""
     from aiohttp import web
 
     from ..core.metrics import GLOBAL_METRICS
+    from ..core.statusz import statusz_snapshot
     from ..core.trace import reload_trace_filter
 
     async def healthz(_):
@@ -141,12 +143,16 @@ async def _serve_health(listen_address: str):
         reload_trace_filter(level)
         return web.Response(text=f"log level set to {level}\n")
 
+    async def statusz(_):
+        return web.json_response(await statusz_snapshot(datastore))
+
     app = web.Application()
     app.add_routes(
         [
             web.get("/healthz", healthz),
             web.get("/metrics", metrics),
             web.put("/traceconfigz", traceconfigz),
+            web.get("/statusz", statusz),
         ]
     )
     runner = web.AppRunner(app)
@@ -155,6 +161,48 @@ async def _serve_health(listen_address: str):
     site = web.TCPSite(runner, host, port)
     await site.start()
     return runner
+
+
+def _start_status_sampler(stop: asyncio.Event, datastore: Datastore, common):
+    """The small sampler loop every binary runs beside its main loop
+    (ISSUE 5): publishes acquirable-backlog and journal-freshness gauges
+    and retires idle executor buckets.  Returns the task (or None when
+    disabled)."""
+    interval = getattr(common, "status_sample_interval_s", 0)
+    if not interval or interval <= 0:
+        return None
+
+    from ..core.statusz import retire_idle_executor_buckets, sample_status_metrics
+
+    async def loop_():
+        while not stop.is_set():
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: sample_status_metrics(datastore)
+                )
+                retire_idle_executor_buckets(
+                    getattr(common, "executor_bucket_idle_s", 0)
+                )
+            except Exception:
+                logger.exception("status sample failed")
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+
+    return asyncio.ensure_future(loop_())
+
+
+def _close_tracing() -> None:
+    """Graceful-shutdown hook shared by every binary: flush/close the
+    chrome tracer so a SIGTERM never truncates the trace mid-event
+    (ISSUE 5 satellite; SIGKILL still loses at most the open spans)."""
+    from ..core.trace import close_chrome_trace
+
+    try:
+        close_chrome_trace()
+    except Exception:
+        logger.exception("chrome-trace close failed during shutdown")
 
 
 # ---------------------------------------------------------------------------
@@ -188,7 +236,9 @@ def run_aggregator(config_path: Optional[str]) -> None:
     async def main():
         loop = asyncio.get_running_loop()
         stop = _stop_event_on_signals(loop)
-        health = await _serve_health(cfg.common.health_check_listen_address)
+        health = await _serve_health(
+            cfg.common.health_check_listen_address, datastore=datastore
+        )
         app = aggregator_app(agg)
         runner = web.AppRunner(app)
         await runner.setup()
@@ -211,6 +261,9 @@ def run_aggregator(config_path: Optional[str]) -> None:
                     pass
 
         tasks = []
+        sampler = _start_status_sampler(stop, datastore, cfg.common)
+        if sampler is not None:
+            tasks.append(sampler)
         if cfg.garbage_collection_interval_s:
             gc = GarbageCollector(datastore)
             tasks.append(
@@ -253,6 +306,7 @@ def run_aggregator(config_path: Optional[str]) -> None:
                 ex.shutdown(drain=True)
         await runner.cleanup()
         await health.cleanup()
+        _close_tracing()
 
     asyncio.run(main())
 
@@ -276,7 +330,10 @@ def run_aggregation_job_creator(config_path: Optional[str]) -> None:
     async def main():
         loop = asyncio.get_running_loop()
         stop = _stop_event_on_signals(loop)
-        health = await _serve_health(cfg.common.health_check_listen_address)
+        health = await _serve_health(
+            cfg.common.health_check_listen_address, datastore=datastore
+        )
+        sampler = _start_status_sampler(stop, datastore, cfg.common)
         while not stop.is_set():
             try:
                 n = await creator.run_once()
@@ -290,7 +347,10 @@ def run_aggregation_job_creator(config_path: Optional[str]) -> None:
                 )
             except asyncio.TimeoutError:
                 pass
+        if sampler is not None:
+            await asyncio.gather(sampler, return_exceptions=True)
         await health.cleanup()
+        _close_tracing()
 
     asyncio.run(main())
 
@@ -424,7 +484,10 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
     async def main():
         loop = asyncio.get_running_loop()
         stop = _stop_event_on_signals(loop)
-        health = await _serve_health(cfg.common.health_check_listen_address)
+        health = await _serve_health(
+            cfg.common.health_check_listen_address, datastore=datastore
+        )
+        sampler = _start_status_sampler(stop, datastore, cfg.common)
         await driver.run(stop)
         # Graceful teardown (SIGTERM): in-flight steps have drained and
         # released their leases in-tx; now flush the executor's pending
@@ -435,7 +498,10 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             await stepper_impl.shutdown()
         else:
             await stepper_impl.close()
+        if sampler is not None:
+            await asyncio.gather(sampler, return_exceptions=True)
         await health.cleanup()
+        _close_tracing()
 
     asyncio.run(main())
 

@@ -143,6 +143,24 @@ class FaultRegistry:
             self._rngs = {}
             self.active = False
 
+    def snapshot(self) -> dict:
+        """Arm-state introspection for /statusz: armed flag, seed, the
+        per-point spec modes, and injection hit counts so an operator can
+        see at a glance whether a wedged soak is chaos pressure or a bug."""
+        with self._lock:
+            return {
+                "armed": self.active,
+                "seed": self._seed,
+                "points": {
+                    point: [
+                        {"mode": s.mode, "probability": s.probability}
+                        for s in specs
+                    ]
+                    for point, specs in sorted(self._specs.items())
+                },
+                "hits": dict(self.hits),
+            }
+
     # -- sampling -------------------------------------------------------
     def _decide(self, point: str) -> Optional[FaultSpec]:
         """Roll each of the point's specs in order; first hit wins.
@@ -262,6 +280,10 @@ def configure(specs: Sequence[FaultSpec], seed: int = 0) -> None:
 
 def clear() -> None:
     _REGISTRY.clear()
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
 
 
 def active() -> bool:

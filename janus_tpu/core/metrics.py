@@ -10,8 +10,9 @@ Exported via a Prometheus scrape endpoint on the health server
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 try:
     from prometheus_client import (
@@ -32,15 +33,256 @@ _LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: Pipeline-freshness buckets: ages from sub-second commit latencies up to
+#: a day-old report landing in an aggregate (SLO alerting range).
+_AGE_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0,
+    3600.0, 7200.0, 21600.0, 86400.0,
+)
+
+
+# -- pure-Python fallback metric implementation ------------------------------
+# When prometheus_client is absent (dev containers without the baked
+# image), Metrics used to no-op (registry=None) — which also silenced every
+# metric-invariant ASSERTION the chaos suites want to make.  This fallback
+# keeps the same Counter/Gauge/Histogram surface (labels/inc/set/observe/
+# remove) in plain dicts, exports Prometheus text, and answers
+# ``registry.get_sample_value`` exactly like CollectorRegistry does, so
+# tests and /metrics behave identically either way.
+
+
+class _FallbackChild:
+    def __init__(self, metric: "_FallbackMetric", key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._metric._lock:
+            self._metric._values[self._key] = (
+                self._metric._values.get(self._key, 0.0) + amount
+            )
+
+    def set(self, value: float) -> None:
+        with self._metric._lock:
+            self._metric._values[self._key] = float(value)
+
+    def observe(self, value: float) -> None:
+        with self._metric._lock:
+            count, total, buckets = self._metric._hist.get(
+                self._key, (0, 0.0, [0] * len(self._metric.buckets))
+            )
+            buckets = list(buckets)
+            for i, le in enumerate(self._metric.buckets):
+                if value <= le:
+                    buckets[i] += 1
+            self._metric._hist[self._key] = (count + 1, total + value, buckets)
+
+
+class _FallbackMetric:
+    """One metric family (all label sets) of the fallback registry."""
+
+    def __init__(
+        self,
+        name: str,
+        documentation: str,
+        labelnames: Tuple[str, ...] = (),
+        registry: Optional["FallbackRegistry"] = None,
+        buckets: Tuple[float, ...] = (),
+        kind: str = "counter",
+    ):
+        self.name = name
+        self.documentation = documentation
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets)
+        self.kind = kind
+        self._lock = threading.Lock()
+        #: label-value tuple -> scalar (counter/gauge)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        #: label-value tuple -> (count, sum, per-bucket cumulative counts)
+        self._hist: Dict[Tuple[str, ...], Tuple[int, float, List[int]]] = {}
+        if registry is not None:
+            registry.register(self)
+        # an unlabeled metric is usable without .labels()
+        if not self.labelnames:
+            self._root = _FallbackChild(self, ())
+
+    def labels(self, *values, **kwargs) -> _FallbackChild:
+        if kwargs:
+            values = tuple(str(kwargs[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {values}"
+            )
+        return _FallbackChild(self, values)
+
+    def remove(self, *values) -> None:
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._values.pop(key, None)
+            self._hist.pop(key, None)
+
+    # unlabeled passthroughs
+    def inc(self, amount: float = 1.0) -> None:
+        self._root.inc(amount)
+
+    def set(self, value: float) -> None:
+        self._root.set(value)
+
+    def observe(self, value: float) -> None:
+        self._root.observe(value)
+
+
+class FallbackRegistry:
+    """Dict-of-families registry with CollectorRegistry's read surface."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _FallbackMetric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _FallbackMetric) -> None:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics[metric.name] = metric
+
+    def families(self) -> List[_FallbackMetric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    @staticmethod
+    def _label_str(labelnames, key) -> str:
+        if not labelnames:
+            return ""
+        pairs = ",".join(f'{n}="{v}"' for n, v in zip(labelnames, key))
+        return "{" + pairs + "}"
+
+    def get_sample_value(
+        self, name: str, labels: Optional[dict] = None
+    ) -> Optional[float]:
+        """CollectorRegistry-compatible: ``name`` is the SAMPLE name
+        (``..._total``, ``..._count``, ``..._sum``, ``..._bucket``)."""
+        labels = dict(labels or {})
+        for m in self.families():
+            with m._lock:
+                if m.kind == "counter" and name == m.name + "_total":
+                    key = tuple(str(labels.get(n, "")) for n in m.labelnames)
+                    return self._maybe(m._values, key, labels, m.labelnames)
+                if m.kind == "gauge" and name == m.name:
+                    key = tuple(str(labels.get(n, "")) for n in m.labelnames)
+                    return self._maybe(m._values, key, labels, m.labelnames)
+                if m.kind == "histogram" and name.startswith(m.name + "_"):
+                    suffix = name[len(m.name) + 1 :]
+                    le = labels.pop("le", None)
+                    key = tuple(str(labels.get(n, "")) for n in m.labelnames)
+                    entry = m._hist.get(key)
+                    if entry is None:
+                        return None
+                    count, total, buckets = entry
+                    if suffix == "count":
+                        return float(count)
+                    if suffix == "sum":
+                        return total
+                    if suffix == "bucket":
+                        if le in ("+Inf", None):
+                            return float(count)
+                        for i, b in enumerate(m.buckets):
+                            if _le_str(b) == le:
+                                return float(buckets[i])
+                        return None
+        return None
+
+    @staticmethod
+    def _maybe(values, key, labels, labelnames) -> Optional[float]:
+        if set(labels) - set(labelnames):
+            return None
+        return values.get(key)
+
+    def generate_text(self) -> bytes:
+        """Prometheus exposition text for /metrics scrapes."""
+        out: List[str] = []
+        for m in self.families():
+            out.append(f"# HELP {m.name} {m.documentation}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            with m._lock:
+                if m.kind == "histogram":
+                    for key, (count, total, buckets) in sorted(m._hist.items()):
+                        base = list(zip(m.labelnames, key))
+                        for i, le in enumerate(m.buckets):
+                            lbl = self._label_str(
+                                [n for n, _ in base] + ["le"],
+                                [v for _, v in base] + [_le_str(le)],
+                            )
+                            out.append(f"{m.name}_bucket{lbl} {buckets[i]}")
+                        lbl = self._label_str(
+                            [n for n, _ in base] + ["le"],
+                            [v for _, v in base] + ["+Inf"],
+                        )
+                        out.append(f"{m.name}_bucket{lbl} {count}")
+                        plain = self._label_str(m.labelnames, key)
+                        out.append(f"{m.name}_count{plain} {count}")
+                        out.append(f"{m.name}_sum{plain} {total}")
+                else:
+                    suffix = "_total" if m.kind == "counter" else ""
+                    for key, value in sorted(m._values.items()):
+                        lbl = self._label_str(m.labelnames, key)
+                        out.append(f"{m.name}{suffix}{lbl} {value}")
+        return ("\n".join(out) + "\n").encode()
+
+
+def _le_str(bound: float) -> str:
+    """Render a bucket bound exactly like prometheus_client's
+    floatToGoString does for our finite bounds ('5.0', not '5'), so
+    ``le`` label values — in scrapes and in get_sample_value lookups —
+    agree between backends."""
+    return repr(float(bound))
+
+
+def _fallback_counter(name, doc, labelnames=(), registry=None):
+    # prometheus_client strips a declared "_total" suffix from the family
+    # name and re-appends it on the sample; mirror that so sample names
+    # (and the golden catalog) agree between backends
+    if name.endswith("_total"):
+        name = name[: -len("_total")]
+    return _FallbackMetric(name, doc, labelnames, registry, kind="counter")
+
+
+def _fallback_gauge(name, doc, labelnames=(), registry=None):
+    return _FallbackMetric(name, doc, labelnames, registry, kind="gauge")
+
+
+def _fallback_histogram(name, doc, labelnames=(), registry=None, buckets=()):
+    return _FallbackMetric(
+        name, doc, labelnames, registry, buckets=buckets, kind="histogram"
+    )
+
 
 class Metrics:
-    """Domain metrics bundle; one per process."""
+    """Domain metrics bundle; one per process.
 
-    def __init__(self, registry: Optional["CollectorRegistry"] = None):
-        if not HAVE_PROMETHEUS:
-            self.registry = None
-            return
-        self.registry = registry or CollectorRegistry()
+    With ``prometheus_client`` available the bundle is a real
+    CollectorRegistry; without it (or with ``force_fallback=True``) the
+    pure-Python fallback above keeps every series live so dev-container
+    runs still scrape and assert on metrics.
+    """
+
+    def __init__(
+        self,
+        registry: Optional["CollectorRegistry"] = None,
+        force_fallback: bool = False,
+    ):
+        self.fallback = force_fallback or not HAVE_PROMETHEUS
+        if self.fallback:
+            self.registry = FallbackRegistry()
+            Counter = _fallback_counter  # noqa: N806 - mirror prometheus API
+            Gauge = _fallback_gauge  # noqa: N806
+            Histogram = _fallback_histogram  # noqa: N806
+        else:
+            self.registry = registry or CollectorRegistry()
+            # local bindings: the fallback branch shadows these names, which
+            # makes them function-local in BOTH branches
+            from prometheus_client import Counter, Gauge, Histogram  # noqa: F811
         self.http_requests = Counter(
             "janus_http_requests_total",
             "DAP HTTP requests by route and status",
@@ -242,6 +484,86 @@ class Metrics:
             registry=self.registry,
         )
 
+        # -- pipeline freshness / SLO metrics (ISSUE 5 tentpole) ---------
+        # The operator question that defines a DAP deployment's SLO: how
+        # old is a report by the time it lands where it is going?
+        # reference analog: per-step timing meters, metrics.rs:303-323.
+        self.report_commit_age = Histogram(
+            "janus_report_commit_age_seconds",
+            "Report age at upload-batch commit (client timestamp -> writer commit)",
+            registry=self.registry,
+            buckets=_AGE_BUCKETS,
+        )
+        self.job_age_at_acquire = Histogram(
+            "janus_job_age_at_acquire_seconds",
+            "Job age (created_at -> lease acquire) by job type",
+            ["job_type"],
+            registry=self.registry,
+            buckets=_AGE_BUCKETS,
+        )
+        self.collection_e2e = Histogram(
+            "janus_collection_e2e_seconds",
+            "Upload->collectable latency: collection finish minus the "
+            "batch's earliest client timestamp",
+            registry=self.registry,
+            buckets=_AGE_BUCKETS,
+        )
+        # Sampled queue-depth gauges (binaries' status sampler loop):
+        # acquirable backlog per job type, and the outstanding deferred-
+        # drain journal (rows counted but not yet merged + oldest age —
+        # a rising oldest-age is a dead replica whose rows nobody replayed).
+        self.acquirable_jobs = Gauge(
+            "janus_acquirable_jobs",
+            "Jobs currently acquirable (active state, lease expired) by job type",
+            ["job_type"],
+            registry=self.registry,
+        )
+        self.journal_outstanding_rows = Gauge(
+            "janus_accumulator_journal_outstanding_rows",
+            "Outstanding accumulator-journal rows (counted reports whose "
+            "shares are not yet merged)",
+            registry=self.registry,
+        )
+        self.journal_oldest_age = Gauge(
+            "janus_accumulator_journal_oldest_age_seconds",
+            "Age of the oldest outstanding accumulator-journal row",
+            registry=self.registry,
+        )
+
+    # -- introspection ---------------------------------------------------
+    def get_sample_value(self, name: str, labels: Optional[dict] = None):
+        """Read one sample (Prometheus sample naming: ``..._total``,
+        ``..._count``, ...) from whichever registry backs this bundle —
+        the accessor metric-invariant assertions use."""
+        if self.registry is None:
+            return None
+        return self.registry.get_sample_value(name, labels or {})
+
+    def catalog(self) -> List[str]:
+        """``name|type|label,label`` per metric family, sorted — compared
+        against tests/metric_manifest.txt so a silent rename/label change
+        fails CI.  Built from the metric objects themselves (not scrape
+        samples), so zero-traffic families are still listed."""
+        out = []
+        for obj in vars(self).values():
+            if isinstance(obj, _FallbackMetric):
+                out.append(f"{obj.name}|{obj.kind}|{','.join(obj.labelnames)}")
+            elif hasattr(obj, "_name") and hasattr(obj, "_labelnames"):
+                out.append(
+                    f"{obj._name}|{obj._type}|{','.join(obj._labelnames)}"
+                )
+        return sorted(out)
+
+    @staticmethod
+    def remove_series(metric, *labelvalues) -> None:
+        """Drop one label set from a metric (both backends); quiet when the
+        series never existed — bucket retirement calls this to cap gauge
+        cardinality."""
+        try:
+            metric.remove(*labelvalues)
+        except Exception:
+            pass
+
     def observe_prepare(self, backend: str, phase: str, reports: int, seconds: float) -> None:
         if self.registry is None:
             return
@@ -258,6 +580,8 @@ class Metrics:
     def export(self) -> bytes:
         if self.registry is None:
             return b""
+        if isinstance(self.registry, FallbackRegistry):
+            return self.registry.generate_text()
         return generate_latest(self.registry)
 
 

@@ -1,0 +1,129 @@
+"""/statusz snapshot: one JSON document of live fleet state.
+
+The thing an operator curls when a soak wedges (ISSUE 5 tentpole): served
+by every binary's health server (binaries/main.py), it assembles the
+process-local runtime state (executor buckets, accumulator occupancy,
+circuit breakers, fault-registry arm state, trace configuration) plus the
+datastore's shared view (outstanding accumulator-journal rows, lease
+occupancy, acquirable backlog) into one consistent snapshot.  Everything
+here is read-only and cheap — indexed COUNTs and in-memory stats — so
+hitting it against a wedged replica never makes things worse.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger("janus_tpu.statusz")
+
+_PROCESS_START = time.monotonic()
+
+
+def runtime_status() -> dict:
+    """Process-local sections (no datastore): safe to call anywhere."""
+    from . import faults
+    from .trace import chrome_trace_path, current_trace
+
+    doc: dict = {
+        "pid": os.getpid(),
+        "uptime_s": round(time.monotonic() - _PROCESS_START, 1),
+        "log_level": logging.getLevelName(logging.getLogger().level),
+        "trace": {
+            "chrome_trace_path": chrome_trace_path(),
+            "context": current_trace() or None,
+        },
+        "faults": faults.snapshot(),
+    }
+
+    from ..executor import peek_global_executor
+
+    ex = peek_global_executor()
+    if ex is None:
+        doc["executor"] = {"enabled": False}
+        doc["accumulator"] = None
+    else:
+        doc["executor"] = {
+            "enabled": True,
+            "buckets": ex.stats(),
+            "circuits": ex.circuit_stats(),
+        }
+        doc["accumulator"] = (
+            ex.accumulator.stats() if ex.accumulator is not None else None
+        )
+    return doc
+
+
+async def statusz_snapshot(datastore=None, clock=None) -> dict:
+    """The full document; ``datastore`` adds the shared-state sections
+    (journal, leases, acquirable backlog)."""
+    doc = runtime_status()
+    if datastore is None:
+        doc["journal"] = None
+        doc["leases"] = None
+        return doc
+
+    def q(tx):
+        count, oldest = tx.accumulator_journal_stats()
+        # lease_summary carries the per-type 'acquirable' counts — it is
+        # the single read-side source for the acquisition predicate
+        return {
+            "journal_rows": count,
+            "journal_oldest": oldest,
+            "leases": tx.lease_summary(),
+        }
+
+    try:
+        shared = await datastore.run_tx_async("statusz", q)
+    except Exception:
+        # a wedged datastore must not take /statusz down with it — the
+        # process-local sections are exactly what the operator needs then
+        logger.exception("statusz datastore sections unavailable")
+        doc["journal"] = {"error": "datastore unavailable"}
+        doc["leases"] = {"error": "datastore unavailable"}
+        return doc
+    now_s = (clock or datastore.clock).now().seconds
+    oldest = shared["journal_oldest"]
+    doc["journal"] = {
+        "outstanding_rows": shared["journal_rows"],
+        "oldest_age_s": max(0, now_s - oldest) if oldest is not None else None,
+    }
+    doc["leases"] = shared["leases"]
+    return doc
+
+
+def sample_status_metrics(datastore, clock=None) -> None:
+    """One status-sampler tick (synchronous; run from an executor thread):
+    publish the sampled queue-depth/freshness gauges and retire idle
+    executor buckets.  Driven by the binaries' main loops on
+    ``common.status_sample_interval_s``."""
+    from .metrics import GLOBAL_METRICS
+
+    def q(tx):
+        count, oldest = tx.accumulator_journal_stats()
+        return count, oldest, tx.lease_summary()
+
+    count, oldest, leases = datastore.run_tx("status_sample", q)
+    if GLOBAL_METRICS.registry is not None:
+        now_s = (clock or datastore.clock).now().seconds
+        GLOBAL_METRICS.journal_outstanding_rows.set(count)
+        GLOBAL_METRICS.journal_oldest_age.set(
+            max(0, now_s - oldest) if oldest is not None else 0
+        )
+        for job_type, summary in leases.items():
+            GLOBAL_METRICS.acquirable_jobs.labels(job_type=job_type).set(
+                summary["acquirable"]
+            )
+
+
+def retire_idle_executor_buckets(max_idle_s: float) -> int:
+    """Sampler-tick companion: cap bucket-gauge cardinality (ISSUE 5
+    satellite).  No-op when no executor exists in this process."""
+    from ..executor import peek_global_executor
+
+    ex = peek_global_executor()
+    if ex is None or max_idle_s <= 0:
+        return 0
+    return ex.retire_idle_buckets(max_idle_s)

@@ -10,12 +10,108 @@ harness can enable — host tracing stays here.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import json
 import logging
+import os
+import secrets
 import sys
 import time
 from dataclasses import dataclass
 from typing import Optional
+
+
+# -- cross-process trace context ---------------------------------------------
+# The fleet-wide correlation layer (reference: trace.rs OTel trace layer +
+# the W3C traceparent the OTLP exporter propagates): a trace id is minted
+# once per pipeline entity (upload batch / aggregation job / collection
+# job), persisted on the job row, carried leader->helper in DAP HTTP
+# headers, and bound here — a contextvar, so it follows the asyncio task —
+# for every log line and ChromeTracer span to pick up.  That is what makes
+# one aggregation job's timeline joinable across replica processes.
+
+#: fields: trace_id (32 hex chars), task_id, job_id — all optional strings
+_TRACE_CTX: contextvars.ContextVar[dict] = contextvars.ContextVar(
+    "janus_trace_ctx", default={}
+)
+
+#: ctx keys stamped onto log records and chrome-trace span args
+TRACE_CTX_KEYS = ("trace_id", "task_id", "job_id")
+
+
+def new_trace_id() -> str:
+    """A W3C-traceparent-style 16-byte random trace id (32 hex chars)."""
+    return secrets.token_hex(16)
+
+
+def current_trace() -> dict:
+    """The bound trace context ({} when none)."""
+    return _TRACE_CTX.get()
+
+
+def bind_trace(**fields) -> contextvars.Token:
+    """Merge ``fields`` (trace_id/task_id/job_id) into the bound context;
+    returns a token for :func:`unbind_trace`.  None values are dropped so
+    an unset field inherits the enclosing scope's."""
+    merged = dict(_TRACE_CTX.get())
+    for k, v in fields.items():
+        if v is not None:
+            merged[k] = str(v)
+    return _TRACE_CTX.set(merged)
+
+
+def unbind_trace(token: contextvars.Token) -> None:
+    _TRACE_CTX.reset(token)
+
+
+@contextlib.contextmanager
+def trace_scope(**fields):
+    """``with trace_scope(trace_id=..., task_id=..., job_id=...):`` — the
+    scoped form of bind/unbind used by job steppers and HTTP handlers."""
+    token = bind_trace(**fields)
+    try:
+        yield
+    finally:
+        unbind_trace(token)
+
+
+def current_traceparent() -> Optional[str]:
+    """The bound context as a W3C ``traceparent`` header value
+    (``00-<trace-id>-<span-id>-01``), or None when no trace id is bound.
+    The span id is minted per call: each outbound hop is its own span."""
+    trace_id = _TRACE_CTX.get().get("trace_id")
+    if not trace_id:
+        return None
+    return f"00-{trace_id}-{secrets.token_hex(8)}-01"
+
+
+def inject_traceparent(headers: dict) -> None:
+    """Stamp the bound context's ``traceparent`` onto outbound request
+    ``headers`` (no-op when no trace id is bound) — the one place every
+    peer-HTTP path calls so cross-process correlation cannot be forgotten
+    by a new client."""
+    traceparent = current_traceparent()
+    if traceparent:
+        headers["traceparent"] = traceparent
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[str]:
+    """Extract the trace id from a ``traceparent`` header (None on any
+    malformation — a bad peer header must never break request handling)."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4 or len(parts[1]) != 32:
+        return None
+    trace_id = parts[1].lower()
+    # strict per-char hex: int(x, 16) would accept '+'/'-'/'_' and
+    # whitespace, adopting ids W3C-strict peers will drop downstream
+    if any(c not in "0123456789abcdef" for c in trace_id):
+        return None
+    if trace_id == "0" * 32:
+        return None
+    return trace_id
 
 
 @dataclass
@@ -26,9 +122,20 @@ class TraceConfiguration:
     level: str = "INFO"
 
 
+class TraceContextFilter(logging.Filter):
+    """Stamps the bound trace context onto every log record, so formatters
+    (and ad-hoc ``%(trace_id)s`` format strings) can render it."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = _TRACE_CTX.get()
+        for key in TRACE_CTX_KEYS:
+            setattr(record, key, ctx.get(key))
+        return True
+
+
 class JsonFormatter(logging.Formatter):
     """One JSON object per line (reference: trace.rs json/stackdriver
-    stdout modes)."""
+    stdout modes), carrying the bound trace context when present."""
 
     def format(self, record: logging.LogRecord) -> str:
         doc = {
@@ -37,6 +144,10 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
         }
+        for key in TRACE_CTX_KEYS:
+            value = getattr(record, key, None)
+            if value is not None:
+                doc[key] = value
         if record.exc_info:
             doc["exception"] = self.formatException(record.exc_info)
         return json.dumps(doc)
@@ -49,6 +160,7 @@ def install_trace_subscriber(config: Optional[TraceConfiguration] = None) -> Non
     for h in list(root.handlers):
         root.removeHandler(h)
     handler = logging.StreamHandler(sys.stdout)
+    handler.addFilter(TraceContextFilter())
     if config.use_json:
         handler.setFormatter(JsonFormatter())
     else:
@@ -76,6 +188,14 @@ class ChromeTracer:
 
     Thread-safe; events are appended as they close, so a crash loses at most
     the open spans (the format tolerates a missing closing bracket).
+
+    Cross-process merging (tools/trace_merge.py): events carry the real OS
+    pid, every span inherits the bound trace context (trace_id/task_id/
+    job_id) into its args, and a ``clock_sync`` metadata event records the
+    wall-clock epoch of this process's monotonic t0 so per-replica files
+    can be rebased onto one shared timeline.  A restarted replica pointed
+    at the same path APPENDS (its new pid gets its own clock_sync) instead
+    of truncating the dead incarnation's events.
     """
 
     def __init__(self, path: str):
@@ -83,9 +203,44 @@ class ChromeTracer:
 
         self.path = path
         self._lock = threading.Lock()
-        self._f = open(path, "w")
-        self._f.write("[\n")
+        self._closed = False
+        append = os.path.exists(path) and os.path.getsize(path) > 0
+        self._f = open(path, "a" if append else "w")
+        if not append:
+            self._f.write("[\n")
+        else:
+            # the dead incarnation may have been SIGKILLed mid-write: start
+            # on a fresh line so its partial trailing line cannot swallow
+            # our clock_sync event (trace_merge needs it to rebase us)
+            self._f.write("\n")
+        self.pid = os.getpid()
         self._t0 = time.monotonic()
+        self._write_event(
+            {
+                "name": "clock_sync",
+                "ph": "M",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"epoch_t0": time.time()},
+            }
+        )
+        self._write_event(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"name": f"{os.path.basename(sys.argv[0] or 'python')}:{self.pid}"},
+            }
+        )
+
+    def _write_event(self, ev: dict) -> None:
+        line = json.dumps(ev) + ",\n"
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line)
+            self._f.flush()
 
     def emit(self, name: str, cat: str, start_s: float, dur_s: float, **args) -> None:
         import threading
@@ -104,27 +259,33 @@ class ChromeTracer:
                 tid = 100000 + id(task) % 100000
         except RuntimeError:
             pass
+        ctx = _TRACE_CTX.get()
+        for key in TRACE_CTX_KEYS:
+            if key not in args and ctx.get(key) is not None:
+                args[key] = ctx[key]
         ev = {
             "name": name,
             "cat": cat,
             "ph": "X",
-            "pid": 1,
+            "pid": self.pid,
             "tid": tid,
             "ts": round((start_s - self._t0) * 1e6, 1),
             "dur": round(dur_s * 1e6, 1),
         }
         if args:
             ev["args"] = args
-        line = json.dumps(ev) + ",\n"
-        with self._lock:
-            self._f.write(line)
-            self._f.flush()
+        self._write_event(ev)
 
     def span(self, name: str, cat: str = "job", **args):
         return _Span(self, name, cat, args)
 
     def close(self) -> None:
+        """Flush and close; idempotent (the graceful-shutdown path and an
+        atexit/teardown race may both call it)."""
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             self._f.write("{}]\n")  # sentinel keeps the array valid JSON
             self._f.close()
 
@@ -161,6 +322,20 @@ def configure_chrome_trace(path: Optional[str]) -> Optional[ChromeTracer]:
     if path:
         _GLOBAL_TRACER = ChromeTracer(path)
     return _GLOBAL_TRACER
+
+
+def close_chrome_trace() -> None:
+    """Flush/close the global tracer WITHOUT dropping the configuration
+    handle — the binaries' graceful-shutdown (SIGTERM) hook, so soak traces
+    are never truncated mid-event.  Safe to call when tracing is off."""
+    if _GLOBAL_TRACER is not None:
+        _GLOBAL_TRACER.close()
+
+
+def chrome_trace_path() -> Optional[str]:
+    """The active chrome-trace output path (None when tracing is off) —
+    surfaced by /statusz."""
+    return _GLOBAL_TRACER.path if _GLOBAL_TRACER is not None else None
 
 
 class _NullSpan:

@@ -92,6 +92,17 @@ T = TypeVar("T")
 #: task-level query-type kind -> wire query-type class
 QUERY_TYPES = {"TimeInterval": TimeInterval, "FixedSize": FixedSize}
 
+#: (job_type, table, active-state) per leasable job table.  The
+#: "acquirable" predicate these imply — ``state = <active> AND
+#: lease_expiry <= now`` — MUST stay in lockstep with the
+#: acquire_incomplete_*_jobs queries; Transaction.lease_summary() is the
+#: single read-side source for those counts (/statusz + the
+#: janus_acquirable_jobs sampler).
+_JOB_LEASE_TABLES = (
+    ("aggregation", "aggregation_jobs", "InProgress"),
+    ("collection", "collection_jobs", "Start"),
+)
+
 
 class DatastoreError(Exception):
     pass
@@ -732,8 +743,8 @@ class Transaction:
                 """INSERT INTO aggregation_jobs (task_id, aggregation_job_id,
                     aggregation_param, batch_id, client_timestamp_interval_start,
                     client_timestamp_interval_duration, state, step,
-                    last_request_hash, created_at, updated_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?)""",
+                    last_request_hash, trace_id, created_at, updated_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?)""",
                 (
                     pk,
                     job.aggregation_job_id.data,
@@ -746,6 +757,7 @@ class Transaction:
                     job.state.value,
                     int(job.step),
                     job.last_request_hash,
+                    job.trace_id,
                     now,
                     now,
                 ),
@@ -760,13 +772,13 @@ class Transaction:
         row = self.conn.execute(
             """SELECT aggregation_param, batch_id, client_timestamp_interval_start,
                       client_timestamp_interval_duration, state, step,
-                      last_request_hash
+                      last_request_hash, trace_id
                FROM aggregation_jobs WHERE task_id = ? AND aggregation_job_id = ?""",
             (pk, aggregation_job_id.data),
         ).fetchone()
         if row is None:
             return None
-        param, batch_id, istart, idur, state, step, req_hash = row
+        param, batch_id, istart, idur, state, step, req_hash, trace_id = row
         return AggregationJob(
             task_id=task_id,
             aggregation_job_id=aggregation_job_id,
@@ -776,6 +788,7 @@ class Transaction:
             state=AggregationJobState(state),
             step=AggregationJobStep(step),
             last_request_hash=req_hash,
+            trace_id=trace_id,
         )
 
     def update_aggregation_job(self, job: AggregationJob) -> None:
@@ -802,7 +815,7 @@ class Transaction:
             """SELECT aggregation_job_id, aggregation_param, batch_id,
                       client_timestamp_interval_start,
                       client_timestamp_interval_duration, state, step,
-                      last_request_hash
+                      last_request_hash, trace_id
                FROM aggregation_jobs WHERE task_id = ? ORDER BY id""",
             (pk,),
         ).fetchall()
@@ -816,8 +829,19 @@ class Transaction:
                 state=AggregationJobState(state),
                 step=AggregationJobStep(step),
                 last_request_hash=req_hash,
+                trace_id=trace_id,
             )
-            for job_id, param, batch_id, istart, idur, state, step, req_hash in rows
+            for (
+                job_id,
+                param,
+                batch_id,
+                istart,
+                idur,
+                state,
+                step,
+                req_hash,
+                trace_id,
+            ) in rows
         ]
 
     def acquire_incomplete_aggregation_jobs(
@@ -838,12 +862,14 @@ class Transaction:
                        SELECT id FROM aggregation_jobs
                        WHERE state = 'InProgress' AND lease_expiry <= ?
                        ORDER BY id LIMIT ? /*skip-locked*/)
-                   RETURNING task_id, aggregation_job_id, lease_attempts""",
+                   RETURNING task_id, aggregation_job_id, lease_attempts,
+                             trace_id, created_at""",
                 (expiry, token, now, now, limit),
             ).fetchall()
         else:
             picked = self.conn.execute(
-                """SELECT id, task_id, aggregation_job_id, lease_attempts
+                """SELECT id, task_id, aggregation_job_id, lease_attempts,
+                          trace_id, created_at
                    FROM aggregation_jobs
                    WHERE state = 'InProgress' AND lease_expiry <= ?
                    ORDER BY id LIMIT ?""",
@@ -855,9 +881,9 @@ class Transaction:
                    WHERE id = ?""",
                 [(expiry, token, now, r[0]) for r in picked],
             )
-            rows = [(r[1], r[2], r[3] + 1) for r in picked]
+            rows = [(r[1], r[2], r[3] + 1, r[4], r[5]) for r in picked]
         leases = []
-        for task_pk, job_id, attempts in rows:
+        for task_pk, job_id, attempts, trace_id, created_at in rows:
             trow = self.conn.execute(
                 "SELECT task_id, query_type, vdaf FROM tasks WHERE id = ?", (task_pk,)
             ).fetchone()
@@ -868,6 +894,8 @@ class Transaction:
                         aggregation_job_id=AggregationJobId(job_id),
                         query_type=TaskQueryType.from_json(trow[1]).kind,
                         vdaf=json.loads(trow[2]),
+                        trace_id=trace_id,
+                        age_seconds=float(max(0, now - (created_at or now))),
                     ),
                     lease_expiry=Time(expiry),
                     lease_token=LeaseToken(token),
@@ -1280,9 +1308,9 @@ class Transaction:
                 """INSERT INTO collection_jobs (task_id, collection_job_id, query,
                     aggregation_param, batch_identifier, state, report_count,
                     client_timestamp_interval_start, client_timestamp_interval_duration,
-                    leader_aggregate_share, helper_aggregate_share,
+                    leader_aggregate_share, helper_aggregate_share, trace_id,
                     created_at, updated_at)
-                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
                 (
                     pk,
                     job.collection_job_id.data,
@@ -1301,6 +1329,7 @@ class Transaction:
                     job.helper_aggregate_share.get_encoded()
                     if job.helper_aggregate_share
                     else None,
+                    job.trace_id,
                     now,
                     now,
                 ),
@@ -1316,7 +1345,7 @@ class Transaction:
             """SELECT query, aggregation_param, batch_identifier, state,
                       report_count, client_timestamp_interval_start,
                       client_timestamp_interval_duration, leader_aggregate_share,
-                      helper_aggregate_share
+                      helper_aggregate_share, trace_id
                FROM collection_jobs WHERE task_id = ? AND collection_job_id = ?""",
             (pk, collection_job_id.data),
         ).fetchone()
@@ -1337,6 +1366,7 @@ class Transaction:
             idur,
             enc_share,
             helper_b,
+            trace_id,
         ) = row
         row_ident = task_id.data + collection_job_id.data
         return CollectionJob(
@@ -1358,6 +1388,7 @@ class Transaction:
             helper_aggregate_share=HpkeCiphertext.get_decoded(helper_b)
             if helper_b
             else None,
+            trace_id=trace_id,
         )
 
     def update_collection_job(self, job: CollectionJob) -> None:
@@ -1407,7 +1438,7 @@ class Transaction:
             """SELECT collection_job_id, query, aggregation_param, batch_identifier,
                       state, report_count, client_timestamp_interval_start,
                       client_timestamp_interval_duration, leader_aggregate_share,
-                      helper_aggregate_share
+                      helper_aggregate_share, trace_id
                FROM collection_jobs WHERE task_id = ? AND batch_identifier = ?""",
             (pk, batch_identifier),
         ).fetchall()
@@ -1462,12 +1493,14 @@ class Transaction:
                        SELECT id FROM collection_jobs
                        WHERE state = 'Start' AND lease_expiry <= ?
                        ORDER BY id LIMIT ? /*skip-locked*/)
-                   RETURNING task_id, collection_job_id, lease_attempts, step_attempts""",
+                   RETURNING task_id, collection_job_id, lease_attempts, step_attempts,
+                             trace_id, created_at""",
                 (expiry, token, now, now, limit),
             ).fetchall()
         else:
             picked = self.conn.execute(
-                """SELECT id, task_id, collection_job_id, lease_attempts, step_attempts
+                """SELECT id, task_id, collection_job_id, lease_attempts, step_attempts,
+                          trace_id, created_at
                    FROM collection_jobs
                    WHERE state = 'Start' AND lease_expiry <= ?
                    ORDER BY id LIMIT ?""",
@@ -1479,9 +1512,9 @@ class Transaction:
                    WHERE id = ?""",
                 [(expiry, token, now, r[0]) for r in picked],
             )
-            rows = [(r[1], r[2], r[3] + 1, r[4]) for r in picked]
+            rows = [(r[1], r[2], r[3] + 1, r[4], r[5], r[6]) for r in picked]
         leases = []
-        for task_pk, job_id, attempts, step_attempts in rows:
+        for task_pk, job_id, attempts, step_attempts, trace_id, created_at in rows:
             trow = self.conn.execute(
                 "SELECT task_id, query_type, vdaf FROM tasks WHERE id = ?", (task_pk,)
             ).fetchone()
@@ -1493,6 +1526,8 @@ class Transaction:
                         query_type=TaskQueryType.from_json(trow[1]).kind,
                         vdaf=json.loads(trow[2]),
                         step_attempts=step_attempts,
+                        trace_id=trace_id,
+                        age_seconds=float(max(0, now - (created_at or now))),
                     ),
                     lease_expiry=Time(expiry),
                     lease_token=LeaseToken(token),
@@ -1957,6 +1992,46 @@ class Transaction:
             (self._now_s(),),
         )
         return cur.rowcount
+
+    # ------------------------------------------------------------------
+    # fleet introspection (ISSUE 5: the binaries' status sampler and the
+    # /statusz endpoint — cheap indexed COUNTs, no payload reads)
+
+    def accumulator_journal_stats(self) -> Tuple[int, Optional[int]]:
+        """(outstanding rows, oldest created_at) across every task — the
+        freshness sampler's journal-age input."""
+        count, oldest = self.conn.execute(
+            "SELECT COUNT(*), MIN(created_at) FROM accumulator_journal"
+        ).fetchone()
+        return int(count or 0), (int(oldest) if oldest is not None else None)
+
+    def lease_summary(self) -> Dict[str, Dict[str, int]]:
+        """Per-job-type lease occupancy: held (live lease), expired_held
+        (lease token outstanding past expiry — a dead/wedged holder the
+        reaper has not cleared yet), acquirable.  The single source for
+        the acquirable-backlog counts (/statusz AND the
+        janus_acquirable_jobs sampler)."""
+        now = self._now_s()
+        out: Dict[str, Dict[str, int]] = {}
+        for job_type, table, state in _JOB_LEASE_TABLES:
+            held, expired, acquirable, active = self.conn.execute(
+                f"""SELECT
+                      SUM(CASE WHEN lease_token IS NOT NULL AND lease_expiry > ?
+                          THEN 1 ELSE 0 END),
+                      SUM(CASE WHEN lease_token IS NOT NULL AND lease_expiry <= ?
+                          THEN 1 ELSE 0 END),
+                      SUM(CASE WHEN lease_expiry <= ? THEN 1 ELSE 0 END),
+                      COUNT(*)
+                    FROM {table} WHERE state = ?""",
+                (now, now, now, state),
+            ).fetchone()
+            out[job_type] = {
+                "active": int(active or 0),
+                "held": int(held or 0),
+                "expired_held": int(expired or 0),
+                "acquirable": int(acquirable or 0),
+            }
+        return out
 
     # ------------------------------------------------------------------
     # accumulator journal (deferred device-resident drains; see

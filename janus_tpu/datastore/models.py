@@ -86,6 +86,9 @@ class AggregationJob:
     state: AggregationJobState
     step: AggregationJobStep
     last_request_hash: Optional[bytes] = None
+    #: 32-hex cross-process trace id (core/trace.py): minted at creation on
+    #: the leader, inherited from the peer's traceparent on the helper.
+    trace_id: Optional[str] = None
 
     def with_state(self, state: AggregationJobState) -> "AggregationJob":
         return replace(self, state=state)
@@ -130,6 +133,10 @@ class AcquiredAggregationJob:
     aggregation_job_id: AggregationJobId
     query_type: str
     vdaf: dict
+    #: persisted trace id, bound by the stepping driver (core/trace.py)
+    trace_id: Optional[str] = None
+    #: created_at -> acquire, for janus_job_age_at_acquire_seconds
+    age_seconds: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -141,6 +148,8 @@ class AcquiredCollectionJob:
     query_type: str
     vdaf: dict
     step_attempts: int
+    trace_id: Optional[str] = None
+    age_seconds: float = 0.0
 
 
 # --------------------------------------------------------------------------
@@ -288,6 +297,8 @@ class CollectionJob:
     client_timestamp_interval: Optional[Interval] = None
     leader_aggregate_share: Optional[bytes] = None  # encoded field vector
     helper_aggregate_share: Optional[HpkeCiphertext] = None
+    #: 32-hex cross-process trace id minted at collection-job creation
+    trace_id: Optional[str] = None
 
     def finished(
         self,

@@ -259,9 +259,20 @@ CREATE INDEX IF NOT EXISTS accumulator_journal_by_batch
     ON accumulator_journal(task_id, batch_identifier);
 """
 
+_TRACE_CONTEXT_SCHEMA = """
+-- Cross-process trace correlation (core/trace.py, ISSUE 5): a W3C-style
+-- 32-hex trace id minted at job creation (leader) or inherited from the
+-- peer's traceparent header (helper), carried on every lease acquisition
+-- so any replica stepping the job binds the same id into its logs and
+-- chrome-trace spans.  TEXT, nullable: rows from older schema versions
+-- simply have no trace.
+ALTER TABLE aggregation_jobs ADD COLUMN trace_id TEXT;
+ALTER TABLE collection_jobs ADD COLUMN trace_id TEXT;
+"""
+
 #: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
 #: edit an entry that has shipped (existing stores have already applied it).
-MIGRATIONS = [_INITIAL_SCHEMA, _ACCUMULATOR_JOURNAL_SCHEMA]
+MIGRATIONS = [_INITIAL_SCHEMA, _ACCUMULATOR_JOURNAL_SCHEMA, _TRACE_CONTEXT_SCHEMA]
 
 SCHEMA_VERSION = len(MIGRATIONS)
 

@@ -251,6 +251,9 @@ class _Bucket:
         self.flushed_rows = 0
         self.flushed_jobs = 0
         self.rejections = 0
+        #: last submit/flush touch — retire_idle_buckets() reaps buckets
+        #: idle past the threshold and removes their gauge label sets
+        self.last_activity = time.monotonic()
 
     @property
     def depth_rows(self) -> int:
@@ -450,6 +453,7 @@ class DeviceExecutor:
                 deadline=now + timeout if timeout and timeout > 0 else None,
                 retain=retain_out_shares and self.accumulator is not None,
             )
+            bucket.last_activity = now
             bucket.pending.append(sub)
             bucket.queued_rows += rows
             self._observe_depth(bucket)
@@ -828,6 +832,7 @@ class DeviceExecutor:
             if s.finished:
                 return
             s.finished = True
+            bucket.last_activity = now
             bucket.inflight_rows -= s.rows
             self._observe_depth(bucket)
 
@@ -909,6 +914,63 @@ class DeviceExecutor:
         with self._lock:
             br = self._breakers.get(shape_key)
         return br is not None and br.is_open_peek()
+
+    def retire_idle_buckets(self, max_idle_s: float = 600.0) -> int:
+        """Reap buckets with no pending/in-flight work that have been idle
+        past ``max_idle_s``, removing their ``janus_executor_queue_rows``
+        label sets; breakers whose shape no longer has any bucket and whose
+        circuit is closed retire with them (their ``janus_executor_
+        circuit_state`` series too).  Without this, a retired task's bucket
+        gauges report stale values forever and series cardinality only ever
+        grows (ISSUE 5 satellite).  Returns the number of buckets retired.
+        """
+        now = time.monotonic()
+        retired: List[str] = []
+        retired_circuits: List[str] = []
+        with self._lock:
+            for key, bucket in list(self._buckets.items()):
+                if (
+                    not bucket.pending
+                    and bucket.depth_rows == 0
+                    and bucket.timer is None
+                    and now - bucket.last_activity >= max_idle_s
+                ):
+                    del self._buckets[key]
+                    retired.append(bucket.label)
+            live_shapes = {key[0] for key in self._buckets}
+            for shape_key, breaker in list(self._breakers.items()):
+                if shape_key not in live_shapes and breaker.state == CIRCUIT_CLOSED:
+                    del self._breakers[shape_key]
+                    retired_circuits.append(breaker.label)
+        if retired or retired_circuits:
+            from ..core.metrics import GLOBAL_METRICS
+
+            if GLOBAL_METRICS.registry is not None:
+                for label in retired:
+                    # EVERY per-bucket series goes with the bucket —
+                    # cardinality must be capped by live traffic, not
+                    # history (rejection reasons are a closed set)
+                    for metric in (
+                        GLOBAL_METRICS.executor_queue_rows,
+                        GLOBAL_METRICS.executor_flush_rows,
+                        GLOBAL_METRICS.executor_wait_seconds,
+                        GLOBAL_METRICS.executor_launch_seconds,
+                    ):
+                        GLOBAL_METRICS.remove_series(metric, label)
+                    for reason in ("queue_full", "deadline"):
+                        GLOBAL_METRICS.remove_series(
+                            GLOBAL_METRICS.executor_rejections, label, reason
+                        )
+                for label in retired_circuits:
+                    GLOBAL_METRICS.remove_series(
+                        GLOBAL_METRICS.circuit_state, label
+                    )
+            logger.info(
+                "retired %d idle executor bucket(s) and %d closed circuit(s)",
+                len(retired),
+                len(retired_circuits),
+            )
+        return len(retired)
 
     def circuit_stats(self) -> Dict[str, dict]:
         """Per-shape breaker state (plain Python; chaos tests read this)."""

@@ -180,11 +180,15 @@ class TestChromeTrace:
             pass
         tr.close()
         doc = _json.load(open(path))
-        events = [e for e in doc if e]
+        # metadata events (clock_sync for cross-process merging,
+        # process_name) ride along; spans are the "X" events
+        events = [e for e in doc if e and e.get("ph") == "X"]
         assert [e["name"] for e in events] == ["step_a", "step_b"]
         assert all(e["ph"] == "X" and "dur" in e and "ts" in e for e in events)
         assert events[1]["args"]["batch"] == 4096
         assert events[0]["args"]["ok"] is True
+        metas = [e["name"] for e in doc if e and e.get("ph") == "M"]
+        assert "clock_sync" in metas
 
     def test_global_span_noop_and_enabled(self, tmp_path):
         import json as _json
@@ -198,5 +202,7 @@ class TestChromeTrace:
         with trace_mod.trace_span("on", cat="job", k=1):
             pass
         trace_mod.configure_chrome_trace(None)  # closes + disables
-        events = [e for e in _json.load(open(path)) if e]
+        events = [
+            e for e in _json.load(open(path)) if e and e.get("ph") == "X"
+        ]
         assert events and events[0]["name"] == "on"

@@ -1,0 +1,495 @@
+"""Fleet-wide observability (ISSUE 5): trace context propagation, the
+pure-Python metrics fallback, freshness metrics, the health server's
+introspection plane (/healthz, /metrics, PUT /traceconfigz, /statusz),
+executor gauge retirement, the trace-merge tool, and the golden
+metric-name/label manifest that catches silent metric renames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import logging
+import os
+import pathlib
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from janus_tpu.core import trace as trace_mod
+from janus_tpu.core.metrics import GLOBAL_METRICS, Metrics
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _run(coro, timeout=60.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# trace context
+
+
+class TestTraceContext:
+    def test_traceparent_roundtrip(self):
+        tid = trace_mod.new_trace_id()
+        assert len(tid) == 32
+        with trace_mod.trace_scope(trace_id=tid):
+            header = trace_mod.current_traceparent()
+            assert header is not None and header.startswith(f"00-{tid}-")
+            assert trace_mod.parse_traceparent(header) == tid
+        assert trace_mod.current_traceparent() is None
+
+    def test_parse_rejects_malformed(self):
+        for bad in (None, "", "junk", "00-zz-aa-01", "00-" + "0" * 32 + "-x-01"):
+            assert trace_mod.parse_traceparent(bad) is None
+
+    def test_scopes_nest_and_merge(self):
+        with trace_mod.trace_scope(trace_id="a" * 32, task_id="t1"):
+            with trace_mod.trace_scope(job_id="j1"):
+                ctx = trace_mod.current_trace()
+                assert ctx["trace_id"] == "a" * 32
+                assert ctx["task_id"] == "t1" and ctx["job_id"] == "j1"
+            assert "job_id" not in trace_mod.current_trace()
+        assert trace_mod.current_trace() == {}
+
+    def test_json_log_lines_carry_trace_context(self):
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.addFilter(trace_mod.TraceContextFilter())
+        handler.setFormatter(trace_mod.JsonFormatter())
+        lg = logging.getLogger("janus_tpu.test.tracectx")
+        lg.addHandler(handler)
+        lg.setLevel(logging.INFO)
+        try:
+            with trace_mod.trace_scope(trace_id="b" * 32, job_id="job-7"):
+                lg.info("inside")
+            lg.info("outside")
+        finally:
+            lg.removeHandler(handler)
+        inside, outside = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert inside["trace_id"] == "b" * 32 and inside["job_id"] == "job-7"
+        assert "trace_id" not in outside
+
+    def test_chrome_spans_inherit_context_and_append_across_restart(self, tmp_path):
+        path = str(tmp_path / "t.json")
+        tr = trace_mod.ChromeTracer(path)
+        with trace_mod.trace_scope(trace_id="c" * 32, task_id="tk"):
+            with tr.span("step", cat="job"):
+                pass
+        tr.close()
+        tr.close()  # idempotent (SIGTERM hook + teardown may both fire)
+        # "restarted replica": same path appends, does not truncate
+        tr2 = trace_mod.ChromeTracer(path)
+        with tr2.span("after_restart", cat="job"):
+            pass
+        tr2.close()
+        from tools.trace_merge import load_events
+
+        events = load_events(path)
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert [e["name"] for e in spans] == ["step", "after_restart"]
+        assert spans[0]["args"]["trace_id"] == "c" * 32
+        assert spans[0]["args"]["task_id"] == "tk"
+        assert spans[0]["pid"] == os.getpid()
+        syncs = [e for e in events if e.get("name") == "clock_sync"]
+        assert len(syncs) == 2  # one per incarnation
+
+
+class TestTraceMerge:
+    def _write_trace(self, path, pid, epoch, spans):
+        with open(path, "w") as f:
+            f.write("[\n")
+            f.write(
+                json.dumps(
+                    {
+                        "name": "clock_sync",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": 0,
+                        "args": {"epoch_t0": epoch},
+                    }
+                )
+                + ",\n"
+            )
+            for name, ts, args in spans:
+                f.write(
+                    json.dumps(
+                        {
+                            "name": name,
+                            "cat": "job",
+                            "ph": "X",
+                            "pid": pid,
+                            "tid": 1,
+                            "ts": ts,
+                            "dur": 10.0,
+                            "args": args,
+                        }
+                    )
+                    + ",\n"
+                )
+
+    def test_merge_rebases_filters_and_survives_partial_lines(self, tmp_path):
+        from tools.trace_merge import merge_trace_files
+
+        tid = "d" * 32
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        # process A started at epoch 1000.0, B at 1002.0; their relative
+        # timestamps interleave only after rebasing
+        self._write_trace(a, 101, 1000.0, [("job_step", 0.0, {"trace_id": tid})])
+        self._write_trace(
+            b, 202, 1002.0, [("http_request", 0.0, {"trace_id": tid})]
+        )
+        with open(b, "a") as f:
+            f.write('{"name": "partial')  # SIGKILL mid-write
+        out = str(tmp_path / "merged.json")
+        summary = merge_trace_files([a, b], out)
+        assert summary["traces"][tid] == [101, 202]
+        merged = json.load(open(out))
+        spans = [e for e in merged if e.get("ph") == "X"]
+        by_name = {e["name"]: e for e in spans}
+        # B's span lands 2s (2e6 us) after A's on the shared timeline
+        assert by_name["http_request"]["ts"] - by_name["job_step"]["ts"] == 2e6
+        # filtering to one trace id keeps both processes' spans
+        summary2 = merge_trace_files([a, b], out, trace_id=tid)
+        assert summary2["traces"] == {tid: [101, 202]}
+
+
+# ---------------------------------------------------------------------------
+# metrics: fallback parity + freshness + golden manifest
+
+
+class TestMetricsFallback:
+    def test_counters_gauges_histograms(self):
+        m = Metrics(force_fallback=True)
+        m.upload_outcomes.labels(decision="accepted").inc(2)
+        m.acquirable_jobs.labels(job_type="aggregation").set(7)
+        m.report_commit_age.observe(3.0)
+        assert (
+            m.get_sample_value(
+                "janus_upload_decision_total", {"decision": "accepted"}
+            )
+            == 2
+        )
+        assert (
+            m.get_sample_value("janus_acquirable_jobs", {"job_type": "aggregation"})
+            == 7
+        )
+        assert m.get_sample_value("janus_report_commit_age_seconds_count") == 1
+        assert m.get_sample_value("janus_report_commit_age_seconds_sum") == 3.0
+        # 'le' renders exactly like prometheus_client (floatToGoString:
+        # '5.0', never '5') so bucket lookups agree between backends
+        assert (
+            m.get_sample_value(
+                "janus_report_commit_age_seconds_bucket", {"le": "5.0"}
+            )
+            == 1
+        )
+        assert (
+            m.get_sample_value(
+                "janus_report_commit_age_seconds_bucket", {"le": "5"}
+            )
+            is None
+        )
+
+    def test_export_is_prometheus_text(self):
+        m = Metrics(force_fallback=True)
+        m.upload_outcomes.labels(decision="accepted").inc()
+        m.report_commit_age.observe(0.2)
+        text = m.export().decode()
+        assert 'janus_upload_decision_total{decision="accepted"} 1' in text
+        assert "# TYPE janus_report_commit_age_seconds histogram" in text
+        assert 'le="+Inf"' in text
+
+    def test_remove_caps_cardinality(self):
+        m = Metrics(force_fallback=True)
+        m.executor_queue_rows.labels(bucket="X/a0/prep_init#abc").set(5)
+        m.remove_series(m.executor_queue_rows, "X/a0/prep_init#abc")
+        assert (
+            m.get_sample_value(
+                "janus_executor_queue_rows", {"bucket": "X/a0/prep_init#abc"}
+            )
+            is None
+        )
+        # removing a series that never existed must not raise
+        m.remove_series(m.executor_queue_rows, "never-there")
+
+    def test_catalog_parity_with_prometheus(self):
+        # whichever backend GLOBAL_METRICS got, the fallback catalogs the
+        # SAME families — a fallback-only dev container asserts against
+        # the same golden manifest as the baked image
+        assert Metrics(force_fallback=True).catalog() == GLOBAL_METRICS.catalog()
+
+
+def test_golden_metric_manifest():
+    """Every metric family (name|type|labels) matches the recorded golden
+    manifest — a silent rename or label change fails here, not on a
+    dashboard three weeks later.  Regenerate deliberately with:
+    python -c "from janus_tpu.core.metrics import GLOBAL_METRICS as g;
+    print('\\n'.join(g.catalog()))" > tests/metric_manifest.txt
+    """
+    golden = (REPO / "tests" / "metric_manifest.txt").read_text().split()
+    assert GLOBAL_METRICS.catalog() == sorted(golden)
+
+
+# ---------------------------------------------------------------------------
+# freshness metrics at their observation points
+
+
+def test_job_age_and_trace_id_surface_at_acquire(tmp_path):
+    pytest.importorskip("cryptography")
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import (
+        AggregationJob,
+        AggregationJobState,
+        Crypter,
+        Datastore,
+        generate_key,
+    )
+    from janus_tpu.messages import (
+        AggregationJobId,
+        AggregationJobStep,
+        Duration,
+        Interval,
+        Time,
+    )
+    from tests.test_datastore import make_task
+
+    ds = Datastore(
+        str(tmp_path / "age.sqlite3"), Crypter([generate_key()]), RealClock()
+    )
+    task = make_task()
+    ds.run_tx("put-task", lambda tx: tx.put_aggregator_task(task))
+    tid = trace_mod.new_trace_id()
+    job = AggregationJob(
+        task_id=task.task_id,
+        aggregation_job_id=AggregationJobId.random(),
+        aggregation_parameter=b"",
+        partial_batch_identifier=None,
+        client_timestamp_interval=Interval(Time(0), Duration(1)),
+        state=AggregationJobState.IN_PROGRESS,
+        step=AggregationJobStep(0),
+        trace_id=tid,
+    )
+    ds.run_tx("put-job", lambda tx: tx.put_aggregation_job(job))
+    # persisted trace id reads back on the job row...
+    got = ds.run_tx(
+        "get", lambda tx: tx.get_aggregation_job(task.task_id, job.aggregation_job_id)
+    )
+    assert got.trace_id == tid
+    # ...and rides the lease, with the freshness age computed at acquire
+    (lease,) = ds.run_tx(
+        "acq", lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+    )
+    assert lease.leased.trace_id == tid
+    assert lease.leased.age_seconds >= 0.0
+    ds.close()
+
+
+def test_report_commit_age_observed_on_upload_batch(tmp_path):
+    pytest.importorskip("cryptography")
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import (
+        Crypter,
+        Datastore,
+        LeaderStoredReport,
+        generate_key,
+    )
+    from janus_tpu.messages import HpkeCiphertext, ReportId, ReportMetadata, Time
+    from tests.test_datastore import make_task
+
+    ds = Datastore(
+        str(tmp_path / "cage.sqlite3"), Crypter([generate_key()]), RealClock()
+    )
+    task = make_task()
+    ds.run_tx("put-task", lambda tx: tx.put_aggregator_task(task))
+    report = LeaderStoredReport(
+        task_id=task.task_id,
+        metadata=ReportMetadata(
+            ReportId(b"\x05" * 16), Time(RealClock().now().seconds - 120)
+        ),
+        public_share=b"ps",
+        leader_extensions=[],
+        leader_input_share=b"input",
+        helper_encrypted_input_share=HpkeCiphertext(1, b"ek", b"ct"),
+    )
+    before = (
+        GLOBAL_METRICS.get_sample_value("janus_report_commit_age_seconds_count")
+        or 0
+    )
+    batcher = ReportWriteBatcher(ds, max_batch_size=1)
+    _run(batcher.write_report(report))
+    after = GLOBAL_METRICS.get_sample_value("janus_report_commit_age_seconds_count")
+    assert after == before + 1
+    ds.close()
+
+
+# ---------------------------------------------------------------------------
+# executor bucket retirement (gauge label leak, ISSUE 5 satellite)
+
+
+def test_idle_executor_buckets_and_circuits_retire():
+    from janus_tpu.executor import DeviceExecutor, ExecutorConfig
+    from tests.test_executor import _FakeBackend
+
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=0.001, flush_max_rows=8, submit_timeout_s=30)
+    )
+    backend = _FakeBackend()
+
+    async def go():
+        vk = b"\x00" * 16
+        reports = [(b"n", b"p", b"s")] * 8
+        await ex.submit(("shape",), "prep_init", (vk, reports), backend=backend)
+
+    _run(go())
+    assert len(ex.stats()) == 1
+    label = next(iter(ex.stats()))
+    if GLOBAL_METRICS.registry is not None:
+        assert (
+            GLOBAL_METRICS.get_sample_value(
+                "janus_executor_queue_rows", {"bucket": label}
+            )
+            is not None
+        )
+    # still fresh: nothing retires
+    assert ex.retire_idle_buckets(max_idle_s=3600) == 0
+    # idle past threshold: bucket goes, EVERY per-bucket series goes
+    # (gauge + histograms + rejection counters), breaker goes
+    assert ex.retire_idle_buckets(max_idle_s=0.0) == 1
+    assert ex.stats() == {}
+    assert ex.circuit_stats() == {}
+    if GLOBAL_METRICS.registry is not None:
+        for sample in (
+            "janus_executor_queue_rows",
+            "janus_executor_flush_rows_count",
+            "janus_executor_wait_duration_seconds_count",
+            "janus_executor_launch_duration_seconds_count",
+        ):
+            assert (
+                GLOBAL_METRICS.get_sample_value(sample, {"bucket": label})
+                is None
+            ), sample
+    ex.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# health server: /healthz, /metrics, PUT /traceconfigz, /statusz
+
+
+@pytest.fixture
+def health_server(tmp_path):
+    pytest.importorskip("cryptography")
+    from janus_tpu.binaries.main import _serve_health
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import Crypter, Datastore, generate_key
+
+    ds = Datastore(
+        str(tmp_path / "hz.sqlite3"), Crypter([generate_key()]), RealClock()
+    )
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    runner = asyncio.run_coroutine_threadsafe(
+        _serve_health("127.0.0.1:0", datastore=ds), loop
+    ).result(timeout=30)
+    port = runner.addresses[0][1]
+
+    def fetch(path, method="GET", data=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method, data=data
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode()
+
+    yield fetch, ds
+    asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result(timeout=30)
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=10)
+    loop.close()
+    ds.close()
+
+
+class TestHealthServer:
+    def test_healthz(self, health_server):
+        fetch, _ds = health_server
+        status, body = fetch("/healthz")
+        assert status == 200 and body == "ok"
+
+    def test_metrics_scrape(self, health_server):
+        fetch, _ds = health_server
+        GLOBAL_METRICS.upload_outcomes.labels(decision="accepted").inc(0)
+        status, body = fetch("/metrics")
+        assert status == 200
+        assert "janus_upload_decision_total" in body
+
+    def test_traceconfigz_reload(self, health_server):
+        fetch, _ds = health_server
+        root = logging.getLogger()
+        before = root.level
+        try:
+            status, body = fetch("/traceconfigz", method="PUT", data=b"DEBUG")
+            assert status == 200 and "DEBUG" in body
+            assert root.level == logging.DEBUG
+        finally:
+            root.setLevel(before)
+
+    def test_statusz_shape(self, health_server):
+        fetch, _ds = health_server
+        status, body = fetch("/statusz")
+        assert status == 200
+        doc = json.loads(body)
+        for section in (
+            "executor",
+            "accumulator",
+            "journal",
+            "leases",
+            "faults",
+            "trace",
+            "pid",
+            "uptime_s",
+        ):
+            assert section in doc, section
+        assert doc["journal"]["outstanding_rows"] == 0
+        assert doc["leases"]["aggregation"]["active"] == 0
+        assert doc["faults"]["armed"] is False
+
+    def test_statusz_stable_under_concurrent_mutation(self, health_server):
+        fetch, _ds = health_server
+        stop = threading.Event()
+
+        def mutate():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                GLOBAL_METRICS.upload_outcomes.labels(decision="accepted").inc()
+                GLOBAL_METRICS.executor_queue_rows.labels(bucket=f"b{i % 17}").set(
+                    i
+                )
+                if i % 13 == 0:
+                    GLOBAL_METRICS.remove_series(
+                        GLOBAL_METRICS.executor_queue_rows, f"b{i % 17}"
+                    )
+
+        threads = [threading.Thread(target=mutate) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(10):
+                status, body = fetch("/statusz")
+                assert status == 200
+                json.loads(body)  # always well-formed
+                status, _body = fetch("/metrics")
+                assert status == 200
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)

@@ -1,0 +1,156 @@
+"""Merge per-replica chrome-trace files into one Perfetto-loadable view.
+
+Each replica binary writes its own Trace-Event-Format file
+(``common.chrome_trace_path``, core/trace.py ChromeTracer).  Those files
+are per-process: their ``ts`` values are relative to each process's own
+monotonic clock, and a SIGKILLed replica leaves a partial trailing line.
+This tool stitches them into ONE timeline:
+
+* events are rebased onto the shared wall clock using each process's
+  ``clock_sync`` metadata event (pid -> wall-clock epoch of monotonic t0);
+* partial/garbage lines (kill mid-write, closing sentinels) are skipped;
+* ``--trace-id`` filters to a single pipeline entity — the spans of one
+  aggregation job crossing leader drivers and the helper, joined by the
+  trace id every span inherits from the bound trace context.
+
+Usage::
+
+    python tools/trace_merge.py -o merged.json driver0.json driver1.json helper.json
+    python tools/trace_merge.py -o job.json --trace-id <32-hex> *.json
+
+Load the output in Perfetto (ui.perfetto.dev) or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Set
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse one ChromeTracer file line-by-line, tolerating the missing
+    closing bracket and partial trailing lines a crash leaves behind."""
+    events: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]", "{}]", "{}"):
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # partial write (killed mid-line) or sentinel
+            if isinstance(ev, dict) and "name" in ev:
+                events.append(ev)
+    return events
+
+
+def _clock_offsets(events: List[dict]) -> Dict[int, float]:
+    """pid -> wall-clock epoch (microseconds) of that process's monotonic
+    t0, from its clock_sync metadata.  A restarted replica appends to the
+    same file under a new pid, so one file can carry several."""
+    offsets: Dict[int, float] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "clock_sync":
+            epoch = ev.get("args", {}).get("epoch_t0")
+            if isinstance(epoch, (int, float)):
+                offsets[ev.get("pid", 0)] = float(epoch) * 1e6
+    return offsets
+
+
+def merge_events(
+    paths: List[str], trace_id: Optional[str] = None
+) -> List[dict]:
+    """Merged, wall-clock-rebased event list across ``paths`` (metadata
+    events are carried through; ``trace_id`` filters "X" spans).  Spans
+    whose pid has no ``clock_sync`` offset (a file from a pre-clock-sync
+    tracer) are DROPPED with a warning — mixing un-rebased monotonic
+    timestamps into an epoch-based timeline would render every real span
+    ~50 years away from the t_min origin, an unusable view with no
+    error."""
+    merged: List[dict] = []
+    for path in paths:
+        events = load_events(path)
+        offsets = _clock_offsets(events)
+        dropped = 0
+        for ev in events:
+            if ev.get("ph") == "M":
+                merged.append(ev)
+                continue
+            if trace_id is not None and ev.get("args", {}).get("trace_id") != trace_id:
+                continue
+            off = offsets.get(ev.get("pid", 0))
+            if off is None:
+                dropped += 1
+                continue
+            ev = dict(ev)
+            ev["ts"] = ev.get("ts", 0) + off
+            merged.append(ev)
+        if dropped:
+            print(
+                f"warning: {path}: dropped {dropped} span(s) with no "
+                "clock_sync offset for their pid (pre-clock-sync tracer?)",
+                file=sys.stderr,
+            )
+    # normalize to a near-zero origin so viewers don't render epoch offsets
+    spans = [ev for ev in merged if ev.get("ph") != "M"]
+    if spans:
+        t_min = min(ev.get("ts", 0) for ev in spans)
+        for ev in merged:
+            if ev.get("ph") != "M":
+                ev["ts"] = round(ev.get("ts", 0) - t_min, 1)
+    merged.sort(key=lambda ev: (ev.get("ph") != "M", ev.get("ts", 0)))
+    return merged
+
+
+def spans_by_trace(events: List[dict]) -> Dict[str, Set[int]]:
+    """trace_id -> set of pids that emitted a span under it (the merge's
+    acceptance probe: one aggregation job seen from >= 2 processes)."""
+    out: Dict[str, Set[int]] = {}
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        tid = ev.get("args", {}).get("trace_id")
+        if tid:
+            out.setdefault(tid, set()).add(ev.get("pid", 0))
+    return out
+
+
+def merge_trace_files(
+    paths: List[str], out_path: str, trace_id: Optional[str] = None
+) -> dict:
+    """Merge ``paths`` into ``out_path``; returns a summary dict
+    ``{"events": n, "pids": [...], "traces": {trace_id: [pids...]}}``."""
+    merged = merge_events(paths, trace_id=trace_id)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    traces = spans_by_trace(merged)
+    return {
+        "events": len(merged),
+        "pids": sorted({ev.get("pid", 0) for ev in merged}),
+        "traces": {t: sorted(pids) for t, pids in traces.items()},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+", help="per-replica chrome-trace files")
+    ap.add_argument("-o", "--output", required=True, help="merged output file")
+    ap.add_argument(
+        "--trace-id", default=None, help="keep only spans of this trace id"
+    )
+    args = ap.parse_args(argv)
+    summary = merge_trace_files(args.inputs, args.output, trace_id=args.trace_id)
+    multi = sum(1 for pids in summary["traces"].values() if len(pids) > 1)
+    print(
+        f"merged {summary['events']} event(s) from {len(args.inputs)} file(s) "
+        f"({len(summary['pids'])} process(es), {len(summary['traces'])} "
+        f"trace id(s), {multi} crossing processes) -> {args.output}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
