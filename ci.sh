@@ -19,10 +19,17 @@
 #   ./ci.sh tier1      the ROADMAP.md tier-1 command VERBATIM, gated on the
 #                      recorded DOTS_PASSED floor (tests/tier1_floor.txt):
 #                      fewer passing dots than the floor fails the gate.
+#   ./ci.sh mesh       multi-chip gate: the mesh parity matrix (test_mesh.py)
+#                      plus the mesh-executor/accumulator suite
+#                      (test_mesh_executor.py) on the 8 virtual CPU devices —
+#                      sharded mega-batches, per-mesh breaker, sharded
+#                      accumulation, flush-tail handling.
 #   ./ci.sh chaos      fault-injection gate: tests/test_chaos.py with a FIXED
 #                      seed (JANUS_CHAOS_SEED, default 7) — registry/breaker/
 #                      budget units plus the 2-replica soak with every
-#                      injection point firing at p~=0.2.
+#                      injection point firing at p~=0.2, and the mesh-enabled
+#                      device-lost run (per-mesh breaker -> oracle fallback,
+#                      exactly-once counts).
 #   ./ci.sh chaos crash  process-level crash stage: the SIGKILL/restart soak
 #                      (tests/test_crash_chaos.py, slow-marked so tier-1
 #                      timing is unaffected) — real replica binaries killed
@@ -121,6 +128,12 @@ case "$tier" in
     fi
     exec python -m pytest tests/test_chaos.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
     ;;
+  mesh)
+    # Multi-chip gate (ISSUE 6).  test_mesh.py is device-tier (sharded
+    # XLA compiles); test_mesh_executor.py also rides the fast tier — this
+    # stage runs both together for a focused mesh signal.
+    exec python -m pytest tests/test_mesh.py tests/test_mesh_executor.py -q
+    ;;
   obs)
     # Observability gate (ISSUE 5): runs everywhere — the pure-Python
     # metrics fallback keeps the metric assertions meaningful even where
@@ -139,7 +152,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|chaos|obs|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mesh|chaos|obs|dryrun]" >&2
     exit 2
     ;;
 esac

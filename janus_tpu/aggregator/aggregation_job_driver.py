@@ -292,7 +292,9 @@ class AggregationJobDriver:
             self._backends[key] = b
         return b
 
-    async def _coalesced_prep_init(self, backend, verify_key: bytes, prep_in):
+    async def _coalesced_prep_init(
+        self, backend, verify_key: bytes, prep_in, task_ident=None
+    ):
         """Join concurrent same-shape jobs (across tasks) into ONE launch.
 
         With the device executor enabled, submission routes through the
@@ -332,6 +334,7 @@ class AggregationJobDriver:
                     backend=backend,
                     agg_id=0,
                     retain_out_shares=self._executor.accumulator is not None,
+                    task_ident=task_ident,
                 )
             except CircuitOpenError as e:
                 # Device sick (K consecutive launch failures): degrade to
@@ -455,7 +458,12 @@ class AggregationJobDriver:
                 (ra.report_id.data, public, share) for ra, public, share in rows
             ]
             prep_out = await self._coalesced_prep_init(
-                backend, task.vdaf_verify_key, prep_in
+                backend,
+                task.vdaf_verify_key,
+                prep_in,
+                # per-task fairness quota: the DRR accounting domain WITHIN
+                # the shared shape bucket (executor._pick_entry_locked)
+                task_ident=task.task_id.data,
             )
 
             def wrap_outcomes():
@@ -1057,19 +1065,43 @@ class AggregationJobDriver:
     # ------------------------------------------------------------------
     # deferred-drain plumbing (accumulator.drain_interval_s > 0)
 
-    async def _maybe_drain_due(self) -> None:
+    async def run_accumulator_maintenance(self) -> int:
+        """The dedicated maintenance pass (binaries background loop,
+        ``accumulator.maintenance_interval_s``): drain deferred buckets
+        that came due while no driver commit was around to drain them —
+        an idle task's resident delta no longer waits for UNRELATED
+        traffic to commit — then rebalance resident occupancy (the LRU
+        eviction pass, off the hot path).  Returns the number of due
+        buckets drained (attempted)."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None:
+            return 0
+        drained = await self._maybe_drain_due()
+        occupancy = store.rebalance()
+        if drained:
+            logger.info(
+                "accumulator maintenance drained %d due bucket(s); "
+                "occupancy: %d bucket(s), %d resident byte(s)",
+                drained,
+                occupancy.get("buckets", 0),
+                occupancy.get("resident_bytes", 0),
+            )
+        return drained
+
+    async def _maybe_drain_due(self) -> int:
         """Cadence scan: drain every deferred bucket whose oldest delta is
         older than drain_interval_s, merging ONE share-only vector per
-        bucket into batch_aggregations and consuming its journal rows."""
+        bucket into batch_aggregations and consuming its journal rows.
+        Returns the number of due buckets scanned."""
         store = self._executor.accumulator if self._executor is not None else None
         if store is None or not getattr(store.config, "deferred", False):
-            return
+            return 0
         # the shared store may also hold 7-tuple drain-at-commit keys
         # (helper requests in the same process); only this driver's
         # 5-tuple deferred keys are cadence-drainable
         keys = [k for k in store.due_buckets(store.config.drain_interval_s) if len(k) == 5]
         if not keys:
-            return
+            return 0
         loop = asyncio.get_running_loop()
         for key in keys:
             try:
@@ -1080,6 +1112,7 @@ class AggregationJobDriver:
                 # must not fail the step or strand its lease; whatever was
                 # not merged stays journaled for the datastore replay
                 logger.exception("deferred cadence drain failed for %r", key)
+        return len(keys)
 
     def _drain_due_bucket(self, key: tuple) -> None:
         store = self._executor.accumulator

@@ -829,8 +829,14 @@ class Aggregator:
         from ..vdaf.backend import vdaf_shape_key
 
         vdaf = ta.vdaf
-        backend = ta.backend
         shape_key = vdaf_shape_key(vdaf)
+        # Resolve through the executor's shape-keyed cache: tasks sharing
+        # one VDAF shape share one backend + compiled graphs, and
+        # ``device_executor.mesh`` upgrades the helper's single-chip
+        # backends to the SPMD MeshBackend exactly like the drivers'.
+        backend = self._executor.backend_for(shape_key, lambda: ta.backend)
+        # task identity for the per-task fairness quota within the bucket
+        task_ident = getattr(getattr(ta.task, "task_id", None), "data", None)
         loop = asyncio.get_running_loop()
 
         def oracle_path():
@@ -859,6 +865,7 @@ class Aggregator:
                 # ON DEVICE and the writer consumes a drained delta
                 # instead of reading every row back.
                 retain_out_shares=self._executor.accumulator is not None,
+                task_ident=task_ident,
             )
             combine_rows = []
             for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
@@ -873,6 +880,7 @@ class Aggregator:
                 [[ls, hs] for (_, _, ls, hs) in combine_rows],
                 backend=backend,
                 agg_id=1,
+                task_ident=task_ident,
             )
             results = await loop.run_in_executor(
                 None,

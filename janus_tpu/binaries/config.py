@@ -150,6 +150,12 @@ class AccumulatorStoreConfig:
     #: are re-derived from the datastore by the collection-time oracle
     #: replay (guaranteed drain-before-collection).
     drain_interval_s: float = 0.0
+    #: Dedicated maintenance loop cadence (aggregation-job-driver binary):
+    #: > 0 drains due deferred buckets and rebalances resident occupancy
+    #: from a background loop instead of only at committing drivers'
+    #: commits, so an idle task's bucket never waits for unrelated
+    #: traffic.  <= 0 disables the loop (commit-driven drains only).
+    maintenance_interval_s: float = 0.0
 
     def to_accumulator_config(self):
         from ..executor.accumulator import AccumulatorConfig
@@ -158,6 +164,7 @@ class AccumulatorStoreConfig:
             enabled=self.enabled,
             byte_budget=self.byte_budget,
             drain_interval_s=self.drain_interval_s,
+            maintenance_interval_s=self.maintenance_interval_s,
         )
 
 
@@ -170,6 +177,17 @@ class DeviceExecutorConfig:
     owns the chip."""
 
     enabled: bool = False
+    #: Mesh-sharded mega-batches (``device_executor.mesh: true``): every
+    #: single-chip TpuBackend the executor caches is upgraded to the SPMD
+    #: MeshBackend over the LOCAL mesh (this host's chips), so staging
+    #: lands each mega-batch's report shards directly on their devices
+    #: and the accumulator keeps per-bucket buffers sharded.  Equivalent
+    #: to setting ``vdaf_backend: mesh`` on every producer in the
+    #: process.  Lease-driven daemons must keep the default local span —
+    #: see the JANUS_TPU_MESH_SPAN caveat on CommonConfig's distributed_*
+    #: fields (a cross-host collective from independent replicas would
+    #: deadlock).
+    mesh: bool = False
     #: flush a bucket once it holds this many rows (pow2-padded launch)
     flush_max_rows: int = 16384
     #: deadline (ms) from a bucket's first pending submission to its flush
@@ -201,6 +219,7 @@ class DeviceExecutorConfig:
 
         return ExecutorConfig(
             enabled=self.enabled,
+            mesh=self.mesh,
             flush_max_rows=self.flush_max_rows,
             flush_window_s=self.flush_window_ms / 1000.0,
             max_queue_rows=self.max_queue_rows,

@@ -193,6 +193,32 @@ def _start_status_sampler(stop: asyncio.Event, datastore: Datastore, common):
     return asyncio.ensure_future(loop_())
 
 
+def _start_accumulator_maintenance(stop: asyncio.Event, stepper_impl, cfg):
+    """Dedicated accumulator maintenance loop beside the aggregation
+    driver's main loop: drains due deferred buckets on cadence (an idle
+    task's resident delta no longer waits for another job's commit) and
+    rebalances resident occupancy.  Returns the task (None when the store
+    or the cadence is disabled)."""
+    acc = cfg.device_executor.accumulator
+    interval = getattr(acc, "maintenance_interval_s", 0)
+    if not acc.enabled or not interval or interval <= 0:
+        return None
+
+    async def loop_():
+        while not stop.is_set():
+            try:
+                await stepper_impl.run_accumulator_maintenance()
+            except Exception:
+                logger.exception("accumulator maintenance pass failed")
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+
+    logger.info("accumulator maintenance loop every %.1fs", interval)
+    return asyncio.ensure_future(loop_())
+
+
 def _close_tracing() -> None:
     """Graceful-shutdown hook shared by every binary: flush/close the
     chrome tracer so a SIGTERM never truncates the trace mid-event
@@ -488,12 +514,19 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             cfg.common.health_check_listen_address, datastore=datastore
         )
         sampler = _start_status_sampler(stop, datastore, cfg.common)
+        maintenance = (
+            _start_accumulator_maintenance(stop, stepper_impl, cfg)
+            if kind == "aggregation"
+            else None
+        )
         await driver.run(stop)
         # Graceful teardown (SIGTERM): in-flight steps have drained and
         # released their leases in-tx; now flush the executor's pending
         # mega-batches and spill committed-but-unspilled accumulator
         # deltas durably (the journal transaction), so ONLY a genuine
         # crash ever takes the discard-and-replay path.
+        if maintenance is not None:
+            await asyncio.gather(maintenance, return_exceptions=True)
         if kind == "aggregation":
             await stepper_impl.shutdown()
         else:

@@ -17,6 +17,7 @@ tests/test_chaos.py cross-checks them):
     ``http.request``         before each attempt in ``retry_http_request``
     ``executor.flush``       at the head of a DeviceExecutor flush
     ``backend.launch``       in ``TpuBackend.launch_prep_init_multi``
+    ``backend.device_lost``  same site, impersonating a lost mesh device
     ``backend.combine``      in ``TpuBackend.prep_shares_to_prep_batch``
     ``clock.skew``           sampled by ``SkewedClock.now``
     ``report_writer.flush``  before a ReportWriteBatcher batch commit
@@ -59,6 +60,12 @@ KNOWN_POINTS = (
     "executor.flush",
     "backend.launch",
     "backend.combine",
+    # mesh failure domain (vdaf/backend.py launch path): impersonates a
+    # chip dropping out of the mesh mid-launch (ICI link loss, plugin
+    # eviction).  Distinct from backend.launch so chaos runs can target
+    # "device lost" specifically; the executor's per-MESH breaker (every
+    # mesh-backed shape shares one circuit) is what this point exercises.
+    "backend.device_lost",
     "clock.skew",
     # maintenance loops (ISSUE 3 satellite: ROADMAP chaos follow-on)
     "report_writer.flush",

@@ -355,6 +355,18 @@ def trace_span(name: str, cat: str = "job", **args):
     return t.span(name, cat, **args) if t is not None else _NULL_SPAN
 
 
+def emit_span(name: str, cat: str, start_s: float, dur_s: float, **args) -> None:
+    """Emit an already-measured span directly (no context manager) —
+    retroactive CHILD spans whose interval is known only after the parent
+    closed, e.g. the per-submission shares of one executor mega-batch
+    flush.  Explicit trace_id/task_id/job_id args override the calling
+    context's, so a flush running on the executor's loop can stamp each
+    child with ITS submitter's identity.  Free no-op when tracing is off."""
+    t = _GLOBAL_TRACER
+    if t is not None:
+        t.emit(name, cat, start_s, dur_s, **args)
+
+
 def start_profiler_server(port: int) -> bool:
     """Opt-in on-device profiling: a jax.profiler server an operator can
     capture from at any time (the analog of the reference's tokio-console /

@@ -106,6 +106,14 @@ class AccumulatorConfig:
     #: crash between commit and drain is recoverable: survivors replay
     #: the journaled reports through the CPU oracle from the datastore.
     drain_interval_s: float = 0.0
+    #: Dedicated maintenance cadence (binaries background loop): > 0 runs
+    #: ``AggregationJobDriver.run_accumulator_maintenance`` every this
+    #: many seconds, draining deferred buckets that came due while no
+    #: driver commit was around to drain them (an idle task's bucket no
+    #: longer waits for the NEXT commit) and rebalancing resident
+    #: occupancy.  <= 0 = commit-driven drains only (pre-maintenance
+    #: behavior).
+    maintenance_interval_s: float = 0.0
 
     @property
     def deferred(self) -> bool:
@@ -291,7 +299,11 @@ class DeviceAccumulatorStore:
     def _buffer_nbytes(backend) -> int:
         try:
             flp = backend.vdaf.flp
-            return flp.OUTPUT_LEN * backend.bp.jf.n * 4
+            # mesh backends keep one (OUT, n) partial-sum row PER DEVICE
+            # (accum_buffer_rows = mesh size), so the resident-byte budget
+            # must account the whole sharded buffer, not one chip's slice
+            rows = getattr(backend, "accum_buffer_rows", 1)
+            return rows * flp.OUTPUT_LEN * backend.bp.jf.n * 4
         except Exception:
             return 0
 
@@ -452,6 +464,18 @@ class DeviceAccumulatorStore:
         self._observe(evicted=True)
 
     # -- lifecycle / introspection --------------------------------------
+    def rebalance(self) -> dict:
+        """Occupancy housekeeping for the maintenance loop: run the LRU
+        eviction pass (normally paid inline by the next commit) so memory
+        pressure is relieved on cadence instead of on the hot path, and
+        return the occupancy snapshot the loop logs.  Bucket placement
+        note: every bucket spans the LOCAL mesh (the same ICI domain its
+        flush matrices live on), so within one process "rebalancing" is
+        budget eviction; spreading buckets across MESHES on multi-slice
+        hosts is the ROADMAP follow-on that would land here."""
+        self._evict_if_needed()
+        return self.stats()
+
     def due_buckets(self, max_age_s: float) -> List[tuple]:
         """Keys of buckets whose oldest un-drained delta is older than
         ``max_age_s`` — the deferred-drain cadence scan."""

@@ -44,6 +44,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..core import faults
+from ..core.trace import current_trace, emit_span
 
 logger = logging.getLogger("janus_tpu.executor")
 
@@ -82,6 +83,13 @@ class ExecutorConfig:
     """Tuning knobs; defaults favor throughput at ~5 ms added latency."""
 
     enabled: bool = False
+    #: Mesh-sharded mega-batches: upgrade every single-chip TpuBackend
+    #: this executor caches to the SPMD MeshBackend over the local mesh
+    #: (vdaf/backend.py), so staging lands each mega-batch's shards
+    #: directly on their chips.  Equivalent to configuring
+    #: ``vdaf_backend: mesh`` on every producer; oracle/hybrid/Poplar1
+    #: backends pass through untouched.
+    mesh: bool = False
     #: flush a bucket as soon as it holds this many rows
     flush_max_rows: int = 16384
     #: deadline from a bucket's first pending submission to its flush
@@ -227,6 +235,15 @@ class _Submission:
     #: the flush keeps the out-share matrix on device and hands back
     #: ResidentRefs instead of limb vectors
     retain: bool = False
+    #: task identity (drivers pass the DAP task id): the per-task DRR
+    #: accounting domain WITHIN a bucket — tasks sharing one VDAF shape
+    #: share its bucket but not its quantum, so one hot task cannot
+    #: starve its shape-mates.  None = unattributed (legacy callers).
+    task: Optional[object] = None
+    #: submitter's trace context (trace_id/task_id/job_id), captured at
+    #: submit time so the flush can emit per-submission child spans — a
+    #: job's merged timeline shows its share of each mega-batch flush
+    trace_ctx: Optional[dict] = None
 
 
 class _Bucket:
@@ -292,6 +309,22 @@ def shape_label(backend, shape_key: tuple) -> str:
     return f"{circuit}#{_shape_digest(shape_key)}"
 
 
+def breaker_domain(shape_key: tuple, backend):
+    """The breaker's failure unit: the MESH for mesh backends (its device
+    set — one circuit per mesh, shared by every shape launching on it),
+    the VDAF shape otherwise."""
+    mesh = getattr(backend, "mesh", None)
+    if mesh is not None:
+        return ("mesh", tuple(str(d) for d in mesh.devices.flat))
+    return shape_key
+
+
+def mesh_label(backend) -> str:
+    """Per-mesh breaker label: device count + a stable device-set digest."""
+    devs = tuple(str(d) for d in backend.mesh.devices.flat)
+    return "mesh[%d]#%s" % (len(devs), _shape_digest(devs))
+
+
 class DeviceExecutor:
     """The continuous batcher.  One per process (get_global_executor)."""
 
@@ -299,7 +332,16 @@ class DeviceExecutor:
         self.config = config or ExecutorConfig()
         self._buckets: Dict[tuple, _Bucket] = {}
         self._backends: Dict[tuple, object] = {}
-        self._breakers: Dict[tuple, CircuitBreaker] = {}
+        #: breaker DOMAIN -> breaker.  The domain is the failure unit: the
+        #: VDAF shape for single-chip backends, the MESH for mesh backends
+        #: (losing a device sickens every shape launching on that mesh, so
+        #: they must share one circuit — breaker-per-mesh, not per-process
+        #: and not per-shape).
+        self._breakers: Dict[object, CircuitBreaker] = {}
+        #: shape_key -> its domain's breaker (the circuit_open peek index)
+        self._breaker_by_shape: Dict[tuple, CircuitBreaker] = {}
+        #: domain -> shape_keys referencing it (retirement bookkeeping)
+        self._breaker_shapes: Dict[object, set] = {}
         self._lock = threading.Lock()
         self._stage_pool: Optional[ThreadPoolExecutor] = None
         self._launch_pool: Optional[ThreadPoolExecutor] = None
@@ -313,6 +355,10 @@ class DeviceExecutor:
         self._ready_seq = 0
         self._rr_cursor: Dict[object, int] = {}
         self._deficit: Dict[tuple, float] = {}
+        #: per-(bucket, task) deficit tabs: fairness WITHIN a bucket, so
+        #: tasks sharing one VDAF shape cannot starve each other (the
+        #: bucket-level tab above keeps fairness ACROSS buckets)
+        self._task_deficit: Dict[tuple, float] = {}
         self._dispatchers: Dict[object, object] = {}
         self._slots: Dict[object, asyncio.Semaphore] = {}
         #: dispatched-but-unfinished flushes per loop: the loop's slot
@@ -343,12 +389,17 @@ class DeviceExecutor:
     def backend_for(self, shape_key: tuple, factory):
         """One backend instance (and its compiled graphs) per VDAF shape,
         shared across every driver in the process.  Newly created backends
-        are warmed up (mega-batch executables compiled) when configured."""
+        are warmed up (mega-batch executables compiled) when configured.
+        With ``config.mesh`` set, single-chip device backends are upgraded
+        to the SPMD MeshBackend over the local mesh before caching, so
+        every producer's mega-batches shard across the chips."""
         created = False
         with self._lock:
             b = self._backends.get(shape_key)
             if b is None:
                 b = factory()
+                if self.config.mesh:
+                    b = self._meshify(b)
                 self._backends[shape_key] = b
                 created = True
         if created and self.config.warmup_rows:
@@ -364,6 +415,18 @@ class DeviceExecutor:
             except Exception:
                 logger.exception("executor warmup failed (serving cold)")
         return b
+
+    @staticmethod
+    def _meshify(backend):
+        """``device_executor.mesh: true`` — upgrade an exact-type
+        TpuBackend to MeshBackend (already-mesh, oracle, hybrid, and
+        Poplar1 backends pass through: they either have no SPMD launch or
+        are mesh-aware already)."""
+        from ..vdaf.backend import MeshBackend, TpuBackend
+
+        if type(backend) is TpuBackend:
+            return MeshBackend(backend.vdaf)
+        return backend
 
     # -- thread pools ----------------------------------------------------
     def _pools(self) -> Tuple[ThreadPoolExecutor, ThreadPoolExecutor]:
@@ -391,6 +454,7 @@ class DeviceExecutor:
         agg_id: int = 0,
         deadline_s: Optional[float] = None,
         retain_out_shares: bool = False,
+        task_ident: Optional[object] = None,
     ):
         """Enqueue prepare work; resolves when its mega-batch lands.
 
@@ -398,6 +462,8 @@ class DeviceExecutor:
         result is the per-row List[PrepOutcome].  kind=KIND_COMBINE:
         payload is the prep-share rows and the result is the per-row
         combine outcomes.  Raises ExecutorOverloadedError on backpressure.
+        ``task_ident`` attributes the rows to a task for the per-task
+        fairness quota within the bucket (None = unattributed).
         """
         if kind == KIND_PREP_INIT:
             rows = len(payload[1])
@@ -452,6 +518,8 @@ class DeviceExecutor:
                 # <= 0 disables the deadline (documented in config.py)
                 deadline=now + timeout if timeout and timeout > 0 else None,
                 retain=retain_out_shares and self.accumulator is not None,
+                task=task_ident,
+                trace_ctx=current_trace() or None,
             )
             bucket.last_activity = now
             bucket.pending.append(sub)
@@ -471,20 +539,34 @@ class DeviceExecutor:
         return await sub.future
 
     def _breaker_for(self, shape_key: tuple, backend) -> Optional[CircuitBreaker]:
-        """One CircuitBreaker per VDAF shape (None when disabled): every
-        bucket of the shape — both aggregator sides, both kinds — shares
-        the health verdict, because they share the sick device."""
+        """One CircuitBreaker per failure DOMAIN (None when disabled).
+        Single-chip backends fail per VDAF shape (a bad compile/OOM is
+        shape-local), so their domain is the shape: every bucket of it —
+        both aggregator sides, both kinds — shares the verdict.  Mesh
+        backends fail per MESH (a lost device sickens every shape that
+        launches collectives over it), so every mesh-backed shape on one
+        mesh shares one breaker: a ``backend.device_lost`` trip opens the
+        circuit for ALL of them at once and the drivers serve those jobs
+        on the bit-exact CPU oracle until the probe heals the mesh."""
         if self.config.breaker_failure_threshold <= 0:
             return None
+        domain = breaker_domain(shape_key, backend)
         with self._lock:
-            br = self._breakers.get(shape_key)
+            br = self._breakers.get(domain)
             if br is None:
+                label = (
+                    mesh_label(backend)
+                    if getattr(backend, "mesh", None) is not None
+                    else shape_label(backend, shape_key)
+                )
                 br = CircuitBreaker(
-                    shape_label(backend, shape_key),
+                    label,
                     self.config.breaker_failure_threshold,
                     self.config.breaker_reset_timeout_s,
                 )
-                self._breakers[shape_key] = br
+                self._breakers[domain] = br
+            self._breaker_by_shape[shape_key] = br
+            self._breaker_shapes.setdefault(domain, set()).add(shape_key)
             return br
 
     def _spawn(self, coro) -> None:
@@ -563,23 +645,65 @@ class DeviceExecutor:
                 if not entries:
                     continue
                 entries.sort(key=lambda e: (e[0], e[1]))  # deadline-earliest
-                rows = sum(s.rows for s in entries[0][3])
+                j, task_refill = self._pick_entry_locked(key, entries, quota)
+                rows = sum(s.rows for s in entries[j][3])
                 # a bucket in deficit debt yields its turn — unless every
                 # bucket is in debt, in which case the round refills below
                 # and the earliest-cursor bucket proceeds (progress
                 # guarantee; the overshoot stays on its tab)
                 if final_pass or self._deficit.get(key, quota) >= min(rows, quota):
-                    entry = entries.pop(0)
+                    if task_refill:
+                        # every entry's tasks are in per-task debt: refill
+                        # the bucket's task tabs — only here, at DISPATCH
+                        # (a refill on a merely CONSIDERED bucket that the
+                        # bucket-level gate then skips would erase a hot
+                        # task's debt without any cold task progressing)
+                        for e in entries:
+                            for s in e[3]:
+                                tk = (key, s.task)
+                                self._task_deficit[tk] = min(
+                                    quota, self._task_deficit.get(tk, 0) + quota
+                                )
+                    entry = entries.pop(j)
                     if not entries:
                         del ready[key]
                     if not ready:
                         del self._ready[loop]
                     self._deficit[key] = self._deficit.get(key, quota) - rows
+                    for s in entry[3]:  # per-task tabs within the bucket
+                        tk = (key, s.task)
+                        self._task_deficit[tk] = (
+                            self._task_deficit.get(tk, quota) - s.rows
+                        )
                     self._rr_cursor[loop] = (cursor + i + 1) % len(keys)
                     return entry[2], entry[3], entry[4]
             for k in keys:  # full round found only debtors: refill
                 self._deficit[k] = min(quota, self._deficit.get(k, 0) + quota)
         return None
+
+    def _pick_entry_locked(self, key, entries, quota):
+        """WITHIN one bucket: deadline-earliest, except that an entry whose
+        tasks are all in per-task deficit debt yields to the first entry of
+        a task still holding quota — tasks sharing one VDAF shape share its
+        bucket but not its quantum, so a task flooding the bucket with
+        ready flushes cannot starve its shape-mates (carried over from
+        PR 3).  ``entries`` is pre-sorted (deadline, seq); returns
+        ``(chosen index, task_refill)``.  PURE — when every entry's tasks
+        are in debt it picks the earliest entry (progress guarantee) and
+        signals ``task_refill=True`` so the caller refills the bucket's
+        task tabs at dispatch time, never on a bucket the bucket-level
+        deficit gate then skips."""
+        if len(entries) == 1:
+            return 0, False
+        for j, e in enumerate(entries):
+            subs = e[3]
+            rows = sum(s.rows for s in subs)
+            credit = min(
+                self._task_deficit.get((key, s.task), quota) for s in subs
+            )
+            if credit >= min(rows, quota):
+                return j, False
+        return 0, True
 
     async def _dispatch_loop(self) -> None:
         loop = asyncio.get_running_loop()
@@ -779,6 +903,21 @@ class DeviceExecutor:
                     continue
                 self._finish(bucket, s, done)
                 self._observe_wait(bucket, done - s.enqueued)
+                # Per-submission CHILD span, stamped with the SUBMITTER's
+                # trace context: one job's merged Perfetto timeline shows
+                # its share of each mega-batch flush (rows of flush_rows),
+                # not just an anonymous executor_flush it cannot claim.
+                emit_span(
+                    "flush_share",
+                    "executor",
+                    t_launch,
+                    done - t_launch,
+                    bucket=bucket.label,
+                    rows=s.rows,
+                    flush_rows=rows,
+                    trigger=trigger,
+                    **(s.trace_ctx or {}),
+                )
                 self._resolve(s, result=out)
         except Exception as e:  # surface the launch failure to every job
             if bucket.breaker is not None:
@@ -910,9 +1049,13 @@ class DeviceExecutor:
         to route straight to the CPU oracle instead of paying a
         submit-then-CircuitOpenError round trip per job.  Returns False
         once the dwell has elapsed so the next real submission runs the
-        half-open probe that can close the circuit."""
+        half-open probe that can close the circuit.  Mesh-backed shapes
+        share their mesh's breaker, so after a device loss this returns
+        True for EVERY shape on that mesh."""
         with self._lock:
-            br = self._breakers.get(shape_key)
+            br = self._breaker_by_shape.get(shape_key) or self._breakers.get(
+                shape_key
+            )
         return br is not None and br.is_open_peek()
 
     def retire_idle_buckets(self, max_idle_s: float = 600.0) -> int:
@@ -936,11 +1079,25 @@ class DeviceExecutor:
                     and now - bucket.last_activity >= max_idle_s
                 ):
                     del self._buckets[key]
+                    # the scheduler tabs go with the bucket — _deficit and
+                    # the per-task _task_deficit entries are keyed by task
+                    # cardinality and would otherwise grow for the process
+                    # lifetime under task churn
+                    self._deficit.pop(key, None)
+                    for tk in [t for t in self._task_deficit if t[0] == key]:
+                        del self._task_deficit[tk]
                     retired.append(bucket.label)
             live_shapes = {key[0] for key in self._buckets}
-            for shape_key, breaker in list(self._breakers.items()):
-                if shape_key not in live_shapes and breaker.state == CIRCUIT_CLOSED:
-                    del self._breakers[shape_key]
+            for domain, breaker in list(self._breakers.items()):
+                # a breaker retires only when NONE of the shapes in its
+                # domain (one for per-shape breakers, many for a mesh's)
+                # still has a live bucket, and its circuit is closed
+                shapes = self._breaker_shapes.get(domain, {domain})
+                if not (shapes & live_shapes) and breaker.state == CIRCUIT_CLOSED:
+                    del self._breakers[domain]
+                    for sk in self._breaker_shapes.pop(domain, set()):
+                        if self._breaker_by_shape.get(sk) is breaker:
+                            del self._breaker_by_shape[sk]
                     retired_circuits.append(breaker.label)
         if retired or retired_circuits:
             from ..core.metrics import GLOBAL_METRICS

@@ -111,6 +111,10 @@ class TpuBackend:
     #: this backend can keep a flush's out shares resident on device and
     #: hand back ResidentRefs (executor/accumulator.py) instead of limbs
     supports_resident_out_shares = True
+    #: leading-axis rows of an accumulator buffer (accumulate_rows):
+    #: 1 on a single chip; the mesh backend keeps one partial-sum row PER
+    #: DEVICE so the accumulator store can account resident bytes honestly
+    accum_buffer_rows = 1
 
     def __init__(self, vdaf: Prio3):
         if vdaf.xof is not XofTurboShake128:
@@ -230,6 +234,12 @@ class TpuBackend:
     def _pad_to(self, B: int) -> int:
         """Power-of-two bucketing bounds recompiles to log2 distinct shapes."""
         return next_power_of_2(B)
+
+    def _align_pad(self, pad_to: int) -> int:
+        """Final alignment applied to an explicitly requested pad (warmup's
+        target mega-batch shape); the mesh backend rounds it up so the
+        batch axis divides evenly across the mesh."""
+        return pad_to
 
     def _place(self, kw: Dict[str, np.ndarray]) -> Dict[str, object]:
         """Commit marshaled inputs to device(s); identity on a single chip."""
@@ -381,7 +391,7 @@ class TpuBackend:
         if not flat:
             return None
         B = len(flat)
-        pad_to = max(pad_to or 0, self._pad_to(B))
+        pad_to = self._align_pad(max(pad_to or 0, self._pad_to(B)))
         kw = self._marshal(agg_id, flat, pad_to)
         vk_mat = np.stack(vk_rows)
         kw["verify_key_u8"] = np.concatenate(
@@ -411,8 +421,12 @@ class TpuBackend:
         # Failure-domain boundary: an injected launch fault impersonates
         # XLA OOM / plugin loss; callers (executor breaker, driver retry
         # budget) must degrade gracefully.  The oracle has no such point —
-        # it is the fallback truth.
+        # it is the fallback truth.  backend.device_lost is the mesh-
+        # flavored twin: a chip dropping out of the mesh mid-launch, which
+        # the executor's per-MESH breaker must answer by opening the
+        # circuit for EVERY mesh-backed shape (./ci.sh chaos exercises it).
         faults.fire("backend.launch")
+        faults.fire("backend.device_lost")
         agg_id, B = staged.agg_id, staged.rows
         from ..core.metrics import GLOBAL_METRICS
 
@@ -590,6 +604,9 @@ class MeshBackend(TpuBackend):
         self.mesh = Mesh(np.array(devs), ("batch",))
         self._batch_sharding = NamedSharding(self.mesh, PartitionSpec("batch"))
         self._replicated = NamedSharding(self.mesh, PartitionSpec())
+        #: accumulator buffers keep one (OUT, n) partial-sum row per device
+        self.accum_buffer_rows = len(devs)
+        self._accum_read_fn = None
 
     # -- sharded launches -------------------------------------------------
     # prepare/combine run under shard_map (manual partitioning): each chip
@@ -659,10 +676,19 @@ class MeshBackend(TpuBackend):
 
     # The batch APIs are inherited: only padding and placement differ.
     def _pad_to(self, B: int) -> int:
-        # Power-of-two bucketing (bounds recompiles) rounded up so the mesh
-        # axis divides the batch evenly.
+        # Power-of-two bucketing (bounds recompiles) rounded up to a
+        # MULTIPLE of the mesh size, so the batch axis divides evenly and
+        # every shard sees the same local batch — the flush-tail guarantee
+        # planar_eligible's per-shard tiling check relies on.  (For a
+        # power-of-two mesh the pow2 pad is already a multiple; the
+        # rounding matters on odd-sized meshes, e.g. after a chip is
+        # cordoned out.)
         n = len(self.mesh.devices)
-        return max(next_power_of_2(B), n)
+        return self._align_pad(max(next_power_of_2(B), n))
+
+    def _align_pad(self, pad_to: int) -> int:
+        n = len(self.mesh.devices)
+        return -(-pad_to // n) * n
 
     def _place(self, kw: Dict[str, np.ndarray]) -> Dict[str, object]:
         """Commit per-report arrays shard-per-device.
@@ -676,6 +702,74 @@ class MeshBackend(TpuBackend):
 
     def _place_batch(self, arr: np.ndarray):
         return self._jax.device_put(arr, self._batch_sharding)
+
+    # -- sharded device-resident accumulation -----------------------------
+    # The accumulator store's per-bucket buffers stay SHARDED: one
+    # (OUT, n) partial-sum row per device, batch-sharded over the mesh.
+    # accumulate_rows is pure per-shard work (each chip psums the
+    # mask-selected rows of ITS shard of the retained out-share matrix
+    # into ITS partial row — no collective, no readback), and the ONE
+    # cross-chip reduction happens at drain/spill time in
+    # read_accum_buffer, where XLA lowers the sum over the device-sharded
+    # axis to an all-reduce.  Bucket placement decision: one bucket spans
+    # the LOCAL mesh (the same ICI domain its flush matrices live on);
+    # hashing buckets across meshes on multi-slice hosts stays a ROADMAP
+    # item.
+
+    def accumulate_rows(self, buffer, matrix, mask: np.ndarray):
+        """Per-shard psum of the mask-selected rows of a batch-sharded
+        (pad, OUT, n) out-share matrix into a (n_dev, OUT, n) sharded
+        buffer (None starts one).  Zero cross-chip traffic."""
+        if self._accum_fn is None:
+            jnp = self._jax.numpy
+            jf = self.bp.jf
+
+            def per_shard(buf, m, msk):
+                masked = jnp.where(msk[:, None, None], m, jnp.zeros_like(m))
+                delta = jf.sum(masked, axis=0)
+                return jf.add(buf, delta[None])
+
+            self._accum_fn = self._shard_wrap3(per_shard)
+        if buffer is None:
+            jf = self.bp.jf
+            buffer = self._jax.device_put(
+                np.zeros(
+                    (len(self.mesh.devices), self.vdaf.flp.OUTPUT_LEN, jf.n),
+                    dtype=np.uint32,
+                ),
+                self._batch_sharding,
+            )
+        return self._accum_fn(buffer, matrix, np.asarray(mask))
+
+    def _shard_wrap3(self, per_shard):
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        return jax.jit(
+            shard_map(
+                per_shard,
+                mesh=self.mesh,
+                in_specs=(
+                    PartitionSpec("batch"),
+                    PartitionSpec("batch"),
+                    PartitionSpec("batch"),
+                ),
+                out_specs=PartitionSpec("batch"),
+                check_rep=False,
+            )
+        )
+
+    def read_accum_buffer(self, buffer) -> List[int]:
+        """Spill readback: the one point where the accumulated shards
+        cross chips — a modular tree-sum over the device-sharded leading
+        axis (XLA inserts the all-reduce), then ONE (OUT,) vector to the
+        host.  (A raw integer psum over u32 limb arrays would be wrong —
+        the carry chain must run inside the modular sum.)"""
+        if self._accum_read_fn is None:
+            jf = self.bp.jf
+            self._accum_read_fn = self._jax.jit(lambda b: jf.sum(b, axis=0))
+        return self.bp.jf.from_limbs(np.asarray(self._accum_read_fn(buffer)))
 
 
 class HybridXofBackend:

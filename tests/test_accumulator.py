@@ -755,6 +755,61 @@ def test_due_buckets_age_scan():
     assert not AccumulatorConfig(enabled=True).deferred
 
 
+def test_maintenance_pass_drains_due_deferred_buckets_and_rebalances():
+    """ISSUE 6 satellite (carried from PR 4): the dedicated maintenance
+    pass the binaries run on ``accumulator.maintenance_interval_s`` —
+    due deferred buckets drain WITHOUT waiting for a committing driver,
+    and the occupancy rebalance (eviction pass) runs off the hot path."""
+    import time as _time
+
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+
+    reset_global_executor()
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="oracle",
+            device_executor=ExecutorConfig(
+                enabled=True,
+                accumulator=AccumulatorConfig(
+                    enabled=True,
+                    drain_interval_s=0.01,
+                    maintenance_interval_s=0.01,
+                ),
+            ),
+        ),
+    )
+    store = driver._executor.accumulator
+    backend = _AccumBackend()
+    m = _matrix(2)
+    fid = store.retain_flush(backend, m, rows=2, nbytes=m.nbytes)
+    key = ("leader", b"task", ("shape",), b"ident", b"param")  # deferred key
+    store.commit_rows(
+        key,
+        backend,
+        [ResidentRef(fid, 0), ResidentRef(fid, 1)],
+        job_token=b"job",
+        report_ids=[b"r0", b"r1"],
+    )
+    drained_keys = []
+
+    def fake_drain(k):  # consume the bucket like the real drain's journal tx
+        drained_keys.append(k)
+        store.discard(k)
+
+    driver._drain_due_bucket = fake_drain
+    _time.sleep(0.02)  # past drain_interval_s: the bucket is due
+    n = _run(driver.run_accumulator_maintenance())
+    assert n == 1 and drained_keys == [key]
+    # nothing due -> a quiet pass; the loop must be safe to run forever
+    assert _run(driver.run_accumulator_maintenance()) == 0
+    reset_global_executor()
+
+
 def test_shutdown_drain_spills_through_sink_exactly_once():
     """SIGTERM path (ISSUE 4 satellite): shutdown(drain=True) — the
     default — spills committed-but-unspilled deltas through the

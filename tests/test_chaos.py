@@ -113,6 +113,7 @@ def test_every_known_point_is_wired():
         "http.request": "janus_tpu/core/retries.py",
         "executor.flush": "janus_tpu/executor/service.py",
         "backend.launch": "janus_tpu/vdaf/backend.py",
+        "backend.device_lost": "janus_tpu/vdaf/backend.py",
         "backend.combine": "janus_tpu/vdaf/backend.py",
         "clock.skew": "janus_tpu/core/faults.py",
         "report_writer.flush": "janus_tpu/aggregator/report_writer.py",
@@ -530,7 +531,7 @@ class ChaosHarness:
 
     N_REPORTS = 4
 
-    def __init__(self, n_tasks=2):
+    def __init__(self, n_tasks=2, mesh=False):
         import aiohttp
 
         from janus_tpu.aggregator import Aggregator, Config
@@ -551,6 +552,12 @@ class ChaosHarness:
 
         self.exec_cfg = ExecutorConfig(
             enabled=True,
+            # mesh-enabled chaos (ISSUE 6): every single-chip backend the
+            # executor caches upgrades to the SPMD MeshBackend over the
+            # 8 virtual CPU devices, so the soak exercises sharded
+            # launches, the per-MESH breaker, and sharded accumulation
+            # under the same fault schedule
+            mesh=mesh,
             flush_window_s=0.02,
             flush_max_rows=4096,
             breaker_failure_threshold=2,
@@ -760,6 +767,10 @@ def _soak_fault_specs():
         FaultSpec("http.request", "hang", 0.05, hang_s=0.1),
         FaultSpec("executor.flush", "error", 0.2),
         FaultSpec("backend.launch", "error", 0.2),
+        # the mesh-flavored twin of backend.launch: a chip dropping out of
+        # the mesh mid-launch (fires on single-chip launches too — the
+        # failure answer is the same breaker + oracle fallback)
+        FaultSpec("backend.device_lost", "error", 0.1),
         FaultSpec("backend.combine", "error", 0.2),
         FaultSpec("clock.skew", "skew", 0.2, skew_s=5),
         # mid-spill failures: drains fall back to the CPU-oracle replay,
@@ -867,6 +878,77 @@ def test_chaos_soak_two_replicas_multitask():
                 result = await harness.collect_task(t)
                 assert result.report_count == len(ms), (t, result)
                 assert result.aggregate_result == sum(ms), (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    _run(flow(), timeout=280.0)
+    reset_global_executor()
+
+
+def test_mesh_chaos_device_lost_opens_per_mesh_breaker_oracle_exact():
+    """ISSUE 6 acceptance: with the MESH backend enabled
+    (``device_executor.mesh: true`` — every cached backend upgraded to the
+    SPMD MeshBackend over the 8 virtual CPU devices), a
+    ``backend.device_lost`` injection (a chip dropping out of the mesh
+    mid-launch) opens the PER-MESH circuit breaker, jobs degrade to the
+    bit-exact CPU oracle, and collection still returns exactly-once
+    counts."""
+    pytest.importorskip("cryptography")
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device mesh conftest provisions")
+
+    reset_global_executor()
+    harness = ChaosHarness(n_tasks=1, mesh=True)
+    measurements = [1, 0, 1, 1]
+
+    async def flow():
+        await harness.start()
+        try:
+            for m in measurements:
+                await harness.upload(0, m)
+            await asyncio.sleep(0.1)
+            await harness.create_jobs()
+
+            # Every mesh launch loses a device: the per-MESH breaker must
+            # open (label carries the mesh device set, not a VDAF shape).
+            faults.configure(
+                [FaultSpec("backend.device_lost", "error", 1.0)], seed=SEED
+            )
+            ex = harness.drivers[0]._executor
+            for _ in range(10):
+                await harness.drive_round()
+                if any(
+                    s["state"] == "open" for s in ex.circuit_stats().values()
+                ):
+                    break
+            circuits = ex.circuit_stats()
+            assert any(
+                label.startswith("mesh[") and s["trips"] >= 1
+                for label, s in circuits.items()
+            ), circuits
+            assert faults.registry().hits.get("backend.device_lost", 0) > 0
+
+            # With the circuit open (fault still armed — the mesh stays
+            # "sick"), every job finishes on the CPU oracle: driver-side
+            # via the breaker peek / CircuitOpenError fallback, helper-side
+            # via the executor-path oracle re-entry.
+            for _ in range(40):
+                await harness.drive_round()
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert states and all(s == "Finished" for s in states), states
+
+            # Exactly-once: the collected aggregate equals the true sum
+            # with every report counted once, despite retries + fallback.
+            faults.clear()
+            result = await harness.collect_task(0)
+            assert result.report_count == len(measurements), result
+            assert result.aggregate_result == sum(measurements), result
         finally:
             faults.clear()
             await harness.stop()

@@ -1,0 +1,519 @@
+"""Mesh-sharded mega-batches through the executor + accumulator (ISSUE 6).
+
+The production multi-chip path end to end, on the 8 virtual CPU devices
+conftest provisions (tests/conftest.py): ``device_executor.mesh: true``
+upgrades every cached single-chip backend to the SPMD MeshBackend, flush
+tails pad to a multiple of the mesh size, per-bucket accumulator buffers
+stay SHARDED (one partial-sum row per device, all-reduce only at drain),
+the breaker is scoped per MESH (a lost device opens the circuit for every
+shape on it), and per-task DRR quotas + per-submission flush child spans
+ride along.  Deliberately fast-tier: only the Count shape compiles here;
+the heavier mesh parity matrix lives in tests/test_mesh.py (device tier).
+"""
+
+import asyncio
+import json
+import threading
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from janus_tpu.core import faults
+from janus_tpu.core.faults import FaultSpec
+from janus_tpu.executor import (
+    AccumulatorConfig,
+    CircuitOpenError,
+    DeviceAccumulatorStore,
+    DeviceExecutor,
+    ExecutorConfig,
+    ResidentRef,
+    reset_global_executor,
+)
+from janus_tpu.utils.test_util import det_rng
+from janus_tpu.vdaf.backend import MeshBackend, OracleBackend, TpuBackend
+from janus_tpu.vdaf.instances import prio3_count
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+    reset_global_executor()
+
+
+def _run(coro, timeout=120.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+def _mesh_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provision 8 virtual CPU devices"
+    return devs[:8]
+
+
+@pytest.fixture(scope="module")
+def mesh_backend():
+    return MeshBackend(prio3_count(), devices=_mesh_devices())
+
+
+def _count_reports(vdaf, n, seed):
+    rng = det_rng(seed)
+    rows = []
+    for i in range(n):
+        nonce = rng(vdaf.NONCE_SIZE)
+        ps, shares = vdaf.shard(i % 2, nonce, rng(vdaf.RAND_SIZE))
+        rows.append((nonce, ps, shares[0]))
+    return rows
+
+
+# -- meshify: the executor upgrade path --------------------------------------
+
+
+def test_executor_mesh_flag_upgrades_cached_tpu_backends():
+    """``device_executor.mesh: true``: backend_for wraps an exact-type
+    TpuBackend into MeshBackend over the local mesh before caching; the
+    cache returns the SAME upgraded instance to every later caller."""
+    vdaf = prio3_count()
+    ex = DeviceExecutor(ExecutorConfig(mesh=True))
+    b = ex.backend_for(("shape",), lambda: TpuBackend(vdaf))
+    assert isinstance(b, MeshBackend)
+    assert len(b.mesh.devices) == len(jax.local_devices())
+    assert ex.backend_for(("shape",), lambda: TpuBackend(vdaf)) is b
+    ex.shutdown()
+
+
+def test_meshify_passes_through_non_tpu_backends(mesh_backend):
+    """Oracle (no SPMD launch) and already-mesh backends are untouched."""
+    oracle = OracleBackend(prio3_count())
+    assert DeviceExecutor._meshify(oracle) is oracle
+    assert DeviceExecutor._meshify(mesh_backend) is mesh_backend
+
+
+def test_mesh_pad_alignment_multiple_of_mesh_size(mesh_backend):
+    """Flush tails pad to a MULTIPLE of the mesh size (so planar_eligible's
+    per-shard tiling holds), on top of the pow2 bucketing; explicitly
+    requested pads (warmup) are re-aligned too."""
+    n = len(mesh_backend.mesh.devices)
+    assert n == 8
+    for B in (1, 3, 8, 11, 100):
+        pad = mesh_backend._pad_to(B)
+        assert pad % n == 0 and pad >= B
+    assert mesh_backend._align_pad(9) == 16
+    vdaf = mesh_backend.vdaf
+    staged = mesh_backend.stage_prep_init_multi(
+        0, [(b"\x2a" * 16, _count_reports(vdaf, 3, "pad"))], pad_to=9
+    )
+    assert staged.pad_to % n == 0
+
+
+# -- sharded submit: parity with the oracle, uneven tails --------------------
+
+
+def test_mesh_executor_submit_uneven_tail_parity_vs_oracle(mesh_backend):
+    """Two tasks coalesce into one sharded mega-batch with B=11 (11 % 8
+    != 0: the padded tail crosses shards unevenly) — results byte-equal
+    the oracle's, per task."""
+    vdaf = mesh_backend.vdaf
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.05, flush_max_rows=4096))
+    vk_a, vk_b = b"\x0a" * 16, b"\x0b" * 16
+    rows_a = _count_reports(vdaf, 7, "tail-a")
+    rows_b = _count_reports(vdaf, 4, "tail-b")
+
+    async def go():
+        return await asyncio.gather(
+            ex.submit(
+                ("count",), "prep_init", (vk_a, rows_a),
+                backend=mesh_backend, task_ident=b"A",
+            ),
+            ex.submit(
+                ("count",), "prep_init", (vk_b, rows_b),
+                backend=mesh_backend, task_ident=b"B",
+            ),
+        )
+
+    got_a, got_b = _run(go())
+    ex.shutdown()
+    oracle = OracleBackend(vdaf)
+    for got, vk, rows in ((got_a, vk_a, rows_a), (got_b, vk_b, rows_b)):
+        want = oracle.prep_init_batch(vk, 0, rows)
+        assert len(got) == len(want)
+        for (gs, gsh), (ws, wsh) in zip(got, want):
+            assert gs.out_share == ws.out_share
+            assert gsh.verifiers_share == wsh.verifiers_share
+
+
+# -- sharded device-resident accumulation ------------------------------------
+
+
+def test_mesh_resident_flush_masked_accumulate_bit_exact_zero_readback(
+    mesh_backend,
+):
+    """The ISSUE 6 accumulator contract on the mesh: the retained flush
+    matrix stays SHARDED, masked accumulate_rows psums per shard with no
+    collective, the ONE cross-chip reduction happens at drain — bit-exact
+    vs the oracle for a masked subset of an uneven (11-row) flush, with
+    ``outshare_readback_rows`` still 0 and the buffer budget accounting
+    one partial-sum row per device."""
+    vdaf = mesh_backend.vdaf
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=4096))
+    ex.accumulator = store
+    vk = b"\x2a" * 16
+    reports = _count_reports(vdaf, 11, "mesh-resident")
+    mesh_backend.outshare_readback_rows = 0
+
+    async def go():
+        return await ex.submit(
+            ("count",), "prep_init", (vk, reports),
+            backend=mesh_backend, retain_out_shares=True,
+        )
+
+    out = _run(go())
+    assert mesh_backend.outshare_readback_rows == 0
+    refs = [state.out_share for state, _ in out]
+    assert all(isinstance(r, ResidentRef) for r in refs)
+
+    # masked commit: only every other row lands in the sharded buffer
+    keep = [i for i in range(len(refs)) if i % 2 == 0]
+    drop = [i for i in range(len(refs)) if i % 2 == 1]
+    store.commit_rows(
+        ("bucket",),
+        mesh_backend,
+        [refs[i] for i in keep],
+        job_token=b"job",
+        report_ids=[reports[i][0] for i in keep],
+    )
+    # the sharded buffer carries one (OUT, n) partial row PER DEVICE
+    n_dev = len(mesh_backend.mesh.devices)
+    assert mesh_backend.accum_buffer_rows == n_dev
+    expect_buf = n_dev * vdaf.flp.OUTPUT_LEN * mesh_backend.bp.jf.n * 4
+    assert store.stats()["resident_bytes"] >= expect_buf
+    store.release_refs([refs[i] for i in drop])
+
+    vector, rids = store.drain(("bucket",), vdaf.flp.field)
+    ex.shutdown()
+    assert mesh_backend.outshare_readback_rows == 0
+    want = vdaf.aggregate(
+        [
+            state.out_share
+            for i, (state, _) in enumerate(
+                OracleBackend(vdaf).prep_init_batch(vk, 0, reports)
+            )
+            if i in set(keep)
+        ]
+    )
+    assert vector == want, "sharded masked accumulation must match the oracle"
+    assert rids == {reports[i][0] for i in keep}
+    assert store.stats()["flushes_resident"] == 0, "flush must free after use"
+
+
+def test_mesh_drain_after_device_loss_replays_journal_exactly_once(
+    mesh_backend, monkeypatch
+):
+    """Regression: a device lost AFTER rows were committed into a sharded
+    buffer (drain's all-reduce fails) poisons the bucket; discard returns
+    the journal EXACTLY ONCE so the oracle replay can re-derive exactly
+    the committed reports — never zero times (drop) and never twice
+    (double count)."""
+    vdaf = mesh_backend.vdaf
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=4096))
+    ex.accumulator = store
+    vk = b"\x2a" * 16
+    reports = _count_reports(vdaf, 5, "mesh-lost")
+
+    async def go():
+        return await ex.submit(
+            ("count",), "prep_init", (vk, reports),
+            backend=mesh_backend, retain_out_shares=True,
+        )
+
+    out = _run(go())
+    refs = [state.out_share for state, _ in out]
+    rids = [r[0] for r in reports]
+    store.commit_rows(
+        ("bucket",), mesh_backend, refs, job_token=b"job", report_ids=rids
+    )
+
+    def lost(buffer):
+        raise RuntimeError("mesh device lost mid-drain")
+
+    monkeypatch.setattr(mesh_backend, "read_accum_buffer", lost)
+    from janus_tpu.executor.accumulator import AccumulatorUnavailable
+
+    with pytest.raises(AccumulatorUnavailable):
+        store.drain(("bucket",), vdaf.flp.field)
+
+    journal = store.discard(("bucket",))
+    assert journal == [(b"job", frozenset(rids))]
+    assert store.discard(("bucket",)) == [], "journal must surface exactly once"
+    assert store.drain(("bucket",), vdaf.flp.field) is None
+    monkeypatch.undo()
+
+    # the replay target: the oracle re-derives exactly the journaled rows
+    replay_rids = set().union(*(ids for _job, ids in journal))
+    assert replay_rids == set(rids)
+    ex.shutdown()
+
+
+# -- per-mesh breaker ---------------------------------------------------------
+
+
+class _LostMeshBackend:
+    """Stage/launch double that looks mesh-backed (``.mesh.devices``) and
+    fires the real ``backend.device_lost`` point on launch."""
+
+    class _V:
+        pass
+
+    def __init__(self, devices):
+        self.vdaf = self._V()
+        self.mesh = SimpleNamespace(devices=np.array(devices, dtype=object))
+        self.launches = 0
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        rows = sum(len(r) for _, r in requests)
+        return SimpleNamespace(agg_id=agg_id, placed=None, pad_to=rows, rows=rows)
+
+    def launch_prep_init_multi(self, staged, requests):
+        self.launches += 1
+        faults.fire("backend.device_lost")
+        return [[("ok", i) for i in range(len(r))] for _, r in requests]
+
+
+def test_device_lost_opens_one_breaker_for_every_shape_on_the_mesh():
+    """Breaker scope is the MESH, not the shape and not the process: after
+    device-lost failures on shape A, shape B (same device set, never
+    launched) fails fast with CircuitOpenError — its jobs go straight to
+    the oracle — and exactly ONE mesh-labeled breaker exists."""
+    devices = ["d0", "d1", "d2", "d3"]
+    backend_a = _LostMeshBackend(devices)
+    backend_b = _LostMeshBackend(devices)
+    ex = DeviceExecutor(
+        ExecutorConfig(
+            flush_window_s=0.005,
+            flush_max_rows=10_000,
+            breaker_failure_threshold=2,
+            breaker_reset_timeout_s=60.0,
+        )
+    )
+    faults.configure([FaultSpec("backend.device_lost", "error", 1.0)], seed=7)
+
+    async def go():
+        for _ in range(2):
+            with pytest.raises(Exception) as ei:
+                await ex.submit(
+                    ("shapeA",), "prep_init", (b"k", [0]), backend=backend_a
+                )
+            assert "device_lost" in str(ei.value)
+        with pytest.raises(CircuitOpenError):
+            await ex.submit(
+                ("shapeB",), "prep_init", (b"k", [0]), backend=backend_b
+            )
+
+    _run(go())
+    assert backend_b.launches == 0, "shape B must fail fast, not launch"
+    assert ex.circuit_open(("shapeA",)) and ex.circuit_open(("shapeB",))
+    circuits = ex.circuit_stats()
+    assert len(circuits) == 1, circuits
+    (label,) = circuits
+    assert label.startswith("mesh[4]#"), label
+    ex.shutdown()
+
+
+def test_mesh_breaker_retires_only_when_every_shape_is_idle():
+    """A mesh breaker serves many shapes: bucket retirement may only drop
+    it once NO shape on the mesh still has a live bucket."""
+    devices = ["d0", "d1"]
+    backend = _LostMeshBackend(devices)
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=0.005, breaker_failure_threshold=2)
+    )
+
+    async def go():
+        await ex.submit(("shapeA",), "prep_init", (b"k", [0]), backend=backend)
+        await ex.submit(("shapeB",), "prep_init", (b"k", [0]), backend=backend)
+
+    _run(go())
+    assert len(ex.circuit_stats()) == 1
+    # shape A's bucket idles out; B's stays -> the shared breaker survives
+    ex._buckets[(("shapeA",), "prep_init", 0)].last_activity -= 1000
+    ex.retire_idle_buckets(max_idle_s=600)
+    assert len(ex.circuit_stats()) == 1, "breaker retired while B is live"
+    ex._buckets[(("shapeB",), "prep_init", 0)].last_activity -= 1000
+    ex.retire_idle_buckets(max_idle_s=600)
+    assert ex.circuit_stats() == {}
+    ex.shutdown()
+
+
+# -- per-task fairness within a bucket ----------------------------------------
+
+
+class _GatedBackend:
+    """Launch-gated double logging the submitting task of each flush."""
+
+    class _V:
+        pass
+
+    def __init__(self, gate):
+        self.vdaf = self._V()
+        self.gate = gate
+        self.launch_order = []
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        rows = sum(len(r) for _, r in requests)
+        if rows == 0:
+            return None
+        return SimpleNamespace(agg_id=agg_id, placed=None, pad_to=rows, rows=rows)
+
+    def launch_prep_init_multi(self, staged, requests):
+        assert self.gate.wait(10), "test launch gate never opened"
+        self.launch_order.append(requests[0][0])
+        return [
+            [("prep", vk, i) for i in range(len(reports))]
+            for vk, reports in requests
+        ]
+
+
+def test_per_task_quota_within_bucket_prevents_starvation():
+    """ISSUE 6 satellite (carried from PR 3): tasks sharing ONE VDAF shape
+    share its bucket but not its quantum.  A hot task floods the bucket
+    with ready flushes before a cold task's lands; deadline-earliest alone
+    would serve every hot flush first — the per-task deficit must pull the
+    cold task's flush ahead of the hot tail."""
+    gate = threading.Event()
+    backend = _GatedBackend(gate)
+    ex = DeviceExecutor(
+        ExecutorConfig(flush_window_s=60.0, flush_max_rows=2, fair_quota_rows=4)
+    )
+
+    async def go():
+        hot = [
+            asyncio.ensure_future(
+                ex.submit(
+                    ("shape",), "prep_init", (b"h%d" % i, [0, 1]),
+                    backend=backend, task_ident=b"hot",
+                )
+            )
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.05)  # four hot size-flushes ready, same bucket
+        cold = asyncio.ensure_future(
+            ex.submit(
+                ("shape",), "prep_init", (b"c0", [0, 1]),
+                backend=backend, task_ident=b"cold",
+            )
+        )
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(*hot, cold)
+
+    _run(go())
+    ex.shutdown()
+    order = backend.launch_order
+    assert len(order) == 5
+    assert order.index(b"c0") < len(order) - 1, (
+        f"cold task starved behind the hot task's flushes: {order}"
+    )
+
+
+# -- per-submission flush child spans -----------------------------------------
+
+
+def test_flush_share_child_spans_carry_each_submitters_trace(tmp_path):
+    """ISSUE 6 satellite (carried from PR 5): one mega-batch flush serving
+    two jobs emits one ``flush_share`` child span PER SUBMISSION, stamped
+    with the SUBMITTER's trace id — a job's merged Perfetto timeline shows
+    its share of the flush it rode."""
+    from janus_tpu.core.trace import configure_chrome_trace, trace_scope
+
+    gate = threading.Event()
+    gate.set()
+    backend = _GatedBackend(gate)
+    path = tmp_path / "trace.json"
+    configure_chrome_trace(str(path))
+    try:
+        ex = DeviceExecutor(
+            ExecutorConfig(flush_window_s=0.05, flush_max_rows=4096)
+        )
+
+        async def submit_with_trace(trace_id, vk):
+            with trace_scope(trace_id=trace_id, job_id=vk.decode()):
+                return await ex.submit(
+                    ("shape",), "prep_init", (vk, [0, 1]), backend=backend
+                )
+
+        async def go():
+            await asyncio.gather(
+                submit_with_trace("a" * 32, b"job-a"),
+                submit_with_trace("b" * 32, b"job-b"),
+            )
+
+        _run(go())
+        ex.shutdown()
+    finally:
+        configure_chrome_trace(None)
+
+    events = []
+    for line in path.read_text().splitlines():
+        line = line.strip().rstrip(",")
+        if line.startswith("{") and line.endswith("}"):
+            events.append(json.loads(line))
+    shares = [e for e in events if e.get("name") == "flush_share"]
+    assert len(shares) == 2, shares
+    by_trace = {e["args"]["trace_id"]: e for e in shares}
+    assert set(by_trace) == {"a" * 32, "b" * 32}
+    for e in shares:
+        assert e["args"]["rows"] == 2
+        assert e["args"]["flush_rows"] == 4, "one coalesced flush of 4 rows"
+        assert e["args"]["job_id"] in ("job-a", "job-b")
+    # both jobs coalesced: exactly one launch served both child spans
+    assert len(backend.launch_order) == 1
+
+
+# -- driver path over the mesh ------------------------------------------------
+
+
+def test_driver_coalesced_prep_on_mesh_matches_oracle():
+    """The leader driver's executor routing with ``mesh: true``: the
+    factory-built TpuBackend is upgraded before caching and the coalesced
+    prepare stays byte-exact vs the oracle."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+
+    reset_global_executor()
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu",
+            device_executor=ExecutorConfig(
+                enabled=True, mesh=True, flush_window_s=0.02
+            ),
+        ),
+    )
+    vdaf = prio3_count()
+    key = AggregationJobDriver._vdaf_shape_key(vdaf)
+    backend = driver._executor.backend_for(key, lambda: TpuBackend(vdaf))
+    assert isinstance(backend, MeshBackend)
+    vk = b"\x2a" * 16
+    reports = _count_reports(vdaf, 6, "driver-mesh")
+
+    out = _run(
+        driver._coalesced_prep_init(backend, vk, reports, task_ident=b"t")
+    )
+    want = OracleBackend(vdaf).prep_init_batch(vk, 0, reports)
+    assert len(out) == len(want)
+    for (gs, gsh), (ws, wsh) in zip(out, want):
+        assert gs.out_share == ws.out_share
+        assert gsh.verifiers_share == wsh.verifiers_share
+    reset_global_executor()
