@@ -19,6 +19,12 @@
 #   ./ci.sh tier1      the ROADMAP.md tier-1 command VERBATIM, gated on the
 #                      recorded DOTS_PASSED floor (tests/tier1_floor.txt):
 #                      fewer passing dots than the floor fails the gate.
+#   ./ci.sh mxu        MXU field-arithmetic gate: the limb-plane contraction
+#                      layer's fuzz/property suite (test_mxu_field.py — exact
+#                      vs arbitrary-precision ints for adversarial operands)
+#                      plus the prepare byte-parity sweep under BOTH
+#                      field_backend values (the -mxu twins in
+#                      test_prepare.py) on the virtual-device setup.
 #   ./ci.sh mesh       multi-chip gate: the mesh parity matrix (test_mesh.py)
 #                      plus the mesh-executor/accumulator suite
 #                      (test_mesh_executor.py) on the 8 virtual CPU devices —
@@ -134,6 +140,14 @@ case "$tier" in
     # stage runs both together for a focused mesh signal.
     exec python -m pytest tests/test_mesh.py tests/test_mesh_executor.py -q
     ;;
+  mxu)
+    # MXU field-arithmetic gate (ISSUE 7): dot_general contraction layer
+    # exactness (random + adversarial operands, both fields, matvec/matmul
+    # shapes, chunked long-K, batched inversion, compiled-HLO dot evidence)
+    # + the full prepare byte-parity matrix under field_backend vpu AND mxu.
+    exec python -m pytest tests/test_mxu_field.py \
+      "tests/test_prepare.py::test_device_prepare_matches_oracle" -q
+    ;;
   obs)
     # Observability gate (ISSUE 5): runs everywhere — the pure-Python
     # metrics fallback keeps the metric assertions meaningful even where
@@ -152,7 +166,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mesh|chaos|obs|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|chaos|obs|dryrun]" >&2
     exit 2
     ;;
 esac

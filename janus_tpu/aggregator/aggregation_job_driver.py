@@ -83,6 +83,11 @@ class DriverConfig:
     retry_initial_delay_s: float = 1.0
     retry_max_delay_s: float = 300.0
     vdaf_backend: str = "oracle"
+    #: Field-arithmetic layout for the device backends ("vpu" | "mxu" —
+    #: vdaf/backend.py FIELD_BACKENDS); None = process default
+    #: (JANUS_TPU_FIELD_BACKEND or "vpu").  The A/B seam for the MXU
+    #: limb-plane contraction layer; the oracle ignores it.
+    field_backend: Optional[str] = None
     http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
     #: Gather window for coalescing same-shape jobs from DIFFERENT tasks
     #: into one device launch (BASELINE configs[4]); 0 disables.  Only
@@ -276,9 +281,11 @@ class AggregationJobDriver:
                             vdaf_type=vdaf_type, reason=reason[:80]
                         ).inc()
                     backend_name = "oracle"  # don't even attempt the device
+            field_backend = self.config.field_backend
+
             def factory():
                 try:
-                    return make_backend(vdaf, backend_name)
+                    return make_backend(vdaf, backend_name, field_backend=field_backend)
                 except (VdafError, NotImplementedError):
                     return make_backend(vdaf, "oracle")
 

@@ -112,6 +112,9 @@ class Config:
     #: VDAF execution backend: "oracle", "tpu" (batched device launch), or
     #: "mesh" (SPMD over a device mesh).
     vdaf_backend: str = "oracle"
+    #: Device field-arithmetic layout ("vpu" | "mxu"); None = process
+    #: default (JANUS_TPU_FIELD_BACKEND or "vpu").
+    field_backend: Optional[str] = None
     collection_job_retry_after: int = 10
     #: Process-wide device executor (executor.ExecutorConfig): when set and
     #: enabled, the HELPER's Prio3 prep_init/combine launches submit
@@ -125,17 +128,25 @@ class TaskAggregator:
     """A task with its VDAF instance + backend resolved once
     (reference: aggregator.rs:868-1137)."""
 
-    def __init__(self, task: AggregatorTask, backend_name: str):
+    def __init__(
+        self,
+        task: AggregatorTask,
+        backend_name: str,
+        field_backend: Optional[str] = None,
+    ):
         self.task = task
         self.vdaf = task.vdaf_instance()
         self.backend_name = backend_name
+        self.field_backend = field_backend
         self._backend = None
 
     @property
     def backend(self):
         if self._backend is None:
             try:
-                self._backend = make_backend(self.vdaf, self.backend_name)
+                self._backend = make_backend(
+                    self.vdaf, self.backend_name, field_backend=self.field_backend
+                )
             except VdafError:
                 # e.g. HMAC-XOF instances have no device path yet
                 self._backend = make_backend(self.vdaf, "oracle")
@@ -210,7 +221,7 @@ class Aggregator:
         )
         if task is None:
             raise UnrecognizedTask(str(task_id))
-        ta = TaskAggregator(task, self.config.vdaf_backend)
+        ta = TaskAggregator(task, self.config.vdaf_backend, self.config.field_backend)
         self._task_cache[key] = (_t.monotonic() + self.config.task_cache_ttl, ta)
         return ta
 

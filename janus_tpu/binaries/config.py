@@ -266,6 +266,12 @@ class AggregatorConfig:
     task_counter_shard_count: int = 8
     #: "tpu" routes whole-job prepare through one batched device launch.
     vdaf_backend: str = "tpu"
+    #: Field-arithmetic layout for the device backends: "vpu" (scalar-lane
+    #: CIOS chains + limb-planar Pallas kernels, the default) or "mxu"
+    #: (limb-plane dot_general contractions so the FLP wire/gadget math
+    #: runs on the matrix units).  Bit-exact either way — the A/B toggle
+    #: for ops/field_jax.py's MXU contraction layer.
+    field_backend: str = "vpu"
     #: Helper-side executor routing (default off): the helper's Prio3
     #: prep_init/combine submit through the process-wide device executor,
     #: sharing its continuous batching + circuit breaker with the drivers.
@@ -294,6 +300,9 @@ class JobDriverBinaryConfig:
     job_driver: JobDriverConfig = field(default_factory=JobDriverConfig)
     batch_aggregation_shard_count: int = 8
     vdaf_backend: str = "tpu"
+    #: Device field-arithmetic layout ("vpu" | "mxu") — see
+    #: AggregatorConfig.field_backend.
+    field_backend: str = "vpu"
     #: Continuous cross-job batching for device prepare (default off).
     device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
 

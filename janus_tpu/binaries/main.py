@@ -253,6 +253,7 @@ def run_aggregator(config_path: Optional[str]) -> None:
             batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
             task_counter_shard_count=cfg.task_counter_shard_count,
             vdaf_backend=cfg.vdaf_backend,
+            field_backend=cfg.field_backend,
             device_executor=cfg.device_executor.to_executor_config()
             if cfg.device_executor.enabled
             else None,
@@ -412,6 +413,7 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 retry_initial_delay_s=cfg.job_driver.retry_initial_delay_s,
                 retry_max_delay_s=cfg.job_driver.retry_max_delay_s,
                 vdaf_backend=cfg.vdaf_backend,
+                field_backend=cfg.field_backend,
                 device_executor=exec_cfg,
             ),
         )

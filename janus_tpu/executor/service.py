@@ -425,7 +425,10 @@ class DeviceExecutor:
         from ..vdaf.backend import MeshBackend, TpuBackend
 
         if type(backend) is TpuBackend:
-            return MeshBackend(backend.vdaf)
+            # Preserve the field-arithmetic layout across the upgrade: the
+            # mesh backend runs the same per-shard graphs, so an mxu-
+            # configured producer must stay mxu after meshification.
+            return MeshBackend(backend.vdaf, field_backend=backend.field_backend)
         return backend
 
     # -- thread pools ----------------------------------------------------

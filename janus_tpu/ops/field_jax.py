@@ -26,6 +26,16 @@ from jax import lax
 
 _U32 = jnp.uint32
 _MASK16 = np.uint32(0xFFFF)
+_MASK8 = np.uint32(0xFF)
+
+#: Max contraction length for one ``mat_mul_mont`` dot pass.  The layer
+#: contracts base-2^8 digit planes, so every per-digit-pair partial sum
+#: P[d,e] = sum_k a_d[k] * b_e[k] is bounded by K * 255^2 and must stay
+#: exact in the u32 dot accumulator: K <= floor((2^32-1)/255^2) = 66051.
+#: 65536 keeps a round power of two and matches the u16-half lazy-sum cap
+#: (JField.sum / planar aggregate) used across the prepare pipeline.
+#: Longer contractions split into exact modular-added chunks.
+DOT_MAX_K = 65536
 
 
 def _eager_jit(static_argnums=(0,)):
@@ -329,8 +339,7 @@ class JField:
         bits = 32 * self.n
         return jnp.asarray(self._int_to_limbs_np((1 << bits) % self.p))
 
-    @_eager_jit(static_argnums=(0,))
-    def inv_mont(self, a):
+    def _fermat_inv_mont(self, a):
         """Fermat inversion in Montgomery domain: a^(p-2).  inv(0) = 0.
 
         Two single-multiply scans instead of one square-and-multiply scan:
@@ -358,6 +367,38 @@ class JField:
 
         acc, _ = lax.scan(mulsel, one, (squares, bits))
         return _scan_fence(acc)
+
+    @_eager_jit(static_argnums=(0,))
+    def inv_mont(self, a):
+        """Inversion in Montgomery domain; inv(0) = 0.
+
+        A single element runs the Fermat square-and-multiply chain
+        (``_fermat_inv_mont``).  Any BATCHED input runs Montgomery batch
+        inversion instead: the whole batch collapses through one prefix
+        product, ONE Fermat chain inverts the single total, and two
+        prefix/suffix passes fan the inverse back out — so the
+        127-iteration sequential scan (the thing ``_scan_fence`` exists to
+        protect on XLA:CPU) runs over ONE field element instead of the
+        full tensor, and the deepest sequential chain a vector call site
+        pays drops from 2*127 tensor-wide multiplies to one scalar chain
+        plus log-depth prefix scans.  Zero entries are substituted with 1
+        before the product (a zero would annihilate it) and masked back to
+        0 after, preserving inv(0) = 0 exactly.  The inverse of a nonzero
+        element is unique and canonical limbs are unique, so the result is
+        limb-identical to the per-element Fermat chain.
+        """
+        batch_elems = 1
+        for d in a.shape[:-1]:
+            batch_elems *= d
+        if batch_elems <= 1:
+            return self._fermat_inv_mont(a)
+        flat = a.reshape((-1, self.n))
+        z = jnp.all(flat == 0, axis=-1)
+        one = jnp.broadcast_to(self.mont_one(), flat.shape)
+        safe = jnp.where(z[:, None], one, flat)
+        inv = self._batch_inv_nonzero(safe, 0)
+        inv = jnp.where(z[:, None], jnp.zeros_like(inv), inv)
+        return inv.reshape(a.shape)
 
     @_eager_jit(static_argnums=(0,))
     def eq(self, a, b):
@@ -603,21 +644,194 @@ class JField:
             m *= 2
         return x
 
-    @_eager_jit(static_argnums=(0, 2))
-    def batch_inv_mont(self, a, axis: int):
-        """Montgomery-trick batched inversion along an axis (all nonzero).
-
-        inv(a_k) = inv(prod_j a_j) * prod_{j != k} a_j — one Fermat
-        inversion plus the exclusive mutual products.
-        """
-        axis = axis % (a.ndim - 1)
+    def _batch_inv_nonzero(self, a, axis: int):
+        """Montgomery-trick core: inv(a_k) = inv(prod_j a_j) * prod_{j != k}
+        a_j — one Fermat inversion of the single total plus the exclusive
+        mutual products.  All entries along the axis must be nonzero."""
         total = jnp.squeeze(
             lax.slice_in_dim(
                 self.cumprod_mont(a, axis), a.shape[axis] - 1, a.shape[axis], axis=axis
             ),
             axis=axis,
         )
-        inv_total = self.inv_mont(total)
+        inv_total = self._fermat_inv_mont(total)
         others = self.mutual_products_mont(a, axis)
         inv_b = jnp.expand_dims(inv_total, axis=axis)
         return _scan_fence(self.mont_mul(others, jnp.broadcast_to(inv_b, a.shape)))
+
+    @_eager_jit(static_argnums=(0, 2))
+    def batch_inv_mont(self, a, axis: int):
+        """Montgomery-trick batched inversion along an axis (all nonzero)."""
+        return self._batch_inv_nonzero(a, axis % (a.ndim - 1))
+
+    # -- MXU contraction layer (limb-plane dot_general) -----------------
+    def _digits8(self, x):
+        """(..., n) u32 limbs -> (..., 4n) u32 base-2^8 digit planes.
+
+        Little-endian, limb-major: digit d of an element has weight
+        2^(8d).  Digits are held in u32 (not u8) so the contraction's
+        dot_general accumulates in u32 — on TPU, XLA decomposes the
+        integer matmul into MXU-native narrow passes; on CPU it stays one
+        exact integer ``dot``.
+        """
+        parts = jnp.stack([(x >> (8 * i)) & _MASK8 for i in range(4)], axis=-1)
+        return parts.reshape(x.shape[:-1] + (4 * self.n,))
+
+    @_eager_jit(static_argnums=(0,))
+    def mat_mul_mont(self, a, b):
+        """Modular matmul with ONE Montgomery reduction per output element.
+
+        a (*B, K, M, n) x b (*B, K, N, n) -> (*B, M, N, n) with
+        out[m, v] = sum_k a[k, m] * b[k, v] * R^-1 mod p — exactly
+        sum_k mont_mul(a_k, b_k), so it composes with the prepare
+        pipeline's domain convention (one canonical operand times one
+        Montgomery operand yields a canonical result) the same way a
+        mont_mul/sum chain does.  ``b`` may omit the batch dims
+        ((K, N, n)): a host-constant matrix (e.g. the gadget Vandermonde
+        table) shared by every batch element.
+
+        The contraction runs on base-2^8 digit planes as a single batched
+        ``lax.dot_general`` with u32 accumulation (the MXU path named by
+        the multi-precision-systolic-NTT recipe in PAPERS.md): all 4n x 4n
+        cross-digit partial products for a whole output tile come out of
+        one integer matmul, and carry propagation + modular reduction are
+        DEFERRED to a single pass per output tile (``_lazy_reduce_digits``).
+        Contractions longer than DOT_MAX_K split into exact modular-added
+        chunks.  Every step is exact integer arithmetic, so outputs are
+        limb-identical to the mont_mul/sum form (tests/test_mxu_field.py
+        fuzzes random and adversarial operands against the oracle field).
+        """
+        K = a.shape[-3]
+        if K <= DOT_MAX_K:
+            return self._mat_mul_dot(a, b)
+        out = None
+        for s in range(0, K, DOT_MAX_K):
+            part = self._mat_mul_dot(
+                a[..., s : s + DOT_MAX_K, :, :], b[..., s : s + DOT_MAX_K, :, :]
+            )
+            out = part if out is None else self.add(out, part)
+        return out
+
+    def _mat_mul_dot(self, a, b):
+        """Single-chunk core of mat_mul_mont (K <= DOT_MAX_K)."""
+        n = self.n
+        D = 4 * n
+        K, M = a.shape[-3], a.shape[-2]
+        N = b.shape[-2]
+        batch = a.shape[:-3]
+        nb = len(batch)
+        shared_rhs = b.ndim == 3 and nb > 0
+        lhs = jnp.moveaxis(self._digits8(a), -3, -1).reshape(batch + (M * D, K))
+        if shared_rhs:
+            rhs = self._digits8(b).reshape(K, N * D)
+            dn = (((nb + 1,), (0,)), ((), ()))
+        else:
+            rhs = self._digits8(b).reshape(batch + (K, N * D))
+            dn = (((nb + 1,), (nb,)), (tuple(range(nb)), tuple(range(nb))))
+        prod = lax.dot_general(lhs, rhs, dn, preferred_element_type=_U32)
+        return self._lazy_reduce_digits(
+            prod.reshape(batch + (M, D, N, D)), batch + (M, N)
+        )
+
+    def _lazy_reduce_digits(self, P, out_shape):
+        """(..., M, D, N, D) digit-pair partial sums -> canonical (..., M, N, n).
+
+        The deferred half of the MXU contraction — one pass per output
+        tile.  Lazy-carry bounds (all exact in u32):
+
+        * each partial sum P[d, e] <= K * 255^2 < 2^32 for K <= DOT_MAX_K;
+        * P splits into u16 halves before the diagonal fold, so a base-2^8
+          digit column S[g] accumulates at most 2D addends each < 2^16 —
+          S[g] < 2^21 regardless of K (the same trick as JField._sum_lazy);
+        * the sequential carry pass keeps carry < 2^14.
+
+        The normalized integer U < K * 2^(64n) <= 2^(64n+16) packs into
+        2n+1 u32 limbs U = U0 + R*U1 + R^2*U2 (R = 2^(32n)), and
+        U*R^-1 mod p folds with the existing primitives:
+        from_mont(U0) + canonicalize(U1) + mont_mul(U2, R^2).  Each piece
+        is the unique canonical residue of the same value mod p, so the
+        result is limb-identical to the multiply/add tree it replaces.
+        """
+        n = self.n
+        D = 4 * n
+        lo = P & _MASK16
+        hi = P >> 16
+        zero = jnp.zeros(out_shape, dtype=_U32)
+        # S[g]: base-2^8 digit column g — lo[d,e] lands at d+e, hi at d+e+2.
+        S = [zero] * (2 * D + 1)
+        for d in range(D):
+            for e in range(D):
+                f = d + e
+                S[f] = S[f] + lo[..., d, :, e]
+                S[f + 2] = S[f + 2] + hi[..., d, :, e]
+        L = 2 * n + 1
+        digits = []
+        carry = zero
+        for g in range(4 * L):
+            t = (S[g] if g < len(S) else zero) + carry
+            digits.append(t & _MASK8)
+            carry = t >> 8
+        # carry == 0 here: U < 2^(64n+16) and 4L digits span 2^(64n+32).
+        U = jnp.stack(
+            [
+                digits[4 * j]
+                | (digits[4 * j + 1] << 8)
+                | (digits[4 * j + 2] << 16)
+                | (digits[4 * j + 3] << 24)
+                for j in range(L)
+            ],
+            axis=-1,
+        )  # (..., M, N, L)
+        U0 = U[..., :n]
+        U1 = U[..., n : 2 * n]
+        U2 = jnp.concatenate(
+            [U[..., 2 * n :], jnp.zeros(U.shape[:-1] + (n - 1,), dtype=_U32)],
+            axis=-1,
+        )
+        r2 = jnp.asarray(self.r2_np)
+        res = self.add(self.from_mont(U0), self.add(U1, jnp.zeros_like(U1)))
+        return self.add(res, self.mont_mul(U2, jnp.broadcast_to(r2, U2.shape)))
+
+    @_eager_jit(static_argnums=(0,))
+    def dot_mont(self, a, b):
+        """Contraction form of mat_mul_mont: sum_k mont_mul(a_k, b_k).
+
+        a (*B, K, M, n) x b (*B, K, n) -> (*B, M, n): the wire-evaluation
+        shape (per-report Lagrange coefficients contracted against a
+        per-report wire tensor).  One batched dot_general under the hood.
+        """
+        return jnp.squeeze(self.mat_mul_mont(a, b[..., :, None, :]), axis=-2)
+
+    @_eager_jit(static_argnums=(0,))
+    def poly_eval_dot(self, coeffs, x):
+        """MXU twin of poly_eval_mont: baby-step/giant-step powers with
+        BOTH contractions (per-giant coefficient fold, giant fold) run as
+        mat_mul_mont dot_generals instead of mont_mul/sum trees.
+
+        coeffs (..., C, n) canonical low-order-first, x (..., n) Montgomery
+        -> (..., n) canonical.  Same residues stage for stage as
+        poly_eval_mont (exact integer math), so limbs are identical.
+        """
+        C = coeffs.shape[-2]
+        bs = max(1, math.isqrt(C))
+        gs = -(-C // bs)
+        pad = bs * gs - C
+        if pad:
+            coeffs = jnp.concatenate(
+                [coeffs, self.zeros(coeffs.shape[:-2] + (pad,))], axis=-2
+            )
+        one = jnp.broadcast_to(self.mont_one(), x.shape)
+        baby = [one]  # x^i * R for i in 0..bs-1
+        for _ in range(bs - 1):
+            baby.append(self.mont_mul(baby[-1], x))
+        xbs = self.mont_mul(baby[-1], x)  # x^bs * R
+        giant = [one]  # x^(bs*g) * R
+        for _ in range(gs - 1):
+            giant.append(self.mont_mul(giant[-1], xbs))
+        baby_t = jnp.stack(baby, axis=-2)  # (..., bs, n)
+        giant_t = jnp.stack(giant, axis=-2)  # (..., gs, n)
+        cg = coeffs.reshape(coeffs.shape[:-2] + (gs, bs, self.n))
+        inner = self.dot_mont(jnp.swapaxes(cg, -3, -2), baby_t)  # (..., gs, n)
+        return jnp.squeeze(
+            self.dot_mont(inner[..., :, None, :], giant_t), axis=-2
+        )

@@ -78,10 +78,17 @@ def bytes_to_limbs(jf: JField, data: jnp.ndarray, num_elems: int) -> jnp.ndarray
 
 
 class _DeviceCircuit:
-    """Device twin of one FLP validity circuit (all have exactly one gadget)."""
+    """Device twin of one FLP validity circuit (all have exactly one gadget).
 
-    def __init__(self, valid):
+    ``mxu=True`` routes the K-axis field contractions (wire Lagrange
+    evaluation, weighted truncates, joint-rand verifier folds) through the
+    limb-plane dot_general layer (JField.mat_mul_mont/dot_mont) instead of
+    mont_mul/sum trees — identical canonical limbs, MXU-shaped compute.
+    """
+
+    def __init__(self, valid, mxu: bool = False):
         self.valid = valid
+        self.mxu = mxu
         self.calls = valid.GADGET_CALLS[0]
         (g,) = valid.new_gadgets()
         self.arity = g.ARITY
@@ -103,6 +110,8 @@ class _DeviceCircuit:
         win)."""
         inp = self.inputs(jf, meas_m, jr_m, consts)  # (B, calls, arity, n)
         wires = jnp.concatenate([seeds[:, None], inp], axis=1)  # (B, K, arity, n)
+        if self.mxu:
+            return jf.dot_mont(wires, lag)
         return jf.sum(jf.mont_mul(wires, lag[:, :, None, :]), axis=1)
 
 
@@ -131,10 +140,16 @@ class _DSum(_DeviceCircuit):
         r = jr_m[:, 0]  # (B, n) Montgomery
         r_b = jnp.broadcast_to(r[:, None, :], gk.shape)
         r_pows = jf.cumprod_mont(r_b, axis=1)  # r^(k+1)*R at call k
+        if self.mxu:
+            # joint-rand verifier fold as a (1 x calls) x (calls x 1) dot
+            return jnp.squeeze(jf.dot_mont(gk[:, :, None, :], r_pows), axis=1)
         return jf.sum(jf.mont_mul(r_pows, gk), axis=1)  # canonical
 
     def truncate(self, jf, meas_m, consts):
         w = consts["pow2_m"]  # (bits, n) Montgomery constants 2^b*R
+        if self.mxu:
+            # bit-weight contraction against the shared constant vector
+            return jf.dot_mont(meas_m[:, :, None, :], w)
         return jf.sum(jf.mont_mul(meas_m, w[None]), axis=1)[:, None, :]
 
     def gadget_eval_scaled(self, jf, x):
@@ -146,8 +161,8 @@ class _DSum(_DeviceCircuit):
 class _DChunked(_DeviceCircuit):
     """Shared machinery for the ParallelSum(Mul, chunk) circuits."""
 
-    def __init__(self, valid):
-        super().__init__(valid)
+    def __init__(self, valid, mxu: bool = False):
+        super().__init__(valid, mxu)
         self.chunk = valid.chunk_length
         self.pad_len = self.calls * self.chunk - valid.MEAS_LEN
 
@@ -177,7 +192,10 @@ class _DChunked(_DeviceCircuit):
         (exact: mont_mul distributes over mod-p addition; canonical limbs are
         unique, so the rearranged form is byte-identical to the oracle's).
         """
-        s2 = jf.sum(jf.mont_mul(m, lagk[:, :, None, :]), axis=1)  # (B, chunk, n)
+        if self.mxu:
+            s2 = jf.dot_mont(m, lagk)  # (B, chunk, n) via one dot_general
+        else:
+            s2 = jf.sum(jf.mont_mul(m, lagk[:, :, None, :]), axis=1)  # (B, chunk, n)
         lag_sum = jf.sum(lagk, axis=1)  # (B, n) Montgomery
         c = jnp.broadcast_to(consts["shares_inv_c"], lag_sum.shape)
         ccorr = jf.mont_mul(c, lag_sum)  # (B, n) canonical
@@ -206,7 +224,10 @@ class _DSumVec(_DChunked):
         """Fused: evens[u] = sum_k lag_{k+1} * m[k,u] * jr_k^(u+1).
 
         jr differs per call, so lag folds into the per-(k,u) Montgomery
-        power table; no (B, calls, arity, n) tensor is ever written."""
+        power table; no (B, calls, arity, n) tensor is ever written.  (The
+        evens coefficient varies over BOTH contraction axes, so unlike the
+        histogram it is not a matmul — under mxu only the odds/seed halves
+        ride the dot layer, via _odds_and_seed.)"""
         B = meas_m.shape[0]
         m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
         lag0, lagk = lag[:, 0], lag[:, 1:]
@@ -228,6 +249,8 @@ class _DSumVec(_DChunked):
         B = meas_m.shape[0]
         w = consts["pow2_m"]  # (bits, n)
         m = meas_m.reshape(B, self.valid.length, self.valid.bits, jf.n)
+        if self.mxu:
+            return jf.dot_mont(jnp.swapaxes(m, 1, 2), w)  # (B, length, n)
         return jf.sum(jf.mont_mul(m, w[None, None]), axis=2)
 
 
@@ -259,9 +282,16 @@ class _DHistogram(_DChunked):
         B = meas_m.shape[0]
         m = self._pad(jf, meas_m).reshape(B, self.calls, self.chunk, jf.n)
         kl, lagk, lag0, ccorr, r_ch = self.planar_coeffs(jf, jr_m, lag, consts)
-        s1 = jf.sum(jf.mont_mul(m, kl[:, :, None, :]), axis=1)  # (B, chunk, n)
+        if self.mxu:
+            # Both k-contractions share the measurement operand, so the kl
+            # and lagk coefficient columns stack into ONE (B, calls, 2, n)
+            # rhs and a single dot_general produces s1 and s2 together.
+            s12 = jf.mat_mul_mont(m, jnp.stack([kl, lagk], axis=2))
+            s1, s2 = s12[:, :, 0], s12[:, :, 1]
+        else:
+            s1 = jf.sum(jf.mont_mul(m, kl[:, :, None, :]), axis=1)  # (B, chunk, n)
+            s2 = jf.sum(jf.mont_mul(m, lagk[:, :, None, :]), axis=1)
         evens = jf.mont_mul(s1, r_ch)
-        s2 = jf.sum(jf.mont_mul(m, lagk[:, :, None, :]), axis=1)
         odds = jf.sub(s2, ccorr[:, None, :])
         se = jf.mont_mul(seeds, lag0[:, None, :])  # (B, arity, n)
         return self._zip_wires(jf, evens, odds, se)
@@ -315,15 +345,15 @@ class _DHistogram(_DChunked):
         return meas_m
 
 
-def _device_circuit(valid) -> _DeviceCircuit:
+def _device_circuit(valid, mxu: bool = False) -> _DeviceCircuit:
     if isinstance(valid, Count):
-        return _DCount(valid)
+        return _DCount(valid, mxu)
     if isinstance(valid, Sum):
-        return _DSum(valid)
+        return _DSum(valid, mxu)
     if isinstance(valid, SumVec):
-        return _DSumVec(valid)
+        return _DSumVec(valid, mxu)
     if isinstance(valid, Histogram):
-        return _DHistogram(valid)
+        return _DHistogram(valid, mxu)
     raise NotImplementedError(f"no device circuit for {type(valid).__name__}")
 
 
@@ -335,17 +365,32 @@ class BatchedPrio3:
     byte-identical to the CPU oracle.
     """
 
-    def __init__(self, prio3: Prio3, ntt_min_p: int = 64, require_device_xof: bool = True):
+    def __init__(
+        self,
+        prio3: Prio3,
+        ntt_min_p: int = 64,
+        require_device_xof: bool = True,
+        field_backend: str = "vpu",
+    ):
         #: TurboSHAKE has device (Pallas) kernels; other XOFs (the HMAC
         #: multiproof variant) run on the HOST and feed query_batch — the
         #: hybrid split in vdaf/backend.py HybridXofBackend.
         self.device_xof = prio3.xof is XofTurboShake128
         if require_device_xof and not self.device_xof:
             raise NotImplementedError("device path requires XofTurboShake128")
+        if field_backend not in ("vpu", "mxu"):
+            raise ValueError(f"unknown field_backend {field_backend!r}")
+        #: "vpu" (default): scalar-lane CIOS mont_mul chains, limb-planar
+        #: Pallas fast paths.  "mxu": the K-axis field contractions (wire
+        #: Lagrange evaluation, gadget Vandermonde evaluation, weighted
+        #: truncates, joint-rand folds) run as limb-plane dot_generals
+        #: (JField.mat_mul_mont) on the row-major path — identical limbs,
+        #: matmul-shaped compute for the matrix units.
+        self.field_backend = field_backend
         self.prio3 = prio3
         self.flp = prio3.flp
         self.jf = JField(self.flp.field)
-        self.circ = _device_circuit(self.flp.valid)
+        self.circ = _device_circuit(self.flp.valid, mxu=field_backend == "mxu")
         jf, circ, field = self.jf, self.circ, self.flp.field
         p = field.MODULUS
 
@@ -477,8 +522,11 @@ class BatchedPrio3:
     def _gpoly_at(self, gpoly, t_m):
         """Gadget polynomial at t.  Wide polynomials (the 100k-element
         SumVec has glen=1023) use baby-step/giant-step evaluation —
-        Horner's glen-step serial chain is the launch's critical path."""
+        Horner's glen-step serial chain is the launch's critical path.
+        Under mxu both bsgs contractions run as dot_generals."""
         jf = self.jf
+        if self.field_backend == "mxu":
+            return jf.poly_eval_dot(gpoly, t_m)
         if gpoly.shape[1] >= 64:
             return jf.poly_eval_mont(gpoly, t_m)
         return jf.horner_mont(gpoly, t_m)
@@ -486,6 +534,15 @@ class BatchedPrio3:
     def _gadget_outputs(self, gpoly, B):
         """gk (B, calls, n): the gadget polynomial at alpha^1..alpha^calls."""
         jf, circ = self.jf, self.circ
+        if self.field_backend == "mxu":
+            # Vandermonde-style matmul: gk[b, k] = sum_j gpoly[b, j] * w^(kj)
+            # with the alpha-power table a host-precomputed Montgomery
+            # constant shared by every report — ONE dot_general across calls
+            # replaces the NTT butterfly stages / the Horner scan, and the
+            # canonical residues are identical (exact integer math).
+            amat = self._alpha_mat_m()  # (calls, glen, n) Montgomery, host
+            w = jnp.asarray(np.ascontiguousarray(amat.transpose(1, 0, 2)))
+            return jnp.squeeze(jf.mat_mul_mont(gpoly[:, :, None, :], w), axis=1)
         if self._ntt is not None:
             P = circ.P
             hi = gpoly[:, P:]
@@ -700,6 +757,12 @@ class BatchedPrio3:
         """True when the limb-planar Pallas fast path serves this prep."""
         from .keccak_pallas import pallas_enabled
 
+        if self.field_backend == "mxu":
+            # The MXU layer lives on the row-major path: its contractions
+            # want (batch x K) matrices feeding dot_general, not lane-planar
+            # tensors feeding the VPU Pallas kernels.  field_backend is the
+            # A/B seam between the two accelerated layouts.
+            return False
         if isinstance(self.circ, _DHistogram):
             # u16-half lazy meas_sum is exact only up to 65535 terms.
             circuit_ok = self.flp.MEAS_LEN <= 65535

@@ -104,6 +104,29 @@ class OracleBackend:
         return out
 
 
+#: Field-arithmetic layouts for the device backends (ops/prepare.py):
+#: "vpu" = scalar-lane CIOS multiply chains + limb-planar Pallas kernels;
+#: "mxu" = limb-plane dot_general contractions (JField.mat_mul_mont) so the
+#: FLP wire/gadget math runs on the matrix units.  Bit-exact either way —
+#: the CPU oracle stays the correctness fence for both.
+FIELD_BACKENDS = ("vpu", "mxu")
+
+
+def default_field_backend() -> str:
+    """Process default, overridable via JANUS_TPU_FIELD_BACKEND (the A/B
+    knob for bench runs that don't thread a config file)."""
+    import os
+
+    return os.environ.get("JANUS_TPU_FIELD_BACKEND", "vpu")
+
+
+def _resolve_field_backend(field_backend: Optional[str]) -> str:
+    fb = field_backend or default_field_backend()
+    if fb not in FIELD_BACKENDS:
+        raise VdafError(f"unknown field_backend {fb!r}")
+    return fb
+
+
 class TpuBackend:
     """Batched device prepare: one XLA launch per aggregation job."""
 
@@ -116,7 +139,7 @@ class TpuBackend:
     #: DEVICE so the accumulator store can account resident bytes honestly
     accum_buffer_rows = 1
 
-    def __init__(self, vdaf: Prio3):
+    def __init__(self, vdaf: Prio3, field_backend: Optional[str] = None):
         if vdaf.xof is not XofTurboShake128:
             raise VdafError("TPU backend requires the TurboSHAKE XOF")
         import jax
@@ -124,7 +147,10 @@ class TpuBackend:
         from ..ops.prepare import BatchedPrio3
 
         self.vdaf = vdaf
-        self.bp = BatchedPrio3(vdaf)
+        #: "vpu" | "mxu" — see FIELD_BACKENDS; carried so the executor's
+        #: mesh upgrade (_meshify) preserves the layout choice.
+        self.field_backend = _resolve_field_backend(field_backend)
+        self.bp = BatchedPrio3(vdaf, field_backend=self.field_backend)
         self.oracle = OracleBackend(vdaf)
         self._jax = jax
         self._prep_fns: Dict[int, object] = {}
@@ -581,8 +607,8 @@ class MeshBackend(TpuBackend):
 
     name = "mesh"
 
-    def __init__(self, vdaf: Prio3, devices=None):
-        super().__init__(vdaf)
+    def __init__(self, vdaf: Prio3, devices=None, field_backend: Optional[str] = None):
+        super().__init__(vdaf, field_backend=field_backend)
         import os
 
         import jax
@@ -785,13 +811,16 @@ class HybridXofBackend:
 
     name = "tpu-hybrid"
 
-    def __init__(self, vdaf: Prio3):
+    def __init__(self, vdaf: Prio3, field_backend: Optional[str] = None):
         import jax
 
         from ..ops.prepare import BatchedPrio3
 
         self.vdaf = vdaf
-        self.bp = BatchedPrio3(vdaf, require_device_xof=False)
+        self.field_backend = _resolve_field_backend(field_backend)
+        self.bp = BatchedPrio3(
+            vdaf, require_device_xof=False, field_backend=self.field_backend
+        )
         self.oracle = OracleBackend(vdaf)
         self._jax = jax
         self._query_fn = None
@@ -1018,8 +1047,13 @@ def device_supported(vdaf) -> Tuple[bool, str]:
     return True, ""
 
 
-def make_backend(vdaf, backend: str = "oracle"):
-    """Backend factory — the dispatch gate named in the north star."""
+def make_backend(vdaf, backend: str = "oracle", field_backend: Optional[str] = None):
+    """Backend factory — the dispatch gate named in the north star.
+
+    ``field_backend`` ("vpu" | "mxu", None = JANUS_TPU_FIELD_BACKEND or
+    "vpu") selects the device backends' field-arithmetic layout; the
+    oracle and Poplar1 paths have no device field layer and ignore it.
+    """
     try:
         cls = BACKENDS[backend]
     except KeyError:
@@ -1034,5 +1068,7 @@ def make_backend(vdaf, backend: str = "oracle"):
         and vdaf.xof is not XofTurboShake128
     ):
         # Host-XOF VDAFs (HMAC multiproof): device FLP, host XOF.
-        return HybridXofBackend(vdaf)
-    return cls(vdaf)
+        return HybridXofBackend(vdaf, field_backend=field_backend)
+    if cls is OracleBackend:
+        return cls(vdaf)
+    return cls(vdaf, field_backend=field_backend)

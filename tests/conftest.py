@@ -46,6 +46,7 @@ DEVICE_TIER_MODULES = {
     "test_ops_field",
     "test_ops_keccak",
     "test_mesh",
+    "test_mxu_field",
     "test_integration_pair",
     "test_backend",
     "test_poplar1_batch",

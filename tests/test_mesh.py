@@ -34,11 +34,11 @@ def _mesh_devices():
     return devs[:8]
 
 
-def _assert_prep_parity(vdaf, measurements):
+def _assert_prep_parity(vdaf, measurements, field_backend="vpu"):
     rng = det_rng("mesh-" + vdaf.__class__.__name__ + str(len(measurements)))
     verify_key = rng(vdaf.VERIFY_KEY_SIZE)
     reports = _shard(vdaf, measurements, rng)
-    mesh = MeshBackend(vdaf, devices=_mesh_devices())
+    mesh = MeshBackend(vdaf, devices=_mesh_devices(), field_backend=field_backend)
     oracle = OracleBackend(vdaf)
     S = vdaf.num_shares
     per_agg = []
@@ -66,6 +66,26 @@ def test_mesh_prep_histogram_joint_rand_matches_oracle():
     """Field128 + joint-rand job SPMD over an 8-device mesh, byte parity."""
     vdaf = prio3_histogram(length=2, chunk_length=1)
     _assert_prep_parity(vdaf, [0, 1, 1, 0, 1, 0, 0, 1])
+
+
+def test_mesh_prep_histogram_mxu_matches_oracle():
+    """ISSUE 7 acceptance: mxu parity holds THROUGH the mesh path — the
+    SPMD prepare launch (per-shard limb-plane dot_generals) and the
+    sharded aggregate drain both stay byte-identical to the oracle."""
+    vdaf = prio3_histogram(length=2, chunk_length=1)
+    mesh, per_agg = _assert_prep_parity(
+        vdaf, [0, 1, 1, 0, 1, 0, 0, 1], field_backend="mxu"
+    )
+    assert mesh.field_backend == "mxu" and mesh.bp.field_backend == "mxu"
+    # sharded drain: the one cross-shard modular reduction over mxu-derived
+    # out-shares equals the oracle aggregate
+    jf = mesh.bp.jf
+    out_shares = [st.out_share for st, _ in per_agg[0]]
+    limbs = jf.to_limbs([x for sh in out_shares for x in sh]).reshape(
+        len(out_shares), -1, jf.n
+    )
+    mask = np.ones(len(out_shares), dtype=bool)
+    assert mesh.aggregate_batch(limbs, mask) == vdaf.aggregate(out_shares)
 
 
 def test_mesh_prep_uneven_batch():

@@ -79,6 +79,36 @@ CASES = [
         id="hist3sh",
         marks=pytest.mark.slow,
     ),
+    # field_backend="mxu" twins (ISSUE 7): the same byte-parity sweep with
+    # the limb-plane dot_general contraction layer carrying the wire/gadget
+    # contractions.  A "-mxu" suffix routes the BatchedPrio3 below.  The
+    # always-on trio covers Field64 (count), Field128 + joint-rand + chunked
+    # gadget (histtiny), and the Vandermonde gadget matmul that replaces the
+    # NTT branch (sumvec1b); Sum's bit-weight truncate + scalar query fold
+    # ride the slow tier with their vpu siblings.
+    pytest.param("count-mxu", prio3_count(), [0, 1, 1, 0], id="count-mxu"),
+    pytest.param(
+        "histtiny-mxu",
+        prio3_histogram(length=2, chunk_length=1),
+        [0, 1, 1, 0],
+        id="histtiny-mxu",
+    ),
+    pytest.param(
+        "sumvec1b-mxu",
+        prio3_sum_vec(length=7, bits=1, chunk_length=4),
+        [[1, 0, 1, 1, 0, 0, 1], [0] * 7, [1] * 7, [0, 1, 0, 0, 1, 1, 0]],
+        id="sumvec1b-mxu",
+    ),
+    pytest.param(
+        "sum8-mxu", prio3_sum(8), [0, 1, 77, 255], id="sum8-mxu", marks=pytest.mark.slow
+    ),
+    pytest.param(
+        "hist3sh-mxu",
+        prio3_histogram(length=5, chunk_length=2, num_shares=3),
+        [0, 4, 2, 1],
+        id="hist3sh-mxu",
+        marks=pytest.mark.slow,
+    ),
 ]
 
 
@@ -119,7 +149,11 @@ def test_device_prepare_matches_oracle(name, vdaf, measurements):
     B = len(measurements)
     verify_key = rng(vdaf.VERIFY_KEY_SIZE)
     reports = shard_batch(vdaf, measurements, rng)
-    bp = BatchedPrio3(vdaf, ntt_min_p=2 if name in _NTT_CASES else 64)
+    bp = BatchedPrio3(
+        vdaf,
+        ntt_min_p=2 if name in _NTT_CASES else 64,
+        field_backend="mxu" if name.endswith("-mxu") else "vpu",
+    )
     jf = bp.jf
     flp = vdaf.flp
     S = vdaf.num_shares
