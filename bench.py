@@ -392,6 +392,226 @@ def run_accumulator_config(args, scaled: bool) -> dict:
     }
 
 
+def run_coldtask_config(args, scaled: bool) -> dict:
+    """The ``coldtask`` row (ISSUE 8): a COLD task joins a busy 16-task
+    fleet.  Phase A runs the shape-churn machinery — pow2 canonical shape
+    keys + registry-driven background warmup — so the cold task either
+    lands in an already-warm bucket (shared executable, zero compile) or
+    drains through the CPU oracle while its bucket compiles OFF the
+    submit path; phase B (the before) gives the same cold task an
+    exact-shape backend with no warmup, so its first flush pays the XLA
+    compile inline.  Recorded: p99 first-flush latency across repeated
+    cold joins (A), the compile-inline first flush (B), whether the
+    compile overlapped service, and the warmup ledger's compile seconds.
+    On TPU platforms with ``common.compile_cache_dir`` set, re-running
+    this row replays the cache and B's compile collapses too — the
+    cache-hit compile seconds are whatever the ledger then reports."""
+    import asyncio
+
+    import numpy as np
+
+    from janus_tpu.executor import DeviceExecutor, ExecutorConfig
+    from janus_tpu.vdaf.backend import make_backend
+    from janus_tpu.vdaf.canonical import executor_shape
+    from janus_tpu.vdaf.instances import prio3_histogram
+
+    # 16 tasks, 8 per canonical bucket; per-submitter batches are sized
+    # so each bucket's busy flush hits the warmed mega-batch pad exactly.
+    n_tasks, per = 16, 16
+    mega = (n_tasks // 2) * per  # 128-row mega-batches (the warmed shape)
+    if scaled:
+        # chunk 3: fleet length 7 is a NON-ceiling bucket member (twin
+        # len 9, TAGGED canonical key) and length 9 the bucket ceiling
+        # (exact key, planar-capable maskless graphs) — two warm
+        # backends; the UNSEEN cold length 8 lands in the warm canonical
+        # bucket.  Small shapes keep the XLA:CPU compiles in tens of
+        # seconds.
+        chunk, fleet_lengths, cold_length, new_bucket_length = (
+            3,
+            [7, 9],
+            8,
+            13,  # calls 5 -> bucket ceiling 7 (a genuinely cold bucket)
+        )
+        desc = "cold task joins 16-task fleet (Histogram chunk=3, scaled)"
+    else:
+        # chunk 316: non-ceiling length 1000 (twin len 1264) + the
+        # ceiling itself; the unseen cold 1100 shares the warm twin.
+        chunk, fleet_lengths, cold_length, new_bucket_length = (
+            316,
+            [1000, 1264],
+            1100,
+            1400,  # calls 5 -> bucket ceiling 7
+        )
+        desc = "cold task joins 16-task fleet (Histogram chunk=316)"
+
+    def build(vdaf_length, canonical_on):
+        vdaf = prio3_histogram(vdaf_length, chunk)
+        key, canon = executor_shape(vdaf, enabled=canonical_on)
+        if canon is not None:
+            return vdaf, key, lambda: make_backend(canon, "tpu", canonical=True)
+        return vdaf, key, lambda: make_backend(vdaf, "tpu")
+
+    def shard_rows(vdaf, seed, rows=None):
+        rng = np.random.default_rng(seed)
+        nonce = rng.integers(0, 256, vdaf.NONCE_SIZE, dtype=np.uint8).tobytes()
+        rand = rng.integers(0, 256, vdaf.RAND_SIZE, dtype=np.uint8).tobytes()
+        public, shares = vdaf.shard(0, nonce, rand)
+        return [(nonce, public, shares[1])] * (rows or per)
+
+    async def first_flush(ex, key, backend, vdaf, rows, vk):
+        """One cold task's first submission, routed the way the driver
+        routes it: oracle-drain while the shape warms, device otherwise.
+        Returns (latency_s, served_on_oracle)."""
+        t0 = time.monotonic()
+        if ex.warming(key):
+            out = backend.oracle_for(vdaf).prep_init_batch(vk, 1, rows)
+            assert len(out) == len(rows)
+            return time.monotonic() - t0, True
+        payload = (
+            (vk, rows, vdaf) if getattr(backend, "canonical", False) else (vk, rows)
+        )
+        out = await ex.submit(key, "prep_init", payload, backend=backend, agg_id=1)
+        assert len(out) == len(rows)
+        return time.monotonic() - t0, False
+
+    # ---- phase A: warmup + canonicalization ON -------------------------
+    ex = DeviceExecutor(
+        ExecutorConfig(
+            enabled=True,
+            flush_max_rows=mega,
+            flush_window_s=0.005,
+            warmup_rows=mega,
+            warmup_async=True,
+            canonical_shapes=True,
+            submit_timeout_s=600.0,
+        )
+    )
+    rng = np.random.default_rng(11)
+    fleet = []
+    for t in range(n_tasks):
+        vdaf, key, factory = build(fleet_lengths[t % len(fleet_lengths)], True)
+        backend = ex.backend_for(key, factory)
+        vk = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        fleet.append((vdaf, key, backend, vk, shard_rows(vdaf, 100 + t)))
+    # registry warmup: wait for the fleet's (one) bucket to finish
+    # compiling in the background, then run busy traffic through it
+    for _, key, *_ in fleet:
+        ex.wait_warm(key, timeout=3600)
+
+    async def busy_pass():
+        await asyncio.gather(
+            *[
+                first_flush(ex, key, backend, vdaf, rows, vk)
+                for vdaf, key, backend, vk, rows in fleet
+            ]
+        )
+        await ex.drain()
+
+    asyncio.run(busy_pass())
+
+    # Repeated cold joins into the busy fleet's bucket: each join is the
+    # cold task's FIRST MEGA-BATCH (flush_max_rows rows — the shape
+    # warmup precompiled), exactly what a driver flushes for a busy new
+    # task.  p99 across the joins is the headline.
+    cold_lat, cold_oracle = [], 0
+    vdaf, key, factory = build(cold_length, True)
+    for trial in range(12):
+        backend = ex.backend_for(key, factory)
+        vk = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        rows = shard_rows(vdaf, 500 + trial, rows=mega)
+
+        async def one():
+            lat, on_oracle = await first_flush(ex, key, backend, vdaf, rows, vk)
+            await ex.drain()
+            return lat, on_oracle
+
+        lat, on_oracle = asyncio.run(one())
+        cold_lat.append(lat)
+        cold_oracle += int(on_oracle)
+    fleet_same_bucket = next(
+        (b for v, k, b, _vk, _r in fleet if k == key), None
+    )
+    shared_bucket = fleet_same_bucket is ex.backend_for(key, factory)
+
+    # a genuinely new bucket: background warmup + oracle-drain until warm
+    vdaf_nb, key_nb, factory_nb = build(new_bucket_length, True)
+    backend_nb = ex.backend_for(key_nb, factory_nb)
+    vk_nb = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    rows_nb = shard_rows(vdaf_nb, 999, rows=mega)
+
+    async def new_bucket_join():
+        lat, on_oracle = await first_flush(
+            ex, key_nb, backend_nb, vdaf_nb, rows_nb, vk_nb
+        )
+        return lat, on_oracle
+
+    nb_lat, nb_oracle = asyncio.run(new_bucket_join())
+    warmed = ex.wait_warm(key_nb, timeout=3600)
+
+    async def warm_flush():
+        lat, on_oracle = await first_flush(
+            ex, key_nb, backend_nb, vdaf_nb, rows_nb, vk_nb
+        )
+        await ex.drain()
+        assert not on_oracle
+        return lat
+
+    nb_warm_lat = asyncio.run(warm_flush()) if warmed else None
+    compile_ledger = {
+        k: v
+        for k, v in ex.compile_stats().items()
+        if v["compile_s"] is not None
+    }
+    ex.shutdown()
+
+    # ---- phase B: before (exact shapes, no warmup) ---------------------
+    ex_b = DeviceExecutor(
+        ExecutorConfig(
+            enabled=True,
+            flush_max_rows=mega,
+            flush_window_s=0.005,
+            warmup_rows=0,
+            canonical_shapes=False,
+            submit_timeout_s=3600.0,
+        )
+    )
+    vdaf_b, key_b, factory_b = build(cold_length, False)  # exact, unwarmed
+    backend_b = ex_b.backend_for(key_b, factory_b)
+    vk_b = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    rows_b = shard_rows(vdaf_b, 1234, rows=mega)
+
+    async def before_join():
+        lat, _ = await first_flush(ex_b, key_b, backend_b, vdaf_b, rows_b, vk_b)
+        await ex_b.drain()
+        return lat
+
+    before_lat = asyncio.run(before_join())
+    ex_b.shutdown()
+
+    cold_sorted = sorted(cold_lat)
+    p99 = cold_sorted[min(len(cold_sorted) - 1, int(len(cold_sorted) * 0.99))]
+    return {
+        "config": desc,
+        "value": round(p99 * 1000.0, 2),
+        "unit": "ms p99 cold-task first flush (warm+canonical)",
+        "cold_trials": len(cold_lat),
+        "cold_first_flush_p50_ms": round(cold_sorted[len(cold_sorted) // 2] * 1e3, 2),
+        "cold_served_on_oracle": cold_oracle,
+        "cold_bucket_shared_with_fleet": bool(shared_bucket),
+        "new_bucket_first_flush_ms": round(nb_lat * 1e3, 2),
+        "new_bucket_served_on_oracle": bool(nb_oracle),
+        "new_bucket_warm_flush_ms": (
+            round(nb_warm_lat * 1e3, 2) if nb_warm_lat is not None else None
+        ),
+        "compile_overlapped_service": bool(nb_oracle or warmed),
+        "before_exact_cold_first_flush_ms": round(before_lat * 1e3, 2),
+        "compile_ledger": compile_ledger,
+        "speedup_first_flush": (
+            round(before_lat / p99, 1) if p99 > 0 else None
+        ),
+    }
+
+
 def run_mesh_config(args, scaled: bool) -> dict:
     """The ``mesh8`` row (ISSUE 6): the north-star histogram1024 prepare
     SPMD over every local device via MeshBackend — the production
@@ -792,11 +1012,13 @@ def main() -> int:
     parser.add_argument(
         "--config",
         default="all",
-        choices=["all"] + list(CONFIGS) + ["executor16", "accum16", "mesh8"],
+        choices=["all"] + list(CONFIGS) + ["executor16", "accum16", "mesh8", "coldtask"],
         help="one config, or 'all' for every BASELINE.md row (default); "
         "executor16 is the device-executor concurrent-task row, accum16 "
         "the same shape with the device-resident accumulator store, "
-        "mesh8 the SPMD multi-chip prepare over every local device",
+        "mesh8 the SPMD multi-chip prepare over every local device, "
+        "coldtask the shape-churn row (cold task joins a busy fleet: "
+        "canonical buckets + background warmup vs exact-shape compile)",
     )
     parser.add_argument(
         "--side",
@@ -863,7 +1085,10 @@ def main() -> int:
     run_executor_row = args.config in ("all", "executor16")
     run_accum_row = args.config in ("all", "accum16")
     run_mesh_row = args.config in ("all", "mesh8")
-    names = [n for n in names if n not in ("executor16", "accum16", "mesh8")]
+    run_coldtask_row = args.config in ("all", "coldtask")
+    names = [
+        n for n in names if n not in ("executor16", "accum16", "mesh8", "coldtask")
+    ]
     # Leader-side rows for the configs whose explicit-share inputs fit the
     # tunnel comfortably; sumvec100k's leader would ship ~1.6 GB of host
     # limbs per staged input, and multitask16's leader is histogram1024's.
@@ -916,6 +1141,14 @@ def main() -> int:
             results["mesh8"] = run_mesh_config(args, scaled=scaled)
         except Exception as e:
             _record_row_failure(results, "mesh8", e)
+    if run_coldtask_row:
+        # Shape-churn survival (ISSUE 8): a cold task joining a busy
+        # fleet — p99 first-flush under canonical buckets + background
+        # warmup vs the exact-shape compile-inline before.
+        try:
+            results["coldtask"] = run_coldtask_config(args, scaled=scaled)
+        except Exception as e:
+            _record_row_failure(results, "coldtask", e)
 
     # Headline: the north-star config when measured, else the first row
     # that produced a number (a skipped/errored headline must not zero out
@@ -959,7 +1192,7 @@ def main() -> int:
             {
                 "metric": f"prepare_throughput_{headline}",
                 "value": round(reports_per_sec, 1),
-                "unit": "reports/s",
+                "unit": head.get("unit", "reports/s"),
                 "vs_baseline": round(reports_per_sec / 1_000_000, 4),
                 "config": head.get("config"),
                 "batch": head.get("batch"),

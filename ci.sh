@@ -40,6 +40,12 @@
 #                      (tests/test_crash_chaos.py, slow-marked so tier-1
 #                      timing is unaffected) — real replica binaries killed
 #                      mid-step, lease reaper + journal replay verified.
+#   ./ci.sh coldstart  shape-churn gate (ISSUE 8): pow2 canonicalization
+#                      oracle-parity sweep (tests/test_shape_canonical.py,
+#                      incl. the RUN_SLOW matrix: all circuit families x
+#                      both agg sides x both field layouts) + the
+#                      background-warmup / compile-cache suite
+#                      (tests/test_warmup.py).
 #   ./ci.sh obs        observability gate: tests/test_observability.py —
 #                      trace-context propagation, the metrics fallback, the
 #                      health server's zpages (/statusz included), and the
@@ -148,6 +154,12 @@ case "$tier" in
     exec python -m pytest tests/test_mxu_field.py \
       "tests/test_prepare.py::test_device_prepare_matches_oracle" -q
     ;;
+  coldstart)
+    # Shape-churn gate (ISSUE 8): canonicalization parity is asserted,
+    # never assumed — the full sweep (slow-marked cases included) plus
+    # the warmup/compile-cache machinery.
+    RUN_SLOW=1 exec python -m pytest tests/test_shape_canonical.py tests/test_warmup.py -q
+    ;;
   obs)
     # Observability gate (ISSUE 5): runs everywhere — the pure-Python
     # metrics fallback keeps the metric assertions meaningful even where
@@ -166,7 +178,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|chaos|obs|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|chaos|coldstart|obs|dryrun]" >&2
     exit 2
     ;;
 esac

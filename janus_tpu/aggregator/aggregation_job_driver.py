@@ -98,6 +98,11 @@ class DriverConfig:
     #: cross-job batching shared by ALL drivers, instead of this driver's
     #: private gather window above.  None/disabled = legacy path.
     device_executor: Optional[object] = None  # executor.ExecutorConfig
+    #: While a shape's executable is still WARMING (background compile),
+    #: wait up to this long on the compile future before draining the job
+    #: through the CPU oracle; 0 (default) = oracle immediately.  Either
+    #: way the breaker never counts compile-wait as a launch failure.
+    warmup_wait_s: float = 0.0
 
 
 class AggregationJobDriver:
@@ -112,6 +117,10 @@ class AggregationJobDriver:
         self._session = None
         self.config = config or DriverConfig()
         self._backends: Dict[tuple, object] = {}
+        #: canonical keys whose twin backend failed to BUILD — negative
+        #: cache so the hot path does not re-pay a doomed construction
+        #: (bounded by shape count; cleared only by process restart)
+        self._canon_build_failed: set = set()
         # key -> [(verify_key, prep_rows, future)] awaiting a coalesced launch
         self._pending_prep: Dict[int, list] = {}
         # Process-wide continuous batcher: every driver in the process
@@ -247,13 +256,29 @@ class AggregationJobDriver:
     def _vdaf_shape_key(vdaf) -> tuple:
         """Backend/bucket key (vdaf_shape_key in vdaf/backend.py — shared
         with the helper aggregator so both protocol sides land in the same
-        executor buckets and breaker domains)."""
+        executor buckets and breaker domains).  Canonical twins are shape
+        fixpoints, so calling this on a canonical backend's own vdaf
+        yields its cache key."""
         from ..vdaf.backend import vdaf_shape_key
 
         return vdaf_shape_key(vdaf)
 
+    def _executor_shape(self, vdaf):
+        """(cache key, canonical twin or None): with the executor's
+        ``canonical_shapes`` on, tasks in one pow2 bucket share a key —
+        one backend, one set of compiled graphs, one set of mega-batch
+        buckets (vdaf/canonical.py); shapes failing the parity
+        preconditions keep their exact key."""
+        from ..vdaf.canonical import executor_shape
+
+        return executor_shape(
+            vdaf,
+            enabled=self._executor is not None
+            and self._executor.config.canonical_shapes,
+        )
+
     def _backend_for(self, task: AggregatorTask, vdaf):
-        key = self._vdaf_shape_key(vdaf)
+        key, canon = self._executor_shape(vdaf)
         b = self._backends.get(key)
         if b is None and isinstance(vdaf, Prio3):
             backend_name = self.config.vdaf_backend
@@ -282,6 +307,54 @@ class AggregationJobDriver:
                         ).inc()
                     backend_name = "oracle"  # don't even attempt the device
             field_backend = self.config.field_backend
+            if (
+                canon is not None
+                and backend_name != "oracle"
+                and key not in self._canon_build_failed
+            ):
+                # Bucket twin (vdaf/canonical.py): graphs compile for the
+                # CANONICAL shape and requests carry the task's actual
+                # vdaf.  A canonical cache entry must ALWAYS be a genuine
+                # canonical device backend — an oracle (or exact-shape)
+                # fallback under this key would serve other bucket members
+                # a wrong-shaped circuit — so a failed build falls through
+                # to the exact-shape resolution below instead of caching
+                # (and is negative-cached: the hot path must not re-pay a
+                # doomed twin construction + stack trace per job step).
+                def canon_factory():
+                    return make_backend(
+                        canon,
+                        backend_name,
+                        field_backend=field_backend,
+                        canonical=True,
+                    )
+
+                try:
+                    b = (
+                        self._executor.backend_for(key, canon_factory)
+                        if self._executor is not None
+                        else canon_factory()
+                    )
+                    self._backends[key] = b
+                    return b
+                except Exception:
+                    self._canon_build_failed.add(key)
+                    logger.exception(
+                        "canonical backend build failed for task %s; "
+                        "serving from an exact-shape compile",
+                        task.task_id,
+                    )
+            if canon is not None:
+                # Not serving canonically (oracle config, unsupported
+                # device path, or a failed twin build): the canonical
+                # bucket key must NEVER hold a non-canonical backend —
+                # resolve and cache under the task's EXACT key instead.
+                from ..vdaf.backend import vdaf_shape_key
+
+                key = vdaf_shape_key(vdaf)
+                b = self._backends.get(key)
+                if b is not None:
+                    return b
 
             def factory():
                 try:
@@ -300,7 +373,7 @@ class AggregationJobDriver:
         return b
 
     async def _coalesced_prep_init(
-        self, backend, verify_key: bytes, prep_in, task_ident=None
+        self, backend, verify_key: bytes, prep_in, task_ident=None, vdaf=None
     ):
         """Join concurrent same-shape jobs (across tasks) into ONE launch.
 
@@ -320,7 +393,15 @@ class AggregationJobDriver:
         if self._executor is not None and hasattr(backend, "stage_prep_init_multi"):
             from ..executor import CircuitOpenError, ExecutorOverloadedError
 
-            shape_key = self._vdaf_shape_key(backend.vdaf)
+            # the executor cache / warmup-ledger / breaker key, derived
+            # from the RESOLVED backend (vdaf/canonical.backend_shape_key)
+            # so key and backend can never diverge — on the twin-build
+            # fallback path the cached backend is exact-shape and must
+            # keep submitting under the exact key, never the canonical
+            # bucket's (which would bind a wrong-shaped backend to it)
+            from ..vdaf.canonical import backend_shape_key
+
+            shape_key = backend_shape_key(backend)
             # Breaker-aware routing (ISSUE 3 satellite): an open circuit is
             # known BEFORE submitting — consult the breaker peek (the
             # programmatic face of circuit_stats()) and serve this job on
@@ -332,12 +413,43 @@ class AggregationJobDriver:
                     verify_key,
                     prep_in,
                     f"circuit for shape {shape_key[0]}/{shape_key[1]} is open",
+                    vdaf=vdaf,
                 )
+            if self._executor.warming(shape_key):
+                # Cold-shape contract (ISSUE 8): the executable is still
+                # compiling on the warmup thread.  Optionally wait a
+                # bounded moment on the compile future; otherwise (or on
+                # timeout) drain this job through the bit-exact CPU
+                # oracle.  Either way the breaker never counts the
+                # compile-wait as a launch failure and no flush deadline
+                # can trip on it.
+                wait_s = self.config.warmup_wait_s
+                warmed = False
+                if wait_s > 0:
+                    warmed = await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: self._executor.wait_warm(shape_key, timeout=wait_s),
+                    )
+                if not warmed and self._executor.warming(shape_key):
+                    return await self._oracle_fallback(
+                        backend,
+                        verify_key,
+                        prep_in,
+                        f"shape {shape_key[0]}/{shape_key[1]} is warming "
+                        "(executable compiling off the submit path)",
+                        vdaf=vdaf,
+                        reason="warming",
+                    )
             try:
                 return await self._executor.submit(
                     shape_key,
                     "prep_init",
-                    (verify_key, prep_in),
+                    # canonical backends take 3-tuple requests: the task's
+                    # actual vdaf rides along so marshal pads its rows to
+                    # the bucket shape (vdaf/backend._req_parts)
+                    (verify_key, prep_in, vdaf)
+                    if getattr(backend, "canonical", False)
+                    else (verify_key, prep_in),
                     backend=backend,
                     agg_id=0,
                     retain_out_shares=self._executor.accumulator is not None,
@@ -348,7 +460,9 @@ class AggregationJobDriver:
                 # the bit-exact CPU oracle for this job instead of burning
                 # the retry budget — the breaker's half-open probes restore
                 # device service without any action here.
-                return await self._oracle_fallback(backend, verify_key, prep_in, e)
+                return await self._oracle_fallback(
+                    backend, verify_key, prep_in, e, vdaf=vdaf
+                )
             except ExecutorOverloadedError as e:
                 raise JobStepError(
                     f"device executor overloaded: {e}", retryable=True
@@ -382,10 +496,16 @@ class AggregationJobDriver:
             # and the lease machinery owns the retry.
             raise JobStepError(f"prepare launch failed: {e}", retryable=True)
 
-    async def _oracle_fallback(self, backend, verify_key: bytes, prep_in, cause):
+    async def _oracle_fallback(
+        self, backend, verify_key: bytes, prep_in, cause, vdaf=None, reason="circuit_open"
+    ):
         """Serve one job's prepare on the CPU oracle (bit-exact with the
-        device path by the backend contract, tests/test_backend.py)."""
-        oracle = getattr(backend, "oracle", None)
+        device path by the backend contract, tests/test_backend.py).
+        ``vdaf`` routes canonical (bucket-twin) backends to the TASK's
+        oracle — the twin's own oracle computes a padded circuit."""
+        from ..vdaf.backend import oracle_backend_for
+
+        oracle = oracle_backend_for(backend, vdaf)
         if oracle is None:
             raise JobStepError(f"device unavailable: {cause}", retryable=True)
         vdaf_type = type(getattr(backend, "vdaf", None)).__name__
@@ -398,7 +518,7 @@ class AggregationJobDriver:
 
         if GLOBAL_METRICS.registry is not None:
             GLOBAL_METRICS.vdaf_backend_fallbacks.labels(
-                vdaf_type=vdaf_type, reason="circuit_open"
+                vdaf_type=vdaf_type, reason=reason
             ).inc()
         return await asyncio.get_running_loop().run_in_executor(
             None, lambda: oracle.prep_init_batch(verify_key, 0, prep_in)
@@ -471,6 +591,7 @@ class AggregationJobDriver:
                 # per-task fairness quota: the DRR accounting domain WITHIN
                 # the shared shape bucket (executor._pick_entry_locked)
                 task_ident=task.task_id.data,
+                vdaf=vdaf,
             )
 
             def wrap_outcomes():
@@ -851,6 +972,7 @@ class AggregationJobDriver:
             return None, None, []
         from ..datastore.query_type import strategy_for
         from ..executor.accumulator import AccumulatorUnavailable, ResidentRef
+        from ..vdaf.canonical import clip_drained_vector
 
         resident = {
             rid: v for rid, v in out_shares.items() if isinstance(v, ResidentRef)
@@ -1036,17 +1158,19 @@ class AggregationJobDriver:
             if drained is None:
                 continue
             vector, drained_rids = drained
-            deltas[ident] = (vector, frozenset(drained_rids))
+            # canonical accumulator buffers are bucket-width; clip the
+            # provably-zero pad tail back to the task's OUTPUT_LEN
+            deltas[ident] = (clip_drained_vector(vdaf, vector), frozenset(drained_rids))
         return deltas or None, journal_entries or None, touched
 
     def _oracle_out_shares(self, task, vdaf, backend, ras):
         """Bit-exact CPU replay of finished reports' out shares (backend
-        contract: oracle == device, tests/test_backend.py)."""
-        oracle = getattr(backend, "oracle", None)
-        if oracle is None:
-            from ..vdaf.backend import OracleBackend
+        contract: oracle == device, tests/test_backend.py).  Canonical
+        backends replay through the TASK's oracle (oracle_for), never the
+        bucket twin's."""
+        from ..vdaf.backend import OracleBackend, oracle_backend_for
 
-            oracle = OracleBackend(vdaf)
+        oracle = oracle_backend_for(backend, vdaf) or OracleBackend(vdaf)
         rows = []
         for ra in ras:
             rows.append(
@@ -1125,7 +1249,7 @@ class AggregationJobDriver:
         store = self._executor.accumulator
         from ..executor.accumulator import AccumulatorError
 
-        task, field = self._task_field_for_bucket(key)
+        task, vdaf, field = self._task_field_for_bucket(key)
         if task is None:
             return
         try:
@@ -1144,7 +1268,7 @@ class AggregationJobDriver:
             self._merge_drained(task, field, key, out[0], out[1])
 
     def _task_field_for_bucket(self, key: tuple):
-        """(task, field) for a deferred bucket key
+        """(task, vdaf, field) for a deferred bucket key
         ``(role, task_id, shape_key, batch_identifier, agg_param)``."""
         from ..messages import TaskId
 
@@ -1155,9 +1279,9 @@ class AggregationJobDriver:
         )
         if task is None:
             logger.warning("bucket %r names an unknown task; dropping", key)
-            return None, None
+            return None, None, None
         vdaf = task.vdaf_instance()
-        return task, vdaf.field_for_agg_param(vdaf.decode_agg_param(param))
+        return task, vdaf, vdaf.field_for_agg_param(vdaf.decode_agg_param(param))
 
     def _merge_drained(self, task, field, key: tuple, vector, journal) -> None:
         """The deferred-drain transaction: consume every contributing
@@ -1168,9 +1292,14 @@ class AggregationJobDriver:
         the whole drain aborts and the SURVIVING rows stay journaled for
         the same replay path.  Either path merges each row exactly once."""
         from ..messages import AggregationJobId
+        from ..vdaf.canonical import clip_drained_vector
         from .aggregation_job_writer import merge_share_delta
 
         _role, _task_id_b, _shape, ident, param = key
+        # canonical accumulator buffers are bucket-width: clip the
+        # provably-zero pad tail back to the task's OUTPUT_LEN here, the
+        # one chokepoint every journaled-drain merge passes through
+        vector = clip_drained_vector(task.vdaf_instance(), vector)
 
         def tx_fn(tx):
             for job_token, _rids in journal:
@@ -1221,7 +1350,7 @@ class AggregationJobDriver:
                 len(journal),
             )
             return
-        task, field = self._task_field_for_bucket(key)
+        task, _vdaf, field = self._task_field_for_bucket(key)
         if task is None:
             return
         self._merge_drained(task, field, key, vector, journal)

@@ -194,6 +194,9 @@ class Aggregator:
         )
         # Helper-side executor routing: share the process-wide continuous
         # batcher (and its per-shape circuit breakers) with the drivers.
+        #: canonical keys whose twin backend failed to build (negative
+        #: cache — see _executor_backend_for)
+        self._canon_build_failed: set = set()
         self._executor = None
         exec_cfg = self.config.device_executor
         if exec_cfg is not None and getattr(exec_cfg, "enabled", False):
@@ -825,6 +828,50 @@ class Aggregator:
         )
         return self._helper_finish_prio3(vdaf, results, combine_rows, combined)
 
+    def _executor_backend_for(self, ta: TaskAggregator):
+        """(shape key, backend) through the executor's shape-keyed cache:
+        tasks sharing one VDAF shape share one backend + compiled graphs,
+        and ``device_executor.mesh`` upgrades the helper's single-chip
+        backends to the SPMD MeshBackend exactly like the drivers'.  With
+        ``canonical_shapes`` on, the key is the CANONICAL shape's
+        (vdaf/canonical.py) and the cached backend is the bucket's padded
+        twin — a canonical cache entry must always be a genuine canonical
+        device backend, so a failed twin build falls back to the task's
+        exact key/backend instead of caching."""
+        from ..vdaf.backend import make_backend, vdaf_shape_key
+        from ..vdaf.canonical import executor_shape
+
+        vdaf = ta.vdaf
+        key, canon = executor_shape(
+            vdaf, enabled=self._executor.config.canonical_shapes
+        )
+        if (
+            canon is not None
+            and ta.backend_name != "oracle"
+            and key not in self._canon_build_failed
+        ):
+            try:
+                return key, self._executor.backend_for(
+                    key,
+                    lambda: make_backend(
+                        canon,
+                        ta.backend_name,
+                        field_backend=ta.field_backend,
+                        canonical=True,
+                    ),
+                )
+            except Exception:
+                # negative-cached: the request path must not re-pay a
+                # doomed twin construction + stack trace per request
+                self._canon_build_failed.add(key)
+                logger.exception(
+                    "canonical helper backend build failed for task %s; "
+                    "serving from an exact-shape compile",
+                    ta.task.task_id,
+                )
+        key = vdaf_shape_key(vdaf)
+        return key, self._executor.backend_for(key, lambda: ta.backend)
+
     async def _helper_prepare_batch_prio3_executor(self, ta: TaskAggregator, decoded):
         """Helper prep through the process-wide device executor: prep_init
         (agg_id=1 buckets) and combine submissions coalesce with every
@@ -837,24 +884,28 @@ class Aggregator:
             KIND_PREP_INIT,
         )
         from ..executor.service import CircuitOpenError, ExecutorOverloadedError
-        from ..vdaf.backend import vdaf_shape_key
 
         vdaf = ta.vdaf
-        shape_key = vdaf_shape_key(vdaf)
-        # Resolve through the executor's shape-keyed cache: tasks sharing
-        # one VDAF shape share one backend + compiled graphs, and
-        # ``device_executor.mesh`` upgrades the helper's single-chip
-        # backends to the SPMD MeshBackend exactly like the drivers'.
-        backend = self._executor.backend_for(shape_key, lambda: ta.backend)
+        shape_key, backend = self._executor_backend_for(ta)
         # task identity for the per-task fairness quota within the bucket
         task_ident = getattr(getattr(ta.task, "task_id", None), "data", None)
         loop = asyncio.get_running_loop()
+        canonical = getattr(backend, "canonical", False)
 
         def oracle_path():
-            oracle = getattr(backend, "oracle", None) or backend
+            # canonical backends must serve fallbacks from the TASK's
+            # oracle (the bucket twin's computes a padded circuit)
+            from ..vdaf.backend import oracle_backend_for
+
+            oracle = oracle_backend_for(backend, vdaf) or backend
             return self._helper_prepare_batch_prio3(ta, decoded, backend=oracle)
 
         if self._executor.circuit_open(shape_key):
+            return await loop.run_in_executor(None, oracle_path)
+        if self._executor.warming(shape_key):
+            # executable still compiling on the warmup thread: the helper
+            # answers on the bit-exact oracle instead of queueing the
+            # request behind XLA (the breaker never sees compile-wait)
             return await loop.run_in_executor(None, oracle_path)
 
         results, rows = await loop.run_in_executor(
@@ -868,7 +919,11 @@ class Aggregator:
             prep_out = await self._executor.submit(
                 shape_key,
                 KIND_PREP_INIT,
-                (ta.task.vdaf_verify_key, prep_in),
+                # canonical backends take 3-tuple requests: the task's
+                # actual vdaf rides along for bucket-shape marshal
+                (ta.task.vdaf_verify_key, prep_in, vdaf)
+                if canonical
+                else (ta.task.vdaf_verify_key, prep_in),
                 backend=backend,
                 agg_id=1,
                 # Helper-side retention (ISSUE 4 satellite): with the
@@ -901,7 +956,9 @@ class Aggregator:
             # re-enter past the decode: (results, rows) are already built;
             # any refs the prep submission minted must free first
             self._release_helper_refs(prep_out)
-            oracle = getattr(backend, "oracle", None) or backend
+            from ..vdaf.backend import oracle_backend_for
+
+            oracle = oracle_backend_for(backend, vdaf) or backend
             return await loop.run_in_executor(
                 None,
                 lambda: self._helper_prep_rows_prio3(ta, oracle, results, rows),
@@ -978,6 +1035,25 @@ class Aggregator:
         task = ta.task
         vdaf = ta.vdaf
         shape_key = vdaf_shape_key(vdaf)
+        # The refs were minted by the EXECUTOR's cached backend (the
+        # canonical bucket twin when canonical_shapes is on): commit_rows'
+        # accumulate launches must run on THAT backend — its buffer widths
+        # match the retained flush matrices; ta.backend's would not.
+        from ..vdaf.canonical import clip_drained_vector, executor_shape
+
+        ex_cfg = getattr(self._executor, "config", None)
+        ckey, _canon = executor_shape(
+            vdaf, enabled=bool(ex_cfg and ex_cfg.canonical_shapes)
+        )
+        peek = getattr(self._executor, "cached_backend", None)
+        commit_backend = None
+        if peek is not None:
+            # canonical key first; the EXACT key second (a failed twin
+            # build makes _executor_backend_for cache the exact-shape —
+            # possibly meshified — backend there, and THAT one minted the
+            # refs whose buffer layout commit_rows must match)
+            commit_backend = peek(ckey) or peek(shape_key)
+        commit_backend = commit_backend or ta.backend
         strategy = strategy_for(task)
         ra_by_rid = {ra.report_id.data: ra for ra in ras}
         field = vdaf.field_for_agg_param(
@@ -1022,7 +1098,7 @@ class Aggregator:
             def commit_and_drain(bucket_key=bucket_key, refs=refs, rids=rids):
                 store.commit_rows(
                     bucket_key,
-                    ta.backend,
+                    commit_backend,
                     refs,
                     job_token=job.aggregation_job_id.data,
                     report_ids=rids,
@@ -1056,7 +1132,9 @@ class Aggregator:
             if drained is None:
                 continue
             vector, drained_rids = drained
-            deltas[ident] = (vector, frozenset(drained_rids))
+            # canonical buffers are bucket-width; clip the provably-zero
+            # pad tail back to the task's OUTPUT_LEN
+            deltas[ident] = (clip_drained_vector(vdaf, vector), frozenset(drained_rids))
         return deltas or None
 
     def _helper_oracle_out_shares(self, ta: TaskAggregator, rids, decoded_by_rid):

@@ -130,6 +130,16 @@ class CommonConfig:
     #: Idle threshold for executor-bucket gauge retirement (cardinality
     #: cap); <= 0 keeps every bucket's series forever (pre-ISSUE-5 shape).
     executor_bucket_idle_s: float = 600.0
+    #: Fleet-wide persistent XLA compile cache ROOT (utils/jax_setup.py):
+    #: when set, every binary points jax's compilation cache at
+    #: ``<dir>/<config-digest>`` at startup, so a restarted replica (crash
+    #: recovery, rollout) replays its executables instead of re-paying
+    #: 37-286 s of compile per VDAF shape.  The digest subdirectory keys
+    #: on (JAX_PLATFORMS, XLA_FLAGS, host CPU fingerprint) — a shared
+    #: volume is safe across heterogeneous hosts — and the no-cache-on-CPU
+    #: guard still applies (XLA:CPU AOT loads are poisoned; see
+    #: enable_compile_cache).  Empty = no persistent cache.
+    compile_cache_dir: str = ""
 
 
 @dataclass
@@ -200,6 +210,16 @@ class DeviceExecutorConfig:
     submit_timeout_s: float = 30.0
     #: mega-batch size to precompile per backend at startup (0 = off)
     warmup_rows: int = 0
+    #: run warmup compiles on a background thread (default): backend
+    #: resolution and binary startup never block behind XLA, and submits
+    #: for a still-warming shape drain through the CPU oracle (or wait
+    #: ``warmup_wait_s``).  False = legacy inline warmup.
+    warmup_async: bool = True
+    #: pow2 shape canonicalization (vdaf/canonical.py): key device
+    #: backends by the canonical (bucket-padded) shape so N task shapes
+    #: share O(log N) compiled executables, bit-exactly; shapes failing
+    #: the parity preconditions keep exact-shape compiles.
+    canonical_shapes: bool = True
     #: consecutive launch failures per VDAF shape before its circuit
     #: opens and the driver degrades to the CPU oracle (0 disables)
     breaker_failure_threshold: int = 5
@@ -225,6 +245,8 @@ class DeviceExecutorConfig:
             max_queue_rows=self.max_queue_rows,
             submit_timeout_s=self.submit_timeout_s,
             warmup_rows=self.warmup_rows,
+            warmup_async=self.warmup_async,
+            canonical_shapes=self.canonical_shapes,
             breaker_failure_threshold=self.breaker_failure_threshold,
             breaker_reset_timeout_s=self.breaker_reset_timeout_s,
             fair_flush=self.fair_flush,
@@ -305,6 +327,10 @@ class JobDriverBinaryConfig:
     field_backend: str = "vpu"
     #: Continuous cross-job batching for device prepare (default off).
     device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
+    #: While a shape's executable is still warming (background compile),
+    #: wait up to this long on the compile future before serving the job
+    #: on the CPU oracle; 0 = oracle immediately.
+    warmup_wait_s: float = 0.0
 
 
 def _merge_dataclass(cls, data: dict):

@@ -93,6 +93,25 @@ def _bootstrap(config_common):
     if getattr(config_common, "profiler_port", 0):
         if start_profiler_server(config_common.profiler_port):
             logger.info("jax profiler server on :%d", config_common.profiler_port)
+    if getattr(config_common, "compile_cache_dir", ""):
+        # Fleet-wide persistent compile cache (ISSUE 8): a restarted
+        # replica replays its XLA executables from the shared cache root
+        # instead of re-paying every shape's compile.  enable_compile_cache
+        # keeps the config/host-fingerprint scoping and the
+        # no-cache-on-CPU guard (poisoned AOT loads) even for an explicit
+        # root, so this is safe to set unconditionally in fleet config.
+        from ..utils.jax_setup import enable_compile_cache, resolve_cache_dir
+
+        if enable_compile_cache(config_common.compile_cache_dir):
+            logger.info(
+                "persistent compile cache -> %s",
+                resolve_cache_dir(config_common.compile_cache_dir),
+            )
+        else:
+            logger.info(
+                "persistent compile cache disabled on this platform "
+                "(CPU AOT loads are poisoned; cold compiles are cheaper)"
+            )
     clock = RealClock()
     if fault_cfg is not None and fault_cfg.enabled:
         # clock-skew failure domain: armed replicas see a drifting clock
@@ -415,36 +434,55 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 vdaf_backend=cfg.vdaf_backend,
                 field_backend=cfg.field_backend,
                 device_executor=exec_cfg,
+                warmup_wait_s=cfg.warmup_wait_s,
             ),
         )
         if exec_cfg is not None and exec_cfg.warmup_rows:
-            # Startup warmup: compile the mega-batch executables for every
-            # provisioned task's VDAF shape now, not at peak traffic.
-            try:
-                tasks = datastore.run_tx(
-                    "warmup_tasks", lambda tx: tx.get_aggregator_tasks()
-                )
-            except Exception:
-                tasks = []
-                logger.exception("device executor warmup failed (serving cold)")
-            warmed = 0
-            for task in tasks:
-                # per-task containment: one bad VDAF must not leave every
-                # other task paying its mega-batch compile at peak traffic
+            # Registry-driven BACKGROUND warmup (ISSUE 8): walk the task
+            # registry and resolve every task's backend — with canonical
+            # shapes on, N tasks collapse to O(log N) distinct backends,
+            # and each resolution queues its compile on the executor's
+            # warmup thread, so startup (and the submit path) never blocks
+            # behind XLA; submits for a still-warming shape drain through
+            # the CPU oracle until the executable lands.
+            import threading
+
+            def _registry_warmup(driver=stepper_impl):
                 try:
-                    stepper_impl._backend_for(task, task.vdaf_instance())
-                    warmed += 1
+                    tasks = datastore.run_tx(
+                        "warmup_tasks", lambda tx: tx.get_aggregator_tasks()
+                    )
                 except Exception:
                     logger.exception(
-                        "executor warmup failed for task %s (it serves cold)",
-                        task.task_id,
+                        "warmup task-registry walk failed (serving cold)"
                     )
-            if tasks:
-                logger.info(
-                    "device executor warmup covered %d/%d task(s)",
-                    warmed,
-                    len(tasks),
-                )
+                    return
+                resolved, shapes = 0, set()
+                for task in tasks:
+                    # per-task containment: one bad VDAF must not leave
+                    # every other task serving cold at peak traffic
+                    try:
+                        vdaf = task.vdaf_instance()
+                        shapes.add(driver._executor_shape(vdaf)[0])
+                        driver._backend_for(task, vdaf)
+                        resolved += 1
+                    except Exception:
+                        logger.exception(
+                            "executor warmup failed for task %s (it serves cold)",
+                            task.task_id,
+                        )
+                if tasks:
+                    logger.info(
+                        "device executor warmup resolved %d/%d task(s) "
+                        "onto %d backend shape(s)",
+                        resolved,
+                        len(tasks),
+                        len(shapes),
+                    )
+
+            threading.Thread(
+                target=_registry_warmup, name="janus-warmup-registry", daemon=True
+            ).start()
 
         async def acquirer(duration, limit):
             return await datastore.run_tx_async(

@@ -412,6 +412,24 @@ class Metrics:
             ["bucket", "reason"],
             registry=self.registry,
         )
+        # Shape-churn visibility (ISSUE 8): how long each VDAF shape's
+        # executables took to compile, and whether the registry-driven
+        # background warmup delivered them (outcome=ok) or the shape is
+        # serving cold (outcome=error).  compile_s per shape is the number
+        # the persistent compile cache should drive to ~0 across restarts.
+        self.executor_compile_seconds = Histogram(
+            "janus_executor_compile_duration_seconds",
+            "Warmup compile wall time per VDAF shape",
+            ["shape"],
+            buckets=(0.5, 2, 5, 15, 30, 60, 120, 300, 600),
+            registry=self.registry,
+        )
+        self.executor_warmups = Counter(
+            "janus_executor_warmup_total",
+            "Backend warmup attempts by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
         # Per-shape circuit breaker (executor/service.py): a sick device
         # path must be visible the moment it trips, and again when the
         # half-open probe restores it.

@@ -49,6 +49,10 @@ def runtime_status() -> dict:
             "enabled": True,
             "buckets": ex.stats(),
             "circuits": ex.circuit_stats(),
+            # per-shape compile ledger (ISSUE 8): cold / warming / warm
+            # (+ last compile_s) / failed — the first thing to curl when a
+            # fresh task's flushes look slow
+            "compile": ex.compile_stats(),
         }
         doc["accumulator"] = (
             ex.accumulator.stats() if ex.accumulator is not None else None

@@ -240,14 +240,6 @@ class DeviceAccumulatorStore:
         # commit cleanly un-applied (exactly-once recovery depends on it)
         self._evict_if_needed()
         with self._lock:
-            bucket = self._buckets.get(bucket_key)
-            if bucket is None:
-                bucket = _Bucket(bucket_key, backend)
-                self._buckets[bucket_key] = bucket
-            if bucket.poisoned:
-                raise AccumulatorUnavailable(
-                    f"bucket {bucket_key!r} poisoned by an earlier launch failure"
-                )
             by_flush: Dict[int, List[int]] = {}
             for ref in refs:
                 by_flush.setdefault(ref.flush_id, []).append(ref.row)
@@ -260,6 +252,22 @@ class DeviceAccumulatorStore:
                     )
                 fl.last_used = time.monotonic()
                 sources.append((fl, rows))
+            # The MINTING backend (recorded on the flush at retain time) is
+            # the accumulation authority: its buffer widths and sharding
+            # match the retained matrix by construction, while the caller's
+            # backend can diverge after a canonical-twin fallback/recovery
+            # (an exact-shape flush committed through the bucket twin — or
+            # vice versa — would mismatch widths).  The caller's backend is
+            # only the last resort for legacy flushes without one.
+            mint = sources[0][0].backend or backend
+            bucket = self._buckets.get(bucket_key)
+            if bucket is None:
+                bucket = _Bucket(bucket_key, mint)
+                self._buckets[bucket_key] = bucket
+            if bucket.poisoned:
+                raise AccumulatorUnavailable(
+                    f"bucket {bucket_key!r} poisoned by an earlier launch failure"
+                )
         with bucket.oplock:
             # re-validate under the op lock: a concurrent drain/discard may
             # have detached this bucket after we looked it up — landing
@@ -274,7 +282,7 @@ class DeviceAccumulatorStore:
                     pad = fl.matrix.shape[0]
                     mask = np.zeros(pad, dtype=bool)
                     mask[rows] = True
-                    bucket.buffer = backend.accumulate_rows(
+                    bucket.buffer = (fl.backend or backend).accumulate_rows(
                         bucket.buffer, fl.matrix, mask
                     )
             except Exception as e:
@@ -286,7 +294,7 @@ class DeviceAccumulatorStore:
             # drain's snapshot can never see the delta without its entry
             with self._lock:
                 if bucket.buffer_nbytes == 0:
-                    bucket.buffer_nbytes = self._buffer_nbytes(backend)
+                    bucket.buffer_nbytes = self._buffer_nbytes(mint)
                     self.resident_bytes += bucket.buffer_nbytes
                 bucket.journal.append((job_token, frozenset(report_ids)))
                 bucket.row_count += len(refs)

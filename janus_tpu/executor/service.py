@@ -101,6 +101,19 @@ class ExecutorConfig:
     submit_timeout_s: float = 30.0
     #: pow2 mega-batch size warmup compiles per (backend, agg_id); 0 = off
     warmup_rows: int = 0
+    #: run warmup compiles on a dedicated background thread (default) so
+    #: backend_for — and therefore the submit path and binary startup —
+    #: never blocks behind XLA; while a shape is WARMING, producers route
+    #: its submissions to the CPU oracle (or wait on the warm future),
+    #: and the breaker never sees the compile.  False = legacy inline
+    #: warmup (the first resolver pays the compile synchronously).
+    warmup_async: bool = True
+    #: pow2 shape canonicalization (vdaf/canonical.py): producers key
+    #: device backends by the CANONICAL shape so N task shapes share
+    #: O(log N) compiled executables; shapes whose bit-exactness
+    #: preconditions fail keep exact-shape compiles.  Read by the job
+    #: drivers and the helper aggregator at backend resolution.
+    canonical_shapes: bool = True
     #: consecutive launch failures per VDAF shape before its circuit
     #: opens (submits raise CircuitOpenError -> oracle fallback); 0 = off
     breaker_failure_threshold: int = 5
@@ -345,6 +358,14 @@ class DeviceExecutor:
         self._lock = threading.Lock()
         self._stage_pool: Optional[ThreadPoolExecutor] = None
         self._launch_pool: Optional[ThreadPoolExecutor] = None
+        #: one dedicated compile thread: warmups serialize (XLA compiles
+        #: are CPU-heavy; two at once just slow each other down) and never
+        #: touch the stage/launch pools that serve live traffic
+        self._warmup_pool: Optional[ThreadPoolExecutor] = None
+        #: shape_key -> {state: cold|warming|warm|failed, compile_s,
+        #: error, future} — the per-shape compile ledger behind
+        #: warming()/wait_warm()/compile_stats() (/statusz surfaces it)
+        self._warmup_state: Dict[tuple, dict] = {}
         # Strong refs to in-flight flush tasks: the event loop holds tasks
         # weakly, and a GC'd flush would strand its detached submissions.
         self._flush_tasks: set = set()
@@ -402,18 +423,15 @@ class DeviceExecutor:
                     b = self._meshify(b)
                 self._backends[shape_key] = b
                 created = True
-        if created and self.config.warmup_rows:
-            try:
-                n = self.warmup_backend(b)
-                if n:
-                    logger.info(
-                        "warmed %d executable(s) for %s at %d rows",
-                        n,
-                        type(b).__name__,
-                        self.config.warmup_rows,
-                    )
-            except Exception:
-                logger.exception("executor warmup failed (serving cold)")
+                if shape_key not in self._warmup_state:
+                    self._warmup_state[shape_key] = {
+                        "state": "cold",
+                        "compile_s": None,
+                        "error": None,
+                        "future": None,
+                    }
+        if created and self.config.warmup_rows and hasattr(b, "stage_prep_init_multi"):
+            self._schedule_warmup(shape_key, b)
         return b
 
     @staticmethod
@@ -425,11 +443,128 @@ class DeviceExecutor:
         from ..vdaf.backend import MeshBackend, TpuBackend
 
         if type(backend) is TpuBackend:
-            # Preserve the field-arithmetic layout across the upgrade: the
-            # mesh backend runs the same per-shard graphs, so an mxu-
-            # configured producer must stay mxu after meshification.
-            return MeshBackend(backend.vdaf, field_backend=backend.field_backend)
+            # Preserve the field-arithmetic layout AND canonical mode
+            # across the upgrade: the mesh backend runs the same per-shard
+            # graphs, so an mxu-configured (or bucket-twin) producer must
+            # stay that way after meshification.
+            return MeshBackend(
+                backend.vdaf,
+                field_backend=backend.field_backend,
+                canonical=backend.canonical,
+            )
         return backend
+
+    def cached_backend(self, shape_key: tuple):
+        """Peek the shape-keyed backend cache WITHOUT creating (commit
+        paths must reuse exactly the backend whose launches minted their
+        resident refs — buffer widths must match the retained matrices)."""
+        with self._lock:
+            return self._backends.get(shape_key)
+
+    # -- background warmup ------------------------------------------------
+    def _schedule_warmup(self, shape_key: tuple, backend) -> None:
+        """Queue a warmup compile for a freshly created backend.  With
+        ``warmup_async`` (the default) the compile runs on the dedicated
+        warmup thread and backend_for returns immediately — producers see
+        warming() True and drain the shape through the CPU oracle (or
+        wait_warm()) until the executable lands.  A FAILED warmup only
+        clears the warming flag: the bucket keeps working (the first live
+        flush pays the compile, exactly the pre-warmup world) and the
+        breaker is untouched — compile trouble is not device sickness."""
+        state = self._warmup_state[shape_key]
+        if not self.config.warmup_async:
+            state["state"] = "warming"
+            self._do_warmup(shape_key, backend)
+            return
+        with self._lock:
+            if self._warmup_pool is None:
+                if self._closed:
+                    return
+                self._warmup_pool = ThreadPoolExecutor(
+                    1, thread_name_prefix="janus-exec-warmup"
+                )
+            state["state"] = "warming"
+            state["future"] = self._warmup_pool.submit(
+                self._do_warmup, shape_key, backend
+            )
+
+    def _do_warmup(self, shape_key: tuple, backend) -> bool:
+        from ..core.metrics import GLOBAL_METRICS
+        from ..core.trace import trace_span
+
+        state = self._warmup_state[shape_key]
+        label = shape_label(backend, shape_key)
+        t0 = time.monotonic()
+        try:
+            with trace_span(
+                "compile",
+                cat="executor",
+                shape=label,
+                rows=self.config.warmup_rows,
+            ):
+                n = self.warmup_backend(backend)
+            dt = time.monotonic() - t0
+            state.update(state="warm", compile_s=round(dt, 3), error=None)
+            outcome = "ok"
+            if n:
+                logger.info(
+                    "warmed %d executable(s) for %s (%s) at %d rows in %.1fs",
+                    n,
+                    type(backend).__name__,
+                    label,
+                    self.config.warmup_rows,
+                    dt,
+                )
+        except Exception as e:
+            dt = time.monotonic() - t0
+            state.update(state="failed", compile_s=round(dt, 3), error=str(e)[:200])
+            outcome = "error"
+            logger.exception("executor warmup failed for %s (serving cold)", label)
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.executor_warmups.labels(outcome=outcome).inc()
+            if outcome == "ok":
+                GLOBAL_METRICS.executor_compile_seconds.labels(shape=label).observe(dt)
+        return outcome == "ok"
+
+    def warming(self, shape_key: tuple) -> bool:
+        """True while the shape's warmup compile is still in flight —
+        producers route its submissions to the CPU oracle meanwhile (the
+        breaker must never count compile-wait as a launch failure, and
+        with this peek it never sees one)."""
+        st = self._warmup_state.get(shape_key)
+        return st is not None and st["state"] == "warming"
+
+    def wait_warm(self, shape_key: tuple, timeout: Optional[float] = None) -> bool:
+        """Block until the shape's warmup settles; True iff it is WARM.
+        The compile-future face of the cold-task contract (producers that
+        prefer waiting a bounded moment over an oracle hop)."""
+        st = self._warmup_state.get(shape_key)
+        if st is None:
+            return False
+        fut = st.get("future")
+        if fut is not None:
+            try:
+                fut.result(timeout=timeout)
+            except Exception:
+                pass
+        return st["state"] == "warm"
+
+    def compile_stats(self) -> Dict[str, dict]:
+        """Per-shape compile ledger for /statusz: cold (resolved, never
+        warmed), warming, warm (last compile_s), or failed (error)."""
+        with self._lock:
+            out = {}
+            for shape_key, st in self._warmup_state.items():
+                b = self._backends.get(shape_key)
+                label = (
+                    shape_label(b, shape_key) if b is not None else repr(shape_key)
+                )
+                out[label] = {
+                    "state": st["state"],
+                    "compile_s": st["compile_s"],
+                    "error": st["error"],
+                }
+            return out
 
     # -- thread pools ----------------------------------------------------
     def _pools(self) -> Tuple[ThreadPoolExecutor, ThreadPoolExecutor]:
@@ -1169,8 +1304,8 @@ class DeviceExecutor:
             except Exception:
                 logger.exception("accumulator shutdown teardown failed")
         with self._lock:
-            pools = [self._stage_pool, self._launch_pool]
-            self._stage_pool = self._launch_pool = None
+            pools = [self._stage_pool, self._launch_pool, self._warmup_pool]
+            self._stage_pool = self._launch_pool = self._warmup_pool = None
         for p in pools:
             if p is not None:
                 p.shutdown(wait=False)

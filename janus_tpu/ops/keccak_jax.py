@@ -198,6 +198,100 @@ def turboshake128_batch(msg: jnp.ndarray, domain: int, out_len: int) -> jnp.ndar
     return out_bytes[..., :out_len]
 
 
+@_eager_jit(static_argnums=(1, 2))
+def turboshake128_batch_select(
+    msg: jnp.ndarray, domain: int, out_len: int, msg_len: jnp.ndarray
+) -> jnp.ndarray:
+    """TurboSHAKE128 over PER-ROW message lengths (canonical shape padding).
+
+    ``msg`` is (..., Lmax) u8 with every byte at or past the row's
+    ``msg_len`` (..., i32) equal to ZERO — the canonical-shape marshal
+    zero-masks its pad columns, which is what lets the TurboSHAKE pad
+    (domain byte at msg_len, 0x80 into the last byte of the row's final
+    RATE block) be written with static-shape where/iota masks.  The
+    absorb runs over ALL Lmax blocks and keeps, per row, the sponge
+    state after the row's own final block — every block before it is
+    byte-identical to the row's true absorb, so the selected state (and
+    the squeeze from it) matches ``turboshake128_batch(msg[:msg_len])``
+    exactly.  ``out_len`` must fit one squeeze block (a seed does).
+
+    Exactness asserted row-for-row against the host oracle in
+    tests/test_shape_canonical.py.
+    """
+    if out_len > RATE:
+        raise NotImplementedError("select squeeze serves seed-sized outputs")
+    Lmax = msg.shape[-1]
+    batch_shape = msg.shape[:-1]
+    nblocks = Lmax // RATE + 1
+    total = nblocks * RATE
+    buf = jnp.concatenate(
+        [msg, jnp.zeros(batch_shape + (total - Lmax,), dtype=jnp.uint8)], axis=-1
+    )
+    ml = msg_len.astype(jnp.int32)[..., None]
+    idx = lax.broadcasted_iota(jnp.int32, buf.shape, buf.ndim - 1)
+    # domain byte lands on a zero; 0x80 xors into the row's final block's
+    # last byte (they coincide exactly when the true pad is one byte).
+    buf = jnp.where(idx == ml, jnp.uint8(domain), buf)
+    last = (ml // RATE + 1) * RATE - 1
+    buf = buf ^ jnp.where(idx == last, jnp.uint8(0x80), jnp.uint8(0))
+    words = bytes_to_words(buf).reshape(batch_shape + (nblocks, RATE_WORDS))
+    blocks = jnp.moveaxis(words, -2, 0)  # (nblocks, ..., 42)
+    target = (msg_len.astype(jnp.int32) // RATE)[..., None]  # row's final block
+
+    state = jnp.zeros(batch_shape + (50,), dtype=_U32)
+    selected = state
+
+    def absorb_select(state, selected, block, i):
+        rate_part = state[..., :RATE_WORDS] ^ block
+        state = keccak_p_batch(
+            jnp.concatenate([rate_part, state[..., RATE_WORDS:]], axis=-1)
+        )
+        return state, jnp.where(target == i, state, selected)
+
+    # mirror turboshake128_batch: unroll short messages, scan long ones
+    # (the scan keeps ONE permutation body in the graph)
+    _UNROLL = 8
+    if nblocks <= _UNROLL:
+        for i in range(nblocks):
+            state, selected = absorb_select(state, selected, blocks[i], i)
+    else:
+
+        def body(carry, xs):
+            block, i = xs
+            return absorb_select(*carry, block, i), None
+
+        (state, selected), _ = lax.scan(
+            body, (state, selected), (blocks, jnp.arange(nblocks, dtype=jnp.int32))
+        )
+        selected = _scan_fence(selected)
+    out_bytes = words_to_bytes(selected[..., :RATE_WORDS])
+    return out_bytes[..., :out_len]
+
+
+@_eager_jit(static_argnums=(1, 3))
+def xof_turboshake128_batch_select(
+    seed: jnp.ndarray,
+    dst: bytes,
+    binder: jnp.ndarray,
+    out_len: int,
+    binder_len: jnp.ndarray,
+) -> jnp.ndarray:
+    """``xof_turboshake128_batch`` with a PER-ROW binder length: binder is
+    (..., Bmax) u8, zero past each row's ``binder_len`` (..., i32).  The
+    fixed head (len(dst) || dst || seed) absorbs identically for every
+    row; only the binder tail varies, via the length-selected sponge."""
+    prefix = np.frombuffer(bytes([len(dst)]) + dst, dtype=np.uint8)
+    batch_shape = seed.shape[:-1]
+    head = len(prefix) + seed.shape[-1]
+    msg = jnp.concatenate(
+        [jnp.broadcast_to(jnp.asarray(prefix), batch_shape + (len(prefix),)), seed, binder],
+        axis=-1,
+    )
+    return turboshake128_batch_select(
+        msg, 0x01, out_len, binder_len.astype(jnp.int32) + head
+    )
+
+
 @_eager_jit(static_argnums=(1, 3))
 def xof_turboshake128_batch(
     seed: jnp.ndarray, dst: bytes, binder: jnp.ndarray, out_len: int

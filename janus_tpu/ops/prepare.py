@@ -99,6 +99,14 @@ class _DeviceCircuit:
     # subclasses: inputs(), v(), truncate(), gadget_eval_scaled().
     # Convention: meas/gk/wires canonical; jr_m Montgomery; consts as noted.
 
+    def calls_from_meas_len(self, meas_len):
+        """Per-row LIVE gadget-call count for a (possibly canonical-padded)
+        measurement length — the mask boundary for the barycentric
+        coefficients and the gadget-output fold (vdaf/canonical.py).
+        Chunked circuits: ceil(meas_len / chunk)."""
+        chunk = getattr(self.valid, "chunk_length", 1)
+        return (meas_len + (chunk - 1)) // chunk
+
     def wire_evals(self, jf, meas_m, jr_m, lag, seeds, consts):
         """Wire-polynomial evaluations at t: (B, arity, n) canonical.
 
@@ -565,7 +573,7 @@ class BatchedPrio3:
         return _scan_fence(gk)
 
     # -- FLP query (one proof) ------------------------------------------
-    def _query_one(self, meas_m, proof_m, jr_m, t_m):
+    def _query_one(self, meas_m, proof_m, jr_m, t_m, calls_live=None):
         """Device FLP query for one proof.
 
         meas_m (B,MEAS_LEN,n) CANONICAL, proof_m (B,PROOF_LEN,n) CANONICAL,
@@ -574,6 +582,16 @@ class BatchedPrio3:
         Every mont_mul pairs one canonical bulk tensor with one Montgomery
         scalar/constant, so products stay canonical (see module docstring).
         Oracle twin: FlpGeneric.query.
+
+        ``calls_live`` (B,) i32 is the canonical-shape mask boundary
+        (vdaf/canonical.py): this graph is compiled for the BUCKET's call
+        count, and rows from a shorter task zero their padded calls out of
+        (a) the gadget-output fold — an adversarial gadget polynomial is
+        NOT zero at unused evaluation points, so gk must be masked before
+        v — and (b) the barycentric coefficient vector, which reproduces
+        the actual circuit's wire polynomial exactly (its values at unused
+        P-th roots are zero BY DEFINITION, and every fused wire path
+        consumes lag downstream of this mask).
         """
         jf, circ = self.jf, self.circ
         B = meas_m.shape[0]
@@ -581,10 +599,16 @@ class BatchedPrio3:
         gpoly = proof_m[:, circ.arity :]  # (B, glen, n)
 
         gk = self._gadget_outputs(gpoly, B)  # (B, calls, n)
+        if calls_live is not None:
+            k = jnp.arange(circ.calls, dtype=jnp.int32)[None, :]
+            gk = jnp.where((k < calls_live[:, None])[:, :, None], gk, 0)
         v = circ.v(jf, gk, meas_m, jr_m, self.consts)  # (B, n)
 
         # Wire evaluations at t via barycentric Lagrange on the P-th roots.
         lag, t_ok = self._lagrange_coeffs(t_m)
+        if calls_live is not None:
+            k = jnp.arange(circ.calls + 1, dtype=jnp.int32)[None, :]
+            lag = jnp.where((k <= calls_live[:, None])[:, :, None], lag, 0)
         wire_evals = circ.wire_evals(jf, meas_m, jr_m, lag, seeds, self.consts)
 
         gp_t = self._gpoly_at(gpoly, t_m)  # (B, n)
@@ -606,6 +630,7 @@ class BatchedPrio3:
         proofs_limbs: Optional[jnp.ndarray] = None,
         blinds_u8: Optional[jnp.ndarray] = None,
         public_parts_u8: Optional[jnp.ndarray] = None,
+        meas_len_u32: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Batched Prio3.prep_init for one aggregator.
 
@@ -615,6 +640,16 @@ class BatchedPrio3:
         out_share (B,OUT,n), verifiers (B,num_proofs*VER,n),
         joint_rand_part/corrected_seed (B,SEED) u8 (if applicable), and
         ok (B,) flagging rows needing host fallback.
+
+        ``meas_len_u32`` (B,) engages canonical-shape masking
+        (vdaf/canonical.py): this instance is the BUCKET's padded twin and
+        each row carries its task's true MEAS_LEN.  Measurement columns at
+        or past it are zeroed (the helper XOF expands the bucket width —
+        its stream is prefix-stable, but the tail is live data that must
+        not reach the wires), the joint-rand-part XOF absorbs the row's
+        true ``enc(meas)`` byte length via the length-selected sponge, and
+        the gadget-call masks flow into _query_one.  Outputs are
+        byte-identical to the row's own unpadded oracle.
 
         Oracle twin: Prio3.prep_init (janus_tpu/vdaf/prio3.py).
         """
@@ -626,6 +661,12 @@ class BatchedPrio3:
         else:
             meas, proofs, ok_h = self.helper_shares(agg_id, share_seeds_u8)
             ok = ok & ok_h
+        ml = calls_live = None
+        if meas_len_u32 is not None:
+            ml = meas_len_u32.astype(jnp.int32)
+            calls_live = self.circ.calls_from_meas_len(ml)
+            col = jnp.arange(flp.MEAS_LEN, dtype=jnp.int32)[None, :]
+            meas = jnp.where((col < ml[:, None])[:, :, None], meas, 0)
 
         if isinstance(verify_key, (bytes, bytearray)):
             verify_key = jnp.asarray(np.frombuffer(bytes(verify_key), dtype=np.uint8))
@@ -647,7 +688,25 @@ class BatchedPrio3:
             )
             meas_bytes = limbs_to_bytes(meas)
             part_binder = jnp.concatenate([agg_b, nonces_u8, meas_bytes], axis=-1)
-            part = self._xof_seed(blinds_u8, self._dst(USAGE_JOINT_RAND_PART), part_binder)
+            if ml is None:
+                part = self._xof_seed(
+                    blinds_u8, self._dst(USAGE_JOINT_RAND_PART), part_binder
+                )
+            else:
+                # Canonical padding: the binder embeds enc(meas), whose true
+                # byte length is per-row — absorb with the length-selected
+                # sponge (the padded tail bytes are zero by the mask above,
+                # which the select absorb's pad construction requires).
+                from .keccak_jax import xof_turboshake128_batch_select
+
+                binder_len = 1 + nonces_u8.shape[-1] + ml * (4 * jf.n)
+                part = xof_turboshake128_batch_select(
+                    blinds_u8,
+                    self._dst(USAGE_JOINT_RAND_PART),
+                    part_binder,
+                    prio3.xof.SEED_SIZE,
+                    binder_len,
+                )
             # corrected joint rand seed over parts with ours substituted.
             S = prio3.num_shares
             pieces = []
@@ -685,7 +744,7 @@ class BatchedPrio3:
                 if jr_m is not None
                 else jnp.zeros((B, 0, jf.n), dtype=_U32)
             )
-            ver, t_ok = self._query_one(meas, pm, ji, ti)
+            ver, t_ok = self._query_one(meas, pm, ji, ti, calls_live=calls_live)
             ok = ok & t_ok
             verifiers.append(ver)
 

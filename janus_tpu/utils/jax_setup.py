@@ -30,18 +30,42 @@ def _host_fingerprint() -> str:
     return platform.machine()
 
 
-def enable_compile_cache(cache_dir: str = None) -> None:
-    """Point XLA's persistent compilation cache at <repo>/.jax_cache/<config>.
+def resolve_cache_dir(cache_dir: str = None) -> str:
+    """The configuration-scoped cache path: ``<root>/<config-digest>``.
+
+    ``cache_dir`` overrides only the ROOT (the fleet-shared location, e.g.
+    a persistent volume every replica mounts) — the per-(JAX_PLATFORMS,
+    XLA_FLAGS, host-fingerprint) subdirectory is kept even then, so a
+    replica restarting on a different host or platform config never loads
+    a foreign executable (see _host_fingerprint)."""
+    config_key = (
+        os.environ.get("JAX_PLATFORMS", "default")
+        + "|"
+        + os.environ.get("XLA_FLAGS", "")
+        + "|"
+        + _host_fingerprint()
+    )
+    sub = hashlib.sha256(config_key.encode()).hexdigest()[:12]
+    return os.path.join(cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"), sub)
+
+
+def enable_compile_cache(cache_dir: str = None) -> bool:
+    """Point XLA's persistent compilation cache at the config-scoped dir.
 
     The limb-arithmetic graphs are large; caching makes every re-run of the
-    same (circuit, batch) shape start in milliseconds instead of minutes.
+    same (circuit, batch) shape start in milliseconds instead of minutes —
+    a RESTARTED replica (crash recovery, rollout) recovers warm instead of
+    re-paying every shape's compile.  Wired into every binary's startup
+    behind ``common.compile_cache_dir`` (binaries/main._bootstrap) and
+    into bench.py.  Returns True when the cache was enabled.
 
-    The cache is scoped per (JAX_PLATFORMS, XLA_FLAGS) configuration:
-    executables AOT-compiled under one configuration (e.g. the real TPU
-    platform, or a different host-feature set) must never be loaded under
-    another — XLA logs machine-feature mismatches and can hang or SIGILL
-    executing them.  XLA-internal AOT kernel caches are disabled for the
-    same reason; only the JAX-level executable cache is persisted.
+    The cache is scoped per (JAX_PLATFORMS, XLA_FLAGS, host fingerprint)
+    configuration: executables AOT-compiled under one configuration (e.g.
+    the real TPU platform, or a different host-feature set) must never be
+    loaded under another — XLA logs machine-feature mismatches and can
+    hang or SIGILL executing them.  XLA-internal AOT kernel caches are
+    disabled for the same reason; only the JAX-level executable cache is
+    persisted.
     """
     import jax
 
@@ -55,22 +79,15 @@ def enable_compile_cache(cache_dir: str = None) -> None:
         # doesn't match...") and falls into a pathological slow path —
         # observed turning a 68 s cold-compile test into a 26+ minute hang.
         # Cold compiles are cheaper than poisoned loads: no persistent
-        # cache on CPU.
-        return
+        # cache on CPU.  This guard applies even to an explicitly
+        # configured cache_dir.
+        return False
 
-    config_key = (
-        os.environ.get("JAX_PLATFORMS", "default")
-        + "|"
-        + os.environ.get("XLA_FLAGS", "")
-        + "|"
-        + _host_fingerprint()
-    )
-    sub = hashlib.sha256(config_key.encode()).hexdigest()[:12]
-    path = cache_dir or os.path.join(_REPO_ROOT, ".jax_cache", sub)
-    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_compilation_cache_dir", resolve_cache_dir(cache_dir))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     try:
         jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
     except AttributeError:
         pass
+    return True

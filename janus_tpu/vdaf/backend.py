@@ -127,6 +127,26 @@ def _resolve_field_backend(field_backend: Optional[str]) -> str:
     return fb
 
 
+def _req_parts(req):
+    """A prepare request is ``(verify_key, reports)`` or — on a CANONICAL
+    backend (vdaf/canonical.py) — ``(verify_key, reports, actual_vdaf)``,
+    the third element naming the task's true (unpadded) VDAF so marshal
+    can pad its rows to the bucket shape and unmarshal can slice back."""
+    return req[0], req[1], (req[2] if len(req) > 2 else None)
+
+
+def oracle_backend_for(backend, vdaf):
+    """The bit-exact CPU oracle for serving ``vdaf``'s reports when
+    ``backend`` cannot (circuit open, executable warming, replay).  The
+    single chokepoint for canonical routing: a canonical backend's own
+    ``.oracle`` computes the bucket twin's padded circuit, so it must
+    resolve through ``oracle_for(vdaf)``; plain backends fall back to
+    their ``.oracle`` (or None when there is none)."""
+    if hasattr(backend, "oracle_for"):
+        return backend.oracle_for(vdaf)
+    return getattr(backend, "oracle", None)
+
+
 class TpuBackend:
     """Batched device prepare: one XLA launch per aggregation job."""
 
@@ -139,7 +159,12 @@ class TpuBackend:
     #: DEVICE so the accumulator store can account resident bytes honestly
     accum_buffer_rows = 1
 
-    def __init__(self, vdaf: Prio3, field_backend: Optional[str] = None):
+    def __init__(
+        self,
+        vdaf: Prio3,
+        field_backend: Optional[str] = None,
+        canonical: bool = False,
+    ):
         if vdaf.xof is not XofTurboShake128:
             raise VdafError("TPU backend requires the TurboSHAKE XOF")
         import jax
@@ -147,11 +172,23 @@ class TpuBackend:
         from ..ops.prepare import BatchedPrio3
 
         self.vdaf = vdaf
+        #: CANONICAL mode (vdaf/canonical.py): ``vdaf`` is a bucket's
+        #: padded twin shared by every task in the bucket.  Requests carry
+        #: the task's actual vdaf (3-tuples), marshal pads measurement
+        #: columns and emits the per-row ``meas_len_u32`` mask input, and
+        #: the graphs run row-major (the planar Pallas kernels take no
+        #: masks).  The graph SIGNATURE is mode-fixed, so one executable
+        #: serves every task mix.
+        self.canonical = canonical
         #: "vpu" | "mxu" — see FIELD_BACKENDS; carried so the executor's
         #: mesh upgrade (_meshify) preserves the layout choice.
         self.field_backend = _resolve_field_backend(field_backend)
         self.bp = BatchedPrio3(vdaf, field_backend=self.field_backend)
         self.oracle = OracleBackend(vdaf)
+        #: actual-shape oracles for canonical-mode fallback rows, keyed by
+        #: vdaf_shape_key (a row that overflowed the device margin must be
+        #: recomputed by ITS task's oracle, not the bucket twin's)
+        self._oracles: Dict[tuple, OracleBackend] = {}
         self._jax = jax
         self._prep_fns: Dict[int, object] = {}
         self._combine_fn = None
@@ -161,6 +198,18 @@ class TpuBackend:
         #: the flush-readback counter the accumulator acceptance tests
         #: assert stays 0 in the device-resident steady state
         self.outshare_readback_rows = 0
+
+    def oracle_for(self, vdaf=None) -> OracleBackend:
+        """The bit-exact CPU oracle for ``vdaf`` (None/own = this
+        backend's).  Canonical-mode callers MUST route fallbacks through
+        this — the bucket twin's oracle computes a different circuit."""
+        if vdaf is None or vdaf is self.vdaf:
+            return self.oracle
+        key = vdaf_shape_key(vdaf)
+        o = self._oracles.get(key)
+        if o is None:
+            o = self._oracles[key] = OracleBackend(vdaf)
+        return o
 
     # -- jit caches ------------------------------------------------------
     #: Gate for the limb-planar fast path.  Pallas custom calls do not
@@ -179,7 +228,13 @@ class TpuBackend:
             def prep(kw):
                 vk = kw.pop("verify_key_u8")
                 B = kw["nonces_u8"].shape[0]
-                if self._planar_capable and self.bp.planar_eligible(agg_id, B):
+                # Canonical-mode batches carry the per-row mask input and
+                # run row-major only (the planar kernels take no masks).
+                if (
+                    self._planar_capable
+                    and "meas_len_u32" not in kw
+                    and self.bp.planar_eligible(agg_id, B)
+                ):
                     # Limb-planar fast path (the bench pipeline), both
                     # sides: helpers expand share seeds through the planar
                     # XOF, the leader transposes its explicit shares in.
@@ -220,7 +275,15 @@ class TpuBackend:
         return self._combine_fn
 
     # -- marshaling ------------------------------------------------------
-    def _marshal(self, agg_id, reports, pad_to: int) -> Dict[str, np.ndarray]:
+    def _marshal(
+        self, agg_id, reports, pad_to: int, segments=None
+    ) -> Dict[str, np.ndarray]:
+        """``segments`` (canonical mode): ``[(rows, actual_meas_len)]``
+        per contiguous same-task run of ``reports`` — leader measurement
+        limbs land in the leading ``actual_meas_len`` columns of the
+        bucket-width matrix (the pad columns STAY ZERO; the graph's mask
+        and the select-absorb's pad construction both require it) and
+        every row gets its ``meas_len_u32`` mask input."""
         vdaf, flp, jf = self.vdaf, self.vdaf.flp, self.bp.jf
         B = len(reports)
         seed_size = vdaf.xof.SEED_SIZE
@@ -240,9 +303,20 @@ class TpuBackend:
                 [r[2].joint_rand_blind for r in reports], seed_size
             )
         if agg_id == 0:
-            meas = jf.to_limbs([x for r in reports for x in r[2].meas_share]).reshape(
-                B, flp.MEAS_LEN, jf.n
-            )
+            if segments is None:
+                meas = jf.to_limbs(
+                    [x for r in reports for x in r[2].meas_share]
+                ).reshape(B, flp.MEAS_LEN, jf.n)
+            else:
+                meas = np.zeros((B, flp.MEAS_LEN, jf.n), dtype=np.uint32)
+                limbs = jf.to_limbs([x for r in reports for x in r[2].meas_share])
+                row = off = 0
+                for rows, mlen in segments:
+                    meas[row : row + rows, :mlen] = limbs[
+                        off : off + rows * mlen
+                    ].reshape(rows, mlen, jf.n)
+                    row += rows
+                    off += rows * mlen
             proofs = jf.to_limbs(
                 [x for r in reports for x in r[2].proofs_share]
             ).reshape(B, flp.PROOF_LEN * vdaf.num_proofs, jf.n)
@@ -254,6 +328,13 @@ class TpuBackend:
             )
         else:
             kw["share_seeds_u8"] = stack_bytes([r[2].share_seed for r in reports], seed_size)
+        if segments is not None:
+            lens = np.concatenate(
+                [np.full(rows, mlen, dtype=np.uint32) for rows, mlen in segments]
+            )
+            kw["meas_len_u32"] = np.concatenate(
+                [lens, np.repeat(lens[-1:], pad_to - B, axis=0)]
+            )
         return kw
 
     # -- placement hooks (MeshBackend shards these over the device mesh) --
@@ -290,12 +371,17 @@ class TpuBackend:
         return self.prep_init_multi(agg_id, [(verify_key, reports)])[0]
 
     def _unmarshal_prep(
-        self, verify_key, agg_id, reports, out, resident=None
+        self, verify_key, agg_id, reports, out, resident=None, actual_vdaf=None
     ) -> List[PrepOutcome]:
         """``resident=(flush_id, start_row)`` means the out-share matrix
         stayed on device (accumulator store): states carry ResidentRefs
-        instead of limb vectors and no out-share bytes cross the PCIe."""
+        instead of limb vectors and no out-share bytes cross the PCIe.
+        ``actual_vdaf`` (canonical mode) slices the bucket-width out share
+        back to the task's OUTPUT_LEN — the pad tail is provably zero —
+        and routes margin-overflow fallback rows to the TASK's oracle."""
         flp, jf = self.vdaf.flp, self.bp.jf
+        out_len = (actual_vdaf or self.vdaf).flp.OUTPUT_LEN
+        oracle = self.oracle_for(actual_vdaf)
         B = len(reports)
         ok = np.asarray(out["ok"])[:B]
         verifiers = np.asarray(out["verifiers"])[:B]
@@ -315,11 +401,11 @@ class TpuBackend:
             if not ok[b]:
                 # Exact-path fallback: the device margin overflowed for this row.
                 results.extend(
-                    self.oracle.prep_init_batch(verify_key, agg_id, [reports[b]])
+                    oracle.prep_init_batch(verify_key, agg_id, [reports[b]])
                 )
                 continue
             state = Prio3PrepareState(
-                out_share=jf.from_limbs(out_shares[b])
+                out_share=jf.from_limbs(out_shares[b, :out_len])
                 if resident is None
                 else ResidentRef(flush_id, start_row + b),
                 corrected_joint_rand_seed=corrected[b].tobytes() if has_jr else None,
@@ -410,15 +496,22 @@ class TpuBackend:
         synthetic rows)."""
         flat: List = []
         vk_rows: List[np.ndarray] = []
-        for verify_key, reports in requests:
+        segments: Optional[List] = [] if self.canonical else None
+        for req in requests:
+            verify_key, reports, actual = _req_parts(req)
             flat.extend(reports)
             vk = np.frombuffer(verify_key, dtype=np.uint8)
             vk_rows.extend([vk] * len(reports))
+            if segments is not None and reports:
+                # a 2-tuple request (warmup's synthetic rows) is shaped for
+                # the canonical twin itself: its mask is the full width
+                mlen = (actual or self.vdaf).flp.MEAS_LEN
+                segments.append((len(reports), mlen))
         if not flat:
             return None
         B = len(flat)
         pad_to = self._align_pad(max(pad_to or 0, self._pad_to(B)))
-        kw = self._marshal(agg_id, flat, pad_to)
+        kw = self._marshal(agg_id, flat, pad_to, segments=segments)
         vk_mat = np.stack(vk_rows)
         kw["verify_key_u8"] = np.concatenate(
             [vk_mat, np.repeat(vk_mat[-1:], pad_to - B, axis=0)]
@@ -478,7 +571,8 @@ class TpuBackend:
             _observe_prepare(self.name, "init", B, time.monotonic() - t0)
             start = 0
             results: List[List[PrepOutcome]] = []
-            for verify_key, reports in requests:
+            for req in requests:
+                verify_key, reports, actual = _req_parts(req)
                 n = len(reports)
                 view = {k: v[start : start + n] for k, v in outputs.items()}
                 results.append(
@@ -490,6 +584,7 @@ class TpuBackend:
                         resident=None
                         if resident is None
                         else (resident[0], start),
+                        actual_vdaf=actual,
                     )
                 )
                 start += n
@@ -607,8 +702,14 @@ class MeshBackend(TpuBackend):
 
     name = "mesh"
 
-    def __init__(self, vdaf: Prio3, devices=None, field_backend: Optional[str] = None):
-        super().__init__(vdaf, field_backend=field_backend)
+    def __init__(
+        self,
+        vdaf: Prio3,
+        devices=None,
+        field_backend: Optional[str] = None,
+        canonical: bool = False,
+    ):
+        super().__init__(vdaf, field_backend=field_backend, canonical=canonical)
         import os
 
         import jax
@@ -667,7 +768,11 @@ class MeshBackend(TpuBackend):
             def per_shard(kw):
                 vk = kw.pop("verify_key_u8")
                 B = kw["nonces_u8"].shape[0]
-                if self._planar_capable and self.bp.planar_eligible(agg_id, B):
+                if (
+                    self._planar_capable
+                    and "meas_len_u32" not in kw
+                    and self.bp.planar_eligible(agg_id, B)
+                ):
                     out = self.bp.prep_init_planar(
                         agg_id,
                         vk,
@@ -1047,12 +1152,21 @@ def device_supported(vdaf) -> Tuple[bool, str]:
     return True, ""
 
 
-def make_backend(vdaf, backend: str = "oracle", field_backend: Optional[str] = None):
+def make_backend(
+    vdaf,
+    backend: str = "oracle",
+    field_backend: Optional[str] = None,
+    canonical: bool = False,
+):
     """Backend factory — the dispatch gate named in the north star.
 
     ``field_backend`` ("vpu" | "mxu", None = JANUS_TPU_FIELD_BACKEND or
     "vpu") selects the device backends' field-arithmetic layout; the
     oracle and Poplar1 paths have no device field layer and ignore it.
+    ``canonical`` marks ``vdaf`` as a bucket's padded twin
+    (vdaf/canonical.py) — device backends then expect 3-tuple requests
+    and emit the per-row mask input; only device Prio3 backends honor it
+    (the oracle/hybrid/Poplar1 paths are never canonicalized).
     """
     try:
         cls = BACKENDS[backend]
@@ -1071,4 +1185,4 @@ def make_backend(vdaf, backend: str = "oracle", field_backend: Optional[str] = N
         return HybridXofBackend(vdaf, field_backend=field_backend)
     if cls is OracleBackend:
         return cls(vdaf)
-    return cls(vdaf, field_backend=field_backend)
+    return cls(vdaf, field_backend=field_backend, canonical=canonical)

@@ -50,6 +50,7 @@ DEVICE_TIER_MODULES = {
     "test_integration_pair",
     "test_backend",
     "test_poplar1_batch",
+    "test_shape_canonical",
 }
 
 

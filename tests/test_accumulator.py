@@ -596,6 +596,7 @@ class _AggStub:
     _helper_prepare_batch_prio3 = _A._helper_prepare_batch_prio3
     _helper_prep_rows_prio3 = _A._helper_prep_rows_prio3
     _helper_prepare_batch_prio3_executor = _A._helper_prepare_batch_prio3_executor
+    _executor_backend_for = _A._executor_backend_for
     _release_helper_refs = _A._release_helper_refs
     _release_unfinished_helper_refs = _A._release_unfinished_helper_refs
 

@@ -1,0 +1,341 @@
+"""Background AOT warmup + persistent compile cache (ISSUE 8).
+
+Scheduling/ledger/metric machinery runs against stubbed warmup compiles
+(no jax); one real-backend case proves the background thread actually
+compiles executables.  The compile-cache tests pin enable_compile_cache's
+contract — host-fingerprint scoping under an explicit root, deterministic
+resolution across a restart, and the no-cache-on-CPU guard — against a
+recording stand-in for jax.config (this container has no TPU)."""
+
+import asyncio
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from janus_tpu.executor import DeviceExecutor, ExecutorConfig
+from janus_tpu.fields import next_power_of_2
+
+
+def _run(coro, timeout=60.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+class _FakeBackend:
+    def __init__(self):
+        self.vdaf = SimpleNamespace()
+        self.launches = []
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        rows = sum(len(r[1]) for r in requests)
+        if rows == 0:
+            return None
+        return SimpleNamespace(
+            agg_id=agg_id,
+            placed=None,
+            pad_to=max(pad_to or 0, next_power_of_2(rows)),
+            rows=rows,
+        )
+
+    def launch_prep_init_multi(self, staged, requests):
+        self.launches.append([len(r[1]) for r in requests])
+        return [["out"] * len(r[1]) for r in requests]
+
+
+# ---------------------------------------------------------------------------
+# background warmup scheduling + ledger
+
+
+def test_backend_for_returns_before_background_warmup_finishes(monkeypatch):
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=4, warmup_async=True))
+    gate = threading.Event()
+
+    def slow_warmup(backend, agg_ids=(0, 1), pad_to=None):
+        assert gate.wait(10)
+        return 2
+
+    monkeypatch.setattr(ex, "warmup_backend", slow_warmup)
+    t0 = time.monotonic()
+    b = ex.backend_for(("shape",), _FakeBackend)
+    assert time.monotonic() - t0 < 1.0, "backend_for must not block on compile"
+    assert ex.warming(("shape",))
+    gate.set()
+    assert ex.wait_warm(("shape",), timeout=10)
+    assert not ex.warming(("shape",))
+    st = ex.compile_stats()
+    (entry,) = st.values()
+    assert entry["state"] == "warm" and entry["compile_s"] is not None
+    # resolving again neither re-warms nor blocks
+    assert ex.backend_for(("shape",), _FakeBackend) is b
+    ex.shutdown()
+
+
+def test_failed_warmup_neither_wedges_bucket_nor_trips_breaker(monkeypatch):
+    """ISSUE 8 satellite: a warmup failure clears the warming flag, counts
+    janus_executor_warmup_total{outcome=error}, leaves the circuit CLOSED,
+    and the bucket still serves (first live flush pays the compile)."""
+    from janus_tpu.core.metrics import GLOBAL_METRICS
+
+    ex = DeviceExecutor(
+        ExecutorConfig(
+            warmup_rows=4,
+            warmup_async=True,
+            flush_window_s=0.01,
+            breaker_failure_threshold=3,
+        )
+    )
+
+    def broken_warmup(backend, agg_ids=(0, 1), pad_to=None):
+        raise RuntimeError("XLA compile exploded")
+
+    monkeypatch.setattr(ex, "warmup_backend", broken_warmup)
+    before = (
+        GLOBAL_METRICS.get_sample_value(
+            "janus_executor_warmup_total", {"outcome": "error"}
+        )
+        or 0
+    )
+    backend = ex.backend_for(("shape",), _FakeBackend)
+    assert ex.wait_warm(("shape",), timeout=10) is False
+    assert not ex.warming(("shape",))  # failed != warming: submits flow
+    (entry,) = ex.compile_stats().values()
+    assert entry["state"] == "failed" and "exploded" in entry["error"]
+    after = GLOBAL_METRICS.get_sample_value(
+        "janus_executor_warmup_total", {"outcome": "error"}
+    )
+    assert after == before + 1
+
+    # the bucket is NOT wedged: a live submission flushes normally...
+    out = _run(
+        ex.submit(("shape",), "prep_init", (b"k", [1, 2]), backend=backend)
+    )
+    assert len(out) == 2
+    # ...and the breaker never counted the compile failure
+    assert all(c["state"] == "closed" for c in ex.circuit_stats().values())
+    assert all(c["consecutive_failures"] == 0 for c in ex.circuit_stats().values())
+    ex.shutdown()
+
+
+def test_warmup_sync_mode_preserves_legacy_inline_behavior(monkeypatch):
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=4, warmup_async=False))
+    calls = []
+    monkeypatch.setattr(
+        ex, "warmup_backend", lambda b, agg_ids=(0, 1), pad_to=None: calls.append(b) or 2
+    )
+    ex.backend_for(("shape",), _FakeBackend)
+    assert len(calls) == 1  # compiled inline, before backend_for returned
+    assert not ex.warming(("shape",))
+    (entry,) = ex.compile_stats().values()
+    assert entry["state"] == "warm"
+    ex.shutdown()
+
+
+def test_cold_state_tracked_without_warmup():
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=0))
+    ex.backend_for(("shape",), _FakeBackend)
+    (entry,) = ex.compile_stats().values()
+    assert entry["state"] == "cold"
+    assert not ex.warming(("shape",))
+    ex.shutdown()
+
+
+def test_statusz_surfaces_compile_states(monkeypatch):
+    from janus_tpu.core.statusz import runtime_status
+    from janus_tpu.executor import service as svc
+
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=4, warmup_async=True))
+    monkeypatch.setattr(
+        ex, "warmup_backend", lambda b, agg_ids=(0, 1), pad_to=None: 2
+    )
+    monkeypatch.setattr(svc, "_GLOBAL", ex)
+    ex.backend_for(("shape",), _FakeBackend)
+    ex.wait_warm(("shape",), timeout=10)
+    doc = runtime_status()
+    (entry,) = doc["executor"]["compile"].values()
+    assert entry["state"] == "warm" and entry["compile_s"] is not None
+    ex.shutdown()
+
+
+def test_real_backend_background_warmup_compiles_executables():
+    from janus_tpu.vdaf.backend import TpuBackend
+    from janus_tpu.vdaf.instances import prio3_count
+
+    backend = TpuBackend(prio3_count())
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=4, warmup_async=True))
+    ex.backend_for(("count",), lambda: backend)
+    assert ex.wait_warm(("count",), timeout=300)
+    assert set(backend._prep_fns) == {0, 1}  # both agg sides precompiled
+    st = ex.compile_stats()
+    assert next(iter(st.values()))["state"] == "warm"
+    ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# driver routing: oracle-drain while warming
+
+
+def test_driver_serves_on_oracle_while_shape_warms(monkeypatch):
+    from janus_tpu.aggregator import AggregationJobDriver, DriverConfig
+    from janus_tpu.executor import reset_global_executor
+    from janus_tpu.utils.test_util import det_rng
+    from janus_tpu.vdaf.backend import OracleBackend, TpuBackend
+    from janus_tpu.vdaf.instances import prio3_count
+
+    reset_global_executor()
+    try:
+        driver = AggregationJobDriver(
+            None,
+            None,
+            DriverConfig(
+                vdaf_backend="tpu",
+                device_executor=ExecutorConfig(enabled=True),
+            ),
+        )
+        ex = driver._executor
+        vdaf = prio3_count()
+        backend = TpuBackend(vdaf)
+        key = driver._vdaf_shape_key(vdaf)
+        monkeypatch.setattr(ex, "warming", lambda sk: sk == key)
+
+        async def no_submit(*a, **kw):
+            raise AssertionError("submit must not run while the shape warms")
+
+        monkeypatch.setattr(ex, "submit", no_submit)
+        rng = det_rng("warmroute")
+        rows = []
+        for i in range(3):
+            nonce = rng(vdaf.NONCE_SIZE)
+            ps, shares = vdaf.shard(i % 2, nonce, rng(vdaf.RAND_SIZE))
+            rows.append((nonce, ps, shares[0]))
+        vk = b"\x01" * vdaf.VERIFY_KEY_SIZE
+        got = _run(
+            driver._coalesced_prep_init(backend, vk, rows, vdaf=vdaf)
+        )
+        want = OracleBackend(vdaf).prep_init_batch(vk, 0, rows)
+        for g, w in zip(got, want):
+            assert g[0].out_share == w[0].out_share
+            assert g[1].verifiers_share == w[1].verifiers_share
+        # compile-wait never reached the breaker
+        assert all(c["state"] == "closed" for c in ex.circuit_stats().values())
+    finally:
+        reset_global_executor()
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache wiring
+
+
+class _RecordingConfig:
+    """Stand-in for jax.config: records update() calls; platform settable."""
+
+    def __init__(self, platforms):
+        self.jax_platforms = platforms
+        self.updates = {}
+
+    def update(self, key, value):
+        self.updates[key] = value
+
+
+def _patched_enable(monkeypatch, platforms, env_platforms, cache_dir=None):
+    import jax
+
+    from janus_tpu.utils import jax_setup
+
+    rec = _RecordingConfig(platforms)
+    monkeypatch.setattr(jax, "config", rec)
+    monkeypatch.setenv("JAX_PLATFORMS", env_platforms)
+    return jax_setup.enable_compile_cache(cache_dir), rec
+
+
+def test_compile_cache_scopes_explicit_root_by_host_fingerprint(
+    monkeypatch, tmp_path
+):
+    from janus_tpu.utils import jax_setup
+
+    enabled, rec = _patched_enable(
+        monkeypatch, "tpu", "tpu", cache_dir=str(tmp_path / "fleet-cache")
+    )
+    assert enabled
+    path = rec.updates["jax_compilation_cache_dir"]
+    # under the configured root, but in a config-digest subdirectory: a
+    # shared volume never mixes executables across platform/host configs
+    assert path.startswith(str(tmp_path / "fleet-cache"))
+    assert path != str(tmp_path / "fleet-cache")
+    assert rec.updates["jax_persistent_cache_min_entry_size_bytes"] == 0
+    assert rec.updates["jax_persistent_cache_min_compile_time_secs"] == 0
+    # a different XLA_FLAGS configuration resolves to a DIFFERENT subdir
+    monkeypatch.setenv("XLA_FLAGS", "--xla_something_else")
+    assert jax_setup.resolve_cache_dir(str(tmp_path / "fleet-cache")) != path
+
+
+def test_compile_cache_restart_resolves_same_dir(monkeypatch, tmp_path):
+    """The restart contract: two processes with identical platform config
+    and host resolve the same cache dir, so the second replay-loads every
+    executable the first compiled (nothing recompiles on TPU platforms)."""
+    enabled1, rec1 = _patched_enable(
+        monkeypatch, "tpu", "tpu", cache_dir=str(tmp_path)
+    )
+    enabled2, rec2 = _patched_enable(
+        monkeypatch, "tpu", "tpu", cache_dir=str(tmp_path)
+    )
+    assert enabled1 and enabled2
+    assert (
+        rec1.updates["jax_compilation_cache_dir"]
+        == rec2.updates["jax_compilation_cache_dir"]
+    )
+
+
+def test_compile_cache_cpu_guard_regression(monkeypatch, tmp_path):
+    """XLA:CPU AOT loads are poisoned (see enable_compile_cache): the
+    guard must win even over an explicitly configured cache dir."""
+    enabled, rec = _patched_enable(
+        monkeypatch, "cpu", "cpu", cache_dir=str(tmp_path)
+    )
+    assert enabled is False
+    assert rec.updates == {}
+
+
+def test_bootstrap_wires_compile_cache_behind_common_config(monkeypatch, tmp_path):
+    from janus_tpu.binaries import main as binmain
+
+    calls = []
+    monkeypatch.setattr(
+        "janus_tpu.utils.jax_setup.enable_compile_cache",
+        lambda d=None: calls.append(d) or True,
+    )
+    monkeypatch.setenv(
+        "DATASTORE_KEYS", "AAAAAAAAAAAAAAAAAAAAAA"
+    )
+    from janus_tpu.binaries.config import CommonConfig, DbConfig
+
+    cfg = CommonConfig(
+        database=DbConfig(path=str(tmp_path / "db.sqlite3")),
+        compile_cache_dir=str(tmp_path / "cache"),
+    )
+    clock, datastore = binmain._bootstrap(cfg)
+    assert calls == [str(tmp_path / "cache")]
+    # absent config -> no cache call
+    calls.clear()
+    cfg2 = CommonConfig(database=DbConfig(path=str(tmp_path / "db2.sqlite3")))
+    binmain._bootstrap(cfg2)
+    assert calls == []
+
+
+def test_executor_config_plumbs_warmup_and_canonical_knobs():
+    from janus_tpu.binaries.config import DeviceExecutorConfig
+
+    cfg = DeviceExecutorConfig(
+        enabled=True, warmup_rows=64, warmup_async=False, canonical_shapes=False
+    )
+    ec = cfg.to_executor_config()
+    assert ec.warmup_rows == 64
+    assert ec.warmup_async is False
+    assert ec.canonical_shapes is False
+    # defaults: background warmup + canonicalization on
+    ec2 = DeviceExecutorConfig(enabled=True).to_executor_config()
+    assert ec2.warmup_async is True and ec2.canonical_shapes is True
