@@ -46,12 +46,15 @@
 #                      both agg sides x both field layouts) + the
 #                      background-warmup / compile-cache suite
 #                      (tests/test_warmup.py).
-#   ./ci.sh obs        observability gate: tests/test_observability.py —
-#                      trace-context propagation, the metrics fallback, the
-#                      health server's zpages (/statusz included), and the
-#                      golden metric-name/label manifest
-#                      (tests/metric_manifest.txt) that catches silent
-#                      metric renames.
+#   ./ci.sh obs        observability gate: tests/test_observability.py +
+#                      tests/test_slo.py — trace-context propagation (incl.
+#                      upload-minted traces + linked-trace --stats), the
+#                      metrics fallback, the OTLP exporter's first-class
+#                      no-op path, SLO burn-rate math against hand-computed
+#                      fixtures, the health server's zpages (/statusz
+#                      included), the metric help-text audit, and the golden
+#                      metric-name/label manifest (tests/metric_manifest.txt)
+#                      that catches silent metric renames.
 #   ./ci.sh dryrun     the driver's gates: multichip dryrun + entry compile.
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -161,11 +164,13 @@ case "$tier" in
     RUN_SLOW=1 exec python -m pytest tests/test_shape_canonical.py tests/test_warmup.py -q
     ;;
   obs)
-    # Observability gate (ISSUE 5): runs everywhere — the pure-Python
+    # Observability gate (ISSUE 5 + 9): runs everywhere — the pure-Python
     # metrics fallback keeps the metric assertions meaningful even where
-    # prometheus_client is absent; datastore-backed cases skip without
-    # `cryptography`.
-    exec python -m pytest tests/test_observability.py -q
+    # prometheus_client is absent, the OTLP suite PROVES the exporter
+    # inert where the opentelemetry-sdk is absent, and the SLO suite
+    # checks burn-rate math against hand-computed histogram fixtures;
+    # datastore-backed cases skip without `cryptography`.
+    exec python -m pytest tests/test_observability.py tests/test_slo.py -q
     ;;
   dryrun)
     python __graft_entry__.py 8

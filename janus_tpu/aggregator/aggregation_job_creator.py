@@ -12,11 +12,12 @@ scrubs the client report.  Metadata-only: no VDAF compute happens here.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..core.time import time_to_batch_interval_start
-from ..core.trace import new_trace_id
+from ..core.trace import emit_span, new_trace_id
 from ..datastore import (
     AggregationJob,
     AggregationJobState,
@@ -66,16 +67,25 @@ class AggregationJobCreator:
             if task.role != Role.LEADER:
                 continue
             try:
-                created += await self.datastore.run_tx_async(
+                count, job_spans = await self.datastore.run_tx_async(
                     "create_aggregation_jobs",
                     lambda tx, task=task: self.create_jobs_for_task(tx, task),
                 )
+                created += count
+                # Trace LINK point (ISSUE 9), emitted only AFTER the
+                # transaction commits: the tx function re-runs on retryable
+                # conflicts, and a span written mid-attempt would link
+                # upload traces to phantom jobs that never committed.
+                for span in job_spans:
+                    emit_span("job_create", "job", **span)
             except Exception:
                 logger.exception("job creation failed for task %s", task.task_id)
         return created
 
     # -- per-task creation (one transaction) ----------------------------
-    def create_jobs_for_task(self, tx: Transaction, task: AggregatorTask) -> int:
+    def create_jobs_for_task(
+        self, tx: Transaction, task: AggregatorTask
+    ) -> Tuple[int, List[dict]]:
         vdaf = task.vdaf_instance()
         if getattr(vdaf, "REQUIRES_AGG_PARAM", False):
             # VDAFs with a real aggregation parameter (Poplar1) get their
@@ -83,12 +93,12 @@ class AggregationJobCreator:
             # (the reference gates this path behind test-util:
             # aggregation_job_creator.rs:741).
             logger.debug("skipping agg-param task %s", task.task_id)
-            return 0
+            return 0, []
         metas = tx.get_unaggregated_client_reports_for_task(
             task.task_id, self.config.reports_per_round
         )
         if not metas:
-            return 0
+            return 0, []
         if task.query_type.kind == "TimeInterval":
             jobs, leftover = self._group_time_interval(task, metas)
         else:
@@ -106,7 +116,9 @@ class AggregationJobCreator:
             initial_write=True,
         )
         count = 0
+        job_spans: List[dict] = []
         for batch_id, group in jobs:
+            t_job = time.monotonic()
             job_id = AggregationJobId.random()
             start = min(m.time.seconds for m in group)
             end = max(m.time.seconds for m in group) + 1
@@ -125,12 +137,15 @@ class AggregationJobCreator:
                 trace_id=new_trace_id(),
             )
             ras = []
+            upload_traces = set()
             for ord_, meta in enumerate(group):
                 # move payload from client_reports into the StartLeader row,
                 # then scrub (reference: :718-731)
                 report = tx.get_client_report(task.task_id, meta.report_id)
                 if report is None:
                     continue
+                if report.trace_id:
+                    upload_traces.add(report.trace_id)
                 ras.append(
                     ReportAggregation(
                         task_id=task.task_id,
@@ -149,9 +164,27 @@ class AggregationJobCreator:
             if not ras:
                 continue
             writer.put(job, ras)
+            # The job's creation span carries the upload trace ids of the
+            # reports it packs, stitching client ingress (upload-minted
+            # traces) onto the job's cross-process timeline — one view
+            # from upload through prepare to collection.  Collected here,
+            # EMITTED by run_once after the transaction commits: spans are
+            # not transactional, so a mid-attempt emit would survive a
+            # retried/rolled-back attempt as a phantom job.
+            job_spans.append(
+                dict(
+                    start_s=t_job,
+                    dur_s=time.monotonic() - t_job,
+                    trace_id=job.trace_id,
+                    task_id=str(task.task_id),
+                    job_id=str(job_id),
+                    reports=len(ras),
+                    links=sorted(upload_traces),
+                )
+            )
             count += 1
         writer.write(tx)
-        return count
+        return count, job_spans
 
     def _group_time_interval(
         self, task: AggregatorTask, metas: List[ReportMetadata]

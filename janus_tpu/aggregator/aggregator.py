@@ -324,16 +324,27 @@ class Aggregator:
     # upload (reference: aggregator.rs:1522 handle_upload_generic)
 
     async def handle_upload(self, task_id: TaskId, report: Report) -> None:
-        ta = await self.task_aggregator_for(task_id)
-        task = ta.task
-        if task.role != Role.LEADER:
-            raise UnrecognizedTask("upload to non-leader")
-        try:
-            stored = self._validate_and_open_report(ta, report)
-        except ReportRejection as rej:
-            await self.report_writer.write_rejection(task_id, rej)
-            raise rej.to_error()
-        await self.report_writer.write_report(stored)
+        from ..core.trace import current_trace, new_trace_id, trace_scope, trace_span
+
+        # Upload trace mint point (ISSUE 9): adopt the client's strict-hex
+        # traceparent (bound by http_handlers._route when valid) or mint a
+        # fresh 32-hex id.  A malformed header therefore costs the client
+        # nothing — parse_traceparent returned None, we mint, the upload
+        # proceeds.  The id is bound for the whole handler (validation
+        # logs, the upload span) and rides the stored report so job
+        # creation can link prepare back to client ingress.
+        trace_id = current_trace().get("trace_id") or new_trace_id()
+        with trace_scope(trace_id=trace_id), trace_span("upload", cat="upload"):
+            ta = await self.task_aggregator_for(task_id)
+            task = ta.task
+            if task.role != Role.LEADER:
+                raise UnrecognizedTask("upload to non-leader")
+            try:
+                stored = self._validate_and_open_report(ta, report)
+            except ReportRejection as rej:
+                await self.report_writer.write_rejection(task_id, rej)
+                raise rej.to_error()
+            await self.report_writer.write_report(stored)
 
     def _validate_and_open_report(self, ta: TaskAggregator, report: Report) -> LeaderStoredReport:
         task = ta.task

@@ -100,6 +100,9 @@ class CollectionJobDriver:
 
     # ------------------------------------------------------------------
     async def step_collection_job(self, lease: Lease) -> None:
+        import time as _time
+
+        t_step = _time.monotonic()
         acq = lease.leased
         if lease.lease_attempts > self.config.maximum_attempts_before_failure:
             await self.abandon_collection_job(lease)
@@ -272,6 +275,62 @@ class CollectionJobDriver:
             GLOBAL_METRICS.collection_e2e.observe(
                 max(0.0, float(self.datastore.now().seconds - interval.start.seconds))
             )
+
+        # Trace LINK point (ISSUE 9): close the merged timeline's far end.
+        # The collection-finish span links the collected reports' upload
+        # trace ids (persisted on client_reports; they survive scrubbing),
+        # so trace_merge stitches client ingress -> prepare -> collection
+        # into ONE critical path even though the collection job's own
+        # trace id was minted independently of any upload's.
+        collected_batch_id = (
+            BatchId.get_decoded(job.batch_identifier)
+            if task.query_type.kind != "TimeInterval"
+            else None
+        )
+        await self._emit_collection_finish_span(
+            task, interval, collected_batch_id, count, t_step
+        )
+
+    # ------------------------------------------------------------------
+    async def _emit_collection_finish_span(
+        self, task, interval, batch_id, report_count, t_step
+    ) -> None:
+        """Emit the collection-finish span with upload-trace links;
+        failure-tolerant and bounded (at most 512 linked ids) — tracing
+        must never fail a finished collection, and with no span consumer
+        active (no chrome tracer, no OTLP sink) the link query is skipped
+        entirely: the collection hot path pays nothing for tracing that
+        is off.  Linked ids come from the reports AGGREGATED into this
+        batch (``batch_id`` scopes fixed-size tasks), so overlapping
+        collections never chain-merge each other's traces."""
+        import time as _time
+
+        from ..core.trace import emit_span, tracing_active
+
+        if (interval is None and batch_id is None) or not tracing_active():
+            return
+        try:
+            trace_ids = await self.datastore.run_tx_async(
+                "collect_trace_links",
+                lambda tx: tx.get_aggregated_report_trace_ids(
+                    task.task_id,
+                    interval=interval if batch_id is None else None,
+                    batch_id=batch_id,
+                    limit=512,
+                ),
+            )
+        except Exception:
+            logger.exception("collection trace-link lookup failed")
+            trace_ids = []
+        emit_span(
+            "collection_finish",
+            "collection",
+            t_step,
+            _time.monotonic() - t_step,
+            task_id=str(task.task_id),
+            reports=report_count,
+            links=trace_ids,
+        )
 
     # ------------------------------------------------------------------
     async def _replay_outstanding_journal(self, acq) -> None:

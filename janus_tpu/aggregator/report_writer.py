@@ -13,7 +13,9 @@ upload counters (reference: report_writer.rs:324 TaskUploadCounters).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import random
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..datastore import Datastore, LeaderStoredReport, TaskUploadCounter, TxConflict
@@ -33,17 +35,32 @@ class ReportWriteBatcher:
         self.max_batch_size = max_batch_size
         self.max_batch_write_delay = max_batch_write_delay
         self.counter_shard_count = counter_shard_count
-        self._queue: List[Tuple[object, asyncio.Future]] = []
+        #: (report, waiter, enqueue-monotonic) — the timestamp feeds
+        #: janus_report_upload_to_commit_seconds and the upload_commit span
+        self._queue: List[Tuple[object, asyncio.Future, float]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
     async def write_report(self, report: LeaderStoredReport) -> None:
         """Enqueue a validated report; resolves when its batch commits.
-        Raises ReportRejection if the store rejected it."""
+        Raises ReportRejection if the store rejected it.
+
+        Upload trace (ISSUE 9): a report arriving without a trace id
+        adopts the caller's bound trace context or mints a fresh one, so
+        EVERY persisted report carries a 32-hex upload trace — including
+        writes from paths that bypass handle_upload (load generators,
+        soaks seeding through the real writer)."""
+        if report.trace_id is None:
+            from ..core.trace import current_trace, new_trace_id
+
+            report = dataclasses.replace(
+                report,
+                trace_id=current_trace().get("trace_id") or new_trace_id(),
+            )
         fut = asyncio.get_running_loop().create_future()
         async with self._lock:
-            self._queue.append((report, fut))
+            self._queue.append((report, fut, time.monotonic()))
             if len(self._queue) >= self.max_batch_size:
                 await self._flush_locked()
             elif self._flush_handle is None:
@@ -82,19 +99,19 @@ class ReportWriteBatcher:
         # In-batch dedup by (task, report id): first wins, dups succeed as
         # idempotent uploads (reference: report_writer.rs:159-237).
         seen: Dict[bytes, int] = {}
-        unique: List[Tuple[object, List[asyncio.Future]]] = []
-        for report, fut in batch:
+        unique: List[Tuple[object, List[asyncio.Future], float]] = []
+        for report, fut, enqueued in batch:
             key = report.task_id.data + report.report_id.data
             if key in seen:
                 unique[seen[key]][1].append(fut)
             else:
                 seen[key] = len(unique)
-                unique.append((report, [fut]))
+                unique.append((report, [fut], enqueued))
 
         def tx_fn(tx):
             outcomes = []
             shard = random.randrange(self.counter_shard_count)
-            for report, _futs in unique:
+            for report, _futs, _enq in unique:
                 try:
                     tx.put_client_report(report)
                     tx.increment_task_upload_counter(
@@ -118,21 +135,41 @@ class ReportWriteBatcher:
             await faults.fire_async("report_writer.flush")
             outcomes = await self.datastore.run_tx_async("upload_batch", tx_fn)
         except Exception as e:  # commit failed: fan the error to every waiter
-            for _report, futs in unique:
+            for _report, futs, _enq in unique:
                 for fut in futs:
                     if not fut.done():
                         fut.set_exception(e)
             return
+        from ..core.trace import emit_span
+
         have_metrics = GLOBAL_METRICS.registry is not None
         now_s = self.datastore.now().seconds if have_metrics else 0
+        committed = time.monotonic()
         accepted = 0
-        for (report, futs), outcome in zip(unique, outcomes):
-            if outcome is None and have_metrics:
-                accepted += 1
-                # Freshness SLO input: report age at commit (client
-                # timestamp -> writer commit) per accepted report.
-                GLOBAL_METRICS.report_commit_age.observe(
-                    max(0.0, float(now_s - report.time.seconds))
+        for (report, futs, enqueued), outcome in zip(unique, outcomes):
+            if outcome is None:
+                if have_metrics:
+                    accepted += 1
+                    # Freshness SLO input: report age at commit (client
+                    # timestamp -> writer commit) per accepted report.
+                    GLOBAL_METRICS.report_commit_age.observe(
+                        max(0.0, float(now_s - report.time.seconds))
+                    )
+                    # Front-door SLO input (ISSUE 9): how long the batcher
+                    # held the report before it was durable.
+                    GLOBAL_METRICS.upload_to_commit.observe(
+                        max(0.0, committed - enqueued)
+                    )
+                # Per-report CHILD span stamped with the UPLOAD's trace id
+                # (the flush_share pattern): the client-ingress hop of the
+                # merged timeline, enqueue -> batch commit.
+                emit_span(
+                    "upload_commit",
+                    "upload",
+                    enqueued,
+                    committed - enqueued,
+                    trace_id=report.trace_id,
+                    task_id=str(report.task_id),
                 )
             for fut in futs:
                 if fut.done():

@@ -130,6 +130,25 @@ class CommonConfig:
     #: Idle threshold for executor-bucket gauge retirement (cardinality
     #: cap); <= 0 keeps every bucket's series forever (pre-ISSUE-5 shape).
     executor_bucket_idle_s: float = 600.0
+    #: OTLP collector endpoint (core/otlp.py), e.g.
+    #: ``http://otel-collector:4318`` — when set, ChromeTracer spans and
+    #: the metric registry are exported OTLP/HTTP on the status-sampler
+    #: cadence.  Import-gated on the opentelemetry-sdk: without the lib
+    #: the exporter is a first-class no-op and /statusz's "otlp" section
+    #: says "unavailable".  Empty = no export.
+    otlp_endpoint: str = ""
+    #: Declarative SLO targets (core/slo.py), evaluated by the status
+    #: sampler into janus_slo_burn_rate{slo,window} /
+    #: janus_slo_breach_total{slo} and the /statusz "slo" section::
+    #:
+    #:     slos:
+    #:       commit_age:     {objective: 0.99, threshold_s: 60}
+    #:       collection_e2e: {objective: 0.95, threshold_s: 600}
+    #:
+    #: Signals: commit_age, upload_to_commit, job_age_at_acquire,
+    #: collection_e2e, first_flush (or any raw janus_* histogram name via
+    #: ``signal:``).  Empty = no SLO evaluation.
+    slos: dict = field(default_factory=dict)
     #: Fleet-wide persistent XLA compile cache ROOT (utils/jax_setup.py):
     #: when set, every binary points jax's compilation cache at
     #: ``<dir>/<config-digest>`` at startup, so a restarted replica (crash

@@ -90,6 +90,31 @@ def _bootstrap(config_common):
     if getattr(config_common, "chrome_trace_path", ""):
         configure_chrome_trace(config_common.chrome_trace_path)
         logger.info("chrome trace -> %s", config_common.chrome_trace_path)
+    if getattr(config_common, "otlp_endpoint", ""):
+        # OTLP export (ISSUE 9): import-gated on the opentelemetry-sdk —
+        # a config naming a collector must start cleanly on an SDK-less
+        # host, with /statusz saying exactly why nothing is exported.
+        from ..core.otlp import configure_otlp
+
+        exporter = configure_otlp(config_common.otlp_endpoint)
+        if exporter is not None and exporter.available:
+            logger.info("otlp export -> %s", config_common.otlp_endpoint)
+        else:
+            logger.warning(
+                "otlp export -> %s UNAVAILABLE (opentelemetry-sdk not "
+                "installed); exporter is inert",
+                config_common.otlp_endpoint,
+            )
+    if getattr(config_common, "slos", None):
+        # SLO evaluation plane (ISSUE 9): declarative targets, evaluated
+        # on the status-sampler tick.  Config typos fail startup loudly.
+        from ..core.slo import configure_slos
+
+        evaluator = configure_slos(config_common.slos)
+        logger.info(
+            "slo evaluator armed: %s",
+            ", ".join(t.name for t in evaluator.targets),
+        )
     if getattr(config_common, "profiler_port", 0):
         if start_profiler_server(config_common.profiler_port):
             logger.info("jax profiler server on :%d", config_common.profiler_port)
@@ -191,10 +216,42 @@ def _start_status_sampler(stop: asyncio.Event, datastore: Datastore, common):
     if not interval or interval <= 0:
         return None
 
+    from ..core.otlp import export_tick, otlp_exporter
+    from ..core.slo import evaluate_tick
     from ..core.statusz import retire_idle_executor_buckets, sample_status_metrics
 
     async def loop_():
+        export_fut = None
         while not stop.is_set():
+            # Self-evaluation rides the same tick (ISSUE 9) but NOT the
+            # same failure domain: the evaluator reads only in-memory
+            # registry snapshots, so it runs FIRST and in its own try —
+            # a wedged datastore (the sampling below raising every tick)
+            # is exactly when burn rates must keep moving.
+            try:
+                evaluate_tick()
+            except Exception:
+                logger.exception("slo evaluation tick failed")
+            # OTLP export is fired WITHOUT awaiting: a slow/blackholed
+            # collector (up to the exporter's timeout per POST) must not
+            # stretch the sampling cadence.  At most one export is in
+            # flight; a tick that finds the previous one still running
+            # skips (export_once drains the whole queue each pass, so
+            # nothing is lost).  Unconfigured (the default) or inert
+            # (SDK absent — already logged at bootstrap, visible in
+            # /statusz): no dispatch at all.
+            exporter = otlp_exporter()
+            if (
+                exporter is not None
+                and exporter.available
+                and (export_fut is None or export_fut.done())
+            ):
+                export_fut = asyncio.get_running_loop().run_in_executor(
+                    None, export_tick
+                )
+                export_fut.add_done_callback(
+                    lambda f: f.exception()  # surfaced in otlp health; never raises past export_tick
+                )
             try:
                 await asyncio.get_running_loop().run_in_executor(
                     None, lambda: sample_status_metrics(datastore)
@@ -208,6 +265,8 @@ def _start_status_sampler(stop: asyncio.Event, datastore: Datastore, common):
                 await asyncio.wait_for(stop.wait(), timeout=interval)
             except asyncio.TimeoutError:
                 pass
+        if export_fut is not None:
+            await asyncio.gather(export_fut, return_exceptions=True)
 
     return asyncio.ensure_future(loop_())
 

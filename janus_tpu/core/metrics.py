@@ -548,6 +548,57 @@ class Metrics:
             registry=self.registry,
         )
 
+        # -- client-ingress observability (ISSUE 9 tentpole) -------------
+        # Upload acceptance latency as the CLIENT experiences it: from the
+        # handler enqueueing the validated report into the write batcher to
+        # the batch transaction committing it.  The front-door half of the
+        # freshness story — report_commit_age measures how old the report
+        # was, this measures how long WE held it before it was durable.
+        self.upload_to_commit = Histogram(
+            "janus_report_upload_to_commit_seconds",
+            "Upload handler enqueue to batch-commit latency per accepted report",
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        # -- SLO evaluation plane (core/slo.py) --------------------------
+        # Burn rate = window error rate / error budget: 1.0 means the SLO
+        # spends its budget exactly at the sustainable pace, >1 means it
+        # will exhaust early.  One sample per (slo, fast|slow) per
+        # evaluator tick.
+        self.slo_burn_rate = Gauge(
+            "janus_slo_burn_rate",
+            "Multi-window SLO burn rate (window error rate / error budget)",
+            ["slo", "window"],
+            registry=self.registry,
+        )
+        self.slo_breaches = Counter(
+            "janus_slo_breach_total",
+            "SLO breaches: transitions into fast AND slow burn above threshold",
+            ["slo"],
+            registry=self.registry,
+        )
+        # -- OTLP export health (core/otlp.py) ---------------------------
+        # The exporter itself must be observable: spans queued vs dropped
+        # (lib absent, queue overflow) and export attempts by outcome tell
+        # an operator whether the collector is actually receiving data.
+        self.otlp_spans = Counter(
+            "janus_otlp_spans_total",
+            "Spans through the OTLP exporter by outcome (queued|exported|dropped)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.otlp_exports = Counter(
+            "janus_otlp_exports_total",
+            "OTLP export attempts by outcome (ok|error|noop)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.otlp_last_export_age = Gauge(
+            "janus_otlp_last_export_age_seconds",
+            "Seconds since the last successful OTLP export (-1 when never)",
+            registry=self.registry,
+        )
+
     # -- introspection ---------------------------------------------------
     def get_sample_value(self, name: str, labels: Optional[dict] = None):
         """Read one sample (Prometheus sample naming: ``..._total``,

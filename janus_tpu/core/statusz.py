@@ -25,6 +25,8 @@ _PROCESS_START = time.monotonic()
 def runtime_status() -> dict:
     """Process-local sections (no datastore): safe to call anywhere."""
     from . import faults
+    from .otlp import otlp_health
+    from .slo import slo_status
     from .trace import chrome_trace_path, current_trace
 
     doc: dict = {
@@ -35,6 +37,12 @@ def runtime_status() -> dict:
             "chrome_trace_path": chrome_trace_path(),
             "context": current_trace() or None,
         },
+        # OTLP export health (ISSUE 9): queued/dropped/last-export-age, or
+        # the explicit unavailable marker when the SDK is absent
+        "otlp": otlp_health(),
+        # SLO evaluation plane (ISSUE 9): per-objective burn rates and
+        # breach state from the sampler-driven evaluator
+        "slo": slo_status(),
         "faults": faults.snapshot(),
     }
 
@@ -50,14 +58,31 @@ def runtime_status() -> dict:
             "buckets": ex.stats(),
             "circuits": ex.circuit_stats(),
             # per-shape compile ledger (ISSUE 8): cold / warming / warm
-            # (+ last compile_s) / failed — the first thing to curl when a
-            # fresh task's flushes look slow
+            # (+ last compile_s) / failed, each with the age of its state
+            # — the first thing to curl when a fresh task's flushes look
+            # slow
             "compile": ex.compile_stats(),
+            # why shapes kept exact-shape compiles (ISSUE 9 satellite):
+            # pow2-canonicalization plan outcomes, counted per reason
+            "canonicalization": _canonicalization_stats(),
         }
         doc["accumulator"] = (
             ex.accumulator.stats() if ex.accumulator is not None else None
         )
     return doc
+
+
+def _canonicalization_stats() -> dict:
+    """Counted canonicalization-plan outcomes (vdaf/canonical.py); lazy
+    and failure-tolerant — control-plane binaries may never import the
+    vdaf layer, and /statusz must not force (or break on) it."""
+    try:
+        from ..vdaf.canonical import plan_stats
+
+        return plan_stats()
+    except Exception:
+        logger.exception("canonicalization stats unavailable")
+        return {"error": "unavailable"}
 
 
 async def statusz_snapshot(datastore=None, clock=None) -> dict:

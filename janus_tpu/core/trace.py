@@ -177,6 +177,37 @@ def reload_trace_filter(level: str) -> None:
     logging.getLogger().setLevel(getattr(logging, level.upper(), logging.INFO))
 
 
+# -- span sinks --------------------------------------------------------------
+# Secondary consumers of closed spans (the OTLP exporter, core/otlp.py):
+# callables ``sink(name, cat, epoch_start_s, dur_s, args)``.  Spans reach
+# sinks whether or not chrome tracing is configured — the ChromeTracer
+# forwards from emit(), and the module-level span helpers forward directly
+# when no tracer exists.  Sink errors are swallowed: an export problem must
+# never break the traced code path.
+
+_SPAN_SINKS: list = []
+
+
+def register_span_sink(sink) -> None:
+    if sink not in _SPAN_SINKS:
+        _SPAN_SINKS.append(sink)
+
+
+def unregister_span_sink(sink) -> None:
+    try:
+        _SPAN_SINKS.remove(sink)
+    except ValueError:
+        pass
+
+
+def _forward_span(name: str, cat: str, epoch_start_s: float, dur_s: float, args: dict) -> None:
+    for sink in list(_SPAN_SINKS):
+        try:
+            sink(name, cat, epoch_start_s, dur_s, args)
+        except Exception:
+            pass
+
+
 # -- chrome-trace export -----------------------------------------------------
 # The analog of the reference's chrome tracing layer (trace.rs:145-156
 # ChromeLayer): spans around job steps / device launches, written in the
@@ -215,13 +246,14 @@ class ChromeTracer:
             self._f.write("\n")
         self.pid = os.getpid()
         self._t0 = time.monotonic()
+        self._epoch_t0 = time.time()
         self._write_event(
             {
                 "name": "clock_sync",
                 "ph": "M",
                 "pid": self.pid,
                 "tid": 0,
-                "args": {"epoch_t0": time.time()},
+                "args": {"epoch_t0": self._epoch_t0},
             }
         )
         self._write_event(
@@ -275,6 +307,10 @@ class ChromeTracer:
         if args:
             ev["args"] = args
         self._write_event(ev)
+        if _SPAN_SINKS:
+            _forward_span(
+                name, cat, self._epoch_t0 + (start_s - self._t0), dur_s, dict(args)
+            )
 
     def span(self, name: str, cat: str = "job", **args):
         return _Span(self, name, cat, args)
@@ -349,10 +385,55 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+class _SinkSpan:
+    """Span measured for the registered sinks only (OTLP configured while
+    chrome tracing is off) — mirrors _Span's context inheritance."""
+
+    def __init__(self, name: str, cat: str, args: dict):
+        self.name, self.cat, self.args = name, cat, args
+
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, *_):
+        _sink_emit(
+            self.name,
+            self.cat,
+            self.start,
+            time.monotonic() - self.start,
+            dict(self.args, ok=exc_type is None),
+        )
+        return False
+
+
+def _sink_emit(name: str, cat: str, start_mono_s: float, dur_s: float, args: dict) -> None:
+    """Forward a monotonic-timed span to the sinks with the bound trace
+    context merged in (the ChromeTracer-less twin of ChromeTracer.emit)."""
+    ctx = _TRACE_CTX.get()
+    for key in TRACE_CTX_KEYS:
+        if key not in args and ctx.get(key) is not None:
+            args[key] = ctx[key]
+    epoch_start = time.time() - (time.monotonic() - start_mono_s)
+    _forward_span(name, cat, epoch_start, dur_s, args)
+
+
+def tracing_active() -> bool:
+    """True when SOME span consumer exists (chrome tracer or a sink) —
+    the cheap guard for span producers whose data gathering is itself
+    expensive (e.g. a datastore query feeding a link span)."""
+    return _GLOBAL_TRACER is not None or bool(_SPAN_SINKS)
+
+
 def trace_span(name: str, cat: str = "job", **args):
-    """Span against the global tracer; free no-op when tracing is off."""
+    """Span against the global tracer (and any registered span sinks);
+    free no-op when both are off."""
     t = _GLOBAL_TRACER
-    return t.span(name, cat, **args) if t is not None else _NULL_SPAN
+    if t is not None:
+        return t.span(name, cat, **args)
+    if _SPAN_SINKS:
+        return _SinkSpan(name, cat, args)
+    return _NULL_SPAN
 
 
 def emit_span(name: str, cat: str, start_s: float, dur_s: float, **args) -> None:
@@ -365,6 +446,8 @@ def emit_span(name: str, cat: str, start_s: float, dur_s: float, **args) -> None
     t = _GLOBAL_TRACER
     if t is not None:
         t.emit(name, cat, start_s, dur_s, **args)
+    elif _SPAN_SINKS:
+        _sink_emit(name, cat, start_s, dur_s, dict(args))
 
 
 def start_profiler_server(port: int) -> bool:

@@ -533,8 +533,8 @@ class Transaction:
             self.conn.execute(
                 """INSERT INTO client_reports (task_id, report_id, client_timestamp,
                     extensions, public_share, leader_input_share,
-                    helper_encrypted_input_share, created_at)
-                   VALUES (?,?,?,?,?,?,?,?)""",
+                    helper_encrypted_input_share, trace_id, created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?)""",
                 (
                     pk,
                     report.report_id.data,
@@ -543,6 +543,7 @@ class Transaction:
                     report.public_share,
                     enc_share,
                     report.helper_encrypted_input_share.get_encoded(),
+                    report.trace_id,
                     self._now_s(),
                 ),
             )
@@ -555,13 +556,13 @@ class Transaction:
         pk = self._task_pk(task_id)
         row = self.conn.execute(
             """SELECT client_timestamp, extensions, public_share,
-                      leader_input_share, helper_encrypted_input_share
+                      leader_input_share, helper_encrypted_input_share, trace_id
                FROM client_reports WHERE task_id = ? AND report_id = ?""",
             (pk, report_id.data),
         ).fetchone()
         if row is None:
             return None
-        ts, ext_b, public_share, enc_share, helper_b = row
+        ts, ext_b, public_share, enc_share, helper_b, trace_id = row
         if enc_share is None:
             return None  # scrubbed
         row_ident = task_id.data + report_id.data
@@ -575,6 +576,7 @@ class Transaction:
             leader_extensions=_decode_extensions(ext_b) if ext_b else [],
             leader_input_share=share,
             helper_encrypted_input_share=HpkeCiphertext.get_decoded(helper_b),
+            trace_id=trace_id,
         )
 
     def check_client_report_exists(self, task_id: TaskId, report_id: ReportId) -> bool:
@@ -654,7 +656,7 @@ class Transaction:
         pk = self._task_pk(task_id)
         rows = self.conn.execute(
             """SELECT report_id, client_timestamp, extensions, public_share,
-                      leader_input_share, helper_encrypted_input_share
+                      leader_input_share, helper_encrypted_input_share, trace_id
                FROM client_reports
                WHERE task_id = ? AND client_timestamp >= ? AND client_timestamp < ?
                  AND leader_input_share IS NOT NULL
@@ -662,7 +664,7 @@ class Transaction:
             (pk, interval.start.seconds, interval.end().seconds, limit),
         ).fetchall()
         out = []
-        for rid, ts, ext_b, public_share, enc_share, helper_b in rows:
+        for rid, ts, ext_b, public_share, enc_share, helper_b, trace_id in rows:
             share = self.crypter.decrypt(
                 "client_reports", task_id.data + rid, "leader_input_share", enc_share
             )
@@ -674,6 +676,7 @@ class Transaction:
                     leader_extensions=_decode_extensions(ext_b) if ext_b else [],
                     leader_input_share=share,
                     helper_encrypted_input_share=HpkeCiphertext.get_decoded(helper_b),
+                    trace_id=trace_id,
                 )
             )
         return out
@@ -707,6 +710,41 @@ class Transaction:
                WHERE task_id = ? AND client_timestamp >= ? AND client_timestamp < ?""",
             (pk, interval.start.seconds, interval.end().seconds),
         ).fetchone()[0]
+
+    def get_aggregated_report_trace_ids(
+        self,
+        task_id: TaskId,
+        interval: Optional[Interval] = None,
+        batch_id: Optional[BatchId] = None,
+        limit: int = 512,
+    ) -> List[str]:
+        """Distinct upload trace ids of reports AGGREGATED into a batch
+        (ISSUE 9): the collection driver links them into its
+        collection-finish span so the merged timeline runs client ingress
+        -> collection.  Membership is by report_aggregations join — not a
+        bare client_reports time scan — so unaggregated leftovers and
+        (for fixed-size tasks, via ``batch_id``) reports packed into
+        OTHER batches in the same time range never leak into another
+        collection's merged trace.  Scrubbing nulls the share columns but
+        keeps trace_id, so linked ids survive packing; GC-deleted rows
+        simply drop out."""
+        pk = self._task_pk(task_id)
+        sql = """SELECT DISTINCT cr.trace_id
+                 FROM report_aggregations ra
+                 JOIN aggregation_jobs aj ON ra.aggregation_job_id = aj.id
+                 JOIN client_reports cr
+                   ON cr.task_id = ra.task_id AND cr.report_id = ra.report_id
+                 WHERE ra.task_id = ? AND cr.trace_id IS NOT NULL"""
+        params: list = [pk]
+        if batch_id is not None:
+            sql += " AND aj.batch_id = ?"
+            params.append(batch_id.data)
+        if interval is not None:
+            sql += " AND ra.client_timestamp >= ? AND ra.client_timestamp < ?"
+            params += [interval.start.seconds, interval.end().seconds]
+        sql += " ORDER BY cr.trace_id LIMIT ?"
+        params.append(limit)
+        return [r[0] for r in self.conn.execute(sql, params).fetchall()]
 
     def count_unaggregated_client_reports_for_interval(
         self, task_id: TaskId, interval: Interval

@@ -49,6 +49,10 @@ class LeaderStoredReport:
     leader_extensions: List[Extension]
     leader_input_share: bytes  # encoded plaintext leader input share
     helper_encrypted_input_share: HpkeCiphertext
+    #: 32-hex upload trace id (core/trace.py, ISSUE 9): adopted from the
+    #: client's strict-hex ``traceparent`` or minted at upload; persisted
+    #: so aggregation-job creation can link jobs back to client ingress.
+    trace_id: Optional[str] = None
 
     @property
     def report_id(self) -> ReportId:

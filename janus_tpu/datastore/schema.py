@@ -270,9 +270,26 @@ ALTER TABLE aggregation_jobs ADD COLUMN trace_id TEXT;
 ALTER TABLE collection_jobs ADD COLUMN trace_id TEXT;
 """
 
+_UPLOAD_TRACE_SCHEMA = """
+-- Upload-minted trace ids (core/trace.py, ISSUE 9): the client-ingress
+-- hop of cross-process correlation.  handle_upload adopts a strict-hex
+-- client ``traceparent`` (or mints a fresh 32-hex id when the header is
+-- absent/malformed) and the report writer persists it here, so the
+-- aggregation-job creator can link each job's span back to the upload
+-- traces of the reports it packs — one merged timeline from client
+-- ingress through prepare to collection.  TEXT, nullable: rows from
+-- older schema versions simply have no upload trace.
+ALTER TABLE client_reports ADD COLUMN trace_id TEXT;
+"""
+
 #: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
 #: edit an entry that has shipped (existing stores have already applied it).
-MIGRATIONS = [_INITIAL_SCHEMA, _ACCUMULATOR_JOURNAL_SCHEMA, _TRACE_CONTEXT_SCHEMA]
+MIGRATIONS = [
+    _INITIAL_SCHEMA,
+    _ACCUMULATOR_JOURNAL_SCHEMA,
+    _TRACE_CONTEXT_SCHEMA,
+    _UPLOAD_TRACE_SCHEMA,
+]
 
 SCHEMA_VERSION = len(MIGRATIONS)
 

@@ -429,6 +429,7 @@ class DeviceExecutor:
                         "compile_s": None,
                         "error": None,
                         "future": None,
+                        "since": time.monotonic(),
                     }
         if created and self.config.warmup_rows and hasattr(b, "stage_prep_init_multi"):
             self._schedule_warmup(shape_key, b)
@@ -473,7 +474,7 @@ class DeviceExecutor:
         breaker is untouched — compile trouble is not device sickness."""
         state = self._warmup_state[shape_key]
         if not self.config.warmup_async:
-            state["state"] = "warming"
+            state.update(state="warming", since=time.monotonic())
             self._do_warmup(shape_key, backend)
             return
         with self._lock:
@@ -483,7 +484,7 @@ class DeviceExecutor:
                 self._warmup_pool = ThreadPoolExecutor(
                     1, thread_name_prefix="janus-exec-warmup"
                 )
-            state["state"] = "warming"
+            state.update(state="warming", since=time.monotonic())
             state["future"] = self._warmup_pool.submit(
                 self._do_warmup, shape_key, backend
             )
@@ -504,7 +505,10 @@ class DeviceExecutor:
             ):
                 n = self.warmup_backend(backend)
             dt = time.monotonic() - t0
-            state.update(state="warm", compile_s=round(dt, 3), error=None)
+            state.update(
+                state="warm", compile_s=round(dt, 3), error=None,
+                since=time.monotonic(),
+            )
             outcome = "ok"
             if n:
                 logger.info(
@@ -517,7 +521,10 @@ class DeviceExecutor:
                 )
         except Exception as e:
             dt = time.monotonic() - t0
-            state.update(state="failed", compile_s=round(dt, 3), error=str(e)[:200])
+            state.update(
+                state="failed", compile_s=round(dt, 3), error=str(e)[:200],
+                since=time.monotonic(),
+            )
             outcome = "error"
             logger.exception("executor warmup failed for %s (serving cold)", label)
         if GLOBAL_METRICS.registry is not None:
@@ -551,7 +558,12 @@ class DeviceExecutor:
 
     def compile_stats(self) -> Dict[str, dict]:
         """Per-shape compile ledger for /statusz: cold (resolved, never
-        warmed), warming, warm (last compile_s), or failed (error)."""
+        warmed), warming, warm (last compile_s), or failed (error) — each
+        with ``age_s``, the time the shape has sat in its current state
+        (a warming age of minutes is a compile an operator should be
+        watching; a warm age across a restart window proves the
+        persistent cache paid off)."""
+        now = time.monotonic()
         with self._lock:
             out = {}
             for shape_key, st in self._warmup_state.items():
@@ -563,6 +575,7 @@ class DeviceExecutor:
                     "state": st["state"],
                     "compile_s": st["compile_s"],
                     "error": st["error"],
+                    "age_s": round(now - st.get("since", now), 1),
                 }
             return out
 

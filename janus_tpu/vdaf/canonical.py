@@ -176,6 +176,26 @@ def canonicalization_reason(vdaf) -> str:
     return _plan(vdaf)[1]
 
 
+def plan_stats() -> dict:
+    """Counted plan outcomes across every shape this process has resolved
+    (the memoized _PLAN_CACHE): how many canonicalized, and the per-reason
+    counts of shapes that kept exact-shape compiles.  Surfaced in the
+    /statusz "compile" neighborhood (ISSUE 9 satellite) so an operator
+    can see at a glance WHY a fleet's shape count is not collapsing."""
+    reasons: dict = {}
+    canonicalized = 0
+    for canon, reason in list(_PLAN_CACHE.values()):
+        if canon is not None:
+            canonicalized += 1
+        else:
+            reasons[reason] = reasons.get(reason, 0) + 1
+    return {
+        "planned": len(_PLAN_CACHE),
+        "canonicalized": canonicalized,
+        "exact_reasons": reasons,
+    }
+
+
 def canonical_vdaf_for(vdaf):
     """The canonical Prio3 twin this task's prepare graphs compile for,
     or None when the task must keep an exact-shape backend (including

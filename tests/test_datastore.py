@@ -212,6 +212,73 @@ class TestClientReports:
             lambda tx: tx.check_client_report_exists(task.task_id, report.report_id),
         )
 
+    def test_upload_trace_id_round_trips_and_survives_scrub(self, ds):
+        """ISSUE 9: the trace_id column (schema v4) persists the upload
+        trace, reads back on every report accessor, survives scrubbing
+        (only share payloads are nulled), and the interval query dedups."""
+        import dataclasses
+
+        from janus_tpu.messages import Duration, Interval
+
+        task = make_task()
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        tid_a, tid_b = "a" * 32, "b" * 32
+        reports = [
+            dataclasses.replace(make_report(task.task_id, 1_600_000_000), trace_id=tid_a),
+            dataclasses.replace(make_report(task.task_id, 1_600_000_001), trace_id=tid_a),
+            dataclasses.replace(make_report(task.task_id, 1_600_000_002), trace_id=tid_b),
+            make_report(task.task_id, 1_600_000_003),  # pre-v4 shape: no trace
+        ]
+        for r in reports:
+            ds.run_tx("putr", lambda tx, r=r: tx.put_client_report(r))
+        got = ds.run_tx(
+            "getr",
+            lambda tx: tx.get_client_report(task.task_id, reports[0].report_id),
+        )
+        assert got.trace_id == tid_a
+        interval = Interval(Time(1_600_000_000), Duration(100))
+        full = ds.run_tx(
+            "geti",
+            lambda tx: tx.get_client_reports_for_interval(task.task_id, interval, 10),
+        )
+        assert [r.trace_id for r in full] == [tid_a, tid_a, tid_b, None]
+        # pack reports[0] and [2] into aggregation jobs — [2] into a
+        # fixed-size batch — leaving [1] and [3] unaggregated, then scrub
+        # [2] (what the creator does after packing)
+        batch = BatchId.random()
+        job_a = put_job(ds, task)
+        job_b = put_job(ds, task, batch_id=batch)
+        for job, rep in ((job_a, reports[0]), (job_b, reports[2])):
+            ra = ReportAggregation(
+                task_id=task.task_id,
+                aggregation_job_id=job.aggregation_job_id,
+                report_id=rep.report_id,
+                time=rep.time,
+                ord=0,
+                state=ReportAggregationState.FINISHED,
+            )
+            ds.run_tx("putra", lambda tx, ra=ra: tx.put_report_aggregation(ra))
+        ds.run_tx(
+            "scrub",
+            lambda tx: tx.scrub_client_report(task.task_id, reports[2].report_id),
+        )
+        # link query is membership-scoped: only AGGREGATED reports' traces
+        # (tid_a via job_a, tid_b via job_b despite the scrub); the
+        # unaggregated tid_a duplicate and the traceless report never leak
+        assert ds.run_tx(
+            "traces",
+            lambda tx: tx.get_aggregated_report_trace_ids(
+                task.task_id, interval=interval, limit=10
+            ),
+        ) == [tid_a, tid_b]
+        # batch_id scoping: a fixed-size collection links ONLY its batch
+        assert ds.run_tx(
+            "traces-batch",
+            lambda tx: tx.get_aggregated_report_trace_ids(
+                task.task_id, batch_id=batch, limit=10
+            ),
+        ) == [tid_b]
+
     def test_counts_and_gc(self, ds):
         task = make_task()
         ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))

@@ -114,6 +114,70 @@ def test_hpke_config_and_upload(loop):
     eds.cleanup()
 
 
+def test_upload_traceparent_adoption_and_malformed_hardening(loop):
+    """ISSUE 9: a strict-hex client ``traceparent`` is adopted onto the
+    stored report; ANY malformed header mints a fresh 32-hex id and never
+    rejects the upload (the header is observability, not protocol)."""
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    eds, app = make_env(leader)
+    vdaf = leader.vdaf_instance()
+
+    def _report(m):
+        return prepare_report(
+            vdaf,
+            leader.task_id,
+            leader.hpke_keys[0].config,
+            helper.hpke_keys[0].config,
+            TIME_PRECISION,
+            m,
+            time=NOW,
+        )
+
+    async def flow():
+        client = await _client(app)
+        try:
+            good_tid = "ab" * 16
+            cases = [
+                (f"00-{good_tid}-00f067aa0ba902b7-01", good_tid),  # adopted
+                ("garbage", None),
+                (f"00-{'zz' * 16}-00f067aa0ba902b7-01", None),  # non-hex
+                ("00-" + "0" * 32 + "-00f067aa0ba902b7-01", None),  # all-zero
+                (None, None),  # absent header
+            ]
+            stored_ids = []
+            for header, expect in cases:
+                report = _report(1)
+                headers = {"traceparent": header} if header is not None else {}
+                resp = await client.put(
+                    f"/tasks/{leader.task_id}/reports",
+                    data=report.get_encoded(),
+                    headers=headers,
+                )
+                assert resp.status == 201, (header, await resp.text())
+                stored = eds.datastore.run_tx(
+                    "get",
+                    lambda tx, r=report: tx.get_client_report(
+                        leader.task_id, r.metadata.report_id
+                    ),
+                )
+                assert stored is not None
+                assert stored.trace_id and len(stored.trace_id) == 32
+                assert all(c in "0123456789abcdef" for c in stored.trace_id)
+                if expect is not None:
+                    assert stored.trace_id == expect
+                else:
+                    assert stored.trace_id != good_tid
+                stored_ids.append(stored.trace_id)
+            # minted ids are fresh per upload, not a shared constant
+            minted = stored_ids[1:]
+            assert len(set(minted)) == len(minted)
+        finally:
+            await client.close()
+
+    loop.run_until_complete(flow())
+    eds.cleanup()
+
+
 def test_aggregation_job_http_flow(loop):
     leader, helper, _ = make_pair_tasks({"type": "Prio3Histogram", "length": 4, "chunk_length": 2})
     eds, app = make_env(helper)

@@ -136,6 +136,63 @@ class TestTraceMerge:
                     + ",\n"
                 )
 
+    def test_stats_stitches_linked_traces_into_one_critical_path(self, tmp_path):
+        """ISSUE 9: upload-minted trace ids live in the client/aggregator
+        process, job trace ids in the drivers — the job_create and
+        collection_finish LINK spans union them, and --stats reports the
+        upload -> commit -> first flush -> collection path."""
+        from tools.trace_merge import trace_stats
+
+        up, job = "1" * 32, "2" * 32
+        client = str(tmp_path / "client.json")
+        driver = str(tmp_path / "driver.json")
+        self._write_trace(
+            client,
+            11,
+            1000.0,
+            [
+                ("upload", 0.0, {"trace_id": up}),
+                ("upload_commit", 20.0, {"trace_id": up}),
+                ("job_create", 100.0, {"trace_id": job, "links": [up]}),
+                ("collection_finish", 5000.0, {"links": [up]}),
+            ],
+        )
+        self._write_trace(
+            driver,
+            22,
+            1000.5,
+            [
+                ("job_step", 500.0, {"trace_id": job}),
+                ("flush_share", 600.0, {"trace_id": job}),
+            ],
+        )
+        stats = trace_stats([client, driver])
+        assert stats["complete_paths"] == 1
+        (g,) = stats["merged_traces"]
+        assert set(g["trace_ids"]) == {up, job}
+        assert g["pids"] == [11, 22]
+        d = g["durations_s"]
+        # hand-computed on the rebased timeline (driver is +0.5s):
+        # upload@0, commit ends 20us+10us dur, flush@0.5s+600us, collect
+        # ends 5000us+10us
+        assert d["upload_to_commit"] == pytest.approx(30e-6)
+        assert d["commit_to_first_flush"] == pytest.approx(0.50057, abs=1e-5)
+        assert d["upload_to_collection"] == pytest.approx(5010e-6)
+        assert g["complete"]
+
+    def test_stats_incomplete_path_reported_as_such(self, tmp_path):
+        from tools.trace_merge import trace_stats
+
+        p = str(tmp_path / "only-upload.json")
+        self._write_trace(
+            p, 11, 1000.0, [("upload_commit", 0.0, {"trace_id": "3" * 32})]
+        )
+        stats = trace_stats([p])
+        assert stats["complete_paths"] == 0
+        (g,) = stats["merged_traces"]
+        assert not g["complete"]
+        assert g["durations_s"]["upload_to_collection"] is None
+
     def test_merge_rebases_filters_and_survives_partial_lines(self, tmp_path):
         from tools.trace_merge import merge_trace_files
 
@@ -160,6 +217,118 @@ class TestTraceMerge:
         # filtering to one trace id keeps both processes' spans
         summary2 = merge_trace_files([a, b], out, trace_id=tid)
         assert summary2["traces"] == {tid: [101, 202]}
+
+
+# ---------------------------------------------------------------------------
+# OTLP export: the no-op path is first-class (ISSUE 9)
+
+
+class TestOtlpNoop:
+    """This container has the opentelemetry API but NOT the SDK — exactly
+    the deployment the import gate exists for.  Everything here must hold
+    wherever the SDK is absent; tests force the gate closed so they stay
+    meaningful if the SDK ever lands in the image."""
+
+    @pytest.fixture
+    def gate_closed(self, monkeypatch):
+        from janus_tpu.core import otlp as otlp_mod
+
+        monkeypatch.setattr(otlp_mod, "HAVE_OTEL_SDK", False)
+        yield otlp_mod
+        otlp_mod.configure_otlp(None)
+
+    def test_import_is_gated_on_the_sdk_not_the_api(self):
+        # the bare opentelemetry API package (present here) must not open
+        # the gate: only the SDK can actually export
+        import importlib.util
+
+        from janus_tpu.core.otlp import HAVE_OTEL_SDK
+
+        has_sdk = importlib.util.find_spec("opentelemetry.sdk") is not None
+        assert HAVE_OTEL_SDK == has_sdk
+
+    def test_exporter_is_inert_without_the_sdk(self, gate_closed):
+        exp = gate_closed.configure_otlp("http://127.0.0.1:9")
+        assert exp is not None and not exp.available
+        # spans offered are counted as dropped, never raise, never queue
+        exp.record_span("x", "job", 0.0, 1.0, {"trace_id": "a" * 32})
+        assert exp.export_once(Metrics(force_fallback=True)) is False
+        h = exp.health()
+        assert h["state"] == "unavailable"
+        assert h["reason"] and "opentelemetry-sdk" in h["reason"]
+        assert h["dropped_total"] == 1 and h["queued"] == 0
+        assert h["last_export_age_s"] is None
+
+    def test_inert_exporter_never_registers_the_span_sink(self, gate_closed):
+        exp = gate_closed.configure_otlp("http://127.0.0.1:9")
+        assert exp.record_span not in trace_mod._SPAN_SINKS
+
+    def test_statusz_says_unavailable(self, gate_closed):
+        from janus_tpu.core.statusz import runtime_status
+
+        gate_closed.configure_otlp("http://127.0.0.1:9")
+        doc = runtime_status()
+        assert doc["otlp"]["state"] == "unavailable"
+        assert doc["otlp"]["endpoint"] == "http://127.0.0.1:9"
+
+    def test_binary_bootstrap_config_path_never_raises(self, gate_closed):
+        # the exact call _bootstrap makes when common.otlp_endpoint is set
+        exp = gate_closed.configure_otlp("http://collector:4318")
+        assert exp is not None
+        gate_closed.export_tick()  # sampler tick with an inert exporter
+        assert gate_closed.otlp_health()["state"] == "unavailable"
+
+    def test_unconfigured_health_is_explicit(self, gate_closed):
+        gate_closed.configure_otlp(None)
+        h = gate_closed.otlp_health()
+        assert h["state"] == "unavailable" and h["endpoint"] is None
+
+    def test_metrics_document_mapping(self):
+        """The OTLP JSON mapping is pure and SDK-free: counters become
+        monotonic sums, histograms carry per-bucket counts + bounds."""
+        from janus_tpu.core.otlp import OtlpConfig, OtlpExporter
+
+        m = Metrics(force_fallback=True)
+        m.upload_outcomes.labels(decision="accepted").inc(3)
+        m.report_commit_age.observe(0.7)
+        m.report_commit_age.observe(40.0)
+        doc = OtlpExporter(OtlpConfig(endpoint="http://x"))._metrics_document(m)
+        metrics = doc["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+        by_name = {mm["name"]: mm for mm in metrics}
+        sum_m = by_name["janus_upload_decision"]["sum"]
+        assert sum_m["isMonotonic"] and sum_m["dataPoints"][0]["asDouble"] == 3
+        hist = by_name["janus_report_commit_age_seconds"]["histogram"]["dataPoints"][0]
+        assert hist["count"] == 2 and hist["sum"] == pytest.approx(40.7)
+        # per-bucket counts (+Inf overflow appended) re-sum to the count
+        assert len(hist["bucketCounts"]) == len(hist["explicitBounds"]) + 1
+        assert sum(hist["bucketCounts"]) == 2
+
+
+def test_span_sinks_receive_spans_with_and_without_chrome_tracer(tmp_path):
+    got = []
+    sink = lambda *a: got.append(a)  # noqa: E731
+    trace_mod.register_span_sink(sink)
+    try:
+        # chrome tracing OFF: module-level span helpers still feed sinks
+        with trace_mod.trace_scope(trace_id="e" * 32):
+            with trace_mod.trace_span("solo", cat="job"):
+                pass
+        assert got and got[-1][0] == "solo"
+        assert got[-1][4]["trace_id"] == "e" * 32
+        epoch_start = got[-1][2]
+        assert abs(epoch_start - time.time()) < 60  # epoch, not monotonic
+        # chrome tracing ON: the tracer forwards from emit()
+        tr = trace_mod.ChromeTracer(str(tmp_path / "sink.json"))
+        with tr.span("traced", cat="job"):
+            pass
+        tr.close()
+        assert got[-1][0] == "traced"
+        # a broken sink must never break the traced path
+        trace_mod.register_span_sink(lambda *a: 1 / 0)
+        with trace_mod.trace_span("unbothered", cat="job"):
+            pass
+    finally:
+        trace_mod._SPAN_SINKS.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -226,6 +395,24 @@ class TestMetricsFallback:
         # SAME families — a fallback-only dev container asserts against
         # the same golden manifest as the baked image
         assert Metrics(force_fallback=True).catalog() == GLOBAL_METRICS.catalog()
+
+
+def test_metric_help_text_audit():
+    """Every registered family carries non-empty help text (ISSUE 9
+    satellite): a bare name on a dashboard is a support ticket."""
+    from janus_tpu.core.metrics import _FallbackMetric
+
+    checked = 0
+    for obj in vars(GLOBAL_METRICS).values():
+        if isinstance(obj, _FallbackMetric):
+            name, doc = obj.name, obj.documentation
+        elif hasattr(obj, "_name") and hasattr(obj, "_documentation"):
+            name, doc = obj._name, obj._documentation
+        else:
+            continue
+        checked += 1
+        assert isinstance(doc, str) and doc.strip(), f"{name} has empty help text"
+    assert checked >= 30  # the audit actually saw the bundle
 
 
 def test_golden_metric_manifest():
@@ -325,10 +512,126 @@ def test_report_commit_age_observed_on_upload_batch(tmp_path):
         GLOBAL_METRICS.get_sample_value("janus_report_commit_age_seconds_count")
         or 0
     )
+    latency_before = (
+        GLOBAL_METRICS.get_sample_value("janus_report_upload_to_commit_seconds_count")
+        or 0
+    )
     batcher = ReportWriteBatcher(ds, max_batch_size=1)
     _run(batcher.write_report(report))
     after = GLOBAL_METRICS.get_sample_value("janus_report_commit_age_seconds_count")
     assert after == before + 1
+    # front-door latency (ISSUE 9): enqueue -> batch commit, per report
+    latency_after = GLOBAL_METRICS.get_sample_value(
+        "janus_report_upload_to_commit_seconds_count"
+    )
+    assert latency_after == latency_before + 1
+    ds.close()
+
+
+def test_upload_trace_minted_and_persisted_through_writer(tmp_path):
+    """ISSUE 9 tentpole: every report committed through the writer carries
+    an upload trace id — adopted from the bound context when one exists,
+    minted otherwise — persisted on its client_reports row."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import Crypter, Datastore, LeaderStoredReport, generate_key
+    from janus_tpu.messages import HpkeCiphertext, ReportId, ReportMetadata, Time
+    from tests.test_datastore import make_task
+
+    ds = Datastore(
+        str(tmp_path / "utrace.sqlite3"), Crypter([generate_key()]), RealClock()
+    )
+    task = make_task()
+    ds.run_tx("put-task", lambda tx: tx.put_aggregator_task(task))
+
+    def report(n):
+        return LeaderStoredReport(
+            task_id=task.task_id,
+            metadata=ReportMetadata(ReportId(bytes([n]) * 16), Time(0)),
+            public_share=b"ps",
+            leader_extensions=[],
+            leader_input_share=b"input",
+            helper_encrypted_input_share=HpkeCiphertext(1, b"ek", b"ct"),
+        )
+
+    batcher = ReportWriteBatcher(ds, max_batch_size=1)
+    # adopted: the bound context's id (the handle_upload scope)
+    adopted = trace_mod.new_trace_id()
+
+    async def write_bound():
+        with trace_mod.trace_scope(trace_id=adopted):
+            await batcher.write_report(report(1))
+
+    _run(write_bound())
+    # minted: no context bound (the direct-writer path soaks use)
+    _run(batcher.write_report(report(2)))
+    got1 = ds.run_tx(
+        "g1", lambda tx: tx.get_client_report(task.task_id, ReportId(b"\x01" * 16))
+    )
+    got2 = ds.run_tx(
+        "g2", lambda tx: tx.get_client_report(task.task_id, ReportId(b"\x02" * 16))
+    )
+    assert got1.trace_id == adopted
+    assert got2.trace_id and len(got2.trace_id) == 32
+    assert all(c in "0123456789abcdef" for c in got2.trace_id)
+    assert got2.trace_id != adopted
+    ds.close()
+
+
+def test_job_create_span_links_upload_traces(tmp_path):
+    """ISSUE 9 tentpole: aggregation-job creation emits a job_create span
+    whose ``links`` carry the packed reports' upload trace ids — the
+    stitch point between client ingress and the job's cross-process
+    timeline."""
+    pytest.importorskip("cryptography")
+    import asyncio
+
+    from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import Crypter, Datastore, LeaderStoredReport, generate_key
+    from janus_tpu.messages import HpkeCiphertext, ReportId, ReportMetadata, Time
+    from tests.test_datastore import make_task
+    from tools.trace_merge import load_events
+
+    ds = Datastore(
+        str(tmp_path / "link.sqlite3"), Crypter([generate_key()]), RealClock()
+    )
+    task = make_task()
+    ds.run_tx("put-task", lambda tx: tx.put_aggregator_task(task))
+    now_s = RealClock().now().seconds
+    batcher = ReportWriteBatcher(ds, max_batch_size=1)
+    upload_ids = []
+    for n in range(3):
+        tid = trace_mod.new_trace_id()
+        upload_ids.append(tid)
+        report = LeaderStoredReport(
+            task_id=task.task_id,
+            metadata=ReportMetadata(ReportId(bytes([n]) * 16), Time(now_s)),
+            public_share=b"ps",
+            leader_extensions=[],
+            leader_input_share=b"input",
+            helper_encrypted_input_share=HpkeCiphertext(1, b"ek", b"ct"),
+            trace_id=tid,
+        )
+        _run(batcher.write_report(report))
+    trace_path = str(tmp_path / "creator.json")
+    trace_mod.configure_chrome_trace(trace_path)
+    try:
+        creator = AggregationJobCreator(
+            ds, CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=10)
+        )
+        assert asyncio.run(creator.run_once()) == 1
+    finally:
+        trace_mod.configure_chrome_trace(None)
+    spans = [
+        e for e in load_events(trace_path) if e.get("name") == "job_create"
+    ]
+    assert len(spans) == 1
+    args = spans[0]["args"]
+    assert sorted(args["links"]) == sorted(upload_ids)
+    assert len(args["trace_id"]) == 32 and args["reports"] == 3
     ds.close()
 
 
@@ -454,6 +757,8 @@ class TestHealthServer:
             "leases",
             "faults",
             "trace",
+            "otlp",
+            "slo",
             "pid",
             "uptime_s",
         ):
@@ -461,6 +766,9 @@ class TestHealthServer:
         assert doc["journal"]["outstanding_rows"] == 0
         assert doc["leases"]["aggregation"]["active"] == 0
         assert doc["faults"]["armed"] is False
+        # no SDK on this container and nothing configured: explicit marker
+        assert doc["otlp"]["state"] in ("unavailable", "disabled")
+        assert doc["slo"]["targets"] == 0
 
     def test_statusz_stable_under_concurrent_mutation(self, health_server):
         fetch, _ds = health_server

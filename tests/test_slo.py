@@ -1,0 +1,288 @@
+"""SLO evaluation plane (ISSUE 9): burn-rate math against hand-computed
+histogram fixtures, multi-window behavior under a fake clock, breach
+transition accounting, declarative-config parsing (strict on typos), the
+registry-snapshot reader on BOTH metric backends, and the /statusz "slo"
+section.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from janus_tpu.core.metrics import HAVE_PROMETHEUS, Metrics
+from janus_tpu.core.otlp import snapshot_metric_families
+from janus_tpu.core.slo import (
+    SloEvaluator,
+    SloTarget,
+    configure_slos,
+    evaluate_tick,
+    histogram_totals,
+    slo_status,
+    targets_from_config,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _evaluator(metrics, clock, **spec):
+    base = dict(
+        objective=0.9,
+        threshold_s=60.0,
+        fast_window_s=100.0,
+        slow_window_s=1000.0,
+        fast_burn=1.0,
+        slow_burn=1.0,
+    )
+    base.update(spec)
+    return SloEvaluator(
+        [SloTarget(name="commit_age", **base)], metrics=metrics, time_fn=clock
+    )
+
+
+# ---------------------------------------------------------------------------
+# histogram snapshot reading
+
+
+class TestHistogramTotals:
+    def _families(self, m):
+        return {f["name"]: f for f in snapshot_metric_families(m)}
+
+    def test_good_vs_bad_split_at_bucket_bound(self):
+        m = Metrics(force_fallback=True)
+        for v in (0.4, 30.0, 59.0):  # <= 60 bucket
+            m.report_commit_age.observe(v)
+        for v in (61.0, 3000.0):  # > 60
+            m.report_commit_age.observe(v)
+        total, good, eff = histogram_totals(
+            self._families(m), "janus_report_commit_age_seconds", 60.0
+        )
+        assert (total, good, eff) == (5, 3, 60.0)
+
+    def test_threshold_rounds_down_to_nearest_bound(self):
+        # _AGE_BUCKETS has 60 and 120; a 100s target judges at 60
+        m = Metrics(force_fallback=True)
+        m.report_commit_age.observe(90.0)  # good at 120, bad at 60
+        total, good, eff = histogram_totals(
+            self._families(m), "janus_report_commit_age_seconds", 100.0
+        )
+        assert (total, good, eff) == (1, 0, 60.0)
+
+    def test_sums_across_label_sets(self):
+        m = Metrics(force_fallback=True)
+        m.job_age_at_acquire.labels(job_type="aggregation").observe(5.0)
+        m.job_age_at_acquire.labels(job_type="collection").observe(500.0)
+        total, good, _ = histogram_totals(
+            self._families(m), "janus_job_age_at_acquire_seconds", 30.0
+        )
+        assert (total, good) == (2, 1)
+
+    def test_missing_family_reads_empty(self):
+        m = Metrics(force_fallback=True)
+        assert histogram_totals(self._families(m), "janus_nope_seconds", 1.0) == (
+            0,
+            0,
+            None,
+        )
+
+    @pytest.mark.skipif(not HAVE_PROMETHEUS, reason="prometheus_client absent")
+    def test_prometheus_backend_reads_identically(self):
+        fb, pm = Metrics(force_fallback=True), Metrics()
+        for m in (fb, pm):
+            for v in (0.4, 59.0, 61.0):
+                m.report_commit_age.observe(v)
+        read = lambda m: histogram_totals(  # noqa: E731
+            self._families(m), "janus_report_commit_age_seconds", 60.0
+        )
+        assert read(fb) == read(pm) == (3, 2, 60.0)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (hand-computed)
+
+
+class TestBurnRate:
+    def test_first_tick_has_no_baseline_and_burns_zero(self):
+        m = Metrics(force_fallback=True)
+        m.report_commit_age.observe(3000.0)  # all bad, but no delta yet
+        ev = _evaluator(m, FakeClock())
+        st = ev.tick()["commit_age"]
+        assert st["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        assert st["events_total"] == 1 and not st["breaching"]
+
+    def test_hand_computed_burn(self):
+        # objective 0.9 -> budget 0.1.  Baseline tick, then 8 good + 2 bad:
+        # error rate 0.2 -> burn 2.0 in both windows.
+        m = Metrics(force_fallback=True)
+        clock = FakeClock()
+        ev = _evaluator(m, clock, fast_burn=100.0, slow_burn=100.0)
+        ev.tick()
+        for _ in range(8):
+            m.report_commit_age.observe(1.0)
+        for _ in range(2):
+            m.report_commit_age.observe(3000.0)
+        clock.advance(10)
+        st = ev.tick()["commit_age"]
+        assert st["burn_rate"] == {"fast": 2.0, "slow": 2.0}
+        assert m.get_sample_value(
+            "janus_slo_burn_rate", {"slo": "commit_age", "window": "fast"}
+        ) == pytest.approx(2.0)
+
+    def test_all_good_burns_zero(self):
+        m = Metrics(force_fallback=True)
+        clock = FakeClock()
+        ev = _evaluator(m, clock)
+        ev.tick()
+        for _ in range(50):
+            m.report_commit_age.observe(0.5)
+        clock.advance(10)
+        st = ev.tick()["commit_age"]
+        assert st["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        assert not st["breaching"] and st["breaches"] == 0
+
+    def test_fast_window_recovers_while_slow_remembers(self):
+        # Bad burst at t=0..10, clean traffic after.  At t=150 the burst
+        # has aged out of the 100s fast window (fast burn 0) but is still
+        # inside the 1000s slow window (slow burn > 0).
+        m = Metrics(force_fallback=True)
+        clock = FakeClock()
+        ev = _evaluator(m, clock, fast_burn=100.0, slow_burn=100.0)
+        ev.tick()  # baseline at t=0
+        for _ in range(10):
+            m.report_commit_age.observe(3000.0)  # the burst: all bad
+        clock.advance(10)
+        ev.tick()
+        for _ in range(10):
+            m.report_commit_age.observe(0.5)  # clean recovery traffic
+        clock.advance(140)  # t=150: burst older than fast window
+        st = ev.tick()["commit_age"]
+        assert st["burn_rate"]["fast"] == 0.0
+        # slow window still sees 10 bad / 20 total -> 0.5/0.1 = 5.0
+        assert st["burn_rate"]["slow"] == 5.0
+
+    def test_breach_counts_transitions_not_ticks(self):
+        m = Metrics(force_fallback=True)
+        clock = FakeClock()
+        ev = _evaluator(m, clock, fast_burn=1.0, slow_burn=1.0)
+        ev.tick()
+        for _ in range(10):
+            m.report_commit_age.observe(3000.0)
+        clock.advance(10)
+        assert ev.tick()["commit_age"]["breaching"]
+        clock.advance(10)
+        ev.tick()  # still breaching: no second increment
+        assert (
+            m.get_sample_value("janus_slo_breach_total", {"slo": "commit_age"}) == 1
+        )
+        # recover: the bad burst ages past the fast window, traffic clean
+        for _ in range(100):
+            m.report_commit_age.observe(0.5)
+        clock.advance(120)
+        st = ev.tick()["commit_age"]
+        assert not st["breaching"]
+        # re-breach is a NEW transition
+        for _ in range(100):
+            m.report_commit_age.observe(3000.0)
+        clock.advance(10)
+        assert ev.tick()["commit_age"]["breaching"]
+        assert (
+            m.get_sample_value("janus_slo_breach_total", {"slo": "commit_age"}) == 2
+        )
+
+    def test_zero_traffic_window_is_not_a_breach(self):
+        m = Metrics(force_fallback=True)
+        clock = FakeClock()
+        ev = _evaluator(m, clock)
+        for _ in range(5):
+            clock.advance(10)
+            st = ev.tick()["commit_age"]
+        assert st["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        assert st["breaches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# declarative config
+
+
+class TestConfig:
+    def test_targets_from_config_defaults_and_signal(self):
+        targets = targets_from_config(
+            {
+                "commit_age": {"objective": 0.99, "threshold_s": 60},
+                "flush": {"signal": "first_flush", "threshold_s": 1.0},
+            }
+        )
+        by_name = {t.name: t for t in targets}
+        assert by_name["commit_age"].family == "janus_report_commit_age_seconds"
+        assert by_name["flush"].family == "janus_executor_wait_duration_seconds"
+        assert by_name["flush"].objective == 0.99  # default
+
+    def test_raw_family_name_accepted(self):
+        (t,) = targets_from_config(
+            {"custom": {"signal": "janus_collection_e2e_seconds", "threshold_s": 5}}
+        )
+        assert t.family == "janus_collection_e2e_seconds"
+
+    def test_typos_fail_loudly(self):
+        with pytest.raises(ValueError, match="unknown keys"):
+            targets_from_config({"commit_age": {"threshold_s": 1, "burn_fast": 2}})
+        with pytest.raises(ValueError, match="threshold_s is required"):
+            targets_from_config({"commit_age": {"objective": 0.9}})
+        with pytest.raises(ValueError, match="unknown signal"):
+            targets_from_config({"nope": {"threshold_s": 1}})
+        with pytest.raises(ValueError, match="objective"):
+            targets_from_config({"commit_age": {"threshold_s": 1, "objective": 1.5}})
+        with pytest.raises(ValueError, match="fast_window_s"):
+            targets_from_config(
+                {"commit_age": {"threshold_s": 1, "fast_window_s": 9999}}
+            )
+
+    def test_yaml_round_trip_through_common_config(self):
+        from janus_tpu.binaries.config import AggregatorConfig, load_config
+
+        cfg = load_config(
+            AggregatorConfig,
+            text="""
+common:
+  slos:
+    commit_age: {objective: 0.95, threshold_s: 30}
+""",
+        )
+        (t,) = targets_from_config(cfg.common.slos)
+        assert (t.objective, t.threshold_s) == (0.95, 30)
+
+
+# ---------------------------------------------------------------------------
+# process-wide evaluator + statusz
+
+
+def test_configure_evaluate_and_statusz_section():
+    m = Metrics(force_fallback=True)
+    try:
+        ev = configure_slos(
+            {"commit_age": {"objective": 0.9, "threshold_s": 60}}, metrics=m
+        )
+        assert ev is not None
+        evaluate_tick()
+        m.report_commit_age.observe(0.5)
+        evaluate_tick()
+        st = slo_status()
+        assert st["targets"] == 1 and st["ticks"] == 2
+        assert st["slos"]["commit_age"]["events_total"] == 1
+        assert st["slos"]["commit_age"]["burn_rate"]["fast"] == 0.0
+        # the section every /statusz serves
+        from janus_tpu.core.statusz import runtime_status
+
+        assert runtime_status()["slo"]["targets"] == 1
+    finally:
+        configure_slos(None)
+    assert slo_status() == {"targets": 0, "ticks": 0, "slos": {}}
+    evaluate_tick()  # cleared: a no-op, never an error

@@ -158,6 +158,34 @@ def test_statusz_surfaces_compile_states(monkeypatch):
     doc = runtime_status()
     (entry,) = doc["executor"]["compile"].values()
     assert entry["state"] == "warm" and entry["compile_s"] is not None
+    # ledger AGE (ISSUE 9 gap fix): time in the current state, so a
+    # minutes-old "warming" entry is visible as the stall it is
+    assert entry["age_s"] >= 0.0
+    # canonicalization-plan outcomes ride the compile neighborhood
+    canon = doc["executor"]["canonicalization"]
+    assert set(canon) == {"planned", "canonicalized", "exact_reasons"}
+    ex.shutdown()
+
+
+def test_statusz_canonicalization_reason_counts(monkeypatch):
+    """The /statusz compile section counts WHY shapes kept exact-shape
+    compiles (ISSUE 9 satellite): plan outcomes per reason."""
+    from janus_tpu.core.statusz import runtime_status
+    from janus_tpu.executor import service as svc
+    from janus_tpu.vdaf import canonical
+    from janus_tpu.vdaf.instances import prio3_count, prio3_histogram
+
+    ex = DeviceExecutor(ExecutorConfig(warmup_rows=0))
+    monkeypatch.setattr(svc, "_GLOBAL", ex)
+    before = canonical.plan_stats()
+    # Count has no parameter axis -> exact-shape reason; Histogram(20, 4)
+    # pads to a pow2 twin -> canonicalized
+    assert canonical.canonicalization_reason(prio3_count())
+    assert canonical.canonicalization_reason(prio3_histogram(20, 4)) == ""
+    stats = runtime_status()["executor"]["canonicalization"]
+    assert stats["planned"] >= before["planned"]
+    assert stats["canonicalized"] >= 1
+    assert any(stats["exact_reasons"].values())
     ex.shutdown()
 
 

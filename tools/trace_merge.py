@@ -13,10 +13,20 @@ This tool stitches them into ONE timeline:
   aggregation job crossing leader drivers and the helper, joined by the
   trace id every span inherits from the bound trace context.
 
+Trace LINKS (ISSUE 9): spans may carry an ``args.links`` list of related
+trace ids — the aggregation-job creation span links the upload traces of
+the reports it packs, and the collection-finish span links the collected
+reports' upload traces.  ``--stats`` unions linked trace ids into MERGED
+traces and reports each one's critical path (upload -> batch commit ->
+first device flush -> collection) with per-process span counts, so "does
+one timeline really run client ingress to collection?" is a command, not
+an archaeology session.
+
 Usage::
 
     python tools/trace_merge.py -o merged.json driver0.json driver1.json helper.json
     python tools/trace_merge.py -o job.json --trace-id <32-hex> *.json
+    python tools/trace_merge.py -o merged.json --stats *.json
 
 Load the output in Perfetto (ui.perfetto.dev) or chrome://tracing.
 """
@@ -118,12 +128,157 @@ def spans_by_trace(events: List[dict]) -> Dict[str, Set[int]]:
     return out
 
 
-def merge_trace_files(
-    paths: List[str], out_path: str, trace_id: Optional[str] = None
-) -> dict:
-    """Merge ``paths`` into ``out_path``; returns a summary dict
+# ---------------------------------------------------------------------------
+# --stats: merged-trace critical paths
+
+#: span-name -> pipeline stage, for the critical-path summary.  "upload"
+#: wraps the handler, "upload_commit" ends at the batch commit; the
+#: executor's per-submission flush_share (or a bare prep_launch from the
+#: non-executor path) marks device prepare; collection_finish closes the
+#: pipeline.
+_STAGE_SPANS = {
+    "upload": ("upload", "upload_commit"),
+    "commit": ("upload_commit",),
+    "flush": ("flush_share", "executor_flush", "prep_launch"),
+    "collection": ("collection_finish",),
+}
+
+
+def _merged_trace_groups(events: List[dict]) -> Dict[str, Set[str]]:
+    """Union-find over trace ids: a span's own trace id unions with every
+    id in its ``args.links``.  Returns root -> set of member trace ids."""
+    parent: Dict[str, str] = {}
+
+    def find(x: str) -> str:
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: str, b: str) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[rb] = ra
+
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        args = ev.get("args", {})
+        ids = [t for t in [args.get("trace_id")] if t]
+        ids += [t for t in args.get("links", []) if t]
+        for other in ids[1:]:
+            union(ids[0], other)
+        for t in ids:
+            find(t)  # ensure singleton membership
+    groups: Dict[str, Set[str]] = {}
+    for t in parent:
+        groups.setdefault(find(t), set()).add(t)
+    return groups
+
+
+def trace_stats(paths_or_events) -> dict:
+    """Per-merged-trace critical-path summary over already-merged events
+    (or file paths).  For each merged trace (linked trace ids unioned):
+    span counts per process, the pids involved, stage timestamps, and the
+    upload -> commit -> first flush -> collection durations.  ``complete``
+    means every stage was seen — the soak's end-to-end assertion."""
+    events = (
+        merge_events(paths_or_events)
+        if paths_or_events and isinstance(paths_or_events[0], str)
+        else list(paths_or_events)
+    )
+    process_names = {
+        ev.get("pid"): ev.get("args", {}).get("name")
+        for ev in events
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    groups = _merged_trace_groups(events)
+    member_to_root = {t: root for root, members in groups.items() for t in members}
+    by_group: Dict[str, List[dict]] = {root: [] for root in groups}
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        args = ev.get("args", {})
+        tid = args.get("trace_id") or next(
+            (t for t in args.get("links", []) if t), None
+        )
+        if tid is not None and tid in member_to_root:
+            by_group[member_to_root[tid]].append(ev)
+
+    out = []
+    for root, spans in by_group.items():
+        if not spans:
+            continue
+        stage_ts: Dict[str, Optional[float]] = {}
+        names = {s: [] for s in _STAGE_SPANS}
+        for ev in spans:
+            for stage, span_names in _STAGE_SPANS.items():
+                if ev.get("name") in span_names:
+                    names[stage].append(ev)
+        stage_ts["upload_start"] = (
+            min(ev.get("ts", 0) for ev in names["upload"]) if names["upload"] else None
+        )
+        stage_ts["commit"] = (
+            min(ev.get("ts", 0) + ev.get("dur", 0) for ev in names["commit"])
+            if names["commit"]
+            else None
+        )
+        stage_ts["first_flush"] = (
+            min(ev.get("ts", 0) for ev in names["flush"]) if names["flush"] else None
+        )
+        stage_ts["collection"] = (
+            max(ev.get("ts", 0) + ev.get("dur", 0) for ev in names["collection"])
+            if names["collection"]
+            else None
+        )
+
+        def _dur(a: Optional[float], b: Optional[float]) -> Optional[float]:
+            return round((b - a) / 1e6, 6) if a is not None and b is not None else None
+
+        spans_per_process: Dict[str, int] = {}
+        for ev in spans:
+            pid = ev.get("pid", 0)
+            key = f"{process_names.get(pid) or 'pid'}:{pid}"
+            spans_per_process[key] = spans_per_process.get(key, 0) + 1
+        complete = all(
+            stage_ts[k] is not None
+            for k in ("upload_start", "commit", "first_flush", "collection")
+        )
+        out.append(
+            {
+                "trace_ids": sorted(groups[root]),
+                "spans": len(spans),
+                "pids": sorted({ev.get("pid", 0) for ev in spans}),
+                "spans_per_process": spans_per_process,
+                "stages_ts_us": stage_ts,
+                "durations_s": {
+                    "upload_to_commit": _dur(
+                        stage_ts["upload_start"], stage_ts["commit"]
+                    ),
+                    "commit_to_first_flush": _dur(
+                        stage_ts["commit"], stage_ts["first_flush"]
+                    ),
+                    "first_flush_to_collection": _dur(
+                        stage_ts["first_flush"], stage_ts["collection"]
+                    ),
+                    "upload_to_collection": _dur(
+                        stage_ts["upload_start"], stage_ts["collection"]
+                    ),
+                },
+                "complete": complete,
+            }
+        )
+    out.sort(key=lambda g: (-g["spans"], g["trace_ids"]))
+    return {
+        "merged_traces": out,
+        "complete_paths": sum(1 for g in out if g["complete"]),
+    }
+
+
+def write_and_summarize(merged: List[dict], out_path: str) -> dict:
+    """Write an already-merged event list and build its summary dict
     ``{"events": n, "pids": [...], "traces": {trace_id: [pids...]}}``."""
-    merged = merge_events(paths, trace_id=trace_id)
     with open(out_path, "w") as f:
         json.dump(merged, f)
     traces = spans_by_trace(merged)
@@ -134,6 +289,13 @@ def merge_trace_files(
     }
 
 
+def merge_trace_files(
+    paths: List[str], out_path: str, trace_id: Optional[str] = None
+) -> dict:
+    """Merge ``paths`` into ``out_path``; returns the summary dict."""
+    return write_and_summarize(merge_events(paths, trace_id=trace_id), out_path)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("inputs", nargs="+", help="per-replica chrome-trace files")
@@ -141,14 +303,35 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--trace-id", default=None, help="keep only spans of this trace id"
     )
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-merged-trace critical-path stats (JSON) — linked "
+        "trace ids unioned, upload->commit->flush->collection durations",
+    )
     args = ap.parse_args(argv)
-    summary = merge_trace_files(args.inputs, args.output, trace_id=args.trace_id)
+    if args.stats and args.trace_id is None:
+        # one parse serves both the merged output and the stats pass
+        merged = merge_events(args.inputs)
+        summary = write_and_summarize(merged, args.output)
+    else:
+        merged = None
+        summary = merge_trace_files(args.inputs, args.output, trace_id=args.trace_id)
     multi = sum(1 for pids in summary["traces"].values() if len(pids) > 1)
     print(
         f"merged {summary['events']} event(s) from {len(args.inputs)} file(s) "
         f"({len(summary['pids'])} process(es), {len(summary['traces'])} "
         f"trace id(s), {multi} crossing processes) -> {args.output}"
     )
+    if args.stats:
+        # a --trace-id run must reload: stats needs the unfiltered links
+        stats = trace_stats(merged if merged is not None else args.inputs)
+        print(json.dumps(stats, indent=2))
+        print(
+            f"{stats['complete_paths']} of {len(stats['merged_traces'])} merged "
+            "trace(s) carry a complete upload->collection critical path",
+            file=sys.stderr,
+        )
     return 0
 
 
