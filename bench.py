@@ -612,6 +612,138 @@ def run_coldtask_config(args, scaled: bool) -> dict:
     }
 
 
+def run_poplar_config(args, scaled: bool) -> dict:
+    """The ``poplar1_hh`` row (ISSUE 10): heavy-hitters reports/s with the
+    device executor's agg-param-keyed poplar_init plane vs the legacy
+    per-job path.
+
+    Four concurrent jobs at ONE IDPF tree level — the multi-round
+    collection steady state — submit through the executor; their bulk-AES
+    walks + device sketches coalesce into level-keyed mega-batches.  The
+    legacy number serializes the same jobs through per-job
+    ``prep_init_batch_poplar`` calls (what every pre-executor round did).
+    A per-row oracle-parity assert (batched walk vs per-report
+    ``Poplar1.prep_init``) gates the number; parity drift records an
+    error, never a throughput value."""
+    import asyncio
+    import random as _random
+
+    from janus_tpu.executor import DeviceExecutor, ExecutorConfig, KIND_POPLAR_INIT
+    from janus_tpu.vdaf.backend import make_backend, vdaf_shape_key
+    from janus_tpu.vdaf.poplar1 import Poplar1, Poplar1AggregationParam
+
+    n_jobs = 4
+    if scaled:
+        bits, level, n_prefixes, per, rounds = 8, 4, 8, 16, 2
+        desc = "4 concurrent jobs x Poplar1 bits=8 level=4 (executor, scaled)"
+    else:
+        bits, level, n_prefixes, per, rounds = 16, 8, 64, 64, 4
+        desc = "4 concurrent jobs x Poplar1 bits=16 level=8 (executor)"
+    vdaf = Poplar1(bits=bits)
+    agg_param = Poplar1AggregationParam(
+        level, tuple(range(n_prefixes))
+    )
+    backend = make_backend(vdaf, "tpu")
+    shape_key = vdaf_shape_key(vdaf)
+
+    rng = _random.Random(7)
+    jobs = []
+    for j in range(n_jobs):
+        vk = rng.randbytes(vdaf.VERIFY_KEY_SIZE)
+        rows = []
+        for i in range(per):
+            nonce = rng.randbytes(vdaf.NONCE_SIZE)
+            public, shares = vdaf.shard(
+                (j * per + i) % (1 << bits), nonce, rng.randbytes(vdaf.RAND_SIZE)
+            )
+            rows.append((nonce, public, shares[1]))
+        jobs.append((vk, rows))
+
+    # oracle-parity fence on a tiny real slice, both aggregator sides
+    vk0 = jobs[0][0]
+    for agg_id in (0, 1):
+        sub = []
+        for i in range(2):
+            nonce = rng.randbytes(vdaf.NONCE_SIZE)
+            public, shares = vdaf.shard(1, nonce, rng.randbytes(vdaf.RAND_SIZE))
+            sub.append((nonce, public, shares[agg_id]))
+        got = backend.prep_init_batch_poplar(vk0, agg_id, agg_param, sub)
+        want = backend.oracle.prep_init_batch_poplar(vk0, agg_id, agg_param, sub)
+        for (gs, gsh), (ws, wsh) in zip(got, want):
+            assert gsh.encode() == wsh.encode(), "poplar sketch-share parity broke"
+            assert gs.y_flat == ws.y_flat, "poplar prefix-value parity broke"
+
+    # legacy per-job path: each job pays its own walk + sketch launch.
+    # One untimed pass first so the timed loop excludes sketch-shape JIT
+    # compilation exactly like the executor path's warmup run below —
+    # the A/B ratio must compare steady states, not compile luck.
+    for vk, rows in jobs:
+        backend.prep_init_batch_poplar(vk, 1, agg_param, rows)
+    t0 = time.monotonic()
+    for _ in range(rounds):
+        for vk, rows in jobs:
+            out = backend.prep_init_batch_poplar(vk, 1, agg_param, rows)
+            assert len(out) == len(rows)
+    legacy_elapsed = time.monotonic() - t0
+    total = n_jobs * per * rounds
+    legacy_rate = total / legacy_elapsed
+
+    # executor path: the 4 jobs' submissions coalesce per level bucket
+    executor = DeviceExecutor(
+        ExecutorConfig(
+            enabled=True, flush_max_rows=n_jobs * per, flush_window_s=0.01
+        )
+    )
+
+    async def submitter(vk, rows):
+        for _ in range(rounds):
+            out = await executor.submit(
+                shape_key,
+                KIND_POPLAR_INIT,
+                (vk, agg_param, rows),
+                backend=backend,
+                agg_id=1,
+                agg_param_key=agg_param.level,
+            )
+            assert len(out) == len(rows)
+
+    async def drive():
+        await asyncio.gather(*[submitter(vk, rows) for vk, rows in jobs])
+        await executor.drain()
+
+    asyncio.run(drive())  # warmup (jits the sketch launch shapes)
+    warm = next(iter(executor.stats().values()), {})
+    t0 = time.monotonic()
+    asyncio.run(drive())
+    elapsed = time.monotonic() - t0
+    executor.shutdown()
+
+    stats = next(iter(executor.stats().values()), {})
+    flushes = stats.get("flushes", 0) - warm.get("flushes", 0)
+    flushed_jobs = stats.get("flushed_jobs", 0) - warm.get("flushed_jobs", 0)
+    flushed_rows = stats.get("flushed_rows", 0) - warm.get("flushed_rows", 0)
+    mean_flush = round(flushed_rows / flushes, 2) if flushes else 0.0
+    return {
+        "config": desc,
+        "value": round(total / elapsed, 1),
+        "unit": "reports/s",
+        "bits": bits,
+        "level": level,
+        "prefixes": n_prefixes,
+        "jobs": n_jobs,
+        "per_job_rows": per,
+        "legacy_per_job_reports_s": round(legacy_rate, 1),
+        "executor_vs_legacy": round((total / elapsed) / legacy_rate, 3)
+        if legacy_rate
+        else None,
+        "mean_flush_rows": mean_flush,
+        "flushes": flushes,
+        "cross_job_coalesced": bool(
+            flushes and flushed_jobs / flushes > 1.0
+        ),
+    }
+
+
 def run_mesh_config(args, scaled: bool) -> dict:
     """The ``mesh8`` row (ISSUE 6): the north-star histogram1024 prepare
     SPMD over every local device via MeshBackend — the production
@@ -1012,13 +1144,17 @@ def main() -> int:
     parser.add_argument(
         "--config",
         default="all",
-        choices=["all"] + list(CONFIGS) + ["executor16", "accum16", "mesh8", "coldtask"],
+        choices=["all"]
+        + list(CONFIGS)
+        + ["executor16", "accum16", "mesh8", "coldtask", "poplar1_hh"],
         help="one config, or 'all' for every BASELINE.md row (default); "
         "executor16 is the device-executor concurrent-task row, accum16 "
         "the same shape with the device-resident accumulator store, "
         "mesh8 the SPMD multi-chip prepare over every local device, "
         "coldtask the shape-churn row (cold task joins a busy fleet: "
-        "canonical buckets + background warmup vs exact-shape compile)",
+        "canonical buckets + background warmup vs exact-shape compile), "
+        "poplar1_hh the heavy-hitters row (Poplar1 jobs coalescing at one "
+        "IDPF level through the executor vs the legacy per-job path)",
     )
     parser.add_argument(
         "--side",
@@ -1086,8 +1222,11 @@ def main() -> int:
     run_accum_row = args.config in ("all", "accum16")
     run_mesh_row = args.config in ("all", "mesh8")
     run_coldtask_row = args.config in ("all", "coldtask")
+    run_poplar_row = args.config in ("all", "poplar1_hh")
     names = [
-        n for n in names if n not in ("executor16", "accum16", "mesh8", "coldtask")
+        n
+        for n in names
+        if n not in ("executor16", "accum16", "mesh8", "coldtask", "poplar1_hh")
     ]
     # Leader-side rows for the configs whose explicit-share inputs fit the
     # tunnel comfortably; sumvec100k's leader would ship ~1.6 GB of host
@@ -1149,6 +1288,15 @@ def main() -> int:
             results["coldtask"] = run_coldtask_config(args, scaled=scaled)
         except Exception as e:
             _record_row_failure(results, "coldtask", e)
+    if run_poplar_row:
+        # Heavy hitters through the executor (ISSUE 10): level-coalesced
+        # Poplar1 prep vs the legacy per-job path, oracle-parity gated;
+        # a mid-run platform loss records the structured skip like every
+        # other row (the sketch launch is the row's only device work).
+        try:
+            results["poplar1_hh"] = run_poplar_config(args, scaled=scaled)
+        except Exception as e:
+            _record_row_failure(results, "poplar1_hh", e)
 
     # Headline: the north-star config when measured, else the first row
     # that produced a number (a skipped/errored headline must not zero out

@@ -33,9 +33,19 @@
 #   ./ci.sh chaos      fault-injection gate: tests/test_chaos.py with a FIXED
 #                      seed (JANUS_CHAOS_SEED, default 7) — registry/breaker/
 #                      budget units plus the 2-replica soak with every
-#                      injection point firing at p~=0.2, and the mesh-enabled
+#                      injection point firing at p~=0.2, the mesh-enabled
 #                      device-lost run (per-mesh breaker -> oracle fallback,
-#                      exactly-once counts).
+#                      exactly-once counts), and the Poplar1 device-lost case
+#                      (ISSUE 10: breaker -> per-report CPU oracle ->
+#                      bit-exact heavy-hitter counts with exactly-once
+#                      accumulation across the agg-param-keyed journal).
+#   ./ci.sh poplar     heavy-hitters gate (ISSUE 10): the executor-routed
+#                      Poplar1 suite (tests/test_poplar_executor.py —
+#                      multi-request walk parity, level-keyed bucket
+#                      identity, breaker/backpressure parity, the 2-job x
+#                      2-level e2e, deferred-journal crash replay) plus the
+#                      protocol/batch suites (test_poplar1.py,
+#                      test_poplar1_batch.py).
 #   ./ci.sh chaos crash  process-level crash stage: the SIGKILL/restart soak
 #                      (tests/test_crash_chaos.py, slow-marked so tier-1
 #                      timing is unaffected) — real replica binaries killed
@@ -149,6 +159,15 @@ case "$tier" in
     # stage runs both together for a focused mesh signal.
     exec python -m pytest tests/test_mesh.py tests/test_mesh_executor.py -q
     ;;
+  poplar)
+    # Heavy-hitters gate (ISSUE 10): Poplar1 through the executor's
+    # agg-param-keyed dispatch plane.  The soft-AES fallback
+    # (utils/softaes.py) keeps the IDPF walk runnable without the
+    # `cryptography` package; the e2e/replay cases still need it (or the
+    # shim) for datastore column encryption and skip cleanly otherwise.
+    exec python -m pytest tests/test_poplar_executor.py tests/test_poplar1.py \
+      tests/test_poplar1_batch.py -q
+    ;;
   mxu)
     # MXU field-arithmetic gate (ISSUE 7): dot_general contraction layer
     # exactness (random + adversarial operands, both fields, matvec/matmul
@@ -183,7 +202,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|chaos|coldstart|obs|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|coldstart|obs|dryrun]" >&2
     exit 2
     ;;
 esac

@@ -370,6 +370,36 @@ class AggregationJobDriver:
             else:
                 b = factory()
             self._backends[key] = b
+        elif (
+            b is None
+            and type(vdaf).__name__ == "Poplar1"
+            and self.config.vdaf_backend != "oracle"
+        ):
+            # Heavy hitters ride the same dispatch plane: the batched
+            # Poplar1Backend (bulk-AES walk + device sketch) resolves
+            # through the executor's shape-keyed cache, so every driver in
+            # the process shares one instance per `bits` shape — and its
+            # poplar_init submissions share the executor's buckets and
+            # breaker domains with the helper's.  A build failure falls
+            # back to the per-report ping-pong path (backend None), never
+            # fails the job.
+            def poplar_factory():
+                return make_backend(vdaf, self.config.vdaf_backend)
+
+            try:
+                b = (
+                    self._executor.backend_for(key, poplar_factory)
+                    if self._executor is not None
+                    else poplar_factory()
+                )
+            except Exception:
+                logger.exception(
+                    "Poplar1 backend build failed for task %s; serving "
+                    "per-report ping-pong",
+                    task.task_id,
+                )
+                return None
+            self._backends[key] = b
         return b
 
     async def _coalesced_prep_init(
@@ -503,25 +533,119 @@ class AggregationJobDriver:
         device path by the backend contract, tests/test_backend.py).
         ``vdaf`` routes canonical (bucket-twin) backends to the TASK's
         oracle — the twin's own oracle computes a padded circuit."""
+        return await self._serve_on_oracle(
+            backend,
+            vdaf,
+            cause,
+            reason,
+            len(prep_in),
+            lambda oracle: oracle.prep_init_batch(verify_key, 0, prep_in),
+        )
+
+    async def _serve_on_oracle(
+        self, backend, vdaf, cause, reason, n_reports, call
+    ):
+        """The ONE fallback policy (logging, fallback metric, retryable
+        guard, off-loop dispatch) shared by the Prio3 and Poplar1 oracle
+        degradations — ``call(oracle)`` runs the VDAF-appropriate batch."""
         from ..vdaf.backend import oracle_backend_for
 
         oracle = oracle_backend_for(backend, vdaf)
         if oracle is None:
             raise JobStepError(f"device unavailable: {cause}", retryable=True)
-        vdaf_type = type(getattr(backend, "vdaf", None)).__name__
         logger.warning(
             "serving prepare on the CPU oracle (%d report(s)): %s",
-            len(prep_in),
+            n_reports,
             cause,
         )
         from ..core.metrics import GLOBAL_METRICS
 
         if GLOBAL_METRICS.registry is not None:
             GLOBAL_METRICS.vdaf_backend_fallbacks.labels(
-                vdaf_type=vdaf_type, reason=reason
+                vdaf_type=type(getattr(backend, "vdaf", None)).__name__,
+                reason=reason,
             ).inc()
         return await asyncio.get_running_loop().run_in_executor(
-            None, lambda: oracle.prep_init_batch(verify_key, 0, prep_in)
+            None, lambda: call(oracle)
+        )
+
+    async def _coalesced_poplar_init(
+        self, backend, verify_key: bytes, agg_param, prep_in, task_ident=None
+    ):
+        """Poplar1 round-0 prepare through the process-wide executor: the
+        submission lands in the agg-param-keyed ``poplar_init`` bucket for
+        this shape at ``agg_param.level``, so concurrent jobs at one IDPF
+        tree level — the multi-round heavy-hitters steady state — coalesce
+        into ONE bulk-AES walk + device sketch launch.  Failure-domain
+        parity with Prio3: an open circuit (peeked before submitting, or
+        raised by the flush) degrades this job to the bit-exact per-report
+        CPU oracle, and backpressure surfaces as a retryable JobStepError
+        (the lease machinery redelivers)."""
+        loop = asyncio.get_running_loop()
+        if self._executor is not None:
+            from ..executor import (
+                KIND_POPLAR_INIT,
+                CircuitOpenError,
+                ExecutorOverloadedError,
+            )
+            from ..vdaf.canonical import backend_shape_key
+
+            shape_key = backend_shape_key(backend)
+            if self._executor.circuit_open(shape_key):
+                return await self._poplar_oracle_fallback(
+                    backend,
+                    verify_key,
+                    agg_param,
+                    prep_in,
+                    f"circuit for shape {shape_key[0]} is open",
+                )
+            try:
+                return await self._executor.submit(
+                    shape_key,
+                    KIND_POPLAR_INIT,
+                    (verify_key, agg_param, prep_in),
+                    backend=backend,
+                    agg_id=0,
+                    task_ident=task_ident,
+                    agg_param_key=getattr(agg_param, "level", None),
+                )
+            except CircuitOpenError as e:
+                return await self._poplar_oracle_fallback(
+                    backend, verify_key, agg_param, prep_in, e
+                )
+            except ExecutorOverloadedError as e:
+                raise JobStepError(
+                    f"device executor overloaded: {e}", retryable=True
+                )
+            except JobStepError:
+                raise
+            except Exception as e:
+                raise JobStepError(f"device launch failed: {e}", retryable=True)
+        try:
+            return await loop.run_in_executor(
+                None,
+                lambda: backend.prep_init_batch_poplar(
+                    verify_key, 0, agg_param, prep_in
+                ),
+            )
+        except Exception as e:
+            raise JobStepError(f"prepare launch failed: {e}", retryable=True)
+
+    async def _poplar_oracle_fallback(
+        self, backend, verify_key, agg_param, prep_in, cause, reason="circuit_open"
+    ):
+        """Serve one Poplar1 job's round-0 prepare on the per-report CPU
+        oracle (bit-exact with the batched walk, tests/test_poplar1_batch
+        + test_poplar_executor assert it)."""
+        return await self._serve_on_oracle(
+            backend,
+            None,
+            cause,
+            reason,
+            len(prep_in),
+            lambda oracle: oracle.prep_init_batch_poplar(
+                verify_key, 0, agg_param, prep_in
+            ),
         )
 
     async def _flush_prep(self, backend, key: int) -> None:
@@ -584,15 +708,28 @@ class AggregationJobDriver:
             prep_in = [
                 (ra.report_id.data, public, share) for ra, public, share in rows
             ]
-            prep_out = await self._coalesced_prep_init(
-                backend,
-                task.vdaf_verify_key,
-                prep_in,
-                # per-task fairness quota: the DRR accounting domain WITHIN
-                # the shared shape bucket (executor._pick_entry_locked)
-                task_ident=task.task_id.data,
-                vdaf=vdaf,
-            )
+            if hasattr(backend, "prep_init_batch_poplar"):
+                # Heavy hitters: round-0 prep through the executor's
+                # agg-param-keyed poplar_init plane (or the direct batched
+                # walk when no executor is configured).
+                prep_out = await self._coalesced_poplar_init(
+                    backend,
+                    task.vdaf_verify_key,
+                    agg_param,
+                    prep_in,
+                    task_ident=task.task_id.data,
+                )
+            else:
+                prep_out = await self._coalesced_prep_init(
+                    backend,
+                    task.vdaf_verify_key,
+                    prep_in,
+                    # per-task fairness quota: the DRR accounting domain
+                    # WITHIN the shared shape bucket
+                    # (executor._pick_entry_locked)
+                    task_ident=task.task_id.data,
+                    vdaf=vdaf,
+                )
 
             def wrap_outcomes():
                 out = {}
@@ -774,6 +911,21 @@ class AggregationJobDriver:
         by_id = {pr.report_id.data: pr for pr in resp.prepare_resps}
         new_ras: List[ReportAggregation] = []
         out_shares: Dict[bytes, Sequence[int]] = {}
+        # Multi-round deferred journaling (Poplar1): a report that will only
+        # FINISH at a later round must carry its StartLeader payload through
+        # every WAITING round — the payload is the journal's oracle-replay
+        # window, and with_state() clears it by default.  Costs storage only
+        # while the journal machinery is armed for this VDAF.
+        store_cfg = getattr(
+            self._executor.accumulator if self._executor is not None else None,
+            "config",
+            None,
+        )
+        retain_waiting_payload = (
+            store_cfg is not None
+            and getattr(store_cfg, "deferred", False)
+            and getattr(vdaf, "REQUIRES_AGG_PARAM", False)
+        )
         for ra in all_ras:
             rid = ra.report_id.data
             if ra.state in (
@@ -817,10 +969,19 @@ class AggregationJobDriver:
                 new_ras.append(ra.with_state(ReportAggregationState.FINISHED))
                 out_shares[rid] = value.out_share
             else:
+                keep = (
+                    dict(
+                        public_share=ra.public_share,
+                        leader_input_share=ra.leader_input_share,
+                    )
+                    if retain_waiting_payload
+                    else {}
+                )
                 new_ras.append(
                     ra.with_state(
                         ReportAggregationState.WAITING_LEADER,
                         leader_prep_transition=value.transition.encode(vdaf),
+                        **keep,
                     )
                 )
 
@@ -939,6 +1100,44 @@ class AggregationJobDriver:
                     len(journal),
                 )
 
+    @staticmethod
+    def _batch_ident_for(task, job):
+        """ra -> batch identifier, shared by the device- and host-vector
+        accumulator commit paths (they must bucket identically)."""
+        from ..datastore.query_type import strategy_for
+
+        strategy = strategy_for(task)
+
+        def ident_for(ra):
+            if job.partial_batch_identifier is not None:
+                return job.partial_batch_identifier.get_encoded()
+            return strategy.to_batch_identifier(task, ra.time)
+
+        return ident_for
+
+    async def _collected_idents(self, task, job, idents) -> set:
+        """Pre-tx collected check shared by both accumulator commit paths:
+        batches already past AGGREGATING must not be accumulated/journaled
+        now — the writer tx would fail their reports and every redelivery
+        would re-trip the StaleAccumulatorDelta fence."""
+        if self.datastore is None or not idents:
+            return set()
+        from ..datastore import BatchAggregationState
+
+        def check(tx):
+            out = set()
+            for ident in idents:
+                bas = tx.get_batch_aggregations_for_batch(
+                    task.task_id, ident, job.aggregation_parameter
+                )
+                if any(
+                    ba.state != BatchAggregationState.AGGREGATING for ba in bas
+                ):
+                    out.add(ident)
+            return out
+
+        return await self.datastore.run_tx_async("accum_collected_check", check)
+
     async def _commit_resident_shares(
         self, task, vdaf, job, all_ras, states, out_shares
     ) -> Tuple[
@@ -970,7 +1169,6 @@ class AggregationJobDriver:
         store = self._executor.accumulator if self._executor is not None else None
         if store is None:
             return None, None, []
-        from ..datastore.query_type import strategy_for
         from ..executor.accumulator import AccumulatorUnavailable, ResidentRef
         from ..vdaf.canonical import clip_drained_vector
 
@@ -988,16 +1186,26 @@ class AggregationJobDriver:
         if leftover:
             store.release_refs(leftover)
         if not resident:
+            if (
+                getattr(vdaf, "REQUIRES_AGG_PARAM", False)
+                and getattr(store.config, "deferred", False)
+                and out_shares
+            ):
+                # Agg-param VDAFs (Poplar1): finished out shares are HOST
+                # vectors (the sketch y values finish in the ping-pong
+                # layer), but the deferred-drain machinery — agg-param-
+                # keyed buckets, persisted journal rows, cadence drains,
+                # crash replay — applies identically.  Route them through
+                # the store's host-vector commit so N jobs at one tree
+                # level merge as ONE datastore write with the journal as
+                # the exactly-once fence.
+                return await self._commit_deferred_host_shares(
+                    task, vdaf, job, all_ras, out_shares
+                )
             return None, None, []
 
         ra_by_rid = {ra.report_id.data: ra for ra in all_ras}
-        strategy = strategy_for(task)
-
-        def ident_for(ra):
-            if job.partial_batch_identifier is not None:
-                return job.partial_batch_identifier.get_encoded()
-            return strategy.to_batch_identifier(task, ra.time)
-
+        ident_for = self._batch_ident_for(task, job)
         by_ident: Dict[bytes, List[bytes]] = {}
         for rid in resident:
             by_ident.setdefault(ident_for(ra_by_rid[rid]), []).append(rid)
@@ -1016,25 +1224,7 @@ class AggregationJobDriver:
         # pops them harmlessly).  The residual race (collection commits
         # between this check and our tx) still aborts cleanly via
         # StaleAccumulatorDelta -> retryable redelivery.
-        collected: set = set()
-        if self.datastore is not None and by_ident:
-            from ..datastore import BatchAggregationState
-
-            def check(tx):
-                out = set()
-                for ident in by_ident:
-                    bas = tx.get_batch_aggregations_for_batch(
-                        task.task_id, ident, job.aggregation_parameter
-                    )
-                    if any(
-                        ba.state != BatchAggregationState.AGGREGATING for ba in bas
-                    ):
-                        out.add(ident)
-                return out
-
-            collected = await self.datastore.run_tx_async(
-                "accum_collected_check", check
-            )
+        collected = await self._collected_idents(task, job, by_ident)
 
         deferred = getattr(store.config, "deferred", False)
         deltas: Dict[bytes, Tuple[Sequence[int], frozenset]] = {}
@@ -1162,6 +1352,84 @@ class AggregationJobDriver:
             # provably-zero pad tail back to the task's OUTPUT_LEN
             deltas[ident] = (clip_drained_vector(vdaf, vector), frozenset(drained_rids))
         return deltas or None, journal_entries or None, touched
+
+    async def _commit_deferred_host_shares(
+        self, task, vdaf, job, all_ras, out_shares
+    ):
+        """Deferred accumulation of HOST-vector out shares (agg-param
+        VDAFs): per batch bucket, sum this job's finished vectors into the
+        store's agg-param-keyed host mirror (commit_host_rows) and hand
+        the writer journal entries instead of shares.  The bucket key —
+        and the persisted ``accumulator_journal`` row — carry the job's
+        encoded aggregation parameter, so two tree levels of one task
+        land in DISTINCT buckets and journal rows and can never merge.
+        Journaled rows' out_shares are replaced with sentinel refs so the
+        writer defers them; a store failure leaves this commit cleanly
+        un-applied and the job's vectors merge directly (no deferral, no
+        journal row — still exactly-once)."""
+        store = self._executor.accumulator
+        from ..executor.accumulator import ResidentRef
+
+        ra_by_rid = {ra.report_id.data: ra for ra in all_ras}
+        ident_for = self._batch_ident_for(task, job)
+        by_ident: Dict[bytes, List[bytes]] = {}
+        for rid in out_shares:
+            by_ident.setdefault(ident_for(ra_by_rid[rid]), []).append(rid)
+
+        # Pre-tx collected check (same rationale as the ResidentRef path):
+        # journaling a report the writer tx will fail guarantees a
+        # StaleAccumulatorDelta abort on every redelivery.
+        collected = await self._collected_idents(task, job, by_ident)
+
+        shape_key = self._vdaf_shape_key(vdaf)
+        field = vdaf.field_for_agg_param(
+            vdaf.decode_agg_param(job.aggregation_parameter)
+        )
+        loop = asyncio.get_running_loop()
+        journal_entries: Dict[bytes, frozenset] = {}
+        touched: List[tuple] = []
+        for ident, rids in by_ident.items():
+            if ident in collected:
+                continue  # writer fails these in-tx; vectors merge nowhere
+            bucket_key = (
+                "leader",
+                task.task_id.data,
+                shape_key,
+                ident,
+                job.aggregation_parameter,
+            )
+            vectors = [out_shares[rid] for rid in rids]
+
+            def commit(bucket_key=bucket_key, vectors=vectors, rids=rids):
+                store.commit_host_rows(
+                    bucket_key,
+                    field,
+                    vectors,
+                    job_token=job.aggregation_job_id.data,
+                    report_ids=rids,
+                )
+
+            try:
+                await loop.run_in_executor(None, commit)
+            except Exception as e:
+                # commit_host_rows mutates nothing on failure: this job's
+                # vectors are still in out_shares and merge directly in
+                # the writer tx — exactly-once without the deferral.
+                logger.warning(
+                    "host-share accumulator commit failed for bucket %r; "
+                    "merging this job's %d vector(s) directly: %s",
+                    bucket_key,
+                    len(rids),
+                    e,
+                )
+                continue
+            journal_entries[ident] = frozenset(rids)
+            touched.append(bucket_key)
+            for i, rid in enumerate(rids):
+                # journaled sentinel: the writer must defer these rows to
+                # the journal (their vectors now live in the store)
+                out_shares[rid] = ResidentRef(-1, i)
+        return None, journal_entries or None, touched
 
     def _oracle_out_shares(self, task, vdaf, backend, ras):
         """Bit-exact CPU replay of finished reports' out shares (backend

@@ -116,6 +116,13 @@ class Config:
     #: default (JANUS_TPU_FIELD_BACKEND or "vpu").
     field_backend: Optional[str] = None
     collection_job_retry_after: int = 10
+    #: Aggregation-job size for agg-param VDAFs (Poplar1), whose jobs are
+    #: created by the collection request (_create_agg_param_jobs) rather
+    #: than the periodic creator: one collection's reports split into
+    #: ceil(N/this) jobs per level.  Small values + the device executor
+    #: mean the split costs nothing at prepare time — the jobs' rows
+    #: re-coalesce in the level-keyed poplar_init bucket.
+    max_agg_param_job_size: int = 256
     #: Process-wide device executor (executor.ExecutorConfig): when set and
     #: enabled, the HELPER's Prio3 prep_init/combine launches submit
     #: through the same continuous batcher the drivers feed, so the
@@ -484,6 +491,15 @@ class Aggregator:
             # so helper requests coalesce with driver traffic and the
             # circuit breaker guards this path too.
             results = await self._helper_prepare_batch_prio3_executor(ta, decoded)
+        elif self._executor is not None and hasattr(
+            ta.backend, "prep_init_batch_poplar"
+        ):
+            # Heavy hitters through the same dispatch plane: the request's
+            # rows coalesce in the agg-param(level)-keyed poplar_init
+            # bucket, breaker + oracle degradation included.
+            results = await self._helper_prepare_batch_poplar1_executor(
+                ta, decoded, agg_param
+            )
         else:
             results = await loop.run_in_executor(
                 None, lambda: self._helper_prepare_batch(ta, decoded, agg_param)
@@ -724,14 +740,32 @@ class Aggregator:
                 )
         return results
 
-    def _helper_prepare_batch_poplar1(self, ta: TaskAggregator, decoded, agg_param):
+    def _helper_prepare_batch_poplar1(
+        self, ta: TaskAggregator, decoded, agg_param, backend=None
+    ):
         """Heavy hitters through the batched backend: the round-0 IDPF tree
         walk + sketch runs once for the whole job (ops/poplar1_batch.py);
         the per-report remainder is the same combine/transition
         helper_initialized performs (reference: Poplar1 rides the common
-        accelerated dispatch, core/src/vdaf.rs:96)."""
+        accelerated dispatch, core/src/vdaf.rs:96).  ``backend`` overrides
+        ``ta.backend`` — the executor routing passes the per-report CPU
+        oracle here while the shape's circuit is open."""
+        backend = backend if backend is not None else ta.backend
         vdaf = ta.vdaf
-        vk = ta.task.vdaf_verify_key
+        results, rows = self._helper_decode_poplar_rows(vdaf, decoded)
+        if not rows:
+            return results
+        prep_out = backend.prep_init_batch_poplar(
+            ta.task.vdaf_verify_key,
+            1,
+            agg_param,
+            [(n, p, s) for (_, n, p, s, _) in rows],
+        )
+        return self._helper_finish_poplar1(vdaf, agg_param, results, rows, prep_out)
+
+    @staticmethod
+    def _helper_decode_poplar_rows(vdaf, decoded):
+        """Decode the leader's round-0 sketch shares; (errors, rows)."""
         results: Dict[int, object] = {}
         rows = []
         for idx, (nonce, public_parts, input_share, leader_msg) in decoded:
@@ -745,11 +779,12 @@ class Aggregator:
                 results[idx] = PrepareError.VDAF_PREP_ERROR
                 continue
             rows.append((idx, nonce, public_parts, input_share, leader_share))
-        if not rows:
-            return results
-        prep_out = ta.backend.prep_init_batch_poplar(
-            vk, 1, agg_param, [(n, p, s) for (_, n, p, s, _) in rows]
-        )
+        return results, rows
+
+    @staticmethod
+    def _helper_finish_poplar1(vdaf, agg_param, results, rows, prep_out):
+        """Combine sketch shares + evaluate the transition per report (the
+        cheap sigma math the executor path runs after its mega-batch)."""
         for (idx, _n, _p, _s, leader_share), outcome in zip(rows, prep_out):
             if isinstance(outcome, VdafError):
                 results[idx] = PrepareError.VDAF_PREP_ERROR
@@ -773,6 +808,78 @@ class Aggregator:
                     outbound,
                 )
         return results
+
+    async def _helper_prepare_batch_poplar1_executor(
+        self, ta: TaskAggregator, decoded, agg_param
+    ):
+        """Helper Poplar1 prep through the process-wide device executor:
+        the request's rows submit into the agg-param-keyed ``poplar_init``
+        bucket (agg_id=1, level discriminant), coalescing with every other
+        helper request at the same tree level.  A co-resident driver's
+        leader traffic keeps its own agg_id=0 bucket (the sides' walks
+        differ) but shares the per-shape circuit breaker.  Failure-domain
+        parity with the Prio3 helper path: an open circuit degrades the
+        request to the bit-exact per-report CPU oracle, and executor
+        backpressure surfaces as a retryable 503 to the leader."""
+        from ..executor import KIND_POPLAR_INIT
+        from ..executor.service import CircuitOpenError, ExecutorOverloadedError
+        from ..vdaf.backend import oracle_backend_for, vdaf_shape_key
+
+        vdaf = ta.vdaf
+        shape_key = vdaf_shape_key(vdaf)
+        # shape-keyed cache: every request (and any driver in-process)
+        # shares one batched backend per Poplar1 `bits` shape
+        backend = self._executor.backend_for(shape_key, lambda: ta.backend)
+        task_ident = getattr(getattr(ta.task, "task_id", None), "data", None)
+        loop = asyncio.get_running_loop()
+
+        def oracle_path():
+            oracle = oracle_backend_for(backend, vdaf) or backend
+            return self._helper_prepare_batch_poplar1(
+                ta, decoded, agg_param, backend=oracle
+            )
+
+        if self._executor.circuit_open(shape_key):
+            return await loop.run_in_executor(None, oracle_path)
+        results, rows = await loop.run_in_executor(
+            None, lambda: self._helper_decode_poplar_rows(vdaf, decoded)
+        )
+        if not rows:
+            return results
+        prep_in = [(nonce, public, share) for (_, nonce, public, share, _) in rows]
+        try:
+            prep_out = await self._executor.submit(
+                shape_key,
+                KIND_POPLAR_INIT,
+                (ta.task.vdaf_verify_key, agg_param, prep_in),
+                backend=backend,
+                agg_id=1,
+                task_ident=task_ident,
+                agg_param_key=getattr(agg_param, "level", None),
+            )
+        except CircuitOpenError:
+            # re-enter past the decode: (results, rows) are already built
+            oracle = oracle_backend_for(backend, vdaf) or backend
+
+            def finish_on_oracle():
+                out = oracle.prep_init_batch_poplar(
+                    ta.task.vdaf_verify_key, 1, agg_param, prep_in
+                )
+                return self._helper_finish_poplar1(
+                    vdaf, agg_param, results, rows, out
+                )
+
+            return await loop.run_in_executor(None, finish_on_oracle)
+        except ExecutorOverloadedError as e:
+            from .error import ServiceUnavailable
+
+            raise ServiceUnavailable(f"device executor overloaded: {e}")
+        return await loop.run_in_executor(
+            None,
+            lambda: self._helper_finish_poplar1(
+                vdaf, agg_param, results, rows, prep_out
+            ),
+        )
 
     @staticmethod
     def _helper_decode_leader_shares(vdaf, decoded):
@@ -1480,8 +1587,9 @@ class Aggregator:
             ):
                 continue  # already aggregated at this level
             fresh.append(report)
-        for i in range(0, len(fresh), 256):
-            chunk = fresh[i : i + 256]
+        job_size = max(1, self.config.max_agg_param_job_size)
+        for i in range(0, len(fresh), job_size):
+            chunk = fresh[i : i + job_size]
             job_id = AggregationJobId.random()
             start = min(r.time.seconds for r in chunk)
             end = max(r.time.seconds for r in chunk) + 1

@@ -403,12 +403,10 @@ class CollectionJobDriver:
                     f"report {rid.hex()} without a replayable payload"
                 )
             rows.append(ra)
-        field = vdaf.field_for_agg_param(
-            vdaf.decode_agg_param(entry.aggregation_parameter)
-        )
+        agg_param = vdaf.decode_agg_param(entry.aggregation_parameter)
+        field = vdaf.field_for_agg_param(agg_param)
 
         def recompute():
-            oracle = OracleBackend(vdaf)
             prep_in = [
                 (
                     ra.report_id.data,
@@ -417,6 +415,22 @@ class CollectionJobDriver:
                 )
                 for ra in rows
             ]
+            if getattr(vdaf, "REQUIRES_AGG_PARAM", False):
+                # Agg-param VDAFs (Poplar1): replay at the journal row's
+                # OWN parameter — the row carries it precisely so two tree
+                # levels can never cross — re-walking each report's IDPF
+                # share and summing the prefix-value vectors the FINISHED
+                # verdict already vouched for (the sketch verified before
+                # the row was journaled).
+                total = None
+                for nonce, public, share in prep_in:
+                    state, _sh = vdaf.prep_init(
+                        task.vdaf_verify_key, 0, agg_param, nonce, public, share
+                    )
+                    out = list(state.y_flat)
+                    total = out if total is None else field.vec_add(total, out)
+                return total
+            oracle = OracleBackend(vdaf)
             total = None
             for outcome in oracle.prep_init_batch(
                 task.vdaf_verify_key, 0, prep_in

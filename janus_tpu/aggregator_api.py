@@ -185,11 +185,17 @@ def aggregator_api_app(datastore: Datastore, auth_tokens: list) -> web.Applicati
         payload = _task_to_json(task)
         # Provisioning-time device-path check: surface (in the response AND
         # the log) when this VDAF will run on the CPU oracle regardless of a
-        # device backend configuration (VERDICT r3 weak #3).
+        # device backend configuration (VERDICT r3 weak #3).  Every task
+        # also gets an explicit `device_path` routing label — notably
+        # Poplar1, which used to read as a bare "supported" while riding a
+        # per-job path outside the executor (ISSUE 10: no silent tier
+        # split, in either direction).
         try:
-            from .vdaf.backend import device_supported
+            from .vdaf.backend import device_path_label, device_supported
 
-            ok, reason = device_supported(task.vdaf_instance())
+            vdaf_instance = task.vdaf_instance()
+            payload["device_path"] = device_path_label(vdaf_instance)
+            ok, reason = device_supported(vdaf_instance)
             if not ok:
                 warning = (
                     f"VDAF runs on the CPU oracle, not the device path: {reason}"

@@ -313,9 +313,15 @@ class AggregatorConfig:
     #: runs on the matrix units).  Bit-exact either way — the A/B toggle
     #: for ops/field_jax.py's MXU contraction layer.
     field_backend: str = "vpu"
+    #: Aggregation-job size for agg-param VDAFs (Poplar1), whose jobs are
+    #: created by the collection request rather than the periodic creator.
+    #: Small values cost nothing at prepare time with the executor on —
+    #: the jobs' rows re-coalesce in the level-keyed poplar_init bucket.
+    max_agg_param_job_size: int = 256
     #: Helper-side executor routing (default off): the helper's Prio3
-    #: prep_init/combine submit through the process-wide device executor,
-    #: sharing its continuous batching + circuit breaker with the drivers.
+    #: prep_init/combine — and Poplar1's poplar_init — submit through the
+    #: process-wide device executor, sharing its continuous batching +
+    #: circuit breaker with the drivers.
     device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
     garbage_collection_interval_s: Optional[float] = None
     #: Global-HPKE key rotation loop (reference: binaries/aggregator.rs:31-150

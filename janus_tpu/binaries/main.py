@@ -332,6 +332,7 @@ def run_aggregator(config_path: Optional[str]) -> None:
             task_counter_shard_count=cfg.task_counter_shard_count,
             vdaf_backend=cfg.vdaf_backend,
             field_backend=cfg.field_backend,
+            max_agg_param_job_size=cfg.max_agg_param_job_size,
             device_executor=cfg.device_executor.to_executor_config()
             if cfg.device_executor.enabled
             else None,

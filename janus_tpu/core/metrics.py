@@ -375,19 +375,26 @@ class Metrics:
         )
 
         # Device executor (janus_tpu/executor/): continuous cross-job
-        # batching visibility per (circuit, aggregator-side, phase) bucket.
-        # flush_rows vs. the per-job submission size is the direct measure
-        # of cross-job coalescing; queue_rows + wait/launch seconds expose
-        # whether backpressure or the chip is the bottleneck.
+        # batching visibility per (circuit, aggregator-side, phase[,
+        # agg-param level]) bucket.  The bucket label enumerates the
+        # submission KINDS — prep_init / combine (Prio3) and poplar_init
+        # (Poplar1 heavy hitters, whose label carries an L{level} segment:
+        # one series per IDPF tree level, so a multi-round collection's
+        # per-level batching is visible round by round).  flush_rows vs.
+        # the per-job submission size is the direct measure of cross-job
+        # coalescing; queue_rows + wait/launch seconds expose whether
+        # backpressure or the chip is the bottleneck.
         self.executor_queue_rows = Gauge(
             "janus_executor_queue_rows",
-            "Report rows queued or in flight per executor bucket",
+            "Report rows queued or in flight per executor bucket "
+            "(circuit/side/kind, Poplar1 buckets carry the tree level)",
             ["bucket"],
             registry=self.registry,
         )
         self.executor_flush_rows = Histogram(
             "janus_executor_flush_rows",
-            "Mega-batch size (rows) per executor flush",
+            "Mega-batch size (rows) per executor flush "
+            "(all submission kinds: prep_init, combine, poplar_init)",
             ["bucket"],
             buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536),
             registry=self.registry,
@@ -401,7 +408,8 @@ class Metrics:
         )
         self.executor_launch_seconds = Histogram(
             "janus_executor_launch_duration_seconds",
-            "Device launch wall time per executor flush by bucket",
+            "Device launch wall time per executor flush by bucket "
+            "(poplar_init flushes include the bulk-AES walk)",
             ["bucket"],
             buckets=_LATENCY_BUCKETS,
             registry=self.registry,

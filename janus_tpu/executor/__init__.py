@@ -16,6 +16,7 @@ from .accumulator import (
 )
 from .service import (
     KIND_COMBINE,
+    KIND_POPLAR_INIT,
     KIND_PREP_INIT,
     CircuitBreaker,
     CircuitOpenError,
@@ -40,6 +41,7 @@ __all__ = [
     "ExecutorConfig",
     "ExecutorOverloadedError",
     "KIND_COMBINE",
+    "KIND_POPLAR_INIT",
     "KIND_PREP_INIT",
     "ResidentRef",
     "StaleAccumulatorDelta",

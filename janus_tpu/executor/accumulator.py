@@ -139,11 +139,19 @@ class _Flush:
 
 
 class _Bucket:
-    """Persistent accumulator for one (task, shape, batch-bucket)."""
+    """Persistent accumulator for one (task, shape, batch-bucket,
+    agg-param) — the aggregation parameter (Poplar1's encoded level +
+    prefixes; b"" for Prio3) is part of the caller's key tuple, so two
+    rounds of one heavy-hitters task can never share a bucket."""
 
     def __init__(self, key: tuple, backend):
         self.key = key
+        #: minting device backend; None for host-vector buckets
+        #: (commit_host_rows — Poplar1 sketch deltas), whose only state is
+        #: the spilled_host mirror
         self.backend = backend
+        #: drain-time field for host-vector buckets (backend is None there)
+        self.field = None
         #: device (OUT, n) limb buffer; None until the first commit
         self.buffer = None
         self.buffer_nbytes = 0
@@ -301,6 +309,58 @@ class DeviceAccumulatorStore:
                 bucket.last_used = time.monotonic()
                 for ref in refs:
                     self._consume_row_locked(ref)
+        self._observe()
+
+    def commit_host_rows(
+        self,
+        bucket_key: tuple,
+        field,
+        vectors: Sequence[Sequence[int]],
+        *,
+        job_token,
+        report_ids: Sequence[bytes],
+    ) -> None:
+        """Host-vector twin of :meth:`commit_rows` for VDAFs whose out
+        shares are materialized on the host (Poplar1's sketch ``y``
+        vectors finish in the ping-pong layer as field ints): sum
+        ``vectors`` into the bucket's host mirror and journal the delta
+        under the SAME exactly-once fence — deferred drains, cadence
+        scans, poisoning, and the datastore journal/replay machinery all
+        behave identically to device buckets.  What the store adds for
+        these buckets is not PCIe savings but the cross-job level-keyed
+        accumulation window: N jobs at one tree level merge as ONE
+        datastore vector write, with the persisted journal rows making a
+        crash before the drain recoverable.  Host mirrors are off the
+        resident-byte budget (same posture as evicted device state)."""
+        if not vectors:
+            return
+        if len(vectors) != len(report_ids):
+            raise AccumulatorError("one vector per report id required")
+        with self._lock:
+            bucket = self._buckets.get(bucket_key)
+            if bucket is None:
+                bucket = _Bucket(bucket_key, None)
+                self._buckets[bucket_key] = bucket
+            if bucket.poisoned:
+                raise AccumulatorUnavailable(
+                    f"bucket {bucket_key!r} poisoned by an earlier failure"
+                )
+        with bucket.oplock:
+            # same re-validation as commit_rows: a concurrent drain/discard
+            # may have detached the bucket after the lookup
+            if bucket.closed or bucket.poisoned:
+                raise AccumulatorUnavailable(
+                    f"bucket {bucket_key!r} was drained/poisoned concurrently"
+                )
+            bucket.field = field
+            acc = bucket.spilled_host
+            for v in vectors:
+                acc = list(v) if acc is None else field.vec_add(acc, v)
+            bucket.spilled_host = acc
+            with self._lock:
+                bucket.journal.append((job_token, frozenset(report_ids)))
+                bucket.row_count += len(vectors)
+                bucket.last_used = time.monotonic()
         self._observe()
 
     @staticmethod
@@ -510,10 +570,21 @@ class DeviceAccumulatorStore:
         for key in keys:
             try:
                 with self._lock:
-                    backend = self._buckets[key].backend if key in self._buckets else None
-                if backend is None:
+                    b = self._buckets.get(key)
+                    # host-vector buckets carry their drain field directly;
+                    # device buckets derive it from the minting backend
+                    field = (
+                        None
+                        if b is None
+                        else (b.field or getattr(
+                            getattr(getattr(b.backend, "vdaf", None), "flp", None),
+                            "field",
+                            None,
+                        ))
+                    )
+                if field is None:
                     continue
-                out = self.drain_with_journal(key, backend.vdaf.flp.field)
+                out = self.drain_with_journal(key, field)
                 if out is not None:
                     sink(key, out[0], out[1])
             except Exception:

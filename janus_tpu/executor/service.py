@@ -12,9 +12,14 @@ kernel pool — shaped like an inference-serving continuous batcher:
   other producer of prepare work) enqueues into a process-wide service
   that owns the device.
 * **Bucketed continuous batching**: submissions are grouped per
-  ``(vdaf_shape_key, kind, agg_id)`` bucket and flushed as ONE
-  pow2-padded mega-batch when the bucket reaches ``flush_max_rows`` or
-  its ``flush_window_s`` deadline expires — whichever comes first.
+  ``(vdaf_shape_key, kind, agg_id, agg_param_key)`` bucket and flushed as
+  ONE pow2-padded mega-batch when the bucket reaches ``flush_max_rows``
+  or its ``flush_window_s`` deadline expires — whichever comes first.
+  The agg-param key is an OPAQUE per-VDAF discriminant of the submission's
+  aggregation parameter: Prio3 (no parameter) passes None, Poplar1 passes
+  its IDPF tree level — so multi-round heavy-hitter rounds from different
+  jobs at the SAME level coalesce into one bulk-AES walk + device sketch
+  mega-batch, while two levels of one task can never share a bucket.
 * **Compiled-executable cache + warmup**: backends are shape-keyed and
   shared by every submitter, so one compiled graph serves all tasks;
   ``warmup_backend`` precompiles the configured mega-batch shapes before
@@ -51,6 +56,13 @@ logger = logging.getLogger("janus_tpu.executor")
 #: Submission kinds (the "phase" of the bucket key).
 KIND_PREP_INIT = "prep_init"
 KIND_COMBINE = "combine"
+#: Poplar1 heavy-hitters round-0 prepare: payload is (verify_key,
+#: agg_param, reports) and the flush runs ONE bulk-AES IDPF walk + device
+#: sketch for every submission in the bucket
+#: (Poplar1Backend.prep_init_multi_poplar).  Buckets of this kind carry an
+#: agg-param key (the tree LEVEL), so different jobs at one level coalesce
+#: while levels never share a mega-batch.
+KIND_POPLAR_INIT = "poplar_init"
 
 
 class ExecutorOverloadedError(Exception):
@@ -293,16 +305,23 @@ class _Bucket:
         return self.flushed_rows / self.flushes if self.flushes else 0.0
 
 
-def bucket_label(backend, kind: str, agg_id: int, shape_key: tuple = None) -> str:
-    """Compact metric label: circuit/aggregator-side/phase.
+def bucket_label(
+    backend, kind: str, agg_id: int, shape_key: tuple = None, agg_param_key=None
+) -> str:
+    """Compact metric label: circuit/aggregator-side/phase[/level].
 
     ``shape_key`` appends a stable digest so two parameterizations of the
     same circuit (e.g. Histogram length=4 vs length=1024) never share a
-    label — stats() and the per-bucket gauges key on it."""
+    label — stats() and the per-bucket gauges key on it.  ``agg_param_key``
+    (agg-param VDAFs: Poplar1 passes its tree level) renders as an ``L{k}``
+    segment so an operator reading /statusz or the ``janus_executor_*``
+    series can tell which LEVEL of a heavy-hitters run a bucket serves."""
     vdaf = getattr(backend, "vdaf", None)
     valid = getattr(getattr(vdaf, "flp", None), "valid", None)
     circuit = type(valid).__name__ if valid is not None else type(vdaf).__name__
     label = f"{circuit}/a{agg_id}/{kind}"
+    if agg_param_key is not None:
+        label += f"/L{agg_param_key}"
     if shape_key is not None:
         label += "#" + _shape_digest(shape_key)
     return label
@@ -606,20 +625,30 @@ class DeviceExecutor:
         deadline_s: Optional[float] = None,
         retain_out_shares: bool = False,
         task_ident: Optional[object] = None,
+        agg_param_key: Optional[object] = None,
     ):
         """Enqueue prepare work; resolves when its mega-batch lands.
 
         kind=KIND_PREP_INIT: payload is (verify_key, report_rows) and the
         result is the per-row List[PrepOutcome].  kind=KIND_COMBINE:
         payload is the prep-share rows and the result is the per-row
-        combine outcomes.  Raises ExecutorOverloadedError on backpressure.
-        ``task_ident`` attributes the rows to a task for the per-task
-        fairness quota within the bucket (None = unattributed).
+        combine outcomes.  kind=KIND_POPLAR_INIT: payload is (verify_key,
+        agg_param, report_rows) and the result is the per-row Poplar1
+        (state, share) outcomes.  Raises ExecutorOverloadedError on
+        backpressure.  ``task_ident`` attributes the rows to a task for
+        the per-task fairness quota within the bucket (None =
+        unattributed).  ``agg_param_key`` is the opaque agg-param bucket
+        discriminant (None for parameter-less VDAFs; Poplar1 passes the
+        tree level): submissions coalesce only within one value, so two
+        rounds of one task can never share a mega-batch — but different
+        JOBS at one level do.
         """
         if kind == KIND_PREP_INIT:
             rows = len(payload[1])
         elif kind == KIND_COMBINE:
             rows = len(payload)
+        elif kind == KIND_POPLAR_INIT:
+            rows = len(payload[2])
         else:
             raise ValueError(f"unknown submission kind {kind!r}")
         if rows == 0:
@@ -635,7 +664,7 @@ class DeviceExecutor:
         loop = asyncio.get_running_loop()
         now = time.monotonic()
         timeout = self.config.submit_timeout_s if deadline_s is None else deadline_s
-        key = (shape_key, kind, agg_id)
+        key = (shape_key, kind, agg_id, agg_param_key)
         with self._lock:
             bucket = self._buckets.get(key)
             if bucket is None:
@@ -644,7 +673,7 @@ class DeviceExecutor:
                     backend,
                     kind,
                     agg_id,
-                    bucket_label(backend, kind, agg_id, shape_key),
+                    bucket_label(backend, kind, agg_id, shape_key, agg_param_key),
                     breaker=breaker,
                 )
                 self._buckets[key] = bucket
@@ -1015,6 +1044,41 @@ class DeviceExecutor:
                             still,
                         )
 
+                    outs, still = await loop.run_in_executor(launch_pool, launch)
+                elif bucket.kind == KIND_POPLAR_INIT:
+                    # Poplar1 mega-batch: every submission's (verify_key,
+                    # agg_param, reports) payload IS a request row for the
+                    # multi-request walk — submissions sharing an agg param
+                    # (different jobs, one level) run as ONE bulk-AES walk
+                    # + ONE device sketch with per-row verify keys.  The
+                    # host-AES half dominates, so the whole flush runs on
+                    # the launch thread like combine (no stage/launch split
+                    # to double-buffer) — and, unlike prep_init (whose
+                    # staged padding already covers expired rows), the walk
+                    # runs ONLY the still-live submissions: paying bulk AES
+                    # for deadline-rejected rows would amplify exactly the
+                    # overload that expired them.  Results are re-expanded
+                    # to live-alignment ([] placeholders) for the shared
+                    # resolution loop below.
+                    def launch():
+                        still = self._reject_expired(bucket, live)
+                        if not still:
+                            return None, []
+                        still_ids = {id(s) for s in still}
+                        outs_still = iter(
+                            bucket.backend.prep_init_multi_poplar(
+                                bucket.agg_id, [s.payload for s in still]
+                            )
+                        )
+                        return (
+                            [
+                                next(outs_still) if id(s) in still_ids else []
+                                for s in live
+                            ],
+                            still,
+                        )
+
+                    t_launch = time.monotonic()
                     outs, still = await loop.run_in_executor(launch_pool, launch)
                 else:  # KIND_COMBINE: concatenate rows, launch once, slice
                     concat = [row for s in live for row in s.payload]

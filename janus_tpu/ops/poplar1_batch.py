@@ -25,7 +25,7 @@ accelerated dispatch as Prio3).
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,15 +38,16 @@ def _ciphers_for(nonces: Sequence[bytes]):
     """Per-report ECB encryptors for the two IDPF usages (extend/convert).
 
     The fixed key depends on (dst, nonce) only — two key schedules per
-    report for the WHOLE walk."""
-    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+    report for the WHOLE walk.  Encryptors resolve through the softaes
+    seam: `cryptography` (AES-NI) when present, numpy soft-AES otherwise."""
+    from ..utils.softaes import aes128_ecb_encryptor
 
     enc = []
     for nonce in nonces:
         pair = []
         for usage in (0, 1):
             key = _fixed_key_aes128(_dst(usage), nonce)
-            pair.append(Cipher(algorithms.AES(key), modes.ECB()).encryptor())
+            pair.append(aes128_ecb_encryptor(key))
         enc.append(pair)
     return enc
 
@@ -217,7 +218,7 @@ class BatchedPoplar1:
     # -- batched sketch ---------------------------------------------------
     def sketch_batch(
         self,
-        verify_key: bytes,
+        verify_key,  # bytes, or a per-report Sequence[bytes]
         agg_id: int,
         agg_param,
         nonces: Sequence[bytes],
@@ -229,7 +230,10 @@ class BatchedPoplar1:
         z = a + Σ r_i y_i ;  zs = b + Σ r_i² y_i — the (B, P) double inner
         product runs as JField limb math on the accelerator; the verify
         randomness r comes from the host TurboSHAKE oracle (tiny, per
-        report).  Byte parity: exact mod-p identities.
+        report).  ``verify_key`` may be per-REPORT (a sequence): the
+        executor's poplar_init mega-batches carry rows from multiple tasks,
+        each with its own key — exactly the per-row traced-verify-key trick
+        the Prio3 mega-batches use.  Byte parity: exact mod-p identities.
         """
         import jax.numpy as jnp
 
@@ -237,8 +241,14 @@ class BatchedPoplar1:
         field = vdaf.field_for_agg_param(agg_param)
         jf = self._jfield(field)
         B, P = y.shape
+        vks = (
+            verify_key
+            if not isinstance(verify_key, (bytes, bytearray))
+            else [verify_key] * B
+        )
         rs = [
-            vdaf._verify_rands(verify_key, nonce, agg_param) for nonce in nonces
+            vdaf._verify_rands(vk, nonce, agg_param)
+            for vk, nonce in zip(vks, nonces)
         ]  # (B, P) ints
         y_l = jnp.asarray(
             jf.to_limbs([int(v) for row in y for v in row]).reshape(B, P, jf.n)
@@ -274,6 +284,20 @@ class BatchedPoplar1:
         Returns per-report (Poplar1PrepareState, Poplar1PrepareShare),
         byte-identical to the oracle's prep_init.
         """
+        return self._prep_rows(
+            [verify_key] * len(reports), agg_id, agg_param, reports
+        )
+
+    def _prep_rows(
+        self,
+        verify_keys: Sequence[bytes],
+        agg_id: int,
+        agg_param,
+        reports: Sequence[Tuple[bytes, object, object]],
+    ):
+        """The per-row-verify-key core: ONE bulk-AES tree walk + ONE device
+        sketch launch for rows that may span multiple tasks (each row uses
+        its own verify key for the sketch randomness)."""
         from ..vdaf.poplar1 import (
             Poplar1PrepareShare,
             Poplar1PrepareState,
@@ -298,7 +322,7 @@ class BatchedPoplar1:
                 inner, leaf = share.corr_inner, share.corr_leaf
             abc.append(leaf if level == vdaf.bits - 1 else inner[level])
 
-        zzs = self.sketch_batch(verify_key, agg_id, agg_param, nonces, y, abc)
+        zzs = self.sketch_batch(verify_keys, agg_id, agg_param, nonces, y, abc)
         out = []
         for b, ((z, zs), (a, bb, c)) in enumerate(zip(zzs, abc)):
             if not ok[b]:
@@ -306,7 +330,7 @@ class BatchedPoplar1:
                 # for some tree value was non-canonical.
                 out.append(
                     vdaf.prep_init(
-                        verify_key, agg_id, agg_param,
+                        verify_keys[b], agg_id, agg_param,
                         reports[b][0], reports[b][1], reports[b][2],
                     )
                 )
@@ -323,3 +347,43 @@ class BatchedPoplar1:
             )
             out.append((state, Poplar1PrepareShare(_field_tag(field), [z, zs])))
         return out
+
+    def prep_init_multi(
+        self,
+        agg_id: int,
+        requests: Sequence[Tuple[bytes, object, Sequence[Tuple[bytes, object, object]]]],
+    ):
+        """ONE walk serving rows from MULTIPLE jobs/tasks: the executor's
+        poplar_init mega-batch form.
+
+        ``requests``: (verify_key, agg_param, reports) per submission.
+        Submissions sharing an aggregation parameter — different jobs of
+        one task at one tree level, the multi-round collection steady state
+        — are concatenated into ONE bulk-AES tree walk + ONE device sketch
+        launch with per-row verify keys.  Distinct parameters at the same
+        level (different tasks, or different prefix sets) run one walk per
+        parameter within the flush: the IDPF frontier and the sketch
+        randomness binder are parameter-shaped, so merging them would
+        change bytes.  Results return per request, byte-identical to
+        separate prep_init_batch calls.
+        """
+        if not requests:
+            return []
+        groups: Dict[object, List[int]] = {}
+        for i, (_vk, agg_param, _reports) in enumerate(requests):
+            groups.setdefault(agg_param, []).append(i)
+        results: List[Optional[list]] = [None] * len(requests)
+        for agg_param, idxs in groups.items():
+            vks: List[bytes] = []
+            rows: List[Tuple[bytes, object, object]] = []
+            for i in idxs:
+                vk, _p, reports = requests[i]
+                vks.extend([vk] * len(reports))
+                rows.extend(reports)
+            outs = self._prep_rows(vks, agg_id, agg_param, rows) if rows else []
+            start = 0
+            for i in idxs:
+                n = len(requests[i][2])
+                results[i] = outs[start : start + n]
+                start += n
+        return results

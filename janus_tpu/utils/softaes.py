@@ -1,0 +1,140 @@
+"""Pure-numpy AES-128-ECB encryption: the `cryptography`-less fallback.
+
+The IDPF tree walk (vdaf/idpf.py, ops/poplar1_batch.py) needs exactly one
+primitive from the `cryptography` package: a fixed-key AES-128-ECB
+*encryptor* (the Davies-Meyer-style hash_block of XofFixedKeyAes128,
+draft-irtf-cfrg-vdaf-08 §6.2.2 — no decryption, no other modes).  Dev
+containers without `cryptography` (or with the test shim that stubs it
+out) used to lose the whole Poplar1 tier to that one import.  This module
+is the gate-don't-skip answer: a vectorized table-based AES-128 encryptor
+over (N, 16) u8 blocks, API-compatible with the ``encryptor().update``
+call sites.
+
+Performance posture: numpy table lookups run the whole batch per round
+(~20 vector ops per 10-round block set), plenty for tests and scaled
+bench rows.  Production hosts install `cryptography` (AES-NI at GB/s) and
+never reach this path — `aes128_ecb_encryptor` prefers it whenever its
+Cipher actually works.
+
+Correctness is anchored to the FIPS-197 appendix C.1 vector at import
+time (a table typo must fail loudly, never walk a wrong tree).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# FIPS-197 S-box, generated from the GF(2^8) inverse + affine map so the
+# table cannot drift from the spec by a transcription typo.
+def _build_sbox() -> np.ndarray:
+    # multiplicative inverse in GF(2^8) mod x^8+x^4+x^3+x+1
+    exp = [0] * 512
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        # multiply by generator 0x03
+        x ^= ((x << 1) ^ (0x1B if x & 0x80 else 0)) & 0xFF
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    sbox = np.zeros(256, dtype=np.uint8)
+    for v in range(256):
+        inv = 0 if v == 0 else exp[255 - log[v]]
+        b = inv
+        res = 0x63
+        for _ in range(4):
+            b = ((b << 1) | (b >> 7)) & 0xFF
+            res ^= b
+        sbox[v] = res ^ inv
+    return sbox
+
+
+_SBOX = _build_sbox()
+_MUL2 = np.array(
+    [((v << 1) ^ (0x1B if v & 0x80 else 0)) & 0xFF for v in range(256)],
+    dtype=np.uint8,
+)
+_MUL3 = _MUL2 ^ np.arange(256, dtype=np.uint8)
+#: ShiftRows as a flat-index permutation: byte i sits at (row=i%4,
+#: col=i//4); row r rotates left by r columns.
+_SHIFT = np.array(
+    [(i % 4) + 4 * (((i // 4) + (i % 4)) % 4) for i in range(16)], dtype=np.intp
+)
+_RCON = (0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36)
+
+
+def _expand_key(key: bytes) -> np.ndarray:
+    """(11, 16) u8 round keys."""
+    if len(key) != 16:
+        raise ValueError("AES-128 key must be 16 bytes")
+    words = [list(key[4 * i : 4 * i + 4]) for i in range(4)]
+    sbox = _SBOX
+    for i in range(4, 44):
+        t = list(words[i - 1])
+        if i % 4 == 0:
+            t = t[1:] + t[:1]
+            t = [int(sbox[b]) for b in t]
+            t[0] ^= _RCON[i // 4 - 1]
+        words.append([a ^ b for a, b in zip(words[i - 4], t)])
+    flat = [b for w in words for b in w]
+    return np.array(flat, dtype=np.uint8).reshape(11, 16)
+
+
+def _mix_columns(s: np.ndarray) -> np.ndarray:
+    """(N, 16) -> (N, 16); state reshaped (N, 4 cols, 4 rows)."""
+    a = s.reshape(-1, 4, 4)
+    a0, a1, a2, a3 = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    out = np.empty_like(a)
+    out[..., 0] = _MUL2[a0] ^ _MUL3[a1] ^ a2 ^ a3
+    out[..., 1] = a0 ^ _MUL2[a1] ^ _MUL3[a2] ^ a3
+    out[..., 2] = a0 ^ a1 ^ _MUL2[a2] ^ _MUL3[a3]
+    out[..., 3] = _MUL3[a0] ^ a1 ^ a2 ^ _MUL2[a3]
+    return out.reshape(-1, 16)
+
+
+def encrypt_blocks(round_keys: np.ndarray, blocks: np.ndarray) -> np.ndarray:
+    """AES-128 encrypt (N, 16) u8 blocks with precomputed round keys."""
+    s = blocks ^ round_keys[0]
+    for rnd in range(1, 10):
+        s = _SBOX[s][:, _SHIFT]
+        s = _mix_columns(s) ^ round_keys[rnd]
+    return _SBOX[s][:, _SHIFT] ^ round_keys[10]
+
+
+class SoftAes128Ecb:
+    """Duck-type of ``Cipher(AES(key), ECB()).encryptor()``: stateless ECB,
+    so ``update`` just encrypts every 16-byte block of its input."""
+
+    def __init__(self, key: bytes):
+        self._rk = _expand_key(key)
+
+    def update(self, data: bytes) -> bytes:
+        if len(data) % 16:
+            raise ValueError("ECB input must be a multiple of 16 bytes")
+        if not data:
+            return b""
+        blocks = np.frombuffer(data, dtype=np.uint8).reshape(-1, 16)
+        return encrypt_blocks(self._rk, blocks).tobytes()
+
+
+def aes128_ecb_encryptor(key: bytes):
+    """An AES-128-ECB encryptor: `cryptography` (AES-NI) when its Cipher
+    is importable AND functional, the numpy fallback otherwise.  The
+    functional probe matters: the dev-container crypto shim imports fine
+    but raises at Cipher construction."""
+    try:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        return Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+    except Exception:
+        return SoftAes128Ecb(key)
+
+
+# -- import-time anchor (FIPS-197 C.1) ---------------------------------------
+_vec = SoftAes128Ecb(bytes(range(16))).update(
+    bytes.fromhex("00112233445566778899aabbccddeeff")
+)
+if _vec != bytes.fromhex("69c4e0d86a7b0430d8cdb78070b4c55a"):  # pragma: no cover
+    raise AssertionError("softaes self-test failed (table corruption)")
+del _vec

@@ -1065,13 +1065,43 @@ class HybridXofBackend:
         return results
 
 
+class Poplar1Oracle:
+    """Scalar per-report Poplar1 prepare — the bit-exact CPU fallback the
+    executor-routed heavy-hitters path degrades to (circuit open, journal
+    replay), mirroring OracleBackend's role for Prio3."""
+
+    name = "poplar1-oracle"
+
+    def __init__(self, vdaf):
+        self.vdaf = vdaf
+
+    def prep_init_batch_poplar(self, verify_key, agg_id, agg_param, reports):
+        t0 = time.monotonic()
+        out = []
+        for nonce, public_share, input_share in reports:
+            try:
+                out.append(
+                    self.vdaf.prep_init(
+                        verify_key, agg_id, agg_param, nonce, public_share, input_share
+                    )
+                )
+            except VdafError as e:
+                out.append(e)
+        _observe_prepare(self.name, "init", len(out), time.monotonic() - t0)
+        return out
+
+
 class Poplar1Backend:
     """Batched prepare for Poplar1 (heavy hitters): bulk-AES IDPF tree walk
     on the host (AES-NI territory) + JField sketch inner products on the
     accelerator — see ops/poplar1_batch.py.  Exposed through the same
     dispatch seam as the Prio3 backends so the role logic stays
     VDAF-agnostic (reference: core/src/vdaf.rs:96 — Poplar1 rides the same
-    accelerated dispatch as Prio3)."""
+    accelerated dispatch as Prio3).  Through the device executor this
+    backend serves the ``poplar_init`` submission kind: mega-batches whose
+    bucket identity carries the aggregation parameter's tree LEVEL, so
+    ping-pong rounds from different jobs at one IDPF level coalesce into
+    one walk + one sketch launch (``prep_init_multi_poplar``)."""
 
     name = "poplar1-batch"
 
@@ -1080,12 +1110,43 @@ class Poplar1Backend:
 
         self.vdaf = vdaf
         self.bp = BatchedPoplar1(vdaf)
+        #: bit-exact per-report CPU fallback (breaker open / replay), the
+        #: same contract as the Prio3 backends' .oracle
+        self.oracle = Poplar1Oracle(vdaf)
+
+    def oracle_for(self, vdaf=None) -> "Poplar1Oracle":
+        """Uniform fallback-resolution face (oracle_backend_for): Poplar1
+        backends are never canonicalized, so the answer is always this
+        backend's own oracle."""
+        return self.oracle
 
     def prep_init_batch_poplar(self, verify_key, agg_id, agg_param, reports):
         """Batched round-0 prep: per-report (state, share), oracle parity."""
+        return self.prep_init_multi_poplar(
+            agg_id, [(verify_key, agg_param, reports)]
+        )[0]
+
+    def prep_init_multi_poplar(self, agg_id, requests):
+        """ONE bulk-AES walk + sketch launch for rows from MULTIPLE jobs
+        (``requests``: (verify_key, agg_param, reports) per submission —
+        the executor's poplar_init flush form).  Same failure domain as
+        the Prio3 device launches: the named fault points fire here so the
+        per-shape circuit breaker (and chaos coverage) treats a sick
+        sketch/walk path exactly like a sick XLA launch."""
+        faults.fire("backend.launch")
+        faults.fire("backend.device_lost")
+        rows = sum(len(r[2]) for r in requests)
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.device_launches.labels(backend=self.name).inc()
+            GLOBAL_METRICS.device_reports.labels(backend=self.name).inc(rows)
+        from ..core.trace import trace_span
+
         t0 = time.monotonic()
-        out = self.bp.prep_init_batch(verify_key, agg_id, agg_param, reports)
-        _observe_prepare(self.name, "init", len(out), time.monotonic() - t0)
+        with trace_span("prep_launch", cat="device", backend=self.name, batch=rows):
+            out = self.bp.prep_init_multi(agg_id, requests)
+        _observe_prepare(self.name, "init", rows, time.monotonic() - t0)
         return out
 
 
@@ -1120,6 +1181,10 @@ def vdaf_shape_key(vdaf) -> tuple:
         getattr(vdaf, "num_shares", None),
         getattr(vdaf, "num_proofs", None),
         getattr(getattr(vdaf, "xof", None), "__name__", None),
+        # FLP-less VDAFs parameterize outside a `valid` circuit: Poplar1's
+        # whole shape is its input bit width (two Poplar1 tasks with
+        # different `bits` must never share a backend, bucket, or breaker)
+        getattr(vdaf, "bits", None) if valid is None else None,
     )
 
 
@@ -1150,6 +1215,26 @@ def device_supported(vdaf) -> Tuple[bool, str]:
     # Non-TurboSHAKE XOFs (HMAC multiproof) ride the hybrid backend: host
     # XOF, device FLP query/decide (HybridXofBackend).
     return True, ""
+
+
+def device_path_label(vdaf) -> str:
+    """Human-readable routing status for provisioning surfaces (task-API
+    responses, startup logs): WHICH accelerated path serves this VDAF and
+    which executor submission plane it batches through.  Poplar1 used to
+    read as a silent "supported" while actually riding a per-job path
+    outside the executor — this label makes the tier explicit, and names
+    the oracle reason when there is no device path at all.  jax-free."""
+    ok, reason = device_supported(vdaf)
+    if not ok:
+        return f"cpu-oracle ({reason})"
+    if type(vdaf).__name__ == "Poplar1":
+        return (
+            "poplar1-batch: bulk-AES IDPF walk + device sketch, "
+            "executor kind=poplar_init (agg-param/level-keyed buckets)"
+        )
+    if isinstance(vdaf, Prio3) and vdaf.xof is not XofTurboShake128:
+        return "tpu-hybrid: host XOF + device FLP, executor kind=prep_init/combine"
+    return "tpu: batched device prepare, executor kind=prep_init/combine"
 
 
 def make_backend(

@@ -217,7 +217,7 @@ class XofFixedKeyAes128(Xof):
     SEED_SIZE = 16
 
     def __init__(self, seed: bytes, dst: bytes, binder: bytes):
-        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+        from .utils.softaes import aes128_ecb_encryptor
 
         if len(seed) != self.SEED_SIZE:
             raise ValueError("bad seed size")
@@ -225,8 +225,11 @@ class XofFixedKeyAes128(Xof):
             raise ValueError("dst too long")
         # The fixed key depends only on (dst, binder) — for an IDPF tree walk
         # that is two values per report, so cache the TurboSHAKE derivation.
+        # The encryptor resolves to `cryptography` (AES-NI) when available,
+        # else the numpy soft-AES fallback — hosts without the lib keep the
+        # whole Poplar1 tier instead of losing it to one import.
         fixed_key = _fixed_key_aes128(dst, binder)
-        self._enc = Cipher(algorithms.AES(fixed_key), modes.ECB()).encryptor()
+        self._enc = aes128_ecb_encryptor(fixed_key)
         self._seed = seed
         self._index = 0
         self._buf = b""

@@ -258,6 +258,70 @@ def test_injected_evict_fault_fires_before_any_mutation():
     assert store._flushes[fid].consumed == set(), "refs must stay live"
 
 
+# -- agg-param-keyed host buckets (ISSUE 10) ---------------------------------
+
+
+def test_host_rows_level_keyed_buckets_never_merge_and_drain_all_spills():
+    """The agg-param element of the bucket key is the level fence: one
+    task's level-k and level-(k+1) deltas live in distinct buckets with
+    independent journals; two jobs at ONE level share a bucket (one
+    drained vector covering both journal rows); and drain_all reaches
+    host buckets through their stored field (no minting backend)."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    base = ("leader", b"task", ("Poplar1", None), b"batch")
+    k_lvl1 = base + (b"\x00\x01prefixes",)
+    k_lvl2 = base + (b"\x00\x02prefixes",)
+    store.commit_host_rows(
+        k_lvl1, _Field, [[1, 10], [2, 20]], job_token=b"j1", report_ids=[b"a", b"b"]
+    )
+    store.commit_host_rows(
+        k_lvl1, _Field, [[3, 30]], job_token=b"j2", report_ids=[b"c"]
+    )
+    store.commit_host_rows(
+        k_lvl2, _Field, [[100, 1]], job_token=b"j3", report_ids=[b"a"]
+    )
+    assert store.stats()["buckets"] == 2, "levels must never share a bucket"
+
+    spilled = {}
+    store.drain_all(
+        lambda key, vector, journal: spilled.update({key: (vector, journal)})
+    )
+    assert set(spilled) == {k_lvl1, k_lvl2}
+    v1, journal1 = spilled[k_lvl1]
+    assert v1 == [6, 60], "same-level jobs merge into ONE vector"
+    assert [j for j, _ in journal1] == [b"j1", b"j2"]
+    v2, journal2 = spilled[k_lvl2]
+    assert v2 == [100, 1] and [j for j, _ in journal2] == [b"j3"]
+    assert store.stats()["buckets"] == 0
+
+
+def test_host_rows_commit_after_poison_raises_and_journal_survives_discard():
+    """Exactly-once plumbing parity with device buckets: a poisoned host
+    bucket refuses commits, and discard returns the journal so the caller
+    can replay from the datastore."""
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    key = ("leader", b"t", ("Poplar1", None), b"b", b"\x00\x05p")
+    store.commit_host_rows(key, _Field, [[5, 50]], job_token=b"j1", report_ids=[b"r"])
+    with store._lock:
+        store._buckets[key].poisoned = True
+    with pytest.raises(AccumulatorUnavailable):
+        store.commit_host_rows(
+            key, _Field, [[7, 70]], job_token=b"j2", report_ids=[b"q"]
+        )
+    journal = store.discard(key)
+    assert [(j, set(r)) for j, r in journal] == [(b"j1", {b"r"})]
+
+
+def test_host_rows_vector_report_mismatch_rejected():
+    from janus_tpu.executor import AccumulatorError
+
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    with pytest.raises(AccumulatorError):
+        store.commit_host_rows(
+            ("k",), _Field, [[1]], job_token=b"j", report_ids=[b"a", b"b"]
+        )
+
+
 # -- fair flush scheduling ---------------------------------------------------
 
 

@@ -56,6 +56,29 @@ def test_device_supported_classification():
     assert not ok and "FixedPoint" in reason
 
 
+def test_device_path_label_names_the_routing_tier():
+    """ISSUE 10 satellite: the provisioning label states WHICH accelerated
+    path (and executor submission kind) serves a VDAF — Poplar1's used to
+    be an implicit 'rides a different path' tier split."""
+    from janus_tpu.vdaf.backend import device_path_label
+    from janus_tpu.vdaf.instances import _poplar1
+
+    label = device_path_label(_poplar1(8))
+    assert "poplar1-batch" in label and "poplar_init" in label
+    assert "level" in label  # the agg-param bucket discriminant is named
+    assert "prep_init" in device_path_label(prio3_histogram(4, 2))
+    hybrid = device_path_label(
+        prio3_sum_vec_field64_multiproof_hmacsha256_aes128(
+            proofs=2, length=4, bits=1, chunk_length=2
+        )
+    )
+    assert hybrid.startswith("tpu-hybrid")
+    oracle = device_path_label(
+        prio3_fixedpoint_bounded_l2_vec_sum("BitSize16", length=3)
+    )
+    assert oracle.startswith("cpu-oracle") and "FixedPoint" in oracle
+
+
 def test_driver_fallback_is_logged(caplog):
     from janus_tpu.aggregator.aggregation_job_driver import (
         AggregationJobDriver,

@@ -886,6 +886,87 @@ def test_chaos_soak_two_replicas_multitask():
     reset_global_executor()
 
 
+def test_poplar1_chaos_device_lost_oracle_fallback_exactly_once():
+    """ISSUE 10 acceptance: Poplar1 heavy hitters share the Prio3 failure
+    domains end to end.  With every Poplar1 walk/sketch losing the device
+    (``backend.device_lost`` at p=1), the per-shape breaker opens, BOTH
+    protocol sides degrade to the per-report CPU oracle (the fault point
+    stays armed — the oracle path must never consult it), each job's
+    level-keyed deltas journal in its commit tx (deferred store), the
+    owning store "crashes" before draining, and the collection-time
+    replay re-derives the level's shares from the datastore: heavy-hitter
+    counts bit-exact, journal empty, nothing double-merged."""
+    pytest.importorskip("cryptography")
+    from test_poplar_executor import NOW_S, _PoplarPair
+
+    from janus_tpu.executor import AccumulatorConfig
+    from janus_tpu.vdaf.poplar1 import Poplar1AggregationParam
+
+    reset_global_executor()
+    exec_cfg = ExecutorConfig(
+        enabled=True,
+        flush_window_s=0.05,
+        flush_max_rows=4096,
+        breaker_failure_threshold=2,
+        breaker_reset_timeout_s=60.0,  # stays open for the whole run
+        accumulator=AccumulatorConfig(enabled=True, drain_interval_s=3600.0),
+    )
+    pair = _PoplarPair(exec_cfg, bits=4, job_size=2)
+    measurements = [0b1011, 0b1011, 0b0100, 0b1111]
+
+    async def flow():
+        from janus_tpu.messages import Duration
+
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            driver = pair.make_driver()
+            ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+
+            # every device walk loses a chip — the per-shape breaker must
+            # open, then the oracle serves the rest of the run
+            faults.configure(
+                [FaultSpec("backend.device_lost", "error", 1.0)], seed=SEED
+            )
+            result = await pair.collect_level(ap1, driver, max_rounds=40)
+
+            ex = driver._executor
+            circuits = ex.circuit_stats()
+            assert any(
+                label.startswith("Poplar1") and s["trips"] >= 1
+                for label, s in circuits.items()
+            ), circuits
+            assert faults.registry().hits.get("backend.device_lost", 0) > 0
+
+            expect = [0, 0, 0, 0]
+            for m in measurements:
+                expect[m >> 2] += 1
+            assert result.aggregate_result == expect, (
+                result.aggregate_result, expect,
+            )
+            assert result.report_count == len(measurements)
+
+            # the level's deltas journaled (deferred) and were consumed
+            # exactly once by drain or replay — none outstanding now
+            ds = pair.leader_ds.datastore
+            assert (
+                ds.run_tx(
+                    "count",
+                    lambda tx: tx.count_accumulator_journal_entries(pair.task_id),
+                )
+                == 0
+            )
+            await driver.close()
+        finally:
+            faults.clear()
+            await pair.stop()
+
+    _run(flow(), timeout=280.0)
+    reset_global_executor()
+
+
 def test_mesh_chaos_device_lost_opens_per_mesh_breaker_oracle_exact():
     """ISSUE 6 acceptance: with the MESH backend enabled
     (``device_executor.mesh: true`` — every cached backend upgraded to the

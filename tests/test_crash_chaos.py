@@ -319,6 +319,52 @@ def test_crash_restart_soak_exactly_once(tmp_path):
         keypairs.append((leader_kp, helper_kp))
         expected_leader_shares[t] = None
 
+    # -- Poplar1 traffic in the soak (ISSUE 10): a heavy-hitters task rides
+    # the same kill/restart schedule — its two-round jobs step through the
+    # driver binaries' executor-routed poplar_init path, its level-keyed
+    # deltas journal in the deferred store, and the SIGKILL orphans replay
+    # at collection exactly like Prio3's.
+    from janus_tpu.vdaf.poplar1 import Poplar1AggregationParam
+
+    POPLAR_T = n_tasks  # tasks[2]
+    poplar_param = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    poplar_task_id = TaskId.random()
+    poplar_common = dict(
+        task_id=poplar_task_id,
+        query_type=TaskQueryType.time_interval(),
+        vdaf={"type": "Poplar1", "bits": 4},
+        vdaf_verify_key=bytes([0x40 + POPLAR_T]) * 16,
+        min_batch_size=3,
+        time_precision=TIME_PRECISION,
+        collector_hpke_config=collector_keys.config,
+    )
+    poplar_leader_kp, poplar_helper_kp = HpkeKeypair.generate(1), HpkeKeypair.generate(2)
+    poplar_leader_task = AggregatorTask(
+        peer_aggregator_endpoint=f"http://127.0.0.1:{helper_port}/",
+        role=Role.LEADER,
+        aggregator_auth_token=agg_token,
+        hpke_keys=[poplar_leader_kp],
+        **poplar_common,
+    )
+    poplar_helper_task = AggregatorTask(
+        peer_aggregator_endpoint="http://127.0.0.1:1/",
+        role=Role.HELPER,
+        aggregator_auth_token_hash=agg_token.hash(),
+        hpke_keys=[poplar_helper_kp],
+        **poplar_common,
+    )
+    leader_ds.run_tx("putl", lambda tx: tx.put_aggregator_task(poplar_leader_task))
+    helper_ds.run_tx("puth", lambda tx: tx.put_aggregator_task(poplar_helper_task))
+    tasks.append((poplar_task_id, poplar_leader_task, poplar_helper_task))
+    keypairs.append((poplar_leader_kp, poplar_helper_kp))
+    expected_leader_shares[POPLAR_T] = None
+    measurements[POPLAR_T] = [0b1011, 0b1011, 0b0100, 0b1111, 0b0000, 0b0100]
+
+    def agg_param_enc(t):
+        if t == POPLAR_T:
+            return tasks[t][1].vdaf_instance().encode_agg_param(poplar_param)
+        return b""
+
     from janus_tpu.core.metrics import GLOBAL_METRICS
     from janus_tpu.core.trace import close_chrome_trace, configure_chrome_trace
     from janus_tpu.vdaf.backend import OracleBackend
@@ -391,26 +437,31 @@ def test_crash_restart_soak_exactly_once(tmp_path):
         _asyncio.run(
             ReportWriteBatcher(leader_ds, max_batch_size=1).write_report(stored)
         )
-        (outcome,) = OracleBackend(vdaf).prep_init_batch(
-            leader_task.vdaf_verify_key,
-            0,
-            [
-                (
-                    report.metadata.report_id.data,
-                    vdaf.decode_public_share(report.public_share),
-                    vdaf.decode_input_share(0, plain.payload),
-                )
-            ],
+        prep_row = (
+            report.metadata.report_id.data,
+            vdaf.decode_public_share(report.public_share),
+            vdaf.decode_input_share(0, plain.payload),
         )
-        field = vdaf.field_for_agg_param(vdaf.decode_agg_param(b""))
+        if t == POPLAR_T:
+            # heavy hitters: the leader out share at the collection level
+            # is the prefix-value vector (state.y_flat)
+            state, _sh = vdaf.prep_init(
+                leader_task.vdaf_verify_key, 0, poplar_param, *prep_row
+            )
+            out_share = list(state.y_flat)
+            field = vdaf.field_for_agg_param(poplar_param)
+        else:
+            (outcome,) = OracleBackend(vdaf).prep_init_batch(
+                leader_task.vdaf_verify_key, 0, [prep_row]
+            )
+            out_share = list(outcome[0].out_share)
+            field = vdaf.field_for_agg_param(vdaf.decode_agg_param(b""))
         prev = expected_leader_shares[t]
         expected_leader_shares[t] = (
-            list(outcome[0].out_share)
-            if prev is None
-            else field.vec_add(prev, outcome[0].out_share)
+            out_share if prev is None else field.vec_add(prev, out_share)
         )
 
-    for t in range(n_tasks):
+    for t in measurements:
         for m in measurements[t]:
             seed_report(t, m)
 
@@ -420,8 +471,46 @@ def test_crash_restart_soak_exactly_once(tmp_path):
         leader_ds,
         CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=3),
     )
+
+    def create_poplar_jobs():
+        """Agg-param jobs come from collection requests, not the periodic
+        creator — drive the production path (_create_agg_param_jobs, job
+        size 3) directly so the soak's Poplar1 jobs are created exactly
+        the way handle_create_collection_job creates them."""
+        from janus_tpu.aggregator import Aggregator, Config
+        from janus_tpu.aggregator.aggregator import TaskAggregator
+
+        agg = Aggregator(
+            leader_ds, clock, Config(vdaf_backend="oracle", max_agg_param_job_size=3)
+        )
+        ta = TaskAggregator(poplar_leader_task, "oracle")
+        before = len(
+            leader_ds.run_tx(
+                "jobs",
+                lambda tx: tx.get_aggregation_jobs_for_task(poplar_task_id),
+            )
+        )
+        leader_ds.run_tx(
+            "poplar_jobs",
+            lambda tx: agg._create_agg_param_jobs(
+                tx, ta, interval.get_encoded(), agg_param_enc(POPLAR_T)
+            ),
+        )
+        return (
+            len(
+                leader_ds.run_tx(
+                    "jobs",
+                    lambda tx: tx.get_aggregation_jobs_for_task(poplar_task_id),
+                )
+            )
+            - before
+        )
+
     n_jobs = asyncio.run(creator.run_once())
     assert n_jobs >= 2 * n_tasks, n_jobs
+    n_poplar_jobs = create_poplar_jobs()
+    assert n_poplar_jobs == 2, n_poplar_jobs  # 6 reports / job size 3
+    n_jobs += n_poplar_jobs
 
     # -- replica configs ----------------------------------------------------
     def driver_yaml(i):
@@ -624,7 +713,15 @@ device_executor:
             for m in [1, 1, 0]:
                 measurements[t].append(m)
                 seed_report(t, m)
+        # wave-2 Poplar1 reports: _create_agg_param_jobs' conflict-key
+        # dedup must pick up ONLY the fresh reports for the new level job
+        for m in [0b0100, 0b1111, 0b1011]:
+            measurements[POPLAR_T].append(m)
+            seed_report(POPLAR_T, m)
         n_jobs += asyncio.run(creator.run_once())
+        wave2_poplar = create_poplar_jobs()
+        assert wave2_poplar == 1, wave2_poplar
+        n_jobs += wave2_poplar
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
             if unfinished_count() == 0:
@@ -660,7 +757,7 @@ device_executor:
                     task_id=task_id,
                     collection_job_id=CollectionJobId.random(),
                     query=Query.new_time_interval(interval),
-                    aggregation_parameter=b"",
+                    aggregation_parameter=agg_param_enc(t),
                     batch_identifier=interval.get_encoded(),
                     state=CollectionJobState.START,
                 )
@@ -709,11 +806,11 @@ device_executor:
         for t, (task_id, leader_task, _h) in enumerate(tasks):
             got = results[t]
             vdaf = leader_task.vdaf_instance()
-            agg_param = vdaf.decode_agg_param(b"")
+            agg_param = vdaf.decode_agg_param(agg_param_enc(t))
             field = vdaf.field_for_agg_param(agg_param)
             leader_share = field.decode_vec(got.leader_aggregate_share)
             aad = AggregateShareAad(
-                task_id, b"", BatchSelector.new_time_interval(interval)
+                task_id, agg_param_enc(t), BatchSelector.new_time_interval(interval)
             ).get_encoded()
             info = HpkeApplicationInfo.new(
                 Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR
@@ -736,7 +833,14 @@ device_executor:
                 leader_share,
                 expected_leader_shares[t],
             )
-            assert result == sum(measurements[t]), (t, result, "helper side")
+            if t == POPLAR_T:
+                # heavy-hitter counts: per-prefix totals at level 1
+                expect = [0, 0, 0, 0]
+                for m in measurements[t]:
+                    expect[m >> 2] += 1
+            else:
+                expect = sum(measurements[t])
+            assert result == expect, (t, result, expect, "helper side")
 
         # every orphaned journal row was consumed by the replay
         assert _sql(leader_db, "SELECT COUNT(*) FROM accumulator_journal")[0][0] == 0

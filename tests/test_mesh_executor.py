@@ -344,10 +344,10 @@ def test_mesh_breaker_retires_only_when_every_shape_is_idle():
     _run(go())
     assert len(ex.circuit_stats()) == 1
     # shape A's bucket idles out; B's stays -> the shared breaker survives
-    ex._buckets[(("shapeA",), "prep_init", 0)].last_activity -= 1000
+    ex._buckets[(("shapeA",), "prep_init", 0, None)].last_activity -= 1000
     ex.retire_idle_buckets(max_idle_s=600)
     assert len(ex.circuit_stats()) == 1, "breaker retired while B is live"
-    ex._buckets[(("shapeB",), "prep_init", 0)].last_activity -= 1000
+    ex._buckets[(("shapeB",), "prep_init", 0, None)].last_activity -= 1000
     ex.retire_idle_buckets(max_idle_s=600)
     assert ex.circuit_stats() == {}
     ex.shutdown()

@@ -1,0 +1,888 @@
+"""Poplar1 heavy hitters through the device executor (ISSUE 10).
+
+Layers, cheapest first:
+
+* ``BatchedPoplar1.prep_init_multi``: the multi-request walk (per-row
+  verify keys, per-agg-param grouping) is byte-identical to per-request
+  ``prep_init_batch`` calls;
+* executor bucket identity: submissions from different jobs at ONE tree
+  level coalesce into one flush, while two levels of one task never share
+  a bucket (the agg-param key) — and the bucket label carries the level;
+* failure domains: ``backend.device_lost`` opens the per-shape breaker,
+  the driver and helper degrade to the bit-exact per-report CPU oracle,
+  backpressure surfaces retryably;
+* the store's agg-param-keyed host buckets: levels isolate, journals
+  never merge;
+* E2E: a multi-round Poplar1 workload (2 jobs x 2 tree levels) through
+  real leader+helper HTTP with BOTH sides' prep served by the executor —
+  cross-job coalescing observable in executor stats, per-level buckets
+  never cross-contaminating, heavy-hitter counts exact;
+* the deferred-journal crash path: rows journaled at the agg param,
+  device state lost, collection-time replay re-derives the level's
+  shares exactly once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from janus_tpu.core import faults
+from janus_tpu.core.faults import FaultInjectedError, FaultSpec
+from janus_tpu.executor import (
+    AccumulatorConfig,
+    CircuitOpenError,
+    DeviceExecutor,
+    ExecutorConfig,
+    KIND_POPLAR_INIT,
+    reset_global_executor,
+)
+from janus_tpu.vdaf import pingpong as pp
+from janus_tpu.vdaf.backend import (
+    Poplar1Backend,
+    Poplar1Oracle,
+    make_backend,
+    vdaf_shape_key,
+)
+from janus_tpu.vdaf.poplar1 import Poplar1, Poplar1AggregationParam
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+    reset_global_executor()
+
+
+def _run(coro, timeout=180.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+def _shard_rows(vdaf, measurements, seed, agg_id):
+    rng = random.Random(seed)
+    rows = []
+    for m in measurements:
+        nonce = rng.randbytes(vdaf.NONCE_SIZE)
+        public, shares = vdaf.shard(m, nonce, rng.randbytes(vdaf.RAND_SIZE))
+        rows.append((nonce, public, shares[agg_id]))
+    return rows
+
+
+def _assert_outcomes_equal(got, want):
+    assert len(got) == len(want)
+    for (gs, gsh), (ws, wsh) in zip(got, want):
+        assert gsh.encode() == wsh.encode()
+        assert gs.y_flat == ws.y_flat
+        assert (gs.a, gs.b, gs.c, gs.zs_share) == (ws.a, ws.b, ws.c, ws.zs_share)
+
+
+# -- the multi-request walk ---------------------------------------------------
+
+
+def test_prep_init_multi_matches_per_request_batches():
+    """Mixed mega-batch: two verify keys sharing one agg param + a third
+    request at a different prefix set — results are byte-identical to
+    separate prep_init_batch calls (the executor flush contract)."""
+    vdaf = Poplar1(bits=4)
+    ap = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    ap_sparse = Poplar1AggregationParam(1, (0, 2))
+    bp = make_backend(vdaf, "tpu").bp
+    vk1, vk2 = b"\x11" * 16, b"\x22" * 16
+    for agg_id in (0, 1):
+        rows = _shard_rows(vdaf, [0b1011, 0b0100, 0b1111], "multi", agg_id)
+        reqs = [
+            (vk1, ap, rows[:2]),
+            (vk2, ap, rows[2:]),
+            (vk1, ap_sparse, rows[:1]),
+        ]
+        multi = bp.prep_init_multi(agg_id, reqs)
+        for got, (vk, param, sub) in zip(multi, reqs):
+            _assert_outcomes_equal(got, bp.prep_init_batch(vk, agg_id, param, sub))
+
+
+def test_backend_batch_matches_per_report_oracle():
+    vdaf = Poplar1(bits=4)
+    ap = Poplar1AggregationParam(3, (0b0010, 0b1011, 0b1111))
+    backend = make_backend(vdaf, "tpu")
+    assert isinstance(backend, Poplar1Backend)
+    assert isinstance(backend.oracle, Poplar1Oracle)
+    rows = _shard_rows(vdaf, [0b0010, 0b1011, 0b0000], "oracle", 0)
+    got = backend.prep_init_batch_poplar(b"\x2a" * 16, 0, ap, rows)
+    want = backend.oracle.prep_init_batch_poplar(b"\x2a" * 16, 0, ap, rows)
+    _assert_outcomes_equal(got, want)
+
+
+# -- executor bucket identity -------------------------------------------------
+
+
+def test_same_level_jobs_coalesce_and_levels_never_share_a_bucket():
+    """THE BUCKET-IDENTITY CONTRACT: two submissions (different jobs /
+    verify keys) at level 1 ride ONE flush; a level-2 submission of the
+    SAME task lands in a different bucket whose label carries L2."""
+    vdaf = Poplar1(bits=4)
+    ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    ap2 = Poplar1AggregationParam(2, (0, 3, 5))
+    backend = make_backend(vdaf, "tpu")
+    key = vdaf_shape_key(vdaf)
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.05, flush_max_rows=4096))
+    rows_a = _shard_rows(vdaf, [0b1011, 0b0100], "job-a", 0)
+    rows_b = _shard_rows(vdaf, [0b1111], "job-b", 0)
+
+    async def go():
+        got_a, got_b = await asyncio.gather(
+            ex.submit(
+                key, KIND_POPLAR_INIT, (b"\x11" * 16, ap1, rows_a),
+                backend=backend, agg_id=0, agg_param_key=ap1.level,
+                task_ident=b"task-a",
+            ),
+            ex.submit(
+                key, KIND_POPLAR_INIT, (b"\x22" * 16, ap1, rows_b),
+                backend=backend, agg_id=0, agg_param_key=ap1.level,
+                task_ident=b"task-b",
+            ),
+        )
+        got_c = await ex.submit(
+            key, KIND_POPLAR_INIT, (b"\x11" * 16, ap2, rows_a),
+            backend=backend, agg_id=0, agg_param_key=ap2.level,
+        )
+        return got_a, got_b, got_c
+
+    got_a, got_b, got_c = _run(go())
+    ex.shutdown()
+    bp = backend.bp
+    _assert_outcomes_equal(got_a, bp.prep_init_batch(b"\x11" * 16, 0, ap1, rows_a))
+    _assert_outcomes_equal(got_b, bp.prep_init_batch(b"\x22" * 16, 0, ap1, rows_b))
+    _assert_outcomes_equal(got_c, bp.prep_init_batch(b"\x11" * 16, 0, ap2, rows_a))
+
+    stats = ex.stats()
+    l1 = next(v for k, v in stats.items() if "/poplar_init/L1" in k)
+    l2 = next(v for k, v in stats.items() if "/poplar_init/L2" in k)
+    assert len(stats) == 2, stats
+    # cross-job coalescing at one level: one flush carried both jobs
+    assert l1["flushes"] == 1 and l1["flushed_jobs"] == 2, l1
+    assert l1["flushed_rows"] == 3
+    # the other level never shared that mega-batch
+    assert l2["flushes"] == 1 and l2["flushed_jobs"] == 1, l2
+
+
+def test_poplar_buckets_isolate_from_prio3_buckets():
+    """A Prio3 bucket key (agg_param_key=None) and a Poplar1 level bucket
+    can never collide even under dict-key coincidence: the kind differs
+    and the agg-param key is part of the tuple."""
+    vdaf = Poplar1(bits=4)
+    backend = make_backend(vdaf, "tpu")
+    ap = Poplar1AggregationParam(0, (0, 1))
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02))
+    rows = _shard_rows(vdaf, [1], "iso", 0)
+
+    async def go():
+        await ex.submit(
+            vdaf_shape_key(vdaf), KIND_POPLAR_INIT, (b"\x11" * 16, ap, rows),
+            backend=backend, agg_id=0, agg_param_key=ap.level,
+        )
+
+    _run(go())
+    ex.shutdown()
+    (key,) = ex._buckets
+    assert key == (vdaf_shape_key(vdaf), "poplar_init", 0, 0)
+
+
+# -- failure domains ----------------------------------------------------------
+
+
+def test_device_lost_trips_breaker_then_circuit_open():
+    """backend.device_lost fires inside prep_init_multi_poplar: K failures
+    open the per-shape circuit, after which submits fail fast."""
+    vdaf = Poplar1(bits=4)
+    backend = make_backend(vdaf, "tpu")
+    ap = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    key = vdaf_shape_key(vdaf)
+    ex = DeviceExecutor(
+        ExecutorConfig(
+            flush_window_s=0.005,
+            breaker_failure_threshold=2,
+            breaker_reset_timeout_s=60.0,
+        )
+    )
+    rows = _shard_rows(vdaf, [1], "lost", 0)
+    faults.configure([FaultSpec("backend.device_lost", "error", 1.0)], seed=7)
+
+    async def go():
+        for _ in range(2):
+            with pytest.raises(FaultInjectedError):
+                await ex.submit(
+                    key, KIND_POPLAR_INIT, (b"\x11" * 16, ap, rows),
+                    backend=backend, agg_id=0, agg_param_key=ap.level,
+                )
+        with pytest.raises(CircuitOpenError):
+            await ex.submit(
+                key, KIND_POPLAR_INIT, (b"\x11" * 16, ap, rows),
+                backend=backend, agg_id=0, agg_param_key=ap.level,
+            )
+
+    _run(go())
+    (st,) = ex.circuit_stats().values()
+    assert st["state"] == "open" and st["trips"] == 1
+    assert ex.circuit_open(key), "peek must report the open circuit"
+    ex.shutdown()
+
+
+def test_driver_poplar_degrades_to_oracle_while_circuit_open():
+    """Driver contract: first delivery's launch failure is retryable (the
+    breaker counts it); the redelivery finds the circuit open and the job
+    is served on the per-report CPU oracle, bit-exact."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+
+    reset_global_executor()
+    vdaf = Poplar1(bits=4)
+    backend = make_backend(vdaf, "tpu")
+    ap = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    rows = _shard_rows(vdaf, [0b1011, 0b0100], "drv", 0)
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu",
+            device_executor=ExecutorConfig(
+                enabled=True,
+                flush_window_s=0.005,
+                breaker_failure_threshold=1,
+                breaker_reset_timeout_s=60.0,
+            ),
+        ),
+    )
+    faults.configure([FaultSpec("backend.device_lost", "error", 1.0)], seed=7)
+
+    async def go():
+        with pytest.raises(JobStepError) as exc_info:
+            await driver._coalesced_poplar_init(backend, b"\x11" * 16, ap, rows)
+        assert exc_info.value.retryable
+        return await driver._coalesced_poplar_init(backend, b"\x11" * 16, ap, rows)
+
+    got = _run(go())
+    # fault still armed: the oracle path must not consult the fault point
+    want = backend.oracle.prep_init_batch_poplar(b"\x11" * 16, 0, ap, rows)
+    _assert_outcomes_equal(got, want)
+    reset_global_executor()
+
+
+def test_driver_poplar_backpressure_is_retryable():
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+
+    reset_global_executor()
+    vdaf = Poplar1(bits=4)
+    backend = make_backend(vdaf, "tpu")
+    ap = Poplar1AggregationParam(0, (0, 1))
+    rows = _shard_rows(vdaf, [1, 0, 1], "bp", 0)
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu",
+            device_executor=ExecutorConfig(
+                enabled=True, flush_window_s=5.0, max_queue_rows=2
+            ),
+        ),
+    )
+
+    async def go():
+        first = asyncio.ensure_future(
+            driver._coalesced_poplar_init(backend, b"\x11" * 16, ap, rows[:2])
+        )
+        await asyncio.sleep(0.01)  # rows queued, window still open
+        with pytest.raises(JobStepError) as exc_info:
+            await driver._coalesced_poplar_init(backend, b"\x11" * 16, ap, rows)
+        assert exc_info.value.retryable
+        await driver._executor.drain()
+        await first
+
+    _run(go())
+    reset_global_executor()
+
+
+# -- helper routing -----------------------------------------------------------
+
+
+class _HelperStub:
+    """Just the Aggregator surface the Poplar1 helper prep path touches."""
+
+    from janus_tpu.aggregator.aggregator import Aggregator as _A
+
+    _helper_decode_poplar_rows = staticmethod(_A._helper_decode_poplar_rows)
+    _helper_finish_poplar1 = staticmethod(_A._helper_finish_poplar1)
+    _helper_prepare_batch_poplar1 = _A._helper_prepare_batch_poplar1
+    _helper_prepare_batch_poplar1_executor = (
+        _A._helper_prepare_batch_poplar1_executor
+    )
+
+    def __init__(self, executor):
+        self._executor = executor
+
+
+def _helper_decoded_rows(vdaf, agg_param, measurements, seed):
+    """(idx, (nonce, public, helper_share, leader INITIALIZE msg)) rows —
+    exactly what handle_aggregate_init hands the prepare batch."""
+    vk = b"\x2a" * vdaf.VERIFY_KEY_SIZE
+    rng = random.Random(seed)
+    decoded = []
+    for i, m in enumerate(measurements):
+        nonce = rng.randbytes(vdaf.NONCE_SIZE)
+        public, shares = vdaf.shard(m, nonce, rng.randbytes(vdaf.RAND_SIZE))
+        _state, l_share = vdaf.prep_init(vk, 0, agg_param, nonce, public, shares[0])
+        msg = pp.PingPongMessage(
+            pp.PingPongMessage.INITIALIZE,
+            prep_share=vdaf.ping_pong_encode_prep_share(l_share),
+        )
+        decoded.append((i, (nonce, public, shares[1], msg)))
+    return vk, decoded
+
+
+def test_helper_poplar_routes_through_executor_and_matches_legacy():
+    from types import SimpleNamespace
+
+    vdaf = Poplar1(bits=4)
+    ap = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    backend = make_backend(vdaf, "tpu")
+    vk, decoded = _helper_decoded_rows(vdaf, ap, [0b1011, 0b0100, 0b1111], "h1")
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02, flush_max_rows=4096))
+    agg = _HelperStub(ex)
+    ta = SimpleNamespace(
+        vdaf=vdaf, backend=backend, task=SimpleNamespace(vdaf_verify_key=vk)
+    )
+    got = _run(agg._helper_prepare_batch_poplar1_executor(ta, decoded, ap))
+    ex.shutdown()
+    want = agg._helper_prepare_batch_poplar1(ta, decoded, ap)
+    assert set(got) == set(want)
+    for idx in want:
+        gk, g_payload, g_msg = got[idx]
+        wk, w_payload, w_msg = want[idx]
+        assert (gk, g_payload) == (wk, w_payload)
+        assert (g_msg.variant, g_msg.prep_msg, g_msg.prep_share) == (
+            w_msg.variant, w_msg.prep_msg, w_msg.prep_share,
+        )
+    stats = ex.stats()
+    assert any("/a1/poplar_init/L1" in k for k in stats), stats
+
+
+def test_helper_poplar_degrades_to_oracle_when_circuit_open():
+    from types import SimpleNamespace
+
+    vdaf = Poplar1(bits=4)
+    ap = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    backend = make_backend(vdaf, "tpu")
+    vk, decoded = _helper_decoded_rows(vdaf, ap, [0b1011, 0b0100], "h2")
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.02))
+    ex.circuit_open = lambda shape_key: True
+    agg = _HelperStub(ex)
+    ta = SimpleNamespace(
+        vdaf=vdaf, backend=backend, task=SimpleNamespace(vdaf_verify_key=vk)
+    )
+    got = _run(agg._helper_prepare_batch_poplar1_executor(ta, decoded, ap))
+    ex.shutdown()
+    assert ex.stats() == {}, "open circuit must not submit to the device"
+    want = agg._helper_prepare_batch_poplar1(
+        ta, decoded, ap, backend=backend.oracle
+    )
+    assert got.keys() == want.keys()
+    for idx in want:
+        assert got[idx][0] == want[idx][0]
+        assert got[idx][1] == want[idx][1]
+
+
+def test_helper_poplar_backpressure_surfaces_as_503():
+    from types import SimpleNamespace
+
+    from janus_tpu.aggregator.error import ServiceUnavailable
+
+    vdaf = Poplar1(bits=4)
+    ap = Poplar1AggregationParam(0, (0, 1))
+    backend = make_backend(vdaf, "tpu")
+    vk, decoded = _helper_decoded_rows(vdaf, ap, [1, 0, 1], "h3")
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=5.0, max_queue_rows=2))
+    agg = _HelperStub(ex)
+    ta = SimpleNamespace(
+        vdaf=vdaf, backend=backend, task=SimpleNamespace(vdaf_verify_key=vk)
+    )
+
+    async def go():
+        first = asyncio.ensure_future(
+            agg._helper_prepare_batch_poplar1_executor(ta, decoded[:2], ap)
+        )
+        await asyncio.sleep(0.01)
+        with pytest.raises(ServiceUnavailable):
+            await agg._helper_prepare_batch_poplar1_executor(ta, decoded, ap)
+        await ex.drain()
+        await first
+
+    _run(go())
+    ex.shutdown()
+
+
+# -- agg-param-keyed store buckets -------------------------------------------
+
+
+def test_host_buckets_isolate_levels_and_journal_exactly_once():
+    """Two levels of one task commit into DISTINCT buckets (the key's
+    agg-param element) with independent journals; drains never merge."""
+    from janus_tpu.executor import DeviceAccumulatorStore
+    from janus_tpu.fields import Field64
+
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    base = ("leader", b"task", ("Poplar1",), b"batch")
+    k1 = base + (b"param-level-1",)
+    k2 = base + (b"param-level-2",)
+    store.commit_host_rows(
+        k1, Field64, [[1, 2], [3, 4]], job_token=b"j1", report_ids=[b"r1", b"r2"]
+    )
+    store.commit_host_rows(
+        k2, Field64, [[10, 20]], job_token=b"j1", report_ids=[b"r1"]
+    )
+    store.commit_host_rows(
+        k1, Field64, [[5, 6]], job_token=b"j2", report_ids=[b"r3"]
+    )
+    assert store.stats()["buckets"] == 2
+    v1, journal1 = store.drain_with_journal(k1, Field64)
+    assert v1 == [9, 12]
+    assert [(j, set(r)) for j, r in journal1] == [
+        (b"j1", {b"r1", b"r2"}),
+        (b"j2", {b"r3"}),
+    ]
+    v2, journal2 = store.drain_with_journal(k2, Field64)
+    assert v2 == [10, 20] and len(journal2) == 1
+    assert store.drain_with_journal(k1, Field64) is None, "drained once"
+
+
+def test_host_bucket_poison_and_discard_semantics():
+    from janus_tpu.executor import AccumulatorUnavailable, DeviceAccumulatorStore
+    from janus_tpu.fields import Field64
+
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    key = ("leader", b"t", ("Poplar1",), b"b", b"p")
+    store.commit_host_rows(
+        key, Field64, [[7]], job_token=b"j1", report_ids=[b"r1"]
+    )
+    journal = store.discard(key)
+    assert [(j, set(r)) for j, r in journal] == [(b"j1", {b"r1"})]
+    # post-discard commits go to a FRESH bucket, not the closed one
+    store.commit_host_rows(
+        key, Field64, [[9]], job_token=b"j2", report_ids=[b"r2"]
+    )
+    v, j = store.drain_with_journal(key, Field64)
+    assert v == [9] and len(j) == 1
+
+
+# -- end-to-end ---------------------------------------------------------------
+
+
+NOW_S = 1_600_002_000
+AGG_TOKEN_STR = "agg-token-poplar"
+COL_TOKEN_STR = "col-token-poplar"
+
+
+class _PoplarPair:
+    """In-process leader+helper with the device executor on BOTH sides
+    (test_integration_pair.InProcessPair specialized to Poplar1 + the
+    executor-routed heavy-hitters path)."""
+
+    def __init__(self, exec_cfg: ExecutorConfig, bits=4, job_size=2):
+        from janus_tpu.aggregator import Aggregator, Config
+        from janus_tpu.core.auth_tokens import AuthenticationToken
+        from janus_tpu.core.hpke import HpkeKeypair
+        from janus_tpu.core.time import MockClock
+        from janus_tpu.datastore.test_util import EphemeralDatastore
+        from janus_tpu.messages import TaskId, Time
+
+        self.exec_cfg = exec_cfg
+        self.bits = bits
+        self.clock = MockClock(Time(NOW_S))
+        self.leader_ds = EphemeralDatastore(self.clock)
+        self.helper_ds = EphemeralDatastore(self.clock)
+        self.agg_token = AuthenticationToken.new_bearer(AGG_TOKEN_STR)
+        self.col_token = AuthenticationToken.new_bearer(COL_TOKEN_STR)
+        self.collector_keys = HpkeKeypair.generate(9)
+        leader_cfg = Config(
+            vdaf_backend="tpu",
+            max_upload_batch_write_delay=0.02,
+            max_agg_param_job_size=job_size,
+        )
+        helper_cfg = Config(
+            vdaf_backend="tpu",
+            max_upload_batch_write_delay=0.02,
+            device_executor=exec_cfg,
+        )
+        self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, leader_cfg)
+        self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, helper_cfg)
+        self.task_id = TaskId.random()
+
+    async def start(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from janus_tpu.aggregator import aggregator_app
+        from janus_tpu.core.hpke import HpkeKeypair
+        from janus_tpu.datastore import AggregatorTask, TaskQueryType
+        from janus_tpu.messages import Duration, Role
+
+        self.leader_client = TestClient(TestServer(aggregator_app(self.leader_agg)))
+        self.helper_client = TestClient(TestServer(aggregator_app(self.helper_agg)))
+        await self.leader_client.start_server()
+        await self.helper_client.start_server()
+        self.leader_url = str(self.leader_client.make_url("/"))
+        helper_url = str(self.helper_client.make_url("/"))
+        common = dict(
+            task_id=self.task_id,
+            query_type=TaskQueryType.time_interval(),
+            vdaf={"type": "Poplar1", "bits": self.bits},
+            vdaf_verify_key=b"\x2a" * 16,
+            min_batch_size=3,
+            time_precision=Duration(3600),
+            collector_hpke_config=self.collector_keys.config,
+        )
+        self.leader_task = AggregatorTask(
+            peer_aggregator_endpoint=helper_url,
+            role=Role.LEADER,
+            aggregator_auth_token=self.agg_token,
+            collector_auth_token_hash=self.col_token.hash(),
+            hpke_keys=[HpkeKeypair.generate(1)],
+            **common,
+        )
+        self.helper_task = AggregatorTask(
+            peer_aggregator_endpoint=self.leader_url,
+            role=Role.HELPER,
+            aggregator_auth_token_hash=self.agg_token.hash(),
+            hpke_keys=[HpkeKeypair.generate(2)],
+            **common,
+        )
+        self.leader_ds.datastore.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(self.leader_task)
+        )
+        self.helper_ds.datastore.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(self.helper_task)
+        )
+
+    async def stop(self):
+        await self.leader_agg.shutdown()
+        await self.helper_agg.shutdown()
+        await self.leader_client.close()
+        await self.helper_client.close()
+        self.leader_ds.cleanup()
+        self.helper_ds.cleanup()
+
+    async def upload(self, measurement):
+        from janus_tpu.client import prepare_report
+        from janus_tpu.messages import Duration, Time
+
+        report = prepare_report(
+            self.leader_task.vdaf_instance(),
+            self.task_id,
+            self.leader_task.hpke_keys[0].config,
+            self.helper_task.hpke_keys[0].config,
+            Duration(3600),
+            measurement,
+            time=Time(NOW_S),
+        )
+        resp = await self.leader_client.put(
+            f"/tasks/{self.task_id}/reports", data=report.get_encoded()
+        )
+        assert resp.status == 201, await resp.text()
+
+    def make_driver(self):
+        import aiohttp
+
+        from janus_tpu.aggregator import AggregationJobDriver, DriverConfig
+        from janus_tpu.core.retries import HttpRetryPolicy
+
+        return AggregationJobDriver(
+            self.leader_ds.datastore,
+            aiohttp.ClientSession,
+            DriverConfig(
+                vdaf_backend="tpu",
+                device_executor=self.exec_cfg,
+                http_retry=HttpRetryPolicy(0.01, 0.1, 2.0, 1.0, 3),
+            ),
+        )
+
+    async def collect_level(self, agg_param, driver, max_rounds=30):
+        """PUT a collection at ``agg_param`` (creates the level's jobs),
+        step aggregation CONCURRENTLY (so same-level jobs coalesce in the
+        executor) and collection until the collector returns."""
+        import aiohttp
+
+        from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+        from janus_tpu.collector import Collector
+        from janus_tpu.messages import Duration, Interval, Query, Time
+
+        vdaf = self.leader_task.vdaf_instance()
+        collector = Collector(
+            task_id=self.task_id,
+            leader_endpoint=self.leader_url,
+            vdaf=vdaf,
+            auth_token=self.col_token,
+            hpke_keypair=self.collector_keys,
+            poll_interval=0.05,
+            max_poll_time=60.0,
+        )
+        coll_driver = CollectionJobDriver(
+            self.leader_ds.datastore, aiohttp.ClientSession
+        )
+
+        async def drive():
+            for _ in range(max_rounds):
+                await asyncio.sleep(0.1)
+                leases = await self.leader_ds.datastore.run_tx_async(
+                    "acquire",
+                    lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                        Duration(600), 10
+                    ),
+                )
+                # concurrent stepping: same-level jobs must be in flight
+                # together for the executor to coalesce their walks
+                await asyncio.gather(
+                    *(driver.step_aggregation_job(l) for l in leases),
+                    return_exceptions=True,
+                )
+                self.clock.advance(Duration(30))
+                coll_leases = await self.leader_ds.datastore.run_tx_async(
+                    "acquire_coll",
+                    lambda tx: tx.acquire_incomplete_collection_jobs(
+                        Duration(600), 10
+                    ),
+                )
+                for lease in coll_leases:
+                    await coll_driver.step_collection_job(lease)
+            await coll_driver.close()
+
+        result, _ = await asyncio.gather(
+            collector.collect(
+                Query.new_time_interval(Interval(Time(NOW_S), Duration(3600))),
+                vdaf.encode_agg_param(agg_param),
+            ),
+            drive(),
+        )
+        return result
+
+
+def test_poplar1_e2e_multi_level_through_executor():
+    """THE ACCEPTANCE FLOW: 4 reports, job size 2 (so every level runs 2
+    aggregation jobs), collected at level 1 then level 3 — leader AND
+    helper prep served by the shared executor, cross-job coalescing
+    observable in its stats, per-level buckets isolated, heavy-hitter
+    counts exact at both levels."""
+    pytest.importorskip("cryptography")
+    reset_global_executor()
+    exec_cfg = ExecutorConfig(
+        enabled=True, flush_window_s=0.15, flush_max_rows=4096
+    )
+    pair = _PoplarPair(exec_cfg, bits=4, job_size=2)
+    measurements = [0b1011, 0b1011, 0b0100, 0b1111]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            driver = pair.make_driver()
+            ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+            r1 = await pair.collect_level(ap1, driver)
+            # level-1 prefixes = top two bits
+            expect1 = [0, 0, 0, 0]
+            for m in measurements:
+                expect1[m >> 2] += 1
+            assert r1.aggregate_result == expect1, (r1.aggregate_result, expect1)
+            assert r1.report_count == len(measurements)
+
+            ap3 = Poplar1AggregationParam(3, (0b0100, 0b1011, 0b1111))
+            r3 = await pair.collect_level(ap3, driver)
+            assert r3.aggregate_result == [1, 2, 1], r3.aggregate_result
+            await driver.close()
+
+            ex = driver._executor
+            stats = ex.stats()
+            # leader (a0) and helper (a1) both served by the executor, at
+            # BOTH levels, with at least one flush carrying 2 jobs at one
+            # level (flushed_jobs > flushes)
+            for side in ("a0", "a1"):
+                for level in ("L1", "L3"):
+                    label = next(
+                        k
+                        for k in stats
+                        if f"/{side}/poplar_init/{level}" in k
+                    )
+                    assert stats[label]["flushed_rows"] >= 4, (label, stats)
+            coalesced = [
+                v for k, v in stats.items()
+                if "/poplar_init/" in k and v["flushed_jobs"] > v["flushes"]
+            ]
+            assert coalesced, f"no cross-job coalescing observed: {stats}"
+        finally:
+            await pair.stop()
+
+    _run(flow(), timeout=300.0)
+    reset_global_executor()
+
+
+def test_poplar1_deferred_journal_crash_replay_exactly_once():
+    """The journal fence at the agg param: deferred drains journal each
+    job's level-keyed delta in its commit tx; the owning process dies
+    before draining (simulated by discarding the store's host buckets);
+    the collection-time replay re-derives the level's shares from the
+    datastore — heavy-hitter counts bit-exact, journal empty after, and
+    the second drain path (cadence) finds nothing to double-merge."""
+    pytest.importorskip("cryptography")
+    reset_global_executor()
+    exec_cfg = ExecutorConfig(
+        enabled=True,
+        flush_window_s=0.15,
+        flush_max_rows=4096,
+        accumulator=AccumulatorConfig(
+            enabled=True, drain_interval_s=3600.0  # cadence never fires
+        ),
+    )
+    pair = _PoplarPair(exec_cfg, bits=4, job_size=2)
+    measurements = [0b1011, 0b1011, 0b0100, 0b1111]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            driver = pair.make_driver()
+            ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+            vdaf = pair.leader_task.vdaf_instance()
+
+            # Create the collection job (which creates the level's agg
+            # jobs) over HTTP, then step ONLY aggregation to Finished so
+            # the journal rows exist while the shares are still resident.
+            import aiohttp
+
+            from janus_tpu.collector import Collector
+            from janus_tpu.messages import (
+                CollectionJobId,
+                Duration,
+                Interval,
+                Query,
+                Time,
+            )
+
+            collector = Collector(
+                task_id=pair.task_id,
+                leader_endpoint=pair.leader_url,
+                vdaf=vdaf,
+                auth_token=pair.col_token,
+                hpke_keypair=pair.collector_keys,
+                poll_interval=0.05,
+                max_poll_time=60.0,
+            )
+            query = Query.new_time_interval(Interval(Time(NOW_S), Duration(3600)))
+            job_id = CollectionJobId.random()
+            session = aiohttp.ClientSession()
+            await collector.create_job(
+                query, job_id, vdaf.encode_agg_param(ap1), session=session
+            )
+
+            for _ in range(20):
+                leases = await pair.leader_ds.datastore.run_tx_async(
+                    "acquire",
+                    lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                        Duration(600), 10
+                    ),
+                )
+                if not leases:
+                    break
+                await asyncio.gather(
+                    *(driver.step_aggregation_job(l) for l in leases),
+                    return_exceptions=True,
+                )
+                pair.clock.advance(Duration(30))
+
+            ds = pair.leader_ds.datastore
+            entries = ds.run_tx(
+                "journal",
+                lambda tx: tx.get_accumulator_journal_entries(pair.task_id),
+            )
+            assert len(entries) == 2, [
+                (e.aggregation_job_id, e.report_ids) for e in entries
+            ]
+            assert all(
+                e.aggregation_parameter == vdaf.encode_agg_param(ap1)
+                for e in entries
+            ), "journal rows must carry the agg-param discriminant"
+
+            # CRASH: the resident (host-mirror) deltas die with the
+            # process; only the datastore journal survives.
+            store = driver._executor.accumulator
+            store.discard_all()
+            assert store.stats()["buckets"] == 0
+
+            # collection replays the journal from the datastore, then
+            # collects — counts must be exact despite the lost deltas
+            from janus_tpu.aggregator.collection_job_driver import (
+                CollectionJobDriver,
+            )
+
+            coll_driver = CollectionJobDriver(ds, aiohttp.ClientSession)
+
+            async def drive_collection():
+                for _ in range(20):
+                    await asyncio.sleep(0.1)
+                    leases = await ds.run_tx_async(
+                        "acquire_coll",
+                        lambda tx: tx.acquire_incomplete_collection_jobs(
+                            Duration(600), 10
+                        ),
+                    )
+                    for lease in leases:
+                        await coll_driver.step_collection_job(lease)
+                    pair.clock.advance(Duration(30))
+                await coll_driver.close()
+
+            async def poll():
+                for _ in range(200):
+                    out, _retry = await collector.poll_once(
+                        query, job_id, vdaf.encode_agg_param(ap1), session=session
+                    )
+                    if out is not None:
+                        return out
+                    await asyncio.sleep(0.05)
+                raise AssertionError("collection never completed")
+
+            try:
+                result, _ = await asyncio.gather(poll(), drive_collection())
+            finally:
+                await session.close()
+            expect = [0, 0, 0, 0]
+            for m in measurements:
+                expect[m >> 2] += 1
+            assert result.aggregate_result == expect, (
+                result.aggregate_result, expect,
+            )
+            assert result.report_count == len(measurements)
+            assert (
+                ds.run_tx(
+                    "count",
+                    lambda tx: tx.count_accumulator_journal_entries(pair.task_id),
+                )
+                == 0
+            ), "replay must consume every journal row exactly once"
+            await driver.close()
+        finally:
+            await pair.stop()
+
+    _run(flow(), timeout=300.0)
+    reset_global_executor()
