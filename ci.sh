@@ -49,7 +49,18 @@
 #   ./ci.sh chaos crash  process-level crash stage: the SIGKILL/restart soak
 #                      (tests/test_crash_chaos.py, slow-marked so tier-1
 #                      timing is unaffected) — real replica binaries killed
-#                      mid-step, lease reaper + journal replay verified.
+#                      mid-step, lease reaper + journal replay verified —
+#                      plus the collection-replica SIGKILL-mid-journal-replay
+#                      case (ISSUE 11: orphaned rows replayed exactly once by
+#                      a clean replacement binary, replay-consumed metric
+#                      delta == orphan count, results unchanged).
+#   ./ci.sh chaos partition  network-partition stage (ISSUE 11): the
+#                      asymmetric leader->helper blackhole soak (jobs quiesce
+#                      with retryable jittered backoff — zero attempt-budget
+#                      abandonments, zero breaker trips, zero expired leases
+#                      — then heal -> exactly-once counts, zero SLO false
+#                      breaches) plus the peer-health / deadline-budget /
+#                      Retry-After unit suite (tests/test_peer_health.py).
 #   ./ci.sh coldstart  shape-churn gate (ISSUE 8): pow2 canonicalization
 #                      oracle-parity sweep (tests/test_shape_canonical.py,
 #                      incl. the RUN_SLOW matrix: all circuit families x
@@ -145,13 +156,21 @@ case "$tier" in
     # test_accumulator.py covers the store/scheduler/replay units.
     export JANUS_CHAOS_SEED="${JANUS_CHAOS_SEED:-7}"
     if [ "${2:-}" = "crash" ]; then
-      # Process-level crash stage (ISSUE 4): SIGKILL/restart soak over
-      # real replica binaries + the lease-holder-death redelivery test.
+      # Process-level crash stage (ISSUE 4 + 11): SIGKILL/restart soak over
+      # real replica binaries, the lease-holder-death redelivery test, and
+      # the collection-replica SIGKILL-mid-journal-replay case.
       # Slow-marked (RUN_SLOW gates it) so the tier-1 budget is
       # unaffected; needs `cryptography` (the tests skip without it).
       RUN_SLOW=1 exec python -m pytest tests/test_crash_chaos.py -q
     fi
-    exec python -m pytest tests/test_chaos.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
+    if [ "${2:-}" = "partition" ]; then
+      # Network-partition stage (ISSUE 11): the asymmetric blackhole soak
+      # (slow-marked — RUN_SLOW gates it) + the peer-health/retry units.
+      RUN_SLOW=1 exec python -m pytest \
+        "tests/test_chaos.py::test_partition_soak_asymmetric_heal_exactly_once" \
+        tests/test_peer_health.py -q
+    fi
+    exec python -m pytest tests/test_chaos.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
     ;;
   mesh)
     # Multi-chip gate (ISSUE 6).  test_mesh.py is device-tier (sharded
@@ -202,7 +221,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|coldstart|obs|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|coldstart|obs|dryrun]" >&2
     exit 2
     ;;
 esac

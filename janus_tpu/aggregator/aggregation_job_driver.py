@@ -49,14 +49,21 @@ from ..vdaf import pingpong as pp
 from ..vdaf.backend import device_supported, make_backend
 from ..vdaf.prio3 import Prio3, VdafError
 from .aggregation_job_writer import AggregationJobWriter
+from .job_driver import helper_request_deadline
 
 logger = logging.getLogger("janus_tpu.aggregation_job_driver")
 
 
 class JobStepError(Exception):
-    def __init__(self, detail: str, retryable: bool):
+    def __init__(self, detail: str, retryable: bool, peer_unhealthy: bool = False):
         super().__init__(detail)
         self.retryable = retryable
+        #: the failure is PARTITION PRESSURE (the peer-health tracker has
+        #: the peer suspect, or the gate refused the attempt outright):
+        #: the job releases with retryable backoff WITHOUT consuming the
+        #: max_step_attempts budget — a long partition must never abandon
+        #: work that will finish fine after the heal.
+        self.peer_unhealthy = peer_unhealthy
 
 
 class _JournalRowMissing(Exception):
@@ -82,6 +89,11 @@ class DriverConfig:
     #: Lease-backoff curve for retryable failures (doubling per attempt).
     retry_initial_delay_s: float = 1.0
     retry_max_delay_s: float = 300.0
+    #: (Peer-health gating thresholds live on the PROCESS-WIDE tracker,
+    #: not here: binaries apply JobDriverConfig.peer_failure_threshold /
+    #: peer_suspect_dwell_s once at startup, and test harnesses call
+    #: peer_health.tracker().configure() explicitly — a per-driver copy
+    #: would either be dead or clobber tuned values.)
     vdaf_backend: str = "oracle"
     #: Field-arithmetic layout for the device backends ("vpu" | "mxu" —
     #: vdaf/backend.py FIELD_BACKENDS); None = process default
@@ -159,14 +171,59 @@ class AggregationJobDriver:
         from ..core.metrics import GLOBAL_METRICS, Timer
 
         if lease.lease_attempts > self.config.maximum_attempts_before_failure:
-            await self.abandon_aggregation_job(lease)
-            return
+            # Entry-ceiling partition guard: clean peer-unhealthy
+            # releases still increment lease_attempts (acquisition
+            # counts deliveries), so a long partition inflates the count
+            # past the ceiling.  While the peer is STILL unhealthy the
+            # job releases; within the heal grace it gets its POST-HEAL
+            # delivery (abandoning then would destroy exactly the work
+            # the partition tolerance exists to preserve); only a peer
+            # that has been healthy past the grace gets the ceiling's
+            # normal abandon verdict.  (Stopping the inflation at its
+            # source — peer-aware acquisition filtering — is the ROADMAP
+            # follow-on.)
+            from .job_driver import heal_grace_s, peer_partition_state
+
+            verdict = await peer_partition_state(
+                self.datastore,
+                lease.leased.task_id,
+                heal_grace_s(self.config.retry_max_delay_s),
+            )
+            if verdict == "suspect":
+                await self._release_ceiling_partition(lease)
+                return
+            if verdict != "healed":
+                await self.abandon_aggregation_job(lease)
+                return
+            # healed: fall through — this delivery is the job's chance
         outcome = "success"
         with Timer() as timer:
             try:
                 await self._step(lease)
             except JobStepError as e:
-                if e.retryable and lease.lease_attempts < self.config.max_step_attempts:
+                # Partition pressure (peer suspect) releases WITHOUT
+                # consuming the retryable budget: the failure is the
+                # network's, not the job's, and a long partition must
+                # not march every in-flight job to abandonment.  The
+                # delivery ceiling (maximum_attempts_before_failure,
+                # checked at entry) still bounds holders that never
+                # report back.
+                from .job_driver import partition_excused
+
+                if e.retryable and (
+                    lease.lease_attempts < self.config.max_step_attempts
+                    or e.peer_unhealthy
+                    # attempts inflated by a partition (peer still
+                    # unhealthy, or healed within the grace) must not
+                    # abandon the post-heal delivery on its first
+                    # ordinary hiccup — evaluated lazily, only when the
+                    # budget comparison would otherwise abandon
+                    or await partition_excused(
+                        self.datastore,
+                        lease.leased.task_id,
+                        self.config.retry_max_delay_s,
+                    )
+                ):
                     from .job_driver import step_retry_delay
 
                     outcome = "retried"
@@ -174,6 +231,10 @@ class AggregationJobDriver:
                         lease.lease_attempts,
                         self.config.retry_initial_delay_s,
                         self.config.retry_max_delay_s,
+                        # seeded per-job jitter: jobs released during a
+                        # partition re-acquire SPREAD OUT after the heal
+                        # instead of thundering-herding the helper
+                        jitter_key=lease.leased.aggregation_job_id.data,
                     )
                     logger.warning(
                         "retryable step failure (attempt %d/%d, redeliver in %ds): %s",
@@ -224,6 +285,11 @@ class AggregationJobDriver:
                 "release_done", lambda tx: tx.release_aggregation_job(lease)
             )
             return
+        # Peer-health gate (ISSUE 11): a suspect helper inside its dwell
+        # means this step WILL end at a dead socket — release now, before
+        # any prepare work (device launch, decode) is burned on it.  Past
+        # the dwell the gate opens (half-open) and this step is the probe.
+        self._gate_peer(task)
         vdaf = task.vdaf_instance()
 
         start_ras = [ra for ra in ras if ra.state == ReportAggregationState.START_LEADER]
@@ -250,6 +316,47 @@ class AggregationJobDriver:
                 tx.release_aggregation_job(lease)
 
             await self.datastore.run_tx_async("step_agg_job_2", tx_fn)
+
+    # ------------------------------------------------------------------
+    async def _release_ceiling_partition(self, lease) -> None:
+        """Release a past-ceiling lease with jittered backoff: the
+        inflated delivery count is partition pressure, not a sick job."""
+        from .job_driver import step_retry_delay
+
+        acq = lease.leased
+        delay = step_retry_delay(
+            lease.lease_attempts,
+            self.config.retry_initial_delay_s,
+            self.config.retry_max_delay_s,
+            jitter_key=acq.aggregation_job_id.data,
+        )
+        logger.warning(
+            "job %s is past its delivery ceiling (%d attempts) but the "
+            "peer is suspect — releasing for %ds instead of abandoning "
+            "partition-pressured work",
+            acq.aggregation_job_id,
+            lease.lease_attempts,
+            delay.seconds,
+        )
+        await self.datastore.run_tx_async(
+            "release_agg_job",
+            lambda tx: tx.release_aggregation_job(lease, delay),
+        )
+
+    def _gate_peer(self, task: AggregatorTask) -> None:
+        """Refuse to burn lease work on a suspect peer (raises a
+        peer-unhealthy retryable JobStepError); no-op while healthy or
+        once the suspect dwell has elapsed (the half-open probe)."""
+        from ..core import peer_health
+
+        url = task.peer_aggregator_endpoint
+        if not peer_health.tracker().allow(url):
+            raise JobStepError(
+                f"peer {peer_health.origin_of(url)} is suspect (consecutive "
+                "transport failures); releasing without an attempt",
+                retryable=True,
+                peer_unhealthy=True,
+            )
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -840,6 +947,7 @@ class AggregationJobDriver:
             f"aggregation_jobs/{job.aggregation_job_id}",
             req.get_encoded(),
             AggregationJobInitializeReq.MEDIA_TYPE,
+            lease=lease,
         )
         await self._process_helper_resp(
             lease, task, vdaf, job, all_ras, states, failed, resp
@@ -876,6 +984,7 @@ class AggregationJobDriver:
             f"aggregation_jobs/{job.aggregation_job_id}",
             req.get_encoded(),
             AggregationJobContinueReq.MEDIA_TYPE,
+            lease=lease,
         )
         await self._process_helper_resp(
             lease,
@@ -1676,13 +1785,26 @@ class AggregationJobDriver:
         body: Optional[bytes],
         media_type: Optional[str],
         expect_body: bool = True,
+        lease=None,
     ) -> Optional[AggregationJobResp]:
         """HTTPS to the peer aggregator with retry/backoff
-        (reference: aggregator.rs:3200 send_request_to_helper)."""
+        (reference: aggregator.rs:3200 send_request_to_helper).  The
+        exchange runs under a lease-derived deadline (a blackholed peer
+        must release the lease, never pin it past reap) and behind the
+        peer-health gate; a transport-level failure against a suspect
+        peer surfaces as partition pressure (peer_unhealthy), which
+        releases without consuming the attempt budget."""
+        from ..core import peer_health
+        from ..core.retries import is_transport_error
+
         url = (
             task.peer_aggregator_endpoint.rstrip("/")
             + f"/tasks/{task.task_id}/{resource}"
         )
+        tracker = peer_health.tracker()
+        # re-gate: a partition detected MID-step (between prepare and
+        # send) must not burn the attempt either
+        self._gate_peer(task)
         headers = {}
         if media_type:
             headers["Content-Type"] = media_type
@@ -1702,9 +1824,19 @@ class AggregationJobDriver:
                 data=body,
                 headers=headers,
                 policy=self.config.http_retry,
+                deadline=helper_request_deadline(lease, self.datastore),
             )
         except Exception as e:
-            raise JobStepError(f"helper request failed: {e}", retryable=True)
+            raise JobStepError(
+                f"helper request failed: {e}",
+                retryable=True,
+                # only a transport failure against a peer the tracker has
+                # ALREADY suspected is partition pressure — a one-off
+                # blip still consumes budget (a broken-but-reachable path
+                # must not ping-pong forever)
+                peer_unhealthy=is_transport_error(e)
+                and tracker.is_suspect(url),
+            )
         if status >= 400:
             # 4xx = fatal (bad request will not heal); 5xx = retryable
             # (reference: aggregation_job_driver.rs:1030 error classification)

@@ -72,6 +72,8 @@ class CollectionDriverConfig:
     #: shard layout for journal-replay share merges — must match the
     #: writers' batch_aggregation_shard_count
     batch_aggregation_shard_count: int = 8
+    #: (Peer-health gating thresholds live on the PROCESS-WIDE tracker —
+    #: see DriverConfig's note; binaries apply them once at startup.)
 
 
 class CollectionJobDriver:
@@ -105,8 +107,39 @@ class CollectionJobDriver:
         t_step = _time.monotonic()
         acq = lease.leased
         if lease.lease_attempts > self.config.maximum_attempts_before_failure:
-            await self.abandon_collection_job(lease)
-            return
+            # Entry-ceiling partition guard (shared classification with
+            # the aggregation driver): a delivery count inflated by
+            # clean peer-unhealthy releases must not abandon the job
+            # while the peer is still unreachable — and within the heal
+            # grace the job gets its post-heal delivery instead of an
+            # entry abandonment.
+            from .job_driver import heal_grace_s, peer_partition_state
+
+            verdict = await peer_partition_state(
+                self.datastore,
+                acq.task_id,
+                heal_grace_s(self.config.step_retry_max_delay.seconds),
+            )
+            if verdict == "suspect":
+                await self._release_retryable(lease, peer_unhealthy=True)
+                return
+            if verdict != "healed":
+                await self.abandon_collection_job(lease)
+                return
+            # healed: fall through — this delivery is the job's chance
+        else:
+            # Early peer gate (mirrors the aggregation driver's
+            # _gate_peer): the helper exchange sits at the END of this
+            # step, after the journal replay and the aggregate-share
+            # recomputation — a suspect peer inside its dwell would
+            # waste all of that per delivery.  Cheap: the in-memory
+            # partition_signal short-circuits the task lookup in the
+            # common no-partition case.
+            from .job_driver import peer_partition_state as _pps
+
+            if await _pps(self.datastore, acq.task_id, 0.0) == "suspect":
+                await self._release_retryable(lease, peer_unhealthy=True)
+                return
 
         # Guaranteed drain-before-collection: outstanding accumulator-
         # journal rows name FINISHED reports whose out shares are still
@@ -215,6 +248,21 @@ class CollectionJobDriver:
             task.peer_aggregator_endpoint.rstrip("/")
             + f"/tasks/{task.task_id}/aggregate_shares"
         )
+        # Peer-health gate (ISSUE 11): a suspect helper inside its dwell
+        # means this exchange is doomed — release with backoff without
+        # burning the attempt (and without consuming the failure budget).
+        from ..core import peer_health
+        from ..core.retries import is_transport_error
+
+        tracker = peer_health.tracker()
+        if not tracker.allow(url):
+            logger.warning(
+                "peer %s is suspect; releasing collection job without an "
+                "attempt",
+                peer_health.origin_of(url),
+            )
+            await self._release_retryable(lease, peer_unhealthy=True)
+            return
         headers = {"Content-Type": AggregateShareReq.MEDIA_TYPE}
         if task.aggregator_auth_token is not None:
             name, value = task.aggregator_auth_token.request_authentication()
@@ -222,6 +270,11 @@ class CollectionJobDriver:
         from ..core.trace import inject_traceparent
 
         inject_traceparent(headers)
+        # lease-derived deadline: a blackholed helper must hand the step
+        # back in time to RELEASE the lease, never leave it to the reaper
+        from .job_driver import helper_request_deadline
+
+        deadline = helper_request_deadline(lease, self.datastore)
         try:
             status, body, _ = await retry_http_request(
                 self._get_session(),
@@ -230,10 +283,14 @@ class CollectionJobDriver:
                 data=req.get_encoded(),
                 headers=headers,
                 policy=self.config.http_retry,
+                deadline=deadline,
             )
         except Exception as e:
             logger.warning("helper aggregate-share request failed: %s", e)
-            await self._release_retryable(lease)
+            await self._release_retryable(
+                lease,
+                peer_unhealthy=is_transport_error(e) and tracker.is_suspect(url),
+            )
             return
         if status >= 400:
             logger.warning("helper aggregate-share returned %d", status)
@@ -487,13 +544,28 @@ class CollectionJobDriver:
                 ).inc()
 
     # ------------------------------------------------------------------
-    async def _release_retryable(self, lease: Lease) -> None:
+    async def _release_retryable(
+        self, lease: Lease, peer_unhealthy: bool = False
+    ) -> None:
         """Retryable-failure budget + exponential lease-backoff (the
         aggregation driver's curve, shared via step_retry_delay): release
-        for redelivery, or abandon once the budget is spent."""
-        from .job_driver import step_retry_delay
+        for redelivery, or abandon once the budget is spent.  Partition
+        pressure (``peer_unhealthy`` — the peer-health tracker has the
+        helper suspect) never consumes the budget: the job releases with
+        jittered backoff for as long as the partition lasts."""
+        from .job_driver import partition_excused, step_retry_delay
 
-        if lease.lease_attempts >= self.config.max_step_attempts:
+        if (
+            lease.lease_attempts >= self.config.max_step_attempts
+            and not peer_unhealthy
+            # attempts inflated by a partition must not abandon the
+            # post-heal delivery on its first ordinary hiccup
+            and not await partition_excused(
+                self.datastore,
+                lease.leased.task_id,
+                self.config.step_retry_max_delay.seconds,
+            )
+        ):
             logger.error(
                 "collection step failure exhausted its %d-attempt budget; "
                 "abandoning",
@@ -505,6 +577,9 @@ class CollectionJobDriver:
             lease.lease_attempts,
             self.config.step_retry_initial_delay.seconds,
             self.config.step_retry_max_delay.seconds,
+            # per-job jitter: heal-time reacquisitions spread out instead
+            # of thundering-herding the freshly recovered helper
+            jitter_key=lease.leased.collection_job_id.data,
         )
         await self.datastore.run_tx_async(
             "release_coll_job", lambda tx: tx.release_collection_job(lease, delay)

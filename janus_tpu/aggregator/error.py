@@ -17,6 +17,11 @@ class AggregatorError(Exception):
 
     problem: Optional[DapProblemType] = None
     status = 500
+    #: seconds for a Retry-After header on the response (None = no
+    #: header).  The leader's retry_http_request honors it — capped at
+    #: its policy's max interval — so helper-side backpressure shapes
+    #: the peer's backoff instead of blind exponential sleeps.
+    retry_after: Optional[int] = None
 
     def __init__(self, detail: str = ""):
         super().__init__(detail)
@@ -29,6 +34,7 @@ class ServiceUnavailable(AggregatorError):
     classification, so the lease machinery redelivers the job."""
 
     status = 503
+    retry_after = 1
 
 
 class UnrecognizedTask(AggregatorError):

@@ -25,6 +25,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..core.auth_tokens import DAP_AUTH_HEADER, AuthenticationToken
+from ..datastore.datastore import DatastoreUnavailable
 from ..messages import (
     AggregateShare,
     AggregationJobId,
@@ -63,13 +64,21 @@ def _extract_auth(request: web.Request) -> Optional[AuthenticationToken]:
 
 
 def _problem(err: AggregatorError, task_id: Optional[TaskId]) -> web.Response:
+    headers = (
+        {"Retry-After": str(err.retry_after)}
+        if err.retry_after is not None
+        else None
+    )
     if err.problem is None:
-        return web.Response(status=err.status, text=err.detail or "")
+        return web.Response(
+            status=err.status, text=err.detail or "", headers=headers
+        )
     doc = problem_document(err.problem, task_id=task_id, detail=err.detail or None)
     return web.Response(
         status=err.status,
         content_type=PROBLEM_CONTENT_TYPE,
         text=json.dumps(doc),
+        headers=headers,
     )
 
 
@@ -157,6 +166,21 @@ def _route(handler):
             from .error import InvalidMessage
 
             return _problem(InvalidMessage(str(err)), task_id)
+        except DatastoreUnavailable as err:
+            # Datastore unreachable / retries exhausted is a TRANSIENT
+            # infrastructure failure, not a protocol error: answer with
+            # the DAP-retryable 503 (+ Retry-After) so the leader's
+            # lease machinery redelivers — a split-brain window (helper
+            # HTTP up, helper datastore down) must not 500 jobs into
+            # their failure budget.  Scoped to the retries-exhausted
+            # subclass: permanent DatastoreErrors (missing rows, schema
+            # mismatch) would retry forever under a 503.
+            logger.warning("datastore unavailable in %s: %s", request.path, err)
+            return web.Response(
+                status=503,
+                text="datastore unavailable",
+                headers={"Retry-After": "5"},
+            )
         except Exception:
             logger.exception("internal error in %s", request.path)
             return web.Response(status=500, text="internal error")

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import zlib
 from typing import Awaitable, Callable, List, Optional
 
 from ..core.time import Clock
@@ -24,7 +25,11 @@ logger = logging.getLogger("janus_tpu.job_driver")
 
 
 def step_retry_delay(
-    attempts: int, initial_s: float, max_s: float, multiplier: float = 2.0
+    attempts: int,
+    initial_s: float,
+    max_s: float,
+    multiplier: float = 2.0,
+    jitter_key: Optional[bytes] = None,
 ) -> Duration:
     """Exponential lease-backoff for a retryable step failure: attempt 1
     waits ``initial_s``, doubling up to ``max_s``.  Shared by both job
@@ -32,10 +37,107 @@ def step_retry_delay(
     (reference analog: collection_job_driver.rs RetryStrategy :723-792,
     generalized to aggregation).  Clamped to >= 1s: Duration is integral
     seconds, and truncating a sub-second delay to 0 would mean immediate
-    redelivery — the hot loop this backoff exists to prevent."""
-    return Duration(
-        max(1, int(min(initial_s * multiplier ** max(0, attempts - 1), max_s)))
+    redelivery — the hot loop this backoff exists to prevent.
+
+    ``jitter_key`` (the job id) spreads the base delay over [base, 2x
+    base) with a seed stable per (job, attempt): every job released
+    during a partition would otherwise sit on the SAME backoff schedule
+    and re-acquire in one wave the moment the link heals — a thundering
+    herd aimed at a helper that just recovered.  Stable seeding keeps
+    redelivery times reproducible for a given chaos seed while distinct
+    jobs land at distinct offsets; the jittered delay may exceed
+    ``max_s`` by up to 2x, which is the point at the cap (every job AT
+    the cap must still spread)."""
+    # exponent clamped: peer-unhealthy releases can push attempts into
+    # the thousands during a long partition, and float ** overflows past
+    # ~2**1024 — 2**64 already exceeds any real max_s
+    base = min(initial_s * multiplier ** min(max(0, attempts - 1), 64), max_s)
+    if jitter_key:
+        rng = random.Random((zlib.crc32(jitter_key) << 8) ^ attempts)
+        base = base * (1.0 + rng.random())
+    return Duration(max(1, round(base)))
+
+
+def heal_grace_s(retry_max_delay_s: float) -> float:
+    """Heal-grace window for the ceiling guards, shared by both drivers:
+    long enough for every job released during the partition to cycle
+    back through acquisition at least once — step_retry_delay's max
+    jittered backoff is 2x the max delay, and the extra 1x is headroom
+    for discovery-poll and worker-queue latency (a boundary job must
+    not miss the window by one poll interval and abandon)."""
+    return 3.0 * retry_max_delay_s
+
+
+async def peer_partition_state(datastore, task_id, grace_s: float) -> str:
+    """Ceiling-time partition classification shared by BOTH job drivers:
+    is the task's peer ``suspect`` (inside its dwell — release, don't
+    abandon), ``healed`` (probing, or back healthy within ``grace_s`` —
+    the inflated delivery count is partition debris, let the job take
+    its delivery: a PROBING peer's delivery IS the half-open probe, and
+    without it a fleet whose every job is past-ceiling could never heal),
+    or ``healthy`` (the ceiling's normal verdict applies)?  Lookup
+    failures report ``healthy`` — fall through to the normal verdict
+    rather than wedge the ceiling on a sick datastore.  The common
+    no-partition case short-circuits on the in-memory tracker without
+    touching the datastore."""
+    from ..core import peer_health
+    from ..core.peer_health import PEER_SUSPECT, PEER_PROBING
+
+    tracker = peer_health.tracker()
+    if not tracker.partition_signal(grace_s):
+        return "healthy"
+    try:
+        task = await datastore.run_tx_async(
+            "ceiling_peer_check", lambda tx: tx.get_aggregator_task(task_id)
+        )
+    except Exception:
+        # the lookup only maps task_id -> peer URL, and partition_signal
+        # already confirmed SOME peer is partitioned: fail toward the
+        # cheap, reversible verdict (release) — failing "healthy" here
+        # would abandon exactly the jobs this guard protects whenever
+        # the datastore is contended by the same redelivery churn
+        return "suspect"
+    if task is None:
+        return "healthy"
+    url = task.peer_aggregator_endpoint
+    state = tracker.state(url)
+    if state == PEER_SUSPECT:
+        return "suspect"
+    if state == PEER_PROBING or tracker.recently_healed(url, grace_s):
+        return "healed"
+    return "healthy"
+
+
+async def partition_excused(datastore, task_id, retry_max_delay_s: float) -> bool:
+    """Budget-exhaustion excuse shared by both drivers: is the task's
+    peer partitioned (suspect/probing) or healed within the grace?  A
+    job whose lease_attempts were inflated by clean partition releases
+    must not be abandoned by the max_step_attempts comparison on its
+    first post-heal hiccup — the count is partition debris, not failure
+    history.  Cheap in the common case (peer_partition_state
+    short-circuits on the in-memory tracker)."""
+    return (
+        await peer_partition_state(
+            datastore, task_id, heal_grace_s(retry_max_delay_s)
+        )
+        != "healthy"
     )
+
+
+def helper_request_deadline(lease, datastore):
+    """Monotonic deadline for one peer exchange, shared by BOTH job
+    drivers: 80% of the remaining lease (floor 1s), so a blackholed peer
+    ALWAYS hands the step back in time to RELEASE the lease in-band —
+    never leaving it to expire into the reaper (the partition soak
+    asserts ``janus_job_leases_expired_total`` stays zero).  None when
+    there is no lease/datastore context (unit tests, best-effort
+    cleanup calls)."""
+    if lease is None or datastore is None:
+        return None
+    import time as _time
+
+    remaining = lease.lease_expiry.seconds - datastore.clock.now().seconds
+    return _time.monotonic() + max(1.0, 0.8 * remaining)
 
 
 class JobDriver:

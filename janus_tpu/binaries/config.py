@@ -295,6 +295,17 @@ class JobDriverConfig:
     #: whose holder died without releasing, counting each into
     #: janus_job_leases_expired_total; <= 0 disables the reaper
     lease_reap_interval_s: float = 10.0
+    #: per-attempt HTTP timeout toward the peer aggregator: one hung or
+    #: blackholed attempt is cut off here instead of riding aiohttp's
+    #: defaults (core/retries.py attempt_timeout); <= 0 disables
+    http_attempt_timeout_s: float = 30.0
+    #: peer-health gating (core/peer_health.py): consecutive transport
+    #: failures before the peer is SUSPECT and lease work stops being
+    #: burned on it (jobs release with retryable jittered backoff that
+    #: never consumes max_step_attempts); 0 disables gating
+    peer_failure_threshold: int = 3
+    #: suspect dwell before half-open probes flow toward the peer again
+    peer_suspect_dwell_s: float = 10.0
 
 
 @dataclass

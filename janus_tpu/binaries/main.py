@@ -467,6 +467,15 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
     cfg = load_config(JobDriverBinaryConfig, config_path)
     clock, datastore = _bootstrap(cfg.common)
 
+    # Peer-health gating thresholds are applied ONCE here (the tracker
+    # is process-wide; driver constructors deliberately don't touch it).
+    from ..core import peer_health
+
+    peer_health.tracker().configure(
+        failure_threshold=cfg.job_driver.peer_failure_threshold,
+        suspect_dwell_s=cfg.job_driver.peer_suspect_dwell_s,
+    )
+
     import aiohttp
 
     from ..aggregator import (
@@ -482,6 +491,8 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             if cfg.device_executor.enabled
             else None
         )
+        from ..core.retries import HttpRetryPolicy
+
         stepper_impl = AggregationJobDriver(
             datastore,
             aiohttp.ClientSession,
@@ -495,6 +506,9 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 field_backend=cfg.field_backend,
                 device_executor=exec_cfg,
                 warmup_wait_s=cfg.warmup_wait_s,
+                http_retry=HttpRetryPolicy(
+                    attempt_timeout=cfg.job_driver.http_attempt_timeout_s
+                ),
             ),
         )
         if exec_cfg is not None and exec_cfg.warmup_rows:
@@ -560,6 +574,7 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
         job_type = "aggregation"
     else:
         from ..aggregator.collection_job_driver import CollectionDriverConfig
+        from ..core.retries import HttpRetryPolicy
 
         stepper_impl = CollectionJobDriver(
             datastore,
@@ -574,6 +589,9 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                     max(1, int(cfg.job_driver.retry_initial_delay_s))
                 ),
                 step_retry_max_delay=Duration(int(cfg.job_driver.retry_max_delay_s)),
+                http_retry=HttpRetryPolicy(
+                    attempt_timeout=cfg.job_driver.http_attempt_timeout_s
+                ),
             ),
         )
 

@@ -501,6 +501,34 @@ class Metrics:
             ["path"],
             registry=self.registry,
         )
+        # Peer transport health (core/peer_health.py): the partition
+        # failure domain made observable — which peer, what state, how
+        # many transport-level failures.  The state-set gauge carries 1
+        # on the peer's current state so dashboards and alerts can match
+        # on janus_peer_health{state="suspect"} == 1 directly.
+        self.peer_health = Gauge(
+            "janus_peer_health",
+            "Peer transport health state-set (1 on the peer's current "
+            "state: healthy|suspect|probing)",
+            ["peer", "state"],
+            registry=self.registry,
+        )
+        self.peer_transport_failures = Counter(
+            "janus_peer_transport_failures_total",
+            "Transport-level failures (connect/reset/timeout) per peer; "
+            "HTTP responses of any status do not count",
+            ["peer"],
+            registry=self.registry,
+        )
+        # Backpressure cooperation: how often the peer's Retry-After hint
+        # (503 overload responses) shaped our backoff instead of the
+        # blind exponential curve.
+        self.http_retry_after_honored = Counter(
+            "janus_http_retry_after_honored_total",
+            "Retryable HTTP responses whose Retry-After hint set the "
+            "backoff sleep (capped at the policy max interval)",
+            registry=self.registry,
+        )
         # Fault injection (core/faults.py): every injected fault is counted
         # so a chaos run's pressure is itself observable.
         self.faults_injected = Counter(

@@ -44,6 +44,10 @@ def runtime_status() -> dict:
         # breach state from the sampler-driven evaluator
         "slo": slo_status(),
         "faults": faults.snapshot(),
+        # Peer transport health (ISSUE 11): per-peer suspect/probing
+        # state + failure counts — the first thing to check when a soak
+        # quiesces (partition pressure vs a bug)
+        "peers": _peer_stats(),
     }
 
     from ..executor import peek_global_executor
@@ -70,6 +74,19 @@ def runtime_status() -> dict:
             ex.accumulator.stats() if ex.accumulator is not None else None
         )
     return doc
+
+
+def _peer_stats() -> dict:
+    """Per-peer transport health (core/peer_health.py); failure-tolerant
+    like every other section — introspection must never take /statusz
+    down."""
+    try:
+        from .peer_health import tracker
+
+        return tracker().stats()
+    except Exception:
+        logger.exception("peer-health stats unavailable")
+        return {"error": "unavailable"}
 
 
 def _canonicalization_stats() -> dict:
@@ -129,6 +146,16 @@ def sample_status_metrics(datastore, clock=None) -> None:
     executor buckets.  Driven by the binaries' main loops on
     ``common.status_sample_interval_s``."""
     from .metrics import GLOBAL_METRICS
+
+    # BEFORE the datastore query: peer-health gauges must refresh (the
+    # time-driven suspect->probing transition has no traffic to publish
+    # it) even while the datastore is wedged
+    try:
+        from .peer_health import tracker
+
+        tracker().republish_metrics()
+    except Exception:
+        logger.exception("peer-health republish failed")
 
     def q(tx):
         count, oldest = tx.accumulator_journal_stats()

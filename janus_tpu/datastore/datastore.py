@@ -108,6 +108,14 @@ class DatastoreError(Exception):
     pass
 
 
+class DatastoreUnavailable(DatastoreError):
+    """A transaction exhausted its retry budget on TRANSIENT failures
+    (lock contention, serialization, injected faults) — the datastore is
+    unreachable-or-overloaded right now, not wrong.  The HTTP layer maps
+    this — and only this — DatastoreError shape to a DAP-retryable 503:
+    permanent conditions (missing rows, schema mismatch) stay loud."""
+
+
 class TxConflict(DatastoreError):
     """A uniqueness/state conflict the caller must handle (maps the
     reference's Error::MutationTargetAlreadyExists and friends)."""
@@ -307,7 +315,9 @@ class Datastore:
                     continue
                 raise
         _metrics_tx(name, "exhausted")
-        raise DatastoreError(f"transaction {name!r} exhausted retries: {last_err}")
+        raise DatastoreUnavailable(
+            f"transaction {name!r} exhausted retries: {last_err}"
+        )
 
     def _is_retryable(self, e: BaseException) -> bool:
         """Backend retry classification, plus injected faults — which

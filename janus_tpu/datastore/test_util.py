@@ -23,7 +23,11 @@ class EphemeralDatastore:
         os.close(fd)
         os.unlink(self.path)  # let SQLite create it fresh
         self.clock = clock if clock is not None else MockClock()
-        self.crypter = Crypter([generate_key()])
+        #: raw crypter key, kept so cross-process tests (chaos soaks
+        #: spawning replica binaries against this store) can export it
+        #: as DATASTORE_KEYS
+        self.key = generate_key()
+        self.crypter = Crypter([self.key])
         self.datastore = Datastore(self.path, self.crypter, self.clock)
 
     def __enter__(self) -> Datastore:

@@ -51,11 +51,16 @@ REPO = pathlib.Path(__file__).resolve().parents[1]
 
 @pytest.fixture(autouse=True)
 def _clean_faults():
-    """Never leak an armed registry (or a tripped global executor) into
-    the rest of the suite."""
+    """Never leak an armed registry (or a tripped global executor, or a
+    suspect peer verdict) into the rest of the suite."""
+    from janus_tpu.core import peer_health
+
     faults.clear()
+    peer_health.reset_peer_health()
     yield
     faults.clear()
+    peer_health.reset_peer_health()
+    peer_health.tracker().configure(failure_threshold=3, suspect_dwell_s=10.0)
     reset_global_executor()
 
 
@@ -531,7 +536,7 @@ class ChaosHarness:
 
     N_REPORTS = 4
 
-    def __init__(self, n_tasks=2, mesh=False):
+    def __init__(self, n_tasks=2, mesh=False, deferred=False, driver_overrides=None):
         import aiohttp
 
         from janus_tpu.aggregator import Aggregator, Config
@@ -565,7 +570,13 @@ class ChaosHarness:
             # ISSUE 3 acceptance: the soak runs with device-resident
             # accumulation ON and a byte budget tiny enough that LRU
             # evictions fire constantly — aggregates must still be exact.
-            accumulator=AccumulatorConfig(enabled=True, byte_budget=256),
+            # ``deferred`` switches to cross-job residency + journal rows
+            # (ISSUE 11's collection-replica SIGKILL case orphans them).
+            accumulator=AccumulatorConfig(
+                enabled=True,
+                byte_budget=256,
+                drain_interval_s=3600.0 if deferred else 0.0,
+            ),
         )
         cfg = Config(vdaf_backend="oracle", max_upload_batch_write_delay=0.02)
         # Helper-side chaos parity (ISSUE 4 satellite / ROADMAP): the
@@ -584,20 +595,36 @@ class ChaosHarness:
         self.collector_keys = HpkeKeypair.generate(9)
         self.tasks = []  # (task_id, leader_task, helper_task)
         # 2 replicas: distinct driver instances, one shared global executor
+        driver_kwargs = dict(
+            vdaf_backend="tpu",
+            device_executor=self.exec_cfg,
+            http_retry=HttpRetryPolicy(0.001, 0.01, 2.0, 0.5, 3),
+            # parity soak: jobs must survive chaos, never abandon
+            maximum_attempts_before_failure=10_000,
+            max_step_attempts=10_000,
+            retry_initial_delay_s=1.0,
+            retry_max_delay_s=8.0,
+            # the soak's rounds spin in mock time while the peer-health
+            # dwell runs in REAL time: keep it short so a suspect helper
+            # (phase 1 drives http.request at p=1) probes again within a
+            # couple of rounds instead of gating for 10 wall seconds
+            peer_suspect_dwell_s=0.2,
+            peer_failure_threshold=3,
+        )
+        driver_kwargs.update(driver_overrides or {})
+        # peer-health thresholds go to the PROCESS-WIDE tracker (what a
+        # binary does once at startup), not onto DriverConfig
+        from janus_tpu.core import peer_health
+
+        peer_health.tracker().configure(
+            failure_threshold=driver_kwargs.pop("peer_failure_threshold"),
+            suspect_dwell_s=driver_kwargs.pop("peer_suspect_dwell_s"),
+        )
         self.drivers = [
             AggregationJobDriver(
                 self.leader_ds.datastore,
                 aiohttp.ClientSession,
-                DriverConfig(
-                    vdaf_backend="tpu",
-                    device_executor=self.exec_cfg,
-                    http_retry=HttpRetryPolicy(0.001, 0.01, 2.0, 0.5, 3),
-                    # parity soak: jobs must survive chaos, never abandon
-                    maximum_attempts_before_failure=10_000,
-                    max_step_attempts=10_000,
-                    retry_initial_delay_s=1.0,
-                    retry_max_delay_s=8.0,
-                ),
+                DriverConfig(**driver_kwargs),
             )
             for _ in range(2)
         ]
@@ -965,6 +992,449 @@ def test_poplar1_chaos_device_lost_oracle_fallback_exactly_once():
 
     _run(flow(), timeout=280.0)
     reset_global_executor()
+
+
+# -- connectivity fault modes (ISSUE 11) -------------------------------------
+
+
+def test_reset_mode_raises_transport_shaped_error():
+    """``reset`` impersonates a mid-exchange socket reset: the error is a
+    ConnectionResetError (the peer-health tracker and retry loop classify
+    it transport) AND a FaultInjectedError (chaos harnesses catch it)."""
+    from janus_tpu.core.faults import FaultInjectedTransportError
+    from janus_tpu.core.retries import is_transport_error
+
+    faults.configure([FaultSpec("http.request", "reset", 1.0)], seed=SEED)
+    with pytest.raises(FaultInjectedTransportError) as exc_info:
+        faults.fire("http.request", target="http://peer:1/x")
+    assert isinstance(exc_info.value, ConnectionResetError)
+    assert is_transport_error(exc_info.value)
+
+
+def test_target_scoped_specs_partition_one_direction():
+    """The asymmetric-partition primitive: a spec targeting the helper's
+    host:port fires ONLY for leader->helper traffic; helper->leader (a
+    different target) and untargeted points flow — and the scoped spec's
+    RNG is rolled only for matching calls, so the partitioned direction's
+    decision sequence is independent of the healthy one's traffic."""
+    from janus_tpu.core.faults import FaultInjectedTransportError
+
+    faults.configure(
+        [FaultSpec("http.request", "reset", 1.0, target="helper-host:81")],
+        seed=SEED,
+    )
+    # leader -> helper: partitioned
+    with pytest.raises(FaultInjectedTransportError):
+        faults.fire("http.request", target="http://helper-host:81/tasks/t/x")
+    # helper -> leader: flows
+    faults.fire("http.request", target="http://leader-host:80/tasks/t/x")
+    # a call site that passes no target never matches a scoped spec
+    faults.fire("http.request")
+    assert faults.registry().hits["http.request"] == 1
+    # datastore tx points stay healthy during an http-scoped partition
+    faults.fire("datastore.tx.begin")
+
+
+def test_flap_schedule_determinism_under_seed():
+    """Two schedules with one (seed, point) agree at every sample; a
+    different seed diverges — a flapping-link chaos run replays."""
+    from janus_tpu.core.faults import FlapSchedule
+
+    grid = [i * 0.173 for i in range(200)]
+    a = FlapSchedule(SEED, "http.request", 1.0)
+    b = FlapSchedule(SEED, "http.request", 1.0)
+    c = FlapSchedule(SEED + 1, "http.request", 1.0)
+    sa = [a.up(t) for t in grid]
+    assert sa == [b.up(t) for t in grid]
+    assert sa != [c.up(t) for t in grid]
+    # distinct specs on ONE point (salt = spec index) flap INDEPENDENTLY
+    # — two target-scoped directions must not partition in lockstep
+    d = FlapSchedule(SEED, "http.request", 1.0, salt=1)
+    assert sa != [d.up(t) for t in grid]
+    assert sa[0] is False, "phase 0 is DOWN: arming must not partition t=0"
+    assert any(sa) and not all(sa), "both phases must occur"
+    # transitions alternate (a schedule, not noise)
+    flips = sum(1 for x, y in zip(sa, sa[1:]) if x != y)
+    assert flips >= 2
+
+
+def test_flap_spec_alternates_connectivity():
+    """An armed flap spec produces BOTH outcomes over a few periods —
+    injected resets while up, clean passes while down."""
+    from janus_tpu.core.faults import FaultInjectedTransportError
+
+    faults.configure(
+        [FaultSpec("http.request", "flap", 1.0, flap_period_s=0.03)], seed=SEED
+    )
+    outcomes = set()
+    deadline = _now() + 2.0
+    while len(outcomes) < 2 and _now() < deadline:
+        try:
+            faults.fire("http.request", target="http://flappy:1/")
+            outcomes.add("pass")
+        except FaultInjectedTransportError:
+            outcomes.add("reset")
+        import time as _t
+
+        _t.sleep(0.005)
+    assert outcomes == {"pass", "reset"}, outcomes
+
+
+def _now():
+    import time as _t
+
+    return _t.monotonic()
+
+
+def test_snapshot_renders_target_scope_and_flap_period():
+    faults.configure(
+        [
+            FaultSpec("http.request", "blackhole", 0.5, target="helper:99"),
+            FaultSpec("http.request", "flap", 1.0, flap_period_s=2.5),
+        ],
+        seed=SEED,
+    )
+    snap = faults.snapshot()
+    specs = snap["points"]["http.request"]
+    assert specs[0] == {
+        "mode": "blackhole",
+        "probability": 0.5,
+        "target": "helper:99",
+    }
+    assert specs[1] == {
+        "mode": "flap",
+        "probability": 1.0,
+        "flap_period_s": 2.5,
+    }
+
+
+# -- helper-side split-brain: datastore down, HTTP up (ISSUE 11) --------------
+
+
+def test_helper_datastore_unreachable_returns_503_with_retry_after():
+    """A helper whose datastore is unreachable must answer DAP-retryable
+    503 (+ Retry-After) — not 500 — so the leader's lease machinery
+    redelivers instead of burning failure budget on the split-brain
+    window."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.aggregator import Aggregator, Config, aggregator_app
+    from janus_tpu.datastore.test_util import EphemeralDatastore
+    from janus_tpu.messages import TaskId
+
+    eph = EphemeralDatastore()
+    # exhaust the tx retry loop quickly: the 503 path is DatastoreError
+    # escaping run_tx, and 30 retries of a p=1 begin fault take ~10s
+    eph.datastore.max_transaction_retries = 2
+    agg = Aggregator(eph.datastore, eph.clock, Config(vdaf_backend="oracle"))
+    task_id = TaskId.random()
+
+    async def flow():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(aggregator_app(agg)))
+        await client.start_server()
+        try:
+            faults.configure(
+                [FaultSpec("datastore.tx.begin", "error", 1.0)], seed=SEED
+            )
+            resp = await client.get(f"/hpke_config?task_id={task_id}")
+            assert resp.status == 503, await resp.text()
+            assert resp.headers.get("Retry-After") == "5"
+            # heal: the same request now reaches the handler (404 — the
+            # task does not exist — proves the datastore answered)
+            faults.clear()
+            resp = await client.get(f"/hpke_config?task_id={task_id}")
+            assert resp.status == 404, await resp.text()
+        finally:
+            faults.clear()
+            await client.close()
+            await agg.shutdown()
+            eph.cleanup()
+
+    _run(flow())
+
+
+def test_helper_redelivery_after_503_is_exactly_once():
+    """Post-heal duplicate redeliveries are FENCED, not assumed: an init
+    request that 503s (datastore down mid-request, nothing committed)
+    succeeds on redelivery, and a SECOND redelivery of the same body (the
+    partition ate the leader's response) returns the stored response
+    without double-accumulating — report counts stay exactly-once."""
+    pytest.importorskip("cryptography")
+    from test_aggregator_handlers import (
+        AGG_TOKEN,
+        NOW as HANDLER_NOW,
+        TIME_PRECISION as HANDLER_PRECISION,
+        leader_prep_inits,
+        make_pair_tasks,
+    )
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.datastore.datastore import DatastoreError
+    from janus_tpu.datastore.test_util import EphemeralDatastore
+    from janus_tpu.messages import (
+        AggregationJobId,
+        AggregationJobInitializeReq,
+        Interval,
+        PartialBatchSelector,
+    )
+
+    eph = EphemeralDatastore(MockClock(HANDLER_NOW))
+    eph.datastore.max_transaction_retries = 2
+    agg = Aggregator(eph.datastore, eph.clock, Config(vdaf_backend="oracle"))
+    leader, helper, _collector = make_pair_tasks({"type": "Prio3Count"})
+    eph.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+    vdaf = helper.vdaf_instance()
+    measurements = (1, 0, 1)
+    inits, _states, _reports = leader_prep_inits(vdaf, leader, helper, measurements)
+    body = AggregationJobInitializeReq(
+        aggregation_parameter=b"",
+        partial_batch_selector=PartialBatchSelector.new_time_interval(),
+        prepare_inits=inits,
+    ).get_encoded()
+    job_id = AggregationJobId.random()
+
+    async def flow():
+        # attempt 1: datastore down -> DatastoreError (503 at the HTTP
+        # layer, test above) with NOTHING committed
+        faults.configure([FaultSpec("datastore.tx.begin", "error", 1.0)], seed=SEED)
+        with pytest.raises(DatastoreError):
+            await agg.handle_aggregate_init(helper.task_id, job_id, body, AGG_TOKEN)
+        faults.clear()
+        # heal -> redelivery commits once
+        resp = await agg.handle_aggregate_init(
+            helper.task_id, job_id, body, AGG_TOKEN
+        )
+        # the response was lost to the partition -> the leader redelivers
+        # the SAME body; the request-hash fence returns the stored resp
+        resp2 = await agg.handle_aggregate_init(
+            helper.task_id, job_id, body, AGG_TOKEN
+        )
+        assert resp2 == resp
+        return resp
+
+    try:
+        resp = _run(flow())
+        assert len(resp.prepare_resps) == len(measurements)
+        ident = Interval(HANDLER_NOW, HANDLER_PRECISION).get_encoded()
+        bas = eph.datastore.run_tx(
+            "get",
+            lambda tx: tx.get_batch_aggregations_for_batch(
+                helper.task_id, ident, b""
+            ),
+        )
+        assert sum(ba.report_count for ba in bas) == len(measurements), (
+            "redelivery double-accumulated"
+        )
+    finally:
+        faults.clear()
+        _run(agg.shutdown())
+        eph.cleanup()
+
+
+# -- THE PARTITION SOAK (ISSUE 11 acceptance) ---------------------------------
+
+
+@pytest.mark.slow
+def test_partition_soak_asymmetric_heal_exactly_once():
+    """./ci.sh chaos partition: mid-aggregation, the leader->helper
+    direction is BLACKHOLED (target-scoped http.request spec — the
+    helper's own datastore and the leader's local points stay healthy).
+    During the partition: jobs quiesce by releasing with retryable
+    jittered backoff (tiny max_step_attempts budget NOT consumed — zero
+    abandonments), the executor breaker never trips (HTTP failure is not
+    device sickness), and the deadline budget releases every lease
+    in-band (zero expired-lease reaps; janus_job_leases_expired_total
+    stays zero).  After the heal: every job finishes, collection counts
+    are exactly-once against the oracle sums, and the soak's own SLO
+    evaluation shows zero false breaches."""
+    pytest.importorskip("cryptography")
+    from urllib.parse import urlsplit
+
+    from janus_tpu.core import peer_health
+    from janus_tpu.core.metrics import GLOBAL_METRICS
+    from janus_tpu.core.slo import SloEvaluator, targets_from_config
+
+    reset_global_executor()
+    harness = ChaosHarness(
+        n_tasks=2,
+        driver_overrides=dict(
+            # a SMALL retryable budget is the teeth: the partition lasts
+            # more deliveries than this, and zero jobs may abandon
+            max_step_attempts=2,
+            retry_initial_delay_s=1.0,
+            retry_max_delay_s=4.0,
+            peer_failure_threshold=2,
+            peer_suspect_dwell_s=0.25,
+            # per-attempt timeout: a blackholed attempt costs 0.1s, the
+            # whole exchange <= ~0.5s — far inside the 60s lease
+            http_retry=HttpRetryPolicy(
+                0.001, 0.01, 2.0, 0.5, 3, attempt_timeout=0.1
+            ),
+        ),
+    )
+    measurements = {0: [1, 0, 1, 1], 1: [1, 1, 0, 1]}
+    slo_eval = SloEvaluator(
+        targets_from_config(
+            {
+                "commit_age": {"objective": 0.99, "threshold_s": 3600},
+                "collection_e2e": {"objective": 0.95, "threshold_s": 21600},
+            }
+        )
+    )
+    slo_eval.tick()  # baseline before any traffic
+
+    leases_expired_before = sum(
+        GLOBAL_METRICS.get_sample_value(
+            "janus_job_leases_expired_total", {"job_type": jt}
+        )
+        or 0
+        for jt in ("aggregation", "collection")
+    )
+
+    async def flow():
+        await harness.start()
+        try:
+            helper_netloc = urlsplit(
+                harness.tasks[0][1].peer_aggregator_endpoint
+            ).netloc
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)
+            await harness.create_jobs()
+
+            # partition BEFORE the first helper exchange: Prio3Count's
+            # init+continue completes in one step, so a "healthy round"
+            # would finish every job — the jobs are created and
+            # IN_PROGRESS (mid-aggregation) when the link goes dark
+            # -- asymmetric partition: leader->helper blackholed --------
+            faults.configure(
+                [
+                    FaultSpec(
+                        "http.request",
+                        "blackhole",
+                        1.0,
+                        target=helper_netloc,
+                        hang_s=3600.0,
+                    )
+                ],
+                seed=SEED,
+            )
+            ex = harness.drivers[0]._executor
+
+            def reap():
+                return harness.leader_ds.datastore.run_tx(
+                    "reap", lambda tx: tx.reap_expired_aggregation_job_leases()
+                )
+
+            reaped_total = 0
+            for _ in range(6):
+                await harness.drive_round()
+                # the deadline budget must have released every lease
+                # in-band: nothing is ever left for the reaper
+                reaped_total += reap()
+            states = harness.agg_job_states()
+            assert states, "jobs must exist"
+            assert "Abandoned" not in states, (
+                "partition pressure consumed the attempt budget",
+                states,
+            )
+            assert not all(s == "Finished" for s in states), (
+                "partition had no effect?",
+                states,
+            )
+            assert reaped_total == 0, (
+                f"{reaped_total} lease(s) expired under partition — the "
+                "deadline budget failed to release first"
+            )
+            # the breaker is a DEVICE verdict: HTTP partition must not trip it
+            assert all(
+                s["trips"] == 0 for s in ex.circuit_stats().values()
+            ), ex.circuit_stats()
+            # the tracker saw the partition
+            stats = peer_health.tracker().stats()
+            assert stats[helper_netloc]["suspect_transitions"] >= 1, stats
+            assert (
+                GLOBAL_METRICS.get_sample_value(
+                    "janus_peer_transport_failures_total",
+                    {"peer": helper_netloc},
+                )
+                > 0
+            )
+            # the budget bypass was genuinely exercised: deliveries went
+            # PAST max_step_attempts=2 without abandoning
+            max_attempts = _sql_scalar(
+                harness.leader_ds.path,
+                "SELECT MAX(lease_attempts) FROM aggregation_jobs",
+            )
+            assert max_attempts > 2, (
+                "partition too short to prove the budget bypass",
+                max_attempts,
+            )
+
+            # -- heal ---------------------------------------------------
+            faults.clear()
+            await asyncio.sleep(0.3)  # past the suspect dwell
+            for _ in range(40):
+                await harness.drive_round()
+                reaped_total += reap()
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert states and all(s == "Finished" for s in states), states
+            assert reaped_total == 0
+            # peer healed: the probe's success restored healthy
+            assert (
+                peer_health.tracker().stats()[helper_netloc]["state"]
+                == "healthy"
+            )
+
+            # -- exactly-once collection --------------------------------
+            for t, ms in measurements.items():
+                result = await harness.collect_task(t)
+                assert result.report_count == len(ms), (t, result)
+                assert result.aggregate_result == sum(ms), (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    try:
+        _run(flow(), timeout=280.0)
+
+        # zero expired leases observable on the metric too (the soak's
+        # replicas never left a lease to the reaper)
+        leases_expired_after = sum(
+            GLOBAL_METRICS.get_sample_value(
+                "janus_job_leases_expired_total", {"job_type": jt}
+            )
+            or 0
+            for jt in ("aggregation", "collection")
+        )
+        assert leases_expired_after == leases_expired_before
+
+        # zero SLO false breaches from the partition
+        verdict = slo_eval.tick()
+        for slo in ("commit_age", "collection_e2e"):
+            st = verdict[slo]
+            assert st["events_total"] > 0, (slo, st)
+            assert st["breaches"] == 0, (slo, st)
+            for window in ("fast", "slow"):
+                sample = GLOBAL_METRICS.get_sample_value(
+                    "janus_slo_burn_rate", {"slo": slo, "window": window}
+                )
+                assert sample == 0.0, (slo, window, sample)
+    finally:
+        reset_global_executor()
+
+
+def _sql_scalar(path, query):
+    conn = sqlite3.connect(path, timeout=10.0)
+    try:
+        return conn.execute(query).fetchone()[0]
+    finally:
+        conn.close()
 
 
 def test_mesh_chaos_device_lost_opens_per_mesh_breaker_oracle_exact():

@@ -16,6 +16,17 @@ Layers:
   expiry the reaper counts it and a survivor reacquires with the
   ``lease_attempts`` accounting intact (the ``max_step_attempts`` budget
   survives holder death).
+* ``test_collection_replica_sigkill_mid_replay_exactly_once`` (slow) —
+  the COLLECTION driver's crash case (ISSUE 11, carried from the
+  ROADMAP): aggregation runs in-process with the accumulator store in
+  deferred mode, the executor is torn down drain-less (orphaning every
+  job's journal rows), and a real ``collection_job_driver`` BINARY picks
+  the collection job up with an ``accumulator.replay`` delay fault armed
+  — it is SIGKILLed mid-journal-replay (zero rows consumed), and a
+  clean replacement binary replays every orphan exactly once: journal
+  drains to empty, the survivor's replay-consumed metric delta equals
+  the orphaned row count, the collected result is unchanged, and the
+  survivor's trace carries the collection_finish span.
 * ``test_crash_restart_soak_exactly_once`` (slow) — THE ACCEPTANCE SOAK:
   a helper aggregator binary plus two aggregation-job-driver binaries
   (device executor + accumulator store in DEFERRED drain mode, device
@@ -253,6 +264,290 @@ def _sql(path: str, query: str):
         return conn.execute(query).fetchall()
     finally:
         conn.close()
+
+
+@pytest.mark.slow
+def test_collection_replica_sigkill_mid_replay_exactly_once(tmp_path):
+    """SIGKILL a collection replica MID-JOURNAL-REPLAY (ISSUE 11): the
+    replay's exactly-once fence is the row DELETE inside the merge tx,
+    so a replica killed between recompute start and commit must consume
+    nothing — and the replacement replica must then consume EVERY
+    orphaned row exactly once.  Asserted via the journal gauges (the
+    dying replica's /statusz shows the orphans, the survivor's /metrics
+    replay counter moves by exactly the orphan count, the table drains
+    to empty), the collected result (bit-exact Prio3Count sums), and the
+    survivor's merged trace carrying the collection_finish span."""
+    import asyncio
+    import urllib.parse
+
+    from test_chaos import NOW, TIME_PRECISION, ChaosHarness
+
+    from janus_tpu.core import faults
+    from janus_tpu.executor import reset_global_executor
+
+    faults.clear()
+    reset_global_executor()
+    harness = ChaosHarness(n_tasks=2, deferred=True)
+    measurements = {0: [1, 0, 1, 1], 1: [1, 1, 0, 1]}
+    coll_health = [_free_port(), _free_port()]
+
+    def _replica_yaml(i, with_fault):
+        fault = (
+            """
+  fault_injection:
+    enabled: true
+    seed: %d
+    points:
+      accumulator.replay: {mode: delay, probability: 1.0, delay_s: 600}
+"""
+            % SEED
+        )
+        return f"""
+common:
+  database: {{path: {harness.leader_ds.path}}}
+  health_check_listen_address: 127.0.0.1:{coll_health[i]}
+  chrome_trace_path: {tmp_path}/trace-coll{i}.json
+  status_sample_interval_s: 0.5{fault if with_fault else ''}
+job_driver:
+  job_discovery_interval_s: 0.2
+  max_concurrent_job_workers: 2
+  worker_lease_duration_s: 5
+  worker_lease_clock_skew_allowance_s: 1
+  maximum_attempts_before_failure: 100000
+  max_step_attempts: 100000
+  lease_reap_interval_s: 0.1
+"""
+
+    cfg_paths = []
+    for i, with_fault in enumerate((True, False)):
+        p = tmp_path / f"coll{i}.yaml"
+        p.write_text(_replica_yaml(i, with_fault))
+        cfg_paths.append(p)
+
+    env = dict(os.environ)
+    env["DATASTORE_KEYS"] = (
+        base64.urlsafe_b64encode(harness.leader_ds.key).decode().rstrip("=")
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    def _spawn_coll(i):
+        log = open(tmp_path / f"coll{i}.log", "wb")
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                _BOOT,
+                "collection_job_driver",
+                "--config-file",
+                str(cfg_paths[i]),
+            ],
+            env=env,
+            cwd=str(REPO),
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def _journal_rows():
+        return _sql(
+            harness.leader_ds.path, "SELECT COUNT(*) FROM accumulator_journal"
+        )[0][0]
+
+    async def _statusz(port):
+        def get():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=5
+            ) as r:
+                return json.loads(r.read().decode())
+
+        return await asyncio.get_running_loop().run_in_executor(None, get)
+
+    procs = [None, None]
+
+    async def flow():
+        from janus_tpu.messages import Interval, Query
+
+        await harness.start()
+        results = {}
+        try:
+            # -- in-process aggregation, deferred store -> journal rows -
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)
+            await harness.create_jobs()
+            for _ in range(30):
+                await harness.drive_round()
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert states and all(s == "Finished" for s in states), states
+
+            orphans = _journal_rows()
+            assert orphans > 0, "deferred store journaled nothing to orphan"
+            # CRASH: the executor (and the resident deltas) die drain-less
+            # — the journal rows are now recoverable ONLY by replay
+            reset_global_executor()
+
+            # -- collection jobs for both tasks -------------------------
+            interval = Interval(NOW, TIME_PRECISION)
+            jobs = {}
+            for t, (task_id, _lt, _ht) in enumerate(harness.tasks):
+                job = CollectionJob(
+                    task_id=task_id,
+                    collection_job_id=CollectionJobId.random(),
+                    query=Query.new_time_interval(interval),
+                    aggregation_parameter=b"",
+                    batch_identifier=interval.get_encoded(),
+                    state=CollectionJobState.START,
+                )
+                harness.leader_ds.datastore.run_tx(
+                    "putc", lambda tx, j=job: tx.put_collection_job(j)
+                )
+                jobs[t] = job
+
+            # -- replica 1: wedged mid-replay, then SIGKILLed -----------
+            procs[0] = _spawn_coll(0)
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: _wait_http(
+                    f"http://127.0.0.1:{coll_health[0]}/healthz", 120
+                ),
+            )
+            deadline = time.monotonic() + 120
+            while True:
+                doc = await _statusz(coll_health[0])
+                if doc["faults"]["hits"].get("accumulator.replay", 0) >= 1:
+                    break
+                assert time.monotonic() < deadline, "replay fault never fired"
+                await asyncio.sleep(0.2)
+            # the dying replica's own gauge SEES the orphans (journal
+            # section is served straight off the shared datastore)
+            assert doc["journal"]["outstanding_rows"] == orphans, doc["journal"]
+            # give one step-timeout cycle so the replica completes (and
+            # traces) at least one wedged job_step before dying
+            await asyncio.sleep(5.0)
+            procs[0].send_signal(signal.SIGKILL)
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: procs[0].wait(timeout=30)
+            )
+            assert _journal_rows() == orphans, (
+                "a replica killed mid-replay must consume NOTHING"
+            )
+
+            # -- replica 2: clean replay, exactly once ------------------
+            procs[1] = _spawn_coll(1)
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: _wait_http(
+                    f"http://127.0.0.1:{coll_health[1]}/healthz", 120
+                ),
+            )
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                done = {}
+                for t, job in jobs.items():
+                    got = await harness.leader_ds.datastore.run_tx_async(
+                        "getc",
+                        lambda tx, j=job: tx.get_collection_job(
+                            j.task_id, j.collection_job_id, "TimeInterval"
+                        ),
+                    )
+                    if got is not None and got.state == CollectionJobState.FINISHED:
+                        done[t] = got
+                if len(done) == len(jobs):
+                    results = done
+                    break
+                await asyncio.sleep(0.5)
+            assert len(results) == len(jobs), "collection never finished"
+
+            # journal drained to empty; the survivor's replay-consumed
+            # metric delta equals the orphaned row count
+            assert _journal_rows() == 0
+            scraped = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: _scrape(coll_health[1])
+            )
+            replayed = _metric_total(
+                scraped, 'janus_accumulator_journal_consumed_total{path="replay"}'
+            )
+            assert replayed == orphans, (replayed, orphans)
+            # and the survivor's sampled gauge agrees once a tick lands
+            deadline = time.monotonic() + 30
+            while True:
+                doc = await _statusz(coll_health[1])
+                if doc["journal"]["outstanding_rows"] == 0:
+                    break
+                assert time.monotonic() < deadline, doc["journal"]
+                await asyncio.sleep(0.3)
+            # graceful SIGTERM for the survivor: _close_tracing flushes
+            # its chrome trace (the collection_finish span asserted below)
+            procs[1].send_signal(signal.SIGTERM)
+            assert (
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: procs[1].wait(timeout=120)
+                )
+                == 0
+            ), "survivor SIGTERM exit must be clean"
+        finally:
+            for p in procs:
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+            await harness.stop()
+        return results
+
+    loop = asyncio.new_event_loop()
+    try:
+        results = loop.run_until_complete(asyncio.wait_for(flow(), 600))
+    finally:
+        loop.close()
+        reset_global_executor()
+
+    # -- collection results unchanged by the crash/replay dance ---------
+    from janus_tpu.messages import AggregateShareAad, Interval as _Interval
+
+    interval = _Interval(NOW, TIME_PRECISION)
+    for t, (task_id, leader_task, _h) in enumerate(harness.tasks):
+        got = results[t]
+        vdaf = leader_task.vdaf_instance()
+        field = vdaf.field_for_agg_param(vdaf.decode_agg_param(b""))
+        leader_share = field.decode_vec(got.leader_aggregate_share)
+        aad = AggregateShareAad(
+            task_id, b"", BatchSelector.new_time_interval(interval)
+        ).get_encoded()
+        info = HpkeApplicationInfo.new(
+            Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR
+        )
+        helper_share = field.decode_vec(
+            open_(harness.collector_keys, info, got.helper_aggregate_share, aad)
+        )
+        result = vdaf.unshard([leader_share, helper_share], got.report_count)
+        assert got.report_count == len(measurements[t]), (t, got.report_count)
+        assert result == sum(measurements[t]), (t, result, measurements[t])
+
+    # -- the survivor's trace carries the collection close-out ----------
+    from tools.trace_merge import load_events, merge_trace_files
+
+    survivor_trace = str(tmp_path / "trace-coll1.json")
+    assert os.path.exists(survivor_trace)
+    events = load_events(survivor_trace)
+    finishes = [
+        e for e in events if e.get("ph") == "X" and e["name"] == "collection_finish"
+    ]
+    assert len(finishes) == len(harness.tasks), (
+        "one collection_finish per task expected",
+        [e.get("name") for e in events],
+    )
+    # each close-out links the collected reports' upload-minted trace ids
+    assert all(e["args"].get("links") for e in finishes), finishes
+    # both incarnations' files merge onto one timeline (the SIGKILLed
+    # replica's partial file must not poison the merge)
+    summary = merge_trace_files(
+        [str(tmp_path / "trace-coll0.json"), survivor_trace],
+        str(tmp_path / "merged-coll-trace.json"),
+    )
+    assert os.path.exists(tmp_path / "merged-coll-trace.json"), summary
 
 
 @pytest.mark.slow

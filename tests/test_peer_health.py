@@ -1,0 +1,726 @@
+"""Peer-health gating + deadline-budgeted HTTP retries (ISSUE 11).
+
+Layers, cheapest first:
+
+* the per-peer transport state machine (healthy -> suspect -> probing),
+  its transport-only failure accounting, and the process-wide tracker's
+  /statusz + metric surfaces;
+* ``retry_http_request`` partition hardening: the per-attempt timeout
+  cuts off a blackholed attempt, the lease-derived ``deadline`` bounds
+  the whole exchange, ``Retry-After`` on retryable responses shapes the
+  backoff (capped at the policy max), and every attempt's transport
+  outcome feeds the tracker;
+* ``step_retry_delay`` heal-time jitter: released jobs re-acquire
+  SPREAD OUT, deterministically per (job, attempt);
+* driver classification: a suspect peer releases the job WITHOUT
+  consuming the ``max_step_attempts`` budget (both drivers).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from janus_tpu.core import faults, peer_health
+from janus_tpu.core.faults import FaultSpec
+from janus_tpu.core.metrics import GLOBAL_METRICS
+from janus_tpu.core.peer_health import (
+    PEER_HEALTHY,
+    PEER_PROBING,
+    PEER_SUSPECT,
+    PeerHealth,
+    origin_of,
+)
+from janus_tpu.core.retries import (
+    HttpRetryPolicy,
+    is_transport_error,
+    retry_http_request,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    peer_health.reset_peer_health()
+    peer_health.tracker().configure(failure_threshold=3, suspect_dwell_s=10.0)
+    yield
+    faults.clear()
+    peer_health.reset_peer_health()
+    peer_health.tracker().configure(failure_threshold=3, suspect_dwell_s=10.0)
+
+
+def _run(coro, timeout=60.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+# -- state machine -----------------------------------------------------------
+
+
+def test_origin_of_extracts_authority():
+    assert origin_of("http://helper.example:8080/tasks/x/reports") == (
+        "helper.example:8080"
+    )
+    assert origin_of("not a url") == "not a url"
+
+
+def test_peer_suspects_after_threshold_and_probes_after_dwell():
+    p = PeerHealth("h:1", failure_threshold=2, suspect_dwell_s=0.15)
+    assert p.state() == PEER_HEALTHY and p.allow()
+    p.record_transport_failure()
+    assert p.state() == PEER_HEALTHY, "one failure is a blip, not a partition"
+    p.record_transport_failure()
+    assert p.state() == PEER_SUSPECT and not p.allow()
+    time.sleep(0.2)
+    assert p.state() == PEER_PROBING and p.allow(), "dwell elapsed: half-open"
+    # a failing probe re-suspects AND restarts the dwell
+    p.record_transport_failure()
+    assert p.state() == PEER_SUSPECT and not p.allow()
+    time.sleep(0.2)
+    p.record_success()
+    assert p.state() == PEER_HEALTHY and p.consecutive_failures == 0
+
+
+def test_success_resets_consecutive_but_not_total():
+    p = PeerHealth("h:2", failure_threshold=3, suspect_dwell_s=1.0)
+    for _ in range(2):
+        p.record_transport_failure()
+    p.record_success()
+    p.record_transport_failure()
+    assert p.state() == PEER_HEALTHY, "the streak broke; no suspect"
+    assert p.transport_failures_total == 3
+
+
+def test_tracker_is_process_wide_and_keyed_by_origin():
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+    t.record_transport_failure("http://peer-a:1/tasks/t1/x")
+    assert not t.allow("http://peer-a:1/tasks/OTHER/y"), "same origin, same verdict"
+    assert t.allow("http://peer-b:2/tasks/t1/x"), "other peer unaffected"
+    stats = t.stats()
+    assert stats["peer-a:1"]["state"] == "suspect"
+    assert stats["peer-a:1"]["suspect_transitions"] == 1
+    assert "suspected_age_s" in stats["peer-a:1"]
+
+
+def test_peer_metrics_state_set_and_failure_counter():
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+    t.record_transport_failure("http://peer-m:9/")
+    assert (
+        GLOBAL_METRICS.get_sample_value(
+            "janus_peer_transport_failures_total", {"peer": "peer-m:9"}
+        )
+        >= 1
+    )
+    assert GLOBAL_METRICS.get_sample_value(
+        "janus_peer_health", {"peer": "peer-m:9", "state": "suspect"}
+    ) == 1.0
+    assert GLOBAL_METRICS.get_sample_value(
+        "janus_peer_health", {"peer": "peer-m:9", "state": "healthy"}
+    ) == 0.0
+    t.record_success("http://peer-m:9/")
+    assert GLOBAL_METRICS.get_sample_value(
+        "janus_peer_health", {"peer": "peer-m:9", "state": "healthy"}
+    ) == 1.0
+
+
+def test_republish_refreshes_time_driven_state_transitions():
+    """suspect -> probing happens purely by time passing: with no
+    traffic to publish it, the state-set gauge would report suspect=1
+    forever — the sampler-tick republish keeps alerts on live state."""
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=0.1)
+    t.record_transport_failure("http://stale.invalid:13/")
+    assert GLOBAL_METRICS.get_sample_value(
+        "janus_peer_health", {"peer": "stale.invalid:13", "state": "suspect"}
+    ) == 1.0
+    time.sleep(0.15)  # dwell elapses silently
+    t.republish_metrics()
+    assert GLOBAL_METRICS.get_sample_value(
+        "janus_peer_health", {"peer": "stale.invalid:13", "state": "probing"}
+    ) == 1.0
+    assert GLOBAL_METRICS.get_sample_value(
+        "janus_peer_health", {"peer": "stale.invalid:13", "state": "suspect"}
+    ) == 0.0
+
+
+def test_statusz_peers_section():
+    from janus_tpu.core.statusz import runtime_status
+
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+    t.record_transport_failure("http://peer-z:3/")
+    doc = runtime_status()
+    assert doc["peers"]["peer-z:3"]["state"] == "suspect"
+
+
+# -- retry_http_request: partition hardening ---------------------------------
+
+
+class _Resp:
+    def __init__(self, status, body=b"", headers=None):
+        self.status = status
+        self._body = body
+        self.headers = dict(headers or {})
+
+    async def read(self):
+        return self._body
+
+
+class _ScriptedSession:
+    """Yields one scripted outcome per attempt: an int+headers tuple for
+    a response, 'hang' to blackhole (sleep forever), or an exception
+    instance to raise at the transport layer."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+        self.attempt_times = []
+
+    def request(self, method, url, data=None, headers=None):
+        step = self.script[min(self.calls, len(self.script) - 1)]
+        self.calls += 1
+        self.attempt_times.append(time.monotonic())
+        sess = self
+
+        class _Ctx:
+            async def __aenter__(self):
+                if step == "hang":
+                    await asyncio.sleep(3600)
+                if isinstance(step, BaseException):
+                    raise step
+                status, headers_ = step
+                return _Resp(status, b"ok", headers_)
+
+            async def __aexit__(self, *exc):
+                return False
+
+        return _Ctx()
+
+
+def test_attempt_timeout_cuts_off_a_blackholed_attempt():
+    """A peer that never answers costs attempt_timeout per attempt, not
+    an open-ended hang: 3 attempts x 0.05s round off in well under a
+    second and surface the timeout."""
+    session = _ScriptedSession(["hang"])
+    t0 = time.monotonic()
+    with pytest.raises(asyncio.TimeoutError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://blackholed.invalid:1/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 30.0, 3, attempt_timeout=0.05),
+            )
+        )
+    assert session.calls == 3
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_deadline_bounds_the_whole_exchange():
+    """The lease-derived deadline wins over max_attempts/max_elapsed: a
+    blackholed exchange hands control back by the deadline so the driver
+    can release the lease in-band."""
+    session = _ScriptedSession(["hang"])
+    t0 = time.monotonic()
+    with pytest.raises(asyncio.TimeoutError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://blackholed.invalid:2/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 300.0, 100),
+                deadline=time.monotonic() + 0.3,
+            )
+        )
+    assert time.monotonic() - t0 < 1.5
+    assert session.calls >= 1
+
+
+def test_blackhole_fault_is_cut_off_by_attempt_timeout():
+    """blackhole-mode injection parks INSIDE the per-attempt timeout
+    scope: the wait_for cancels it exactly like a real black hole, and
+    the transport never sees the attempt."""
+
+    class _NeverCalled:
+        calls = 0
+
+        def request(self, *a, **kw):  # pragma: no cover
+            raise AssertionError("transport reached despite blackhole fault")
+
+    faults.configure([FaultSpec("http.request", "blackhole", 1.0)], seed=7)
+    t0 = time.monotonic()
+    with pytest.raises(asyncio.TimeoutError):
+        _run(
+            retry_http_request(
+                _NeverCalled(),
+                "GET",
+                "http://x.invalid:3/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 30.0, 2, attempt_timeout=0.05),
+            )
+        )
+    assert time.monotonic() - t0 < 2.0
+    assert faults.registry().hits["http.request"] == 2
+
+
+def test_retry_after_shapes_backoff_and_is_capped():
+    """A 503 carrying Retry-After sets the sleep (counted by the honored
+    metric); an absurd hint is capped at policy.max_interval."""
+    before = (
+        GLOBAL_METRICS.get_sample_value("janus_http_retry_after_honored_total") or 0
+    )
+    session = _ScriptedSession(
+        [(503, {"Retry-After": "0.15"}), (200, {})]
+    )
+    status, body, _ = _run(
+        retry_http_request(
+            session,
+            "GET",
+            "http://busy.invalid:4/",
+            policy=HttpRetryPolicy(0.001, 5.0, 2.0, 30.0, 5),
+        )
+    )
+    assert status == 200 and session.calls == 2
+    gap = session.attempt_times[1] - session.attempt_times[0]
+    assert gap >= 0.14, f"Retry-After not honored (gap {gap:.3f}s)"
+    after = GLOBAL_METRICS.get_sample_value("janus_http_retry_after_honored_total")
+    assert after == before + 1
+
+    # cap: a 1000s hint sleeps at most max_interval
+    session = _ScriptedSession([(503, {"Retry-After": "1000"}), (200, {})])
+    t0 = time.monotonic()
+    status, _, _ = _run(
+        retry_http_request(
+            session,
+            "GET",
+            "http://busy.invalid:5/",
+            policy=HttpRetryPolicy(0.001, 0.05, 2.0, 30.0, 5),
+        )
+    )
+    assert status == 200
+    assert time.monotonic() - t0 < 1.0, "hint must cap at max_interval"
+
+
+def test_transport_outcomes_feed_the_tracker():
+    """Failed attempts suspect the peer; ANY response — 503 included —
+    counts as transport success and heals the streak."""
+    import aiohttp
+
+    t = peer_health.tracker()
+    t.configure(failure_threshold=2, suspect_dwell_s=30.0)
+    session = _ScriptedSession(
+        [aiohttp.ClientConnectionError("refused"), aiohttp.ClientConnectionError("refused")]
+    )
+    with pytest.raises(aiohttp.ClientConnectionError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://flaky.invalid:6/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 30.0, 2),
+            )
+        )
+    assert t.is_suspect("http://flaky.invalid:6/")
+    # a 503 is REACHABLE: the streak resets, the suspect clears
+    session = _ScriptedSession([(503, {})])
+    _run(
+        retry_http_request(
+            session,
+            "GET",
+            "http://flaky.invalid:6/",
+            policy=HttpRetryPolicy(0.001, 0.002, 2.0, 0.01, 1),
+        )
+    )
+    assert not t.is_suspect("http://flaky.invalid:6/")
+
+
+def test_is_transport_error_classification():
+    import aiohttp
+
+    assert is_transport_error(asyncio.TimeoutError())
+    assert is_transport_error(ConnectionResetError())
+    assert is_transport_error(aiohttp.ClientConnectionError("x"))
+    assert is_transport_error(faults.FaultInjectedTransportError("http.request"))
+    assert not is_transport_error(ValueError("not transport"))
+
+
+# -- heal-time jitter (ISSUE 11 satellite) -----------------------------------
+
+
+def test_step_retry_delay_jitter_spreads_and_is_deterministic():
+    """Jobs released during a partition must NOT re-acquire in one wave:
+    distinct job ids land at distinct offsets in [base, 2x base), and a
+    given (job, attempt) is stable so a seeded chaos run replays."""
+    from janus_tpu.aggregator.job_driver import step_retry_delay
+
+    keys = [bytes([i]) * 16 for i in range(20)]
+    delays = [step_retry_delay(4, 1.0, 300.0, jitter_key=k).seconds for k in keys]
+    assert all(8 <= d <= 16 for d in delays), delays
+    assert len(set(delays)) >= 4, f"no spread: {delays}"
+    again = [step_retry_delay(4, 1.0, 300.0, jitter_key=k).seconds for k in keys]
+    assert delays == again, "jitter must be deterministic per (job, attempt)"
+    # the un-jittered curve is unchanged (and still capped)
+    assert [step_retry_delay(a, 1.0, 300.0).seconds for a in (1, 2, 3)] == [1, 2, 4]
+    # at the cap the jitter STILL spreads (that's the thundering-herd case)
+    capped = [step_retry_delay(30, 1.0, 300.0, jitter_key=k).seconds for k in keys]
+    assert len(set(capped)) >= 4 and all(300 <= d <= 600 for d in capped)
+    # partition-inflated attempt counts (peer-unhealthy releases are
+    # unbounded) must not overflow the float exponent
+    assert 300 <= step_retry_delay(5000, 1.0, 300.0, jitter_key=keys[0]).seconds <= 600
+
+
+# -- driver classification: partition pressure skips the budget --------------
+
+
+def test_aggregation_driver_peer_unhealthy_release_skips_budget():
+    """A suspect peer releases the job even when lease_attempts is past
+    max_step_attempts — partition pressure must not abandon work."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+    from janus_tpu.datastore.models import AcquiredAggregationJob, Lease, LeaseToken
+    from janus_tpu.messages import AggregationJobId, TaskId, Time
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            return None
+
+    def make_lease(attempts):
+        return Lease(
+            leased=AcquiredAggregationJob(
+                task_id=TaskId.random(),
+                aggregation_job_id=AggregationJobId.random(),
+                query_type="TimeInterval",
+                vdaf={"type": "Prio3Count"},
+            ),
+            lease_expiry=Time(1_600_000_600),
+            lease_token=LeaseToken(b"\x01" * 16),
+            lease_attempts=attempts,
+        )
+
+    ds = _StubDatastore()
+    driver = AggregationJobDriver(ds, None, DriverConfig(max_step_attempts=3))
+
+    async def partitioned_step(lease):
+        raise JobStepError("peer suspect", retryable=True, peer_unhealthy=True)
+
+    driver._step = partitioned_step
+    _run(driver.step_aggregation_job(make_lease(attempts=7)))
+    assert ds.tx_names == ["release_agg_job"], (
+        "partition pressure must release, never abandon",
+        ds.tx_names,
+    )
+
+
+def test_collection_driver_peer_unhealthy_release_skips_budget():
+    from janus_tpu.aggregator.collection_job_driver import (
+        CollectionDriverConfig,
+        CollectionJobDriver,
+    )
+    from janus_tpu.datastore.models import AcquiredCollectionJob, Lease, LeaseToken
+    from janus_tpu.messages import CollectionJobId, TaskId, Time
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            return None
+
+    ds = _StubDatastore()
+    driver = CollectionJobDriver(ds, None, CollectionDriverConfig(max_step_attempts=3))
+    lease = Lease(
+        leased=AcquiredCollectionJob(
+            task_id=TaskId.random(),
+            collection_job_id=CollectionJobId.random(),
+            query_type="TimeInterval",
+            vdaf={"type": "Prio3Count"},
+            step_attempts=0,
+        ),
+        lease_expiry=Time(1_600_000_600),
+        lease_token=LeaseToken(b"\x02" * 16),
+        lease_attempts=7,
+    )
+    _run(driver._release_retryable(lease, peer_unhealthy=True))
+    assert ds.tx_names == ["release_coll_job"], ds.tx_names
+
+
+def test_entry_ceiling_guard_tristate_suspect_healed_healthy():
+    """The delivery ceiling (maximum_attempts_before_failure) must not
+    abandon a job whose attempt count was inflated by clean partition
+    releases: while the peer is suspect the ceiling RELEASES with
+    backoff; within the heal grace the job gets its POST-HEAL delivery
+    (it steps — abandoning then would destroy exactly the work the
+    partition tolerance preserves); past the grace (or for a peer that
+    was never suspect) the ceiling's normal abandon verdict applies."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+    from janus_tpu.datastore.models import AcquiredAggregationJob, Lease, LeaseToken
+    from janus_tpu.messages import AggregationJobId, TaskId, Time
+
+    class _Task:
+        peer_aggregator_endpoint = "http://ceiling.invalid:8/"
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            if name == "ceiling_peer_check":
+                return _Task()
+            return None
+
+    def make_lease(attempts):
+        return Lease(
+            leased=AcquiredAggregationJob(
+                task_id=TaskId.random(),
+                aggregation_job_id=AggregationJobId.random(),
+                query_type="TimeInterval",
+                vdaf={"type": "Prio3Count"},
+            ),
+            lease_expiry=Time(1_600_000_600),
+            lease_token=LeaseToken(b"\x03" * 16),
+            lease_attempts=attempts,
+        )
+
+    ds = _StubDatastore()
+    # retry_max 0.1 => heal grace 0.3s, so "past the grace" is testable
+    driver = AggregationJobDriver(
+        ds,
+        None,
+        DriverConfig(maximum_attempts_before_failure=3, retry_max_delay_s=0.1),
+    )
+    stepped = []
+
+    async def record_step(lease):
+        stepped.append(lease.lease_attempts)
+
+    driver._step = record_step
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+
+    # never-suspect peer: normal ceiling verdict (abandon) — and the
+    # no-partition case never pays the datastore lookup (the in-memory
+    # partition_signal short-circuit)
+    _run(driver.step_aggregation_job(make_lease(attempts=7)))
+    assert ds.tx_names == ["abandon_agg_job"], ds.tx_names
+
+    # suspect: release with backoff, never abandon
+    ds.tx_names.clear()
+    t.record_transport_failure("http://ceiling.invalid:8/")
+    _run(driver.step_aggregation_job(make_lease(attempts=7)))
+    assert ds.tx_names == ["ceiling_peer_check", "release_agg_job"], ds.tx_names
+
+    # healed within the grace: the job STEPS (its post-heal delivery)
+    ds.tx_names.clear()
+    t.record_success("http://ceiling.invalid:8/")
+    _run(driver.step_aggregation_job(make_lease(attempts=7)))
+    assert stepped == [7], (stepped, ds.tx_names)
+    assert ds.tx_names == ["ceiling_peer_check"], ds.tx_names
+
+    # past the grace: the ceiling abandons again (short-circuit: the
+    # healed peer aged out of the partition signal)
+    ds.tx_names.clear()
+    time.sleep(0.35)
+    _run(driver.step_aggregation_job(make_lease(attempts=7)))
+    assert ds.tx_names == ["abandon_agg_job"], ds.tx_names
+    assert stepped == [7]
+
+
+def test_ceiling_guard_probing_peer_lets_the_job_probe():
+    """A PROBING peer (suspect past its dwell) must NOT keep releasing
+    past-ceiling jobs: if every job is past the ceiling, one of them has
+    to carry the half-open probe or the fleet never heals."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+    )
+    from janus_tpu.datastore.models import AcquiredAggregationJob, Lease, LeaseToken
+    from janus_tpu.messages import AggregationJobId, TaskId, Time
+
+    class _Task:
+        peer_aggregator_endpoint = "http://ceiling.invalid:12/"
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            if name == "ceiling_peer_check":
+                return _Task()
+            return None
+
+    ds = _StubDatastore()
+    driver = AggregationJobDriver(
+        ds, None, DriverConfig(maximum_attempts_before_failure=3)
+    )
+    stepped = []
+
+    async def record_step(lease):
+        stepped.append(lease.lease_attempts)
+
+    driver._step = record_step
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=0.1)
+    t.record_transport_failure("http://ceiling.invalid:12/")
+    time.sleep(0.15)  # past the dwell: the peer is PROBING
+    lease = Lease(
+        leased=AcquiredAggregationJob(
+            task_id=TaskId.random(),
+            aggregation_job_id=AggregationJobId.random(),
+            query_type="TimeInterval",
+            vdaf={"type": "Prio3Count"},
+        ),
+        lease_expiry=Time(1_600_000_600),
+        lease_token=LeaseToken(b"\x05" * 16),
+        lease_attempts=7,
+    )
+    _run(driver.step_aggregation_job(lease))
+    assert stepped == [7], (stepped, ds.tx_names)
+    assert ds.tx_names == ["ceiling_peer_check"], ds.tx_names
+
+
+def test_collection_entry_ceiling_guard_tristate():
+    from janus_tpu.aggregator.collection_job_driver import (
+        CollectionDriverConfig,
+        CollectionJobDriver,
+    )
+    from janus_tpu.datastore.models import AcquiredCollectionJob, Lease, LeaseToken
+    from janus_tpu.messages import CollectionJobId, Duration, TaskId, Time
+
+    class _Task:
+        peer_aggregator_endpoint = "http://ceiling.invalid:9/"
+
+    class _StubDatastore:
+        def __init__(self):
+            self.tx_names = []
+
+        async def run_tx_async(self, name, fn):
+            self.tx_names.append(name)
+            if name == "ceiling_peer_check":
+                return _Task()
+            return None
+
+    ds = _StubDatastore()
+    driver = CollectionJobDriver(
+        ds,
+        None,
+        CollectionDriverConfig(
+            maximum_attempts_before_failure=3,
+            step_retry_max_delay=Duration(1),  # heal grace 2s
+        ),
+    )
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+    lease = Lease(
+        leased=AcquiredCollectionJob(
+            task_id=TaskId.random(),
+            collection_job_id=CollectionJobId.random(),
+            query_type="TimeInterval",
+            vdaf={"type": "Prio3Count"},
+            step_attempts=0,
+        ),
+        lease_expiry=Time(1_600_000_600),
+        lease_token=LeaseToken(b"\x04" * 16),
+        lease_attempts=7,
+    )
+    t.record_transport_failure("http://ceiling.invalid:9/")
+    _run(driver.step_collection_job(lease))
+    assert ds.tx_names == ["ceiling_peer_check", "release_coll_job"], ds.tx_names
+
+    # BELOW the ceiling, the early gate still releases a suspect peer
+    # before the journal replay / share recompute is burned
+    ds.tx_names.clear()
+    lease_low = Lease(
+        leased=lease.leased,
+        lease_expiry=lease.lease_expiry,
+        lease_token=lease.lease_token,
+        lease_attempts=1,
+    )
+    _run(driver.step_collection_job(lease_low))
+    assert ds.tx_names == ["ceiling_peer_check", "release_coll_job"], ds.tx_names
+
+    # healed within the grace: the step PROCEEDS (the journal probe is
+    # the first thing a real step does)
+    ds.tx_names.clear()
+    t.record_success("http://ceiling.invalid:9/")
+    _run(driver.step_collection_job(lease))
+    assert ds.tx_names[:2] == [
+        "ceiling_peer_check",
+        "collect_journal_probe",
+    ], ds.tx_names
+
+
+def test_deadline_clamped_timeouts_do_not_feed_the_tracker():
+    """A timeout fired by the CALLER's lease-derived deadline (the
+    attempt got less than its fair attempt_timeout) says nothing about
+    the peer: it must not drive a healthy-but-not-instant helper
+    suspect.  Policy-clamped timeouts (a real blackhole under a fair
+    attempt budget) still count."""
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+    session = _ScriptedSession(["hang"])
+    with pytest.raises(asyncio.TimeoutError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://slowish.invalid:10/",
+                policy=HttpRetryPolicy(0.001, 0.002, 2.0, 300.0, 3),
+                deadline=time.monotonic() + 0.2,  # OUR budget, not theirs
+            )
+        )
+    assert not t.is_suspect("http://slowish.invalid:10/"), (
+        "self-inflicted deadline timeout suspected the peer"
+    )
+    # same hang under a fair per-attempt budget IS the peer's problem
+    session = _ScriptedSession(["hang"])
+    with pytest.raises(asyncio.TimeoutError):
+        _run(
+            retry_http_request(
+                session,
+                "GET",
+                "http://blackholed.invalid:11/",
+                policy=HttpRetryPolicy(
+                    0.001, 0.002, 2.0, 300.0, 1, attempt_timeout=0.05
+                ),
+            )
+        )
+    assert t.is_suspect("http://blackholed.invalid:11/")
+
+
+def test_gate_peer_raises_peer_unhealthy_inside_dwell():
+    """The step-entry gate: suspect peer inside its dwell -> a
+    peer-unhealthy retryable JobStepError before any work is burned."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+
+    t = peer_health.tracker()
+    t.configure(failure_threshold=1, suspect_dwell_s=30.0)
+    t.record_transport_failure("http://gated.invalid:7/")
+
+    class _Task:
+        peer_aggregator_endpoint = "http://gated.invalid:7/"
+
+    driver = AggregationJobDriver(None, None, DriverConfig())
+    with pytest.raises(JobStepError) as exc_info:
+        driver._gate_peer(_Task())
+    assert exc_info.value.retryable and exc_info.value.peer_unhealthy
