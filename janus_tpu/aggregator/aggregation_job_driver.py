@@ -551,6 +551,7 @@ class AggregationJobDriver:
                     prep_in,
                     f"circuit for shape {shape_key[0]}/{shape_key[1]} is open",
                     vdaf=vdaf,
+                    task_ident=task_ident,
                 )
             if self._executor.warming(shape_key):
                 # Cold-shape contract (ISSUE 8): the executable is still
@@ -576,6 +577,7 @@ class AggregationJobDriver:
                         "(executable compiling off the submit path)",
                         vdaf=vdaf,
                         reason="warming",
+                        task_ident=task_ident,
                     )
             try:
                 return await self._executor.submit(
@@ -598,7 +600,8 @@ class AggregationJobDriver:
                 # the retry budget — the breaker's half-open probes restore
                 # device service without any action here.
                 return await self._oracle_fallback(
-                    backend, verify_key, prep_in, e, vdaf=vdaf
+                    backend, verify_key, prep_in, e, vdaf=vdaf,
+                    task_ident=task_ident,
                 )
             except ExecutorOverloadedError as e:
                 raise JobStepError(
@@ -634,7 +637,14 @@ class AggregationJobDriver:
             raise JobStepError(f"prepare launch failed: {e}", retryable=True)
 
     async def _oracle_fallback(
-        self, backend, verify_key: bytes, prep_in, cause, vdaf=None, reason="circuit_open"
+        self,
+        backend,
+        verify_key: bytes,
+        prep_in,
+        cause,
+        vdaf=None,
+        reason="circuit_open",
+        task_ident=None,
     ):
         """Serve one job's prepare on the CPU oracle (bit-exact with the
         device path by the backend contract, tests/test_backend.py).
@@ -647,14 +657,20 @@ class AggregationJobDriver:
             reason,
             len(prep_in),
             lambda oracle: oracle.prep_init_batch(verify_key, 0, prep_in),
+            task_ident=task_ident,
         )
 
     async def _serve_on_oracle(
-        self, backend, vdaf, cause, reason, n_reports, call
+        self, backend, vdaf, cause, reason, n_reports, call, task_ident=None
     ):
         """The ONE fallback policy (logging, fallback metric, retryable
         guard, off-loop dispatch) shared by the Prio3 and Poplar1 oracle
-        degradations — ``call(oracle)`` runs the VDAF-appropriate batch."""
+        degradations — ``call(oracle)`` runs the VDAF-appropriate batch.
+        ``task_ident`` binds the worker-thread task scope so the oracle
+        batch's measured seconds attribute to the task with
+        ``path="oracle"`` (core/costs.py) — the breaker-open cost shift
+        the per-task series exist to show."""
+        from ..core import costs
         from ..vdaf.backend import oracle_backend_for
 
         oracle = oracle_backend_for(backend, vdaf)
@@ -673,7 +689,8 @@ class AggregationJobDriver:
                 reason=reason,
             ).inc()
         return await asyncio.get_running_loop().run_in_executor(
-            None, lambda: call(oracle)
+            None,
+            lambda: costs.run_in_task_scope(task_ident, lambda: call(oracle)),
         )
 
     async def _coalesced_poplar_init(
@@ -705,6 +722,7 @@ class AggregationJobDriver:
                     agg_param,
                     prep_in,
                     f"circuit for shape {shape_key[0]} is open",
+                    task_ident=task_ident,
                 )
             try:
                 return await self._executor.submit(
@@ -718,7 +736,8 @@ class AggregationJobDriver:
                 )
             except CircuitOpenError as e:
                 return await self._poplar_oracle_fallback(
-                    backend, verify_key, agg_param, prep_in, e
+                    backend, verify_key, agg_param, prep_in, e,
+                    task_ident=task_ident,
                 )
             except ExecutorOverloadedError as e:
                 raise JobStepError(
@@ -739,7 +758,14 @@ class AggregationJobDriver:
             raise JobStepError(f"prepare launch failed: {e}", retryable=True)
 
     async def _poplar_oracle_fallback(
-        self, backend, verify_key, agg_param, prep_in, cause, reason="circuit_open"
+        self,
+        backend,
+        verify_key,
+        agg_param,
+        prep_in,
+        cause,
+        reason="circuit_open",
+        task_ident=None,
     ):
         """Serve one Poplar1 job's round-0 prepare on the per-report CPU
         oracle (bit-exact with the batched walk, tests/test_poplar1_batch
@@ -753,6 +779,7 @@ class AggregationJobDriver:
             lambda oracle: oracle.prep_init_batch_poplar(
                 verify_key, 0, agg_param, prep_in
             ),
+            task_ident=task_ident,
         )
 
     async def _flush_prep(self, backend, key: int) -> None:
@@ -1544,7 +1571,10 @@ class AggregationJobDriver:
         """Bit-exact CPU replay of finished reports' out shares (backend
         contract: oracle == device, tests/test_backend.py).  Canonical
         backends replay through the TASK's oracle (oracle_for), never the
-        bucket twin's."""
+        bucket twin's.  The replay runs inside the task's cost scope, so
+        crash-recovery CPU time shows on the task's ``path="oracle"``
+        series like any other oracle work."""
+        from ..core import costs
         from ..vdaf.backend import OracleBackend, oracle_backend_for
 
         oracle = oracle_backend_for(backend, vdaf) or OracleBackend(vdaf)
@@ -1558,9 +1588,11 @@ class AggregationJobDriver:
                 )
             )
         out = {}
-        for ra, outcome in zip(
-            ras, oracle.prep_init_batch(task.vdaf_verify_key, 0, rows)
-        ):
+        replayed = costs.run_in_task_scope(
+            task.task_id.data,
+            lambda: oracle.prep_init_batch(task.vdaf_verify_key, 0, rows),
+        )
+        for ra, outcome in zip(ras, replayed):
             if isinstance(outcome, VdafError):  # cannot happen for a report
                 raise JobStepError(  # that already prepared successfully
                     f"oracle replay rejected report {ra.report_id}: {outcome}",

@@ -501,8 +501,19 @@ class Aggregator:
                 ta, decoded, agg_param
             )
         else:
+            # direct (non-executor) path: bind the task cost scope on the
+            # worker thread so the backend's measured prepare seconds
+            # attribute to this task (core/costs.py — path derives from
+            # the backend: tpu/mesh -> device, oracle -> oracle)
+            from ..core import costs
+
+            _ident = getattr(getattr(ta.task, "task_id", None), "data", None)
             results = await loop.run_in_executor(
-                None, lambda: self._helper_prepare_batch(ta, decoded, agg_param)
+                None,
+                lambda: costs.run_in_task_scope(
+                    _ident,
+                    lambda: self._helper_prepare_batch(ta, decoded, agg_param),
+                ),
             )
 
         # Assemble responses + report aggregations in request order.
@@ -834,9 +845,14 @@ class Aggregator:
         loop = asyncio.get_running_loop()
 
         def oracle_path():
+            from ..core import costs
+
             oracle = oracle_backend_for(backend, vdaf) or backend
-            return self._helper_prepare_batch_poplar1(
-                ta, decoded, agg_param, backend=oracle
+            return costs.run_in_task_scope(
+                task_ident,
+                lambda: self._helper_prepare_batch_poplar1(
+                    ta, decoded, agg_param, backend=oracle
+                ),
             )
 
         if self._executor.circuit_open(shape_key):
@@ -859,11 +875,16 @@ class Aggregator:
             )
         except CircuitOpenError:
             # re-enter past the decode: (results, rows) are already built
+            from ..core import costs
+
             oracle = oracle_backend_for(backend, vdaf) or backend
 
             def finish_on_oracle():
-                out = oracle.prep_init_batch_poplar(
-                    ta.task.vdaf_verify_key, 1, agg_param, prep_in
+                out = costs.run_in_task_scope(
+                    task_ident,
+                    lambda: oracle.prep_init_batch_poplar(
+                        ta.task.vdaf_verify_key, 1, agg_param, prep_in
+                    ),
                 )
                 return self._helper_finish_poplar1(
                     vdaf, agg_param, results, rows, out
@@ -1012,11 +1033,18 @@ class Aggregator:
 
         def oracle_path():
             # canonical backends must serve fallbacks from the TASK's
-            # oracle (the bucket twin's computes a padded circuit)
+            # oracle (the bucket twin's computes a padded circuit); the
+            # task cost scope attributes the oracle batch (path="oracle")
+            from ..core import costs
             from ..vdaf.backend import oracle_backend_for
 
             oracle = oracle_backend_for(backend, vdaf) or backend
-            return self._helper_prepare_batch_prio3(ta, decoded, backend=oracle)
+            return costs.run_in_task_scope(
+                task_ident,
+                lambda: self._helper_prepare_batch_prio3(
+                    ta, decoded, backend=oracle
+                ),
+            )
 
         if self._executor.circuit_open(shape_key):
             return await loop.run_in_executor(None, oracle_path)
@@ -1074,12 +1102,18 @@ class Aggregator:
             # re-enter past the decode: (results, rows) are already built;
             # any refs the prep submission minted must free first
             self._release_helper_refs(prep_out)
+            from ..core import costs
             from ..vdaf.backend import oracle_backend_for
 
             oracle = oracle_backend_for(backend, vdaf) or backend
             return await loop.run_in_executor(
                 None,
-                lambda: self._helper_prep_rows_prio3(ta, oracle, results, rows),
+                lambda: costs.run_in_task_scope(
+                    task_ident,
+                    lambda: self._helper_prep_rows_prio3(
+                        ta, oracle, results, rows
+                    ),
+                ),
             )
         except ExecutorOverloadedError as e:
             from .error import ServiceUnavailable

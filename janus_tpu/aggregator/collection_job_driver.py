@@ -504,7 +504,15 @@ class CollectionJobDriver:
                 )
             return total
 
-        total = await asyncio.get_running_loop().run_in_executor(None, recompute)
+        # task cost scope (core/costs.py): the crash-recovery replay's CPU
+        # time attributes to the task with path="oracle" via the oracle's
+        # _observe_prepare hook
+        from ..core import costs
+
+        total = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: costs.run_in_task_scope(task.task_id.data, recompute),
+        )
 
         def tx_fn(tx):
             # exactly-once hinges on the DELETE: whoever consumes the row

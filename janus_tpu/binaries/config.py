@@ -129,7 +129,14 @@ class CommonConfig:
     status_sample_interval_s: float = 5.0
     #: Idle threshold for executor-bucket gauge retirement (cardinality
     #: cap); <= 0 keeps every bucket's series forever (pre-ISSUE-5 shape).
+    #: The per-task cost series (janus_task_*) retire on the same tick
+    #: and threshold.
     executor_bucket_idle_s: float = 600.0
+    #: Per-task cost-attribution cardinality cap (core/costs.py): at most
+    #: this many live ``task`` label values on the janus_task_* series;
+    #: tasks beyond it attribute to task="other" until the sampler-tick
+    #: retirement frees idle slots.
+    cost_task_cardinality: int = 64
     #: OTLP collector endpoint (core/otlp.py), e.g.
     #: ``http://otel-collector:4318`` — when set, ChromeTracer spans and
     #: the metric registry are exported OTLP/HTTP on the status-sampler
@@ -249,6 +256,13 @@ class DeviceExecutorConfig:
     fair_flush: bool = True
     #: deficit-round-robin quantum in rows
     fair_quota_rows: int = 16384
+    #: flight recorder ring size (per-flush black-box records kept in
+    #: memory for /statusz "flights" + breaker-trip/slow-flush dumps)
+    flight_recorder_size: int = 256
+    #: slow-flush anomaly factor: a flush whose launch exceeds this ×
+    #: its bucket's rolling p95 dumps the flight ring (rate-limited);
+    #: <= 0 disables the detector
+    slow_flush_p95_factor: float = 4.0
     #: device-resident accumulator store (default off)
     accumulator: AccumulatorStoreConfig = field(default_factory=AccumulatorStoreConfig)
 
@@ -270,6 +284,8 @@ class DeviceExecutorConfig:
             breaker_reset_timeout_s=self.breaker_reset_timeout_s,
             fair_flush=self.fair_flush,
             fair_quota_rows=self.fair_quota_rows,
+            flight_recorder_size=self.flight_recorder_size,
+            slow_flush_p95_factor=self.slow_flush_p95_factor,
             accumulator=self.accumulator.to_accumulator_config()
             if self.accumulator.enabled
             else None,

@@ -49,6 +49,13 @@ def _bootstrap(config_common):
     )
 
     install_trace_subscriber(TraceConfiguration(level=config_common.log_level))
+    # Per-task cost-attribution cardinality cap (ISSUE 12): applied once
+    # here, like the peer-health thresholds — the model is process-wide.
+    from ..core.costs import configure_cost_attribution
+
+    configure_cost_attribution(
+        getattr(config_common, "cost_task_cardinality", 64)
+    )
     fault_cfg = getattr(config_common, "fault_injection", None)
     if fault_cfg is not None and fault_cfg.enabled:
         # Chaos mode: arm the deterministic fault registry.  Loud on

@@ -634,6 +634,49 @@ class Metrics:
             "Seconds since the last successful OTLP export (-1 when never)",
             registry=self.registry,
         )
+        # -- per-task device-plane cost attribution (core/costs.py) ------
+        # Which task is burning the chip: each executor flush's measured
+        # stage/launch durations split across its submissions by rows, and
+        # oracle-path batches attributed whole (phase init|combine).  The
+        # path label (device|oracle) makes breaker-driven cost shifts to
+        # the CPU oracle visible on the SAME task series.  Cardinality is
+        # capped (common.cost_task_cardinality) with a task="other"
+        # overflow label; idle task series retire on the sampler tick.
+        self.task_device_seconds = Counter(
+            "janus_task_device_seconds_total",
+            "Attributed device-plane seconds per task by phase "
+            "(stage|launch: executor flush shares; init|combine: direct "
+            "backend batches; drain: accumulator spill readbacks) and "
+            "path (device|oracle)",
+            ["task", "phase", "path"],
+            registry=self.registry,
+        )
+        self.task_rows = Counter(
+            "janus_task_rows_total",
+            "Report rows through the device plane per task by outcome "
+            "(ok|rejected|error)",
+            ["task", "outcome"],
+            registry=self.registry,
+        )
+        self.task_queue_delay = Histogram(
+            "janus_task_queue_delay_seconds",
+            "Per-submission executor queue delay (enqueue -> flush "
+            "dispatch) by task",
+            ["task"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        # Pad waste per flush: mesh-tail + pow2-canonicalization padding
+        # rows the chip computes and throws away — the direct measure of
+        # how much throughput shape canonicalization costs a bucket.
+        self.executor_pad_rows = Counter(
+            "janus_executor_pad_rows_total",
+            "Mask-padded rows launched per executor bucket (pow2 + "
+            "mesh-tail padding waste; real rows ride "
+            "janus_executor_flush_rows)",
+            ["bucket"],
+            registry=self.registry,
+        )
 
     # -- introspection ---------------------------------------------------
     def get_sample_value(self, name: str, labels: Optional[dict] = None):

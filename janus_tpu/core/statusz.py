@@ -69,6 +69,13 @@ def runtime_status() -> dict:
             # why shapes kept exact-shape compiles (ISSUE 9 satellite):
             # pow2-canonicalization plan outcomes, counted per reason
             "canonicalization": _canonicalization_stats(),
+            # flight recorder (ISSUE 12): the last flushes' black-box
+            # records + dump counters — what the operator reads when a
+            # breaker tripped or a flush went anomalously slow
+            "flights": ex.flight_stats(),
+            # per-task cost-attribution ledger occupancy: tracked labels
+            # vs the cardinality cap, and how much landed on "other"
+            "cost_attribution": _cost_stats(),
         }
         doc["accumulator"] = (
             ex.accumulator.stats() if ex.accumulator is not None else None
@@ -86,6 +93,18 @@ def _peer_stats() -> dict:
         return tracker().stats()
     except Exception:
         logger.exception("peer-health stats unavailable")
+        return {"error": "unavailable"}
+
+
+def _cost_stats() -> dict:
+    """Per-task cost-attribution occupancy (core/costs.py); failure-
+    tolerant like every other section."""
+    try:
+        from .costs import cost_model
+
+        return cost_model().stats()
+    except Exception:
+        logger.exception("cost-attribution stats unavailable")
         return {"error": "unavailable"}
 
 
@@ -176,7 +195,15 @@ def sample_status_metrics(datastore, clock=None) -> None:
 
 def retire_idle_executor_buckets(max_idle_s: float) -> int:
     """Sampler-tick companion: cap bucket-gauge cardinality (ISSUE 5
-    satellite).  No-op when no executor exists in this process."""
+    satellite).  No-op when no executor exists in this process.  The
+    per-task cost series (ISSUE 12) retire on the same tick and the same
+    idle threshold — their cardinality cap depends on it."""
+    from .costs import retire_idle_task_series
+
+    try:
+        retire_idle_task_series(max_idle_s)
+    except Exception:
+        logger.exception("cost-series retirement failed")
     from ..executor import peek_global_executor
 
     ex = peek_global_executor()

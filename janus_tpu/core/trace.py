@@ -454,14 +454,27 @@ def start_profiler_server(port: int) -> bool:
     """Opt-in on-device profiling: a jax.profiler server an operator can
     capture from at any time (the analog of the reference's tokio-console /
     OTLP always-on observability sockets, trace.rs:158-236).  Returns False
-    when jax is unavailable in this process (control-plane binaries)."""
+    when jax is unavailable in this process (control-plane binaries — the
+    GATE PROBE, logged quietly: a jax-less process is a deployment shape,
+    not an error) or when the server fails to start (logged with the
+    traceback; the binary continues — a dead profiler socket must never
+    take a replica down)."""
+    log = logging.getLogger("janus_tpu.trace")
     try:
         import jax
-
+    except ImportError:
+        log.info(
+            "jax unavailable in this process; profiler server not started"
+        )
+        return False
+    except Exception:
+        # import jax can die with RuntimeError/OSError on a broken device
+        # runtime (libtpu init) — still logs-and-continues, never fatal
+        log.exception("jax import failed; profiler server not started")
+        return False
+    try:
         jax.profiler.start_server(port)
         return True
     except Exception:
-        logging.getLogger("janus_tpu.trace").exception(
-            "could not start jax profiler server"
-        )
+        log.exception("could not start jax profiler server")
         return False

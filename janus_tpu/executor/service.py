@@ -48,8 +48,9 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from ..core import faults
+from ..core import costs, faults
 from ..core.trace import current_trace, emit_span
+from .flight_recorder import FlightRecorder
 
 logger = logging.getLogger("janus_tpu.executor")
 
@@ -141,6 +142,13 @@ class ExecutorConfig:
     #: round before yielding); a flush larger than the quantum still
     #: dispatches, paying the overshoot out of future rounds
     fair_quota_rows: int = 16384
+    #: flight recorder ring size (per-flush records kept in memory for
+    #: /statusz "flights" + breaker-trip/slow-flush dumps); >= 1
+    flight_recorder_size: int = 256
+    #: slow-flush anomaly threshold: a flush whose launch exceeds this
+    #: factor × its bucket's rolling p95 dumps the flight ring (rate
+    #: limited); <= 0 disables the detector (ring + breaker dumps stay on)
+    slow_flush_p95_factor: float = 4.0
     #: device-resident accumulator store (accumulator.AccumulatorConfig);
     #: None or .enabled=False = out shares read back per flush (legacy)
     accumulator: Optional[object] = None
@@ -155,7 +163,9 @@ class CircuitBreaker:
     flush tasks / the launch thread.
     """
 
-    def __init__(self, label: str, failure_threshold: int, reset_timeout_s: float):
+    def __init__(
+        self, label: str, failure_threshold: int, reset_timeout_s: float, on_trip=None
+    ):
         self.label = label
         self.failure_threshold = failure_threshold
         self.reset_timeout_s = reset_timeout_s
@@ -165,6 +175,11 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probing = False
         self._lock = threading.Lock()
+        #: called as on_trip(breaker) AFTER the lock is released, once per
+        #: closed/half-open -> open transition (the executor hangs the
+        #: flight-recorder dump here); exceptions are swallowed — a broken
+        #: observer must never keep a sick circuit from opening
+        self.on_trip = on_trip
 
     def allow(self) -> bool:
         """May a new submission enter the device path right now?"""
@@ -232,6 +247,11 @@ class CircuitBreaker:
                     self.reset_timeout_s,
                 )
                 self._set_state(CIRCUIT_OPEN)
+        if should_open and self.on_trip is not None:
+            try:
+                self.on_trip(self)
+            except Exception:
+                logger.exception("circuit on_trip observer failed")
 
     def _set_state(self, state: int) -> None:
         """Lock held.  Metrics are best-effort (no registry -> no-op)."""
@@ -406,6 +426,12 @@ class DeviceExecutor:
         #: dispatcher generation would mint fresh permits and break the
         #: two-in-flight double-buffering bound
         self._slot_inflight: Dict[object, int] = {}
+        #: per-flush black box (flight_recorder.py): /statusz "flights",
+        #: breaker-trip dumps, slow-flush anomaly dumps
+        self.flight_recorder = FlightRecorder(
+            size=self.config.flight_recorder_size,
+            slow_flush_p95_factor=self.config.slow_flush_p95_factor,
+        )
         # Device-resident accumulator store (out-share residency).
         acc_cfg = self.config.accumulator
         self.accumulator = None
@@ -684,6 +710,7 @@ class DeviceExecutor:
             if bucket.depth_rows and bucket.depth_rows + rows > self.config.max_queue_rows:
                 bucket.rejections += 1
                 self._observe_rejection(bucket, "queue_full")
+                costs.cost_model().observe_rows(task_ident, "rejected", rows)
                 raise ExecutorOverloadedError(
                     f"bucket {bucket.label}: {bucket.depth_rows} rows queued/"
                     f"in flight, +{rows} exceeds max_queue_rows="
@@ -743,6 +770,16 @@ class DeviceExecutor:
                     label,
                     self.config.breaker_failure_threshold,
                     self.config.breaker_reset_timeout_s,
+                    # black box on trip: the ring of recent flushes ships
+                    # with the failure as one structured log event
+                    on_trip=lambda b: self.flight_recorder.dump(
+                        "breaker_trip",
+                        detail={
+                            "circuit": b.label,
+                            "consecutive_failures": b.consecutive_failures,
+                            "trips": b.trips,
+                        },
+                    ),
                 )
                 self._breakers[domain] = br
             self._breaker_by_shape[shape_key] = br
@@ -982,6 +1019,25 @@ class DeviceExecutor:
                 bucket.breaker.probe_aborted()
             return
         rows = sum(s.rows for s in live)
+        # Per-submission queue delay (enqueue -> flush dispatch): the
+        # ReportWriteBatcher 3-tuple pattern — _Submission carries its
+        # enqueue stamp, so the delay is measured here where dispatch
+        # actually happens, per submission, not once per flush.
+        t_dispatch = time.monotonic()
+        queue_delay_max = 0.0
+        model = costs.cost_model()
+        for s in live:
+            delay = max(0.0, t_dispatch - s.enqueued)
+            queue_delay_max = max(queue_delay_max, delay)
+            model.observe_queue_delay(s.task, delay)
+        stage_s = 0.0
+        padded_rows = 0
+        t_launch = t_dispatch
+        #: set the moment the launch is known-good (record_success):
+        #: an exception AFTER it (resolve bookkeeping, ref release) must
+        #: not re-attribute the measured durations, re-record the flight,
+        #: or count a launch failure against a healthy device
+        launch_ok = False
         stage_pool, launch_pool = self._pools()
         retain = None
         try:
@@ -1010,6 +1066,7 @@ class DeviceExecutor:
                         )
                     ):
                         retain = self.accumulator
+                    t_stage = time.monotonic()
                     staged = await loop.run_in_executor(
                         stage_pool,
                         lambda: bucket.backend.stage_prep_init_multi(
@@ -1017,6 +1074,13 @@ class DeviceExecutor:
                         ),
                     )
                     t_launch = time.monotonic()
+                    stage_s = t_launch - t_stage
+                    # pad waste: rows the compiled executable computes and
+                    # masks away (pow2 canonicalization + mesh-tail
+                    # rounding) — invisible on flush_rows, counted here
+                    pad_to = getattr(staged, "pad_to", None)
+                    if pad_to is not None:
+                        padded_rows = max(0, pad_to - rows)
 
                     def launch():
                         # Deadline re-check AFTER the launch-queue wait —
@@ -1099,14 +1163,42 @@ class DeviceExecutor:
             if outs is None:
                 if bucket.breaker is not None:
                     bucket.breaker.probe_aborted()
+                # every submission expired at the launch dequeue: nothing
+                # touched the device, but the black box still records it
+                self.flight_recorder.record(
+                    bucket=bucket.label,
+                    trigger=trigger,
+                    rows=rows,
+                    padded_rows=padded_rows,
+                    tasks=[model.label_for(s.task) for s in live],
+                    queue_delay_max_s=queue_delay_max,
+                    stage_s=stage_s,
+                    launch_s=0.0,
+                    outcome="expired",
+                    breaker_state=self._breaker_state_name(bucket),
+                    fault=False,
+                )
                 return
             if bucket.breaker is not None:
                 bucket.breaker.record_success()
+            launch_ok = True
             done = time.monotonic()
+            launch_s = done - t_launch
             bucket.flushes += 1
             bucket.flushed_rows += rows
             bucket.flushed_jobs += len(live)
-            self._observe_flush(bucket, rows, done - t_launch)
+            self._observe_flush(bucket, rows, launch_s)
+            self._observe_pad(bucket, padded_rows)
+            # Per-task cost attribution (ISSUE 12): split the measured
+            # stage/launch durations across the flush's submissions
+            # proportionally by rows.  Conservation: the per-task shares
+            # sum to the measured totals; padding overhead rides with the
+            # rows that caused it.
+            model.attribute_flush(
+                [(s.task, s.rows) for s in live],
+                {"stage": stage_s, "launch": launch_s},
+                path="device",
+            )
             still_set = set(id(s) for s in still)
             for s, out in zip(live, outs):
                 if id(s) not in still_set:
@@ -1118,6 +1210,7 @@ class DeviceExecutor:
                     continue
                 self._finish(bucket, s, done)
                 self._observe_wait(bucket, done - s.enqueued)
+                model.observe_rows(s.task, "ok", s.rows)
                 # Per-submission CHILD span, stamped with the SUBMITTER's
                 # trace context: one job's merged Perfetto timeline shows
                 # its share of each mega-batch flush (rows of flush_rows),
@@ -1126,7 +1219,7 @@ class DeviceExecutor:
                     "flush_share",
                     "executor",
                     t_launch,
-                    done - t_launch,
+                    launch_s,
                     bucket=bucket.label,
                     rows=s.rows,
                     flush_rows=rows,
@@ -1134,10 +1227,59 @@ class DeviceExecutor:
                     **(s.trace_ctx or {}),
                 )
                 self._resolve(s, result=out)
+            self.flight_recorder.record(
+                bucket=bucket.label,
+                trigger=trigger,
+                rows=rows,
+                padded_rows=padded_rows,
+                tasks=[model.label_for(s.task) for s in live],
+                queue_delay_max_s=queue_delay_max,
+                stage_s=stage_s,
+                launch_s=launch_s,
+                outcome="ok",
+                breaker_state=self._breaker_state_name(bucket),
+                fault=False,
+            )
         except Exception as e:  # surface the launch failure to every job
-            if bucket.breaker is not None:
-                bucket.breaker.record_failure()
             done = time.monotonic()
+            if not launch_ok:
+                launch_s = max(0.0, done - t_launch)
+                # attribute whatever the chip DID spend before failing,
+                # then record the flight BEFORE the breaker verdict so a
+                # trip's ring dump includes this failing flush.  Error
+                # rows count only submissions not already accounted (a
+                # launch-dequeue rejection was counted "rejected"; the
+                # success loop counted resolved rows "ok").
+                model.attribute_flush(
+                    [(s.task, s.rows) for s in live],
+                    {"stage": stage_s, "launch": launch_s},
+                    path="device",
+                )
+                for s in live:
+                    if not s.finished:
+                        model.observe_rows(s.task, "error", s.rows)
+                self.flight_recorder.record(
+                    bucket=bucket.label,
+                    trigger=trigger,
+                    rows=rows,
+                    padded_rows=padded_rows,
+                    tasks=[model.label_for(s.task) for s in live],
+                    queue_delay_max_s=queue_delay_max,
+                    stage_s=stage_s,
+                    launch_s=launch_s,
+                    outcome="error",
+                    breaker_state=self._breaker_state_name(bucket),
+                    fault=isinstance(e, faults.FaultInjectedError),
+                    error=e,
+                )
+                if bucket.breaker is not None:
+                    bucket.breaker.record_failure()
+            else:
+                logger.exception(
+                    "flush bookkeeping failed after a successful launch "
+                    "(bucket %s); unresolved submissions get the error",
+                    bucket.label,
+                )
             for s in live:
                 self._finish(bucket, s, done)
                 self._resolve(s, exc=e)
@@ -1158,6 +1300,14 @@ class DeviceExecutor:
         if refs:
             store.release_refs(refs)
 
+    @staticmethod
+    def _breaker_state_name(bucket: _Bucket) -> Optional[str]:
+        """The bucket's breaker state at record time (flight recorder
+        field); None when breakers are disabled."""
+        if bucket.breaker is None:
+            return None
+        return _CIRCUIT_STATE_NAMES.get(bucket.breaker.state)
+
     def _reject_expired(self, bucket: _Bucket, subs: List[_Submission]):
         """Reject (retryably) every submission whose deadline has passed;
         returns the still-live remainder.  Called when a flush starts and
@@ -1172,6 +1322,7 @@ class DeviceExecutor:
             self._finish(bucket, s, now)
             bucket.rejections += 1
             self._observe_rejection(bucket, "deadline")
+            costs.cost_model().observe_rows(s.task, "rejected", s.rows)
             self._resolve(
                 s,
                 exc=ExecutorOverloadedError(
@@ -1327,6 +1478,7 @@ class DeviceExecutor:
                         GLOBAL_METRICS.executor_flush_rows,
                         GLOBAL_METRICS.executor_wait_seconds,
                         GLOBAL_METRICS.executor_launch_seconds,
+                        GLOBAL_METRICS.executor_pad_rows,
                     ):
                         GLOBAL_METRICS.remove_series(metric, label)
                     for reason in ("queue_full", "deadline"):
@@ -1343,6 +1495,13 @@ class DeviceExecutor:
                 len(retired_circuits),
             )
         return len(retired)
+
+    def flight_stats(self, n: int = 32) -> dict:
+        """The flight recorder's /statusz face: ring stats + the newest
+        ``n`` per-flush records, newest first."""
+        out = self.flight_recorder.stats()
+        out["records"] = self.flight_recorder.snapshot(n)
+        return out
 
     def circuit_stats(self) -> Dict[str, dict]:
         """Per-shape breaker state (plain Python; chaos tests read this)."""
@@ -1404,6 +1563,14 @@ class DeviceExecutor:
             GLOBAL_METRICS.executor_launch_seconds.labels(
                 bucket=bucket.label
             ).observe(launch_s)
+
+    def _observe_pad(self, bucket: _Bucket, padded_rows: int) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        if padded_rows > 0 and GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.executor_pad_rows.labels(bucket=bucket.label).inc(
+                padded_rows
+            )
 
     def _observe_wait(self, bucket: _Bucket, wait_s: float) -> None:
         from ..core.metrics import GLOBAL_METRICS

@@ -56,11 +56,22 @@ class StagedPrepInit:
 
 
 def _observe_prepare(backend: str, phase: str, reports: int, seconds: float) -> None:
-    """Per-backend steady-state throughput/latency metrics (VERDICT r4 #6)."""
+    """Per-backend steady-state throughput/latency metrics (VERDICT r4 #6).
+
+    Also the oracle-path COST ATTRIBUTION hook (ISSUE 12): when the
+    calling thread carries a task scope (core/costs.run_in_task_scope —
+    the drivers and the helper bind it around oracle fallbacks and direct
+    backend batches), the same measured duration lands on
+    ``janus_task_device_seconds_total{task,phase,path}``, path derived
+    from the backend name — so an open breaker's cost shift to the CPU
+    oracle is visible per task.  Conservation is exact by construction:
+    one measurement, observed once here and attributed once there."""
+    from ..core import costs
     from ..core.metrics import GLOBAL_METRICS
 
     if GLOBAL_METRICS.registry is not None:
         GLOBAL_METRICS.observe_prepare(backend, phase, reports, seconds)
+    costs.attribute_prepare(backend, phase, seconds)
 
 
 class OracleBackend:
@@ -996,8 +1007,21 @@ class HybridXofBackend:
         results: List[PrepOutcome] = []
         for b in range(B):
             if not ok[b]:
+                # Per-row oracle rescue is an INTERNAL detail of this
+                # device batch: the enclosing _observe_prepare below
+                # already spans it, so the nested oracle call must not
+                # ALSO attribute its slice to the task's cost scope (the
+                # conservation invariant is one measurement, attributed
+                # once) — clear the scope around the rescue.
+                from ..core import costs
+
                 results.extend(
-                    self.oracle.prep_init_batch(verify_key, agg_id, [reports[b]])
+                    costs.run_in_task_scope(
+                        None,
+                        lambda b=b: self.oracle.prep_init_batch(
+                            verify_key, agg_id, [reports[b]]
+                        ),
+                    )
                 )
                 continue
             state = Prio3PrepareState(

@@ -1269,3 +1269,281 @@ device_executor:
         leader_ds.close()
         helper_ds.close()
         configure_chrome_trace(None)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder SIGKILL semantics + per-task cost attribution (ISSUE 12)
+
+
+@pytest.mark.slow
+def test_flight_recorder_sigkill_semantics_and_per_task_cost(tmp_path):
+    """The flight recorder is deliberately in-memory: a fresh binary
+    starts an EMPTY ring (probed on a just-started driver before any job
+    exists), a SIGKILLed binary's records die with it (the survivor's
+    ring carries only its OWN flushes), and the survivor's breaker trip
+    dumps the ring EXACTLY ONCE into its log.  After recovery, the
+    per-task cost series prove the failure-domain shift: every seeded
+    task has device-seconds > 0, attributed on the oracle path the open
+    breaker degraded it to."""
+    import asyncio
+
+    from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.client import prepare_report
+    from janus_tpu.executor.flight_recorder import DUMP_MARKER
+    from janus_tpu.messages import InputShareAad
+
+    key = generate_key()
+    leader_db = str(tmp_path / "leader.sqlite3")
+    helper_db = str(tmp_path / "helper.sqlite3")
+    helper_port, helper_health = _free_port(), _free_port()
+    driver_health = [_free_port(), _free_port()]
+
+    clock = RealClock()
+    leader_ds = Datastore(leader_db, Crypter([key]), clock)
+    helper_ds = Datastore(helper_db, Crypter([key]), clock)
+    agg_token = AuthenticationToken.new_bearer("agg-token-flights")
+    collector_keys = HpkeKeypair.generate(9)
+    now = clock.now()
+    report_time = Time(now.seconds - now.seconds % TIME_PRECISION.seconds)
+
+    n_tasks = 2
+    tasks = []
+    for t in range(n_tasks):
+        task_id = TaskId.random()
+        common = dict(
+            task_id=task_id,
+            query_type=TaskQueryType.time_interval(),
+            vdaf={"type": "Prio3Count"},
+            vdaf_verify_key=bytes([0x50 + t]) * 16,
+            min_batch_size=3,
+            time_precision=TIME_PRECISION,
+            collector_hpke_config=collector_keys.config,
+        )
+        leader_kp, helper_kp = HpkeKeypair.generate(1), HpkeKeypair.generate(2)
+        leader_task = AggregatorTask(
+            peer_aggregator_endpoint=f"http://127.0.0.1:{helper_port}/",
+            role=Role.LEADER,
+            aggregator_auth_token=agg_token,
+            hpke_keys=[leader_kp],
+            **common,
+        )
+        helper_task = AggregatorTask(
+            peer_aggregator_endpoint="http://127.0.0.1:1/",
+            role=Role.HELPER,
+            aggregator_auth_token_hash=agg_token.hash(),
+            hpke_keys=[helper_kp],
+            **common,
+        )
+        leader_ds.run_tx("putl", lambda tx, lt=leader_task: tx.put_aggregator_task(lt))
+        helper_ds.run_tx("puth", lambda tx, ht=helper_task: tx.put_aggregator_task(ht))
+        tasks.append((task_id, leader_task, leader_kp, helper_kp))
+
+    def seed_report(t, m):
+        task_id, leader_task, leader_kp, helper_kp = tasks[t]
+        vdaf = leader_task.vdaf_instance()
+        report = prepare_report(
+            vdaf,
+            task_id,
+            leader_kp.config,
+            helper_kp.config,
+            TIME_PRECISION,
+            m,
+            time=report_time,
+        )
+        aad = InputShareAad(
+            task_id, report.metadata, report.public_share
+        ).get_encoded()
+        info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+        plain = PlaintextInputShare.get_decoded(
+            open_(leader_kp, info, report.leader_encrypted_input_share, aad)
+        )
+        stored = LeaderStoredReport(
+            task_id=task_id,
+            metadata=report.metadata,
+            public_share=report.public_share,
+            leader_extensions=[],
+            leader_input_share=plain.payload,
+            helper_encrypted_input_share=report.helper_encrypted_input_share,
+        )
+        asyncio.run(
+            ReportWriteBatcher(leader_ds, max_batch_size=1).write_report(stored)
+        )
+
+    for t in range(n_tasks):
+        for m in (1, 0, 1):
+            seed_report(t, m)
+
+    # -- replica configs ----------------------------------------------------
+    def driver_yaml(i):
+        if i == 0:  # the WEDGER: every flush parks for 600s mid-step
+            fault_point = "executor.flush: {mode: delay, probability: 1.0, delay_s: 600}"
+        else:  # the SURVIVOR: every device launch fails -> breaker trip
+            fault_point = "backend.launch: {mode: error, probability: 1.0}"
+        return f"""
+common:
+  database: {{path: {leader_db}}}
+  health_check_listen_address: 127.0.0.1:{driver_health[i]}
+  status_sample_interval_s: 0.5
+  fault_injection:
+    enabled: true
+    seed: {SEED}
+    points:
+      {fault_point}
+job_driver:
+  job_discovery_interval_s: 0.2
+  max_concurrent_job_workers: 2
+  worker_lease_duration_s: 5
+  worker_lease_clock_skew_allowance_s: 1
+  maximum_attempts_before_failure: 100000
+  max_step_attempts: 100000
+  retry_initial_delay_s: 0.5
+  retry_max_delay_s: 1.0
+  lease_reap_interval_s: 0.1
+vdaf_backend: tpu
+device_executor:
+  enabled: true
+  flush_window_ms: 20
+  flush_max_rows: 4096
+  breaker_failure_threshold: 1
+  breaker_reset_timeout_s: 3600
+"""
+
+    helper_yaml = f"""
+common:
+  database: {{path: {helper_db}}}
+  health_check_listen_address: 127.0.0.1:{helper_health}
+listen_address: 127.0.0.1:{helper_port}
+vdaf_backend: oracle
+"""
+    cfg_paths = []
+    for i in range(2):
+        p = tmp_path / f"driver{i}.yaml"
+        p.write_text(driver_yaml(i))
+        cfg_paths.append(p)
+    helper_cfg = tmp_path / "helper.yaml"
+    helper_cfg.write_text(helper_yaml)
+
+    env = dict(os.environ)
+    env["DATASTORE_KEYS"] = base64.urlsafe_b64encode(key).decode().rstrip("=")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    def _statusz(port):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=10
+        ) as r:
+            return json.loads(r.read().decode())
+
+    def _unfinished():
+        return _sql(
+            leader_db,
+            "SELECT COUNT(*) FROM aggregation_jobs WHERE state = 'InProgress'",
+        )[0][0]
+
+    def _task_seconds_from_scrape(text, label):
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith("janus_task_device_seconds_total{") and (
+                f'task="{label}"' in line
+            ):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    reps = _Replicas(env, cfg_paths, helper_cfg, tmp_path)
+    try:
+        reps.start_helper()
+        _wait_http(f"http://127.0.0.1:{helper_health}/healthz", 120)
+
+        # -- binary #1 starts BEFORE any job exists: a fresh binary's
+        # flight ring is EMPTY (deterministic probe, nothing to flush yet)
+        reps.start_driver(0)
+        _wait_http(f"http://127.0.0.1:{driver_health[0]}/healthz", 120)
+        doc = _statusz(driver_health[0])
+        flights = doc["executor"]["flights"]
+        assert flights["recorded"] == 0 and flights["records"] == [], flights
+        assert doc["executor"]["cost_attribution"]["tracked"] == 0
+
+        # jobs appear; the wedger acquires and parks mid-flush (the
+        # injected 600s executor.flush delay) — a wedged flush never
+        # COMPLETES, so its ring stays empty right up to the SIGKILL
+        creator = AggregationJobCreator(
+            leader_ds,
+            CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=3),
+        )
+        n_jobs = asyncio.run(creator.run_once())
+        assert n_jobs == n_tasks, n_jobs
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if _statusz(driver_health[0])["faults"]["hits"].get("executor.flush", 0):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("the wedger never reached its flush fault")
+        assert _statusz(driver_health[0])["executor"]["flights"]["recorded"] == 0
+
+        # -- SIGKILL the wedger; its in-memory ring dies with it --------
+        reps.kill_driver(0)
+
+        # -- binary #2 (the survivor): launch faults trip the breaker,
+        # jobs degrade to the per-task-attributed oracle, and converge
+        reps.start_driver(1)
+        _wait_http(f"http://127.0.0.1:{driver_health[1]}/healthz", 120)
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if _unfinished() == 0:
+                break
+            time.sleep(0.3)
+        assert _unfinished() == 0, "survivor never converged on the oracle path"
+
+        # the survivor's ring carries ONLY its own flushes (SIGKILL
+        # semantics: nothing leaked over from binary #1's incarnation),
+        # and each is the error-outcome record of its own launch faults
+        doc = _statusz(driver_health[1])
+        records = doc["executor"]["flights"]["records"]
+        assert records, "survivor must have recorded its failing flushes"
+        assert all(r["outcome"] == "error" and r["fault"] for r in records), records
+        assert doc["executor"]["flights"]["dumps"] == {"breaker_trip": 1}, doc[
+            "executor"
+        ]["flights"]
+
+        # per-task device-seconds > 0 for EVERY seeded task after
+        # recovery — and specifically on the ORACLE path (the breaker
+        # cost shift the series exist to show)
+        scraped = _scrape(driver_health[1])
+        for task_id, _lt, _lk, _hk in tasks:
+            label = str(task_id)
+            assert _task_seconds_from_scrape(scraped, label) > 0, label
+            oracle_line = [
+                line
+                for line in scraped.splitlines()
+                if line.startswith("janus_task_device_seconds_total{")
+                and f'task="{label}"' in line
+                and 'path="oracle"' in line
+            ]
+            assert oracle_line, f"task {label} has no oracle-path attribution"
+    finally:
+        reps.terminate_all()
+
+    # -- the dump appears EXACTLY ONCE in the survivor's log ------------
+    def _dump_lines(tag):
+        lines = []
+        for log in sorted(tmp_path.glob(f"{tag}-*.log")):
+            lines += [
+                line
+                for line in log.read_text(errors="replace").splitlines()
+                if DUMP_MARKER in line
+            ]
+        return lines
+
+    survivor_dumps = _dump_lines("driver1")
+    assert len(survivor_dumps) == 1, survivor_dumps
+    payload = json.loads(survivor_dumps[0].split(DUMP_MARKER, 1)[1])
+    assert payload["reason"] == "breaker_trip"
+    assert payload["flights"], "the dump must carry the ring that led to the trip"
+    assert all(f["outcome"] == "error" for f in payload["flights"])
+    # the wedger never completed a flush, never tripped: zero dumps
+    assert _dump_lines("driver0") == []
+    leader_ds.close()
+    helper_ds.close()

@@ -801,3 +801,113 @@ class TestHealthServer:
             stop.set()
             for t in threads:
                 t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# jax profiler server wiring (ISSUE 12 satellite: core/trace.py
+# start_profiler_server + binaries/main.py common.profiler_port — the
+# always-on capture socket was wired in PR 5 and never tested)
+
+
+class TestProfilerServerWiring:
+    def test_start_profiler_server_starts_on_the_port(self, monkeypatch):
+        import jax
+
+        started = []
+        monkeypatch.setattr(
+            jax.profiler, "start_server", lambda port: started.append(port)
+        )
+        assert trace_mod.start_profiler_server(9090) is True
+        assert started == [9090]
+
+    def test_gate_probe_jaxless_process_is_quiet_false(self, monkeypatch, caplog):
+        """Control-plane binaries have no jax: the probe returns False
+        with an INFO line, never a traceback (a deployment shape is not
+        an error)."""
+        import sys
+
+        monkeypatch.setitem(sys.modules, "jax", None)  # import jax -> ImportError
+        with caplog.at_level(logging.INFO, logger="janus_tpu.trace"):
+            assert trace_mod.start_profiler_server(9092) is False
+        assert "jax unavailable" in caplog.text
+        assert "Traceback" not in caplog.text
+
+    def test_failure_logs_and_continues(self, monkeypatch, caplog):
+        """The failure contract: a dead profiler socket must never take a
+        binary down — False + one logged exception, nothing raised."""
+        import jax
+
+        def boom(port):
+            raise OSError("port already bound")
+
+        monkeypatch.setattr(jax.profiler, "start_server", boom)
+        with caplog.at_level(logging.ERROR, logger="janus_tpu.trace"):
+            assert trace_mod.start_profiler_server(9091) is False
+        assert "could not start jax profiler server" in caplog.text
+
+    def _bootstrap_with(self, tmp_path, monkeypatch, profiler_port):
+        """Run the real binary bootstrap with a given profiler_port,
+        recording start_profiler_server calls.  The gate under test is
+        main.py's ``if getattr(config_common, 'profiler_port', 0)``; the
+        datastore layer is stubbed (it needs `cryptography`, absent on
+        dev containers, and is not what this test is about)."""
+        import base64 as b64
+
+        from janus_tpu.binaries import main as main_mod
+        from janus_tpu.binaries.config import CommonConfig, DbConfig
+
+        calls = []
+        monkeypatch.setattr(
+            trace_mod, "start_profiler_server", lambda port: calls.append(port) or True
+        )
+        monkeypatch.setattr(main_mod, "Crypter", lambda keys: None)
+        monkeypatch.setattr(
+            main_mod,
+            "Datastore",
+            lambda *a, **kw: type("FakeDs", (), {"close": lambda self: None})(),
+        )
+        monkeypatch.setenv(
+            "DATASTORE_KEYS",
+            b64.urlsafe_b64encode(b"\x07" * 16).rstrip(b"=").decode(),
+        )
+        common = CommonConfig(
+            database=DbConfig(path=str(tmp_path / "boot.sqlite3")),
+            profiler_port=profiler_port,
+        )
+        clock, datastore = main_mod._bootstrap(common)
+        datastore.close()
+        return calls
+
+    def test_bootstrap_port_zero_is_a_no_op(self, tmp_path, monkeypatch):
+        assert self._bootstrap_with(tmp_path, monkeypatch, 0) == []
+
+    def test_bootstrap_wires_the_configured_port(self, tmp_path, monkeypatch):
+        assert self._bootstrap_with(tmp_path, monkeypatch, 9123) == [9123]
+
+    def test_bootstrap_survives_profiler_failure(self, tmp_path, monkeypatch):
+        """logs-and-continues at the wiring layer too: a False return (the
+        failure path) must not abort the bootstrap."""
+        import base64 as b64
+
+        from janus_tpu.binaries import main as main_mod
+        from janus_tpu.binaries.config import CommonConfig, DbConfig
+
+        monkeypatch.setattr(
+            trace_mod, "start_profiler_server", lambda port: False
+        )
+        monkeypatch.setattr(main_mod, "Crypter", lambda keys: None)
+        monkeypatch.setattr(
+            main_mod,
+            "Datastore",
+            lambda *a, **kw: type("FakeDs", (), {"close": lambda self: None})(),
+        )
+        monkeypatch.setenv(
+            "DATASTORE_KEYS",
+            b64.urlsafe_b64encode(b"\x07" * 16).rstrip(b"=").decode(),
+        )
+        common = CommonConfig(
+            database=DbConfig(path=str(tmp_path / "boot2.sqlite3")),
+            profiler_port=9999,
+        )
+        clock, datastore = main_mod._bootstrap(common)  # must not raise
+        datastore.close()

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Per-task device-plane cost report (ISSUE 12 tentpole).
+
+Renders "which task is burning the chip" from one live replica's
+``/statusz`` + ``/metrics`` pair (or saved copies of both):
+
+* per task: attributed device-seconds split by path (device vs the CPU
+  oracle — a non-zero oracle share on a device-configured fleet is a
+  breaker/warming story), rows by outcome, reports/s over the process
+  uptime, and mean executor queue delay;
+* per bucket: pad-waste%% — mask-padded rows (pow2 canonicalization +
+  mesh tails) as a share of everything the chip computed for the bucket;
+* the flight-recorder digest: ring occupancy and dump counts.
+
+Usage::
+
+    python tools/cost_report.py --base http://127.0.0.1:8000
+    python tools/cost_report.py --statusz-file s.json --metrics-file m.txt
+    python tools/cost_report.py ... --json    # machine-readable
+
+Stdlib-only on purpose: it must run from any operator box that can curl
+the health port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_metrics(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Prometheus exposition text -> {sample_name: {label tuple: value}}."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = tuple(
+            sorted((k, v) for k, v in _LABEL_RE.findall(m.group("labels") or ""))
+        )
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        out.setdefault(m.group("name"), {})[labels] = value
+    return out
+
+
+def _by_label(samples, name: str, key: str) -> Dict[str, Dict[Tuple, float]]:
+    """Group one family's samples by the value of ``key``."""
+    grouped: Dict[str, Dict[Tuple, float]] = {}
+    for labels, value in samples.get(name, {}).items():
+        d = dict(labels)
+        label = d.pop(key, None)
+        if label is None:
+            continue
+        grouped.setdefault(label, {})[tuple(sorted(d.items()))] = value
+    return grouped
+
+
+def build_report(statusz: dict, metrics_text: str) -> dict:
+    samples = parse_metrics(metrics_text)
+    uptime_s = float(statusz.get("uptime_s") or 0.0)
+    report = {
+        "pid": statusz.get("pid"),
+        "uptime_s": uptime_s,
+        "tasks": {},
+        "buckets": {},
+        "flights": None,
+        "cost_attribution": None,
+    }
+
+    # -- per-task rollup -------------------------------------------------
+    seconds = _by_label(samples, "janus_task_device_seconds_total", "task")
+    rows = _by_label(samples, "janus_task_rows_total", "task")
+    qd_sum = _by_label(samples, "janus_task_queue_delay_seconds_sum", "task")
+    qd_count = _by_label(samples, "janus_task_queue_delay_seconds_count", "task")
+    for task in sorted(set(seconds) | set(rows)):
+        by_path: Dict[str, float] = {}
+        for labels, value in seconds.get(task, {}).items():
+            path = dict(labels).get("path", "device")
+            by_path[path] = by_path.get(path, 0.0) + value
+        by_outcome: Dict[str, float] = {}
+        for labels, value in rows.get(task, {}).items():
+            outcome = dict(labels).get("outcome", "ok")
+            by_outcome[outcome] = by_outcome.get(outcome, 0.0) + value
+        ok_rows = by_outcome.get("ok", 0.0)
+        qsum = sum(qd_sum.get(task, {}).values())
+        qcount = sum(qd_count.get(task, {}).values())
+        total_s = sum(by_path.values())
+        report["tasks"][task] = {
+            "device_s": round(by_path.get("device", 0.0), 6),
+            "oracle_s": round(by_path.get("oracle", 0.0), 6),
+            "oracle_share": round(by_path.get("oracle", 0.0) / total_s, 4)
+            if total_s > 0
+            else 0.0,
+            "rows": {k: int(v) for k, v in sorted(by_outcome.items())},
+            "reports_per_s": round(ok_rows / uptime_s, 2) if uptime_s > 0 else None,
+            "queue_delay_mean_ms": round(1000.0 * qsum / qcount, 3)
+            if qcount
+            else None,
+        }
+
+    # -- per-bucket pad waste ---------------------------------------------
+    pad = {
+        dict(labels).get("bucket"): value
+        for labels, value in samples.get("janus_executor_pad_rows_total", {}).items()
+    }
+    flushed = _by_label(samples, "janus_executor_flush_rows_sum", "bucket")
+    for bucket in sorted(set(pad) | set(flushed)):
+        pad_rows = pad.get(bucket, 0.0)
+        real_rows = sum(flushed.get(bucket, {}).values())
+        launched = real_rows + pad_rows
+        report["buckets"][bucket] = {
+            "rows": int(real_rows),
+            "pad_rows": int(pad_rows),
+            "pad_waste": round(pad_rows / launched, 4) if launched > 0 else 0.0,
+        }
+
+    ex = statusz.get("executor") or {}
+    report["flights"] = {
+        k: v for k, v in (ex.get("flights") or {}).items() if k != "records"
+    } or None
+    report["cost_attribution"] = ex.get("cost_attribution")
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"cost report — pid {report['pid']}, uptime {report['uptime_s']:.0f}s"
+    ]
+    if not report["tasks"]:
+        lines.append("  (no per-task series yet — has any prepare traffic run?)")
+    else:
+        lines.append(
+            "  %-14s %12s %12s %8s %10s %10s %12s"
+            % ("task", "device_s", "oracle_s", "oracle%", "rows_ok", "rps", "qdelay_ms")
+        )
+        for task, t in sorted(
+            report["tasks"].items(),
+            key=lambda kv: -(kv[1]["device_s"] + kv[1]["oracle_s"]),
+        ):
+            lines.append(
+                "  %-14s %12.3f %12.3f %7.1f%% %10d %10s %12s"
+                % (
+                    task[:14],
+                    t["device_s"],
+                    t["oracle_s"],
+                    100.0 * t["oracle_share"],
+                    t["rows"].get("ok", 0),
+                    t["reports_per_s"] if t["reports_per_s"] is not None else "-",
+                    t["queue_delay_mean_ms"]
+                    if t["queue_delay_mean_ms"] is not None
+                    else "-",
+                )
+            )
+    if report["buckets"]:
+        lines.append("  pad waste per bucket:")
+        for bucket, b in sorted(report["buckets"].items()):
+            lines.append(
+                "    %-40s rows=%d pad=%d waste=%.1f%%"
+                % (bucket[:40], b["rows"], b["pad_rows"], 100.0 * b["pad_waste"])
+            )
+    if report["flights"]:
+        lines.append(f"  flight recorder: {report['flights']}")
+    if report["cost_attribution"]:
+        lines.append(f"  attribution ledger: {report['cost_attribution']}")
+    return "\n".join(lines)
+
+
+def _fetch(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--base",
+        help="replica health-server base URL (fetches <base>/statusz + <base>/metrics)",
+    )
+    p.add_argument("--statusz-file", help="saved /statusz JSON (offline mode)")
+    p.add_argument("--metrics-file", help="saved /metrics text (offline mode)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    try:
+        if args.base:
+            statusz = json.loads(_fetch(args.base.rstrip("/") + "/statusz"))
+            metrics_text = _fetch(args.base.rstrip("/") + "/metrics").decode()
+        elif args.statusz_file and args.metrics_file:
+            with open(args.statusz_file) as f:
+                statusz = json.load(f)
+            with open(args.metrics_file) as f:
+                metrics_text = f.read()
+        else:
+            p.error("need --base URL or both --statusz-file/--metrics-file")
+            return 2
+    except Exception as e:
+        print(f"cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    report = build_report(statusz, metrics_text)
+    print(json.dumps(report, indent=2) if args.json else render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
