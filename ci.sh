@@ -39,11 +39,16 @@
 #                      (ISSUE 10: breaker -> per-report CPU oracle ->
 #                      bit-exact heavy-hitter counts with exactly-once
 #                      accumulation across the agg-param-keyed journal).
-#   ./ci.sh poplar     heavy-hitters gate (ISSUE 10): the executor-routed
-#                      Poplar1 suite (tests/test_poplar_executor.py —
-#                      multi-request walk parity, level-keyed bucket
-#                      identity, breaker/backpressure parity, the 2-job x
-#                      2-level e2e, deferred-journal crash replay) plus the
+#   ./ci.sh poplar     heavy-hitters gate (ISSUE 10 + 13): the jitted AES
+#                      kernel (tests/test_aes_jax.py — FIPS-197 vectors,
+#                      soft-AES fuzz, the poplar_backend seam), the
+#                      executor-routed Poplar1 suite
+#                      (tests/test_poplar_executor.py — multi-request walk
+#                      parity, level-keyed bucket identity,
+#                      breaker/backpressure parity, device-resident sketch
+#                      refs + dead-ref oracle replay, the walk/sketch
+#                      double buffer, the 2-job x 2-level e2e,
+#                      deferred-journal crash replay) plus the
 #                      protocol/batch suites (test_poplar1.py,
 #                      test_poplar1_batch.py).
 #   ./ci.sh chaos crash  process-level crash stage: the SIGKILL/restart soak
@@ -54,13 +59,16 @@
 #                      case (ISSUE 11: orphaned rows replayed exactly once by
 #                      a clean replacement binary, replay-consumed metric
 #                      delta == orphan count, results unchanged).
-#   ./ci.sh chaos partition  network-partition stage (ISSUE 11): the
+#   ./ci.sh chaos partition  network-partition stage (ISSUE 11 + 13): the
 #                      asymmetric leader->helper blackhole soak (jobs quiesce
 #                      with retryable jittered backoff — zero attempt-budget
 #                      abandonments, zero breaker trips, zero expired leases
 #                      — then heal -> exactly-once counts, zero SLO false
-#                      breaches) plus the peer-health / deadline-budget /
-#                      Retry-After unit suite (tests/test_peer_health.py).
+#                      breaches), the FLAPPING-LINK soak (deterministic
+#                      on/off schedule, mid-exchange resets, suspect-dwell
+#                      restarts under churn, exactly-once after settle),
+#                      plus the peer-health / deadline-budget / Retry-After
+#                      unit suite (tests/test_peer_health.py).
 #   ./ci.sh coldstart  shape-churn gate (ISSUE 8): pow2 canonicalization
 #                      oracle-parity sweep (tests/test_shape_canonical.py,
 #                      incl. the RUN_SLOW matrix: all circuit families x
@@ -177,10 +185,13 @@ case "$tier" in
       RUN_SLOW=1 exec python -m pytest tests/test_crash_chaos.py -q
     fi
     if [ "${2:-}" = "partition" ]; then
-      # Network-partition stage (ISSUE 11): the asymmetric blackhole soak
-      # (slow-marked — RUN_SLOW gates it) + the peer-health/retry units.
+      # Network-partition stage (ISSUE 11 + 13): the asymmetric blackhole
+      # soak, the FLAPPING-LINK soak (half-open probes interleaved with
+      # mid-exchange resets, suspect-dwell restart under churn), and the
+      # peer-health/retry units.  Slow-marked — RUN_SLOW gates them.
       RUN_SLOW=1 exec python -m pytest \
         "tests/test_chaos.py::test_partition_soak_asymmetric_heal_exactly_once" \
+        "tests/test_chaos.py::test_partition_flap_soak_suspect_dwell_restart_exactly_once" \
         tests/test_peer_health.py -q
     fi
     exec python -m pytest tests/test_chaos.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
@@ -192,13 +203,17 @@ case "$tier" in
     exec python -m pytest tests/test_mesh.py tests/test_mesh_executor.py -q
     ;;
   poplar)
-    # Heavy-hitters gate (ISSUE 10): Poplar1 through the executor's
-    # agg-param-keyed dispatch plane.  The soft-AES fallback
+    # Heavy-hitters gate (ISSUE 10 + 13): Poplar1 through the executor's
+    # agg-param-keyed dispatch plane, the jitted AES walk (FIPS-197
+    # vectors + soft-AES fuzz, tests/test_aes_jax.py), and the
+    # device-resident sketch path (ResidentRefs across the ping-pong
+    # persistence hop, deferred drains, dead-ref oracle replay, the
+    # walk/sketch double buffer).  The soft-AES fallback
     # (utils/softaes.py) keeps the IDPF walk runnable without the
     # `cryptography` package; the e2e/replay cases still need it (or the
     # shim) for datastore column encryption and skip cleanly otherwise.
-    exec python -m pytest tests/test_poplar_executor.py tests/test_poplar1.py \
-      tests/test_poplar1_batch.py -q
+    exec python -m pytest tests/test_aes_jax.py tests/test_poplar_executor.py \
+      tests/test_poplar1.py tests/test_poplar1_batch.py -q
     ;;
   mxu)
     # MXU field-arithmetic gate (ISSUE 7): dot_general contraction layer

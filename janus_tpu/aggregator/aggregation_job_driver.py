@@ -100,6 +100,10 @@ class DriverConfig:
     #: (JANUS_TPU_FIELD_BACKEND or "vpu").  The A/B seam for the MXU
     #: limb-plane contraction layer; the oracle ignores it.
     field_backend: Optional[str] = None
+    #: Poplar1 AES-walk backend ("host" | "jax"); None = process default
+    #: (JANUS_TPU_POPLAR_BACKEND or "host").  The A/B seam for the
+    #: device-resident IDPF walk; only the Poplar1 path reads it.
+    poplar_backend: Optional[str] = None
     http_retry: HttpRetryPolicy = field(default_factory=HttpRetryPolicy)
     #: Gather window for coalescing same-shape jobs from DIFFERENT tasks
     #: into one device launch (BASELINE configs[4]); 0 disables.  Only
@@ -491,7 +495,11 @@ class AggregationJobDriver:
             # back to the per-report ping-pong path (backend None), never
             # fails the job.
             def poplar_factory():
-                return make_backend(vdaf, self.config.vdaf_backend)
+                return make_backend(
+                    vdaf,
+                    self.config.vdaf_backend,
+                    poplar_backend=self.config.poplar_backend,
+                )
 
             try:
                 b = (
@@ -724,6 +732,17 @@ class AggregationJobDriver:
                     f"circuit for shape {shape_key[0]} is open",
                     task_ident=task_ident,
                 )
+            # Device-resident sketches (ISSUE 13): only with DEFERRED
+            # drains — the refs cross the WAITING_LEADER persistence hop,
+            # and only deferred mode retains the StartLeader payloads that
+            # make a dead ref (restart/eviction-past-recall) recoverable
+            # via the per-report oracle.
+            store = self._executor.accumulator
+            retain_sketch = (
+                store is not None
+                and getattr(store.config, "deferred", False)
+                and getattr(backend, "supports_resident_sketch", False)
+            )
             try:
                 return await self._executor.submit(
                     shape_key,
@@ -731,6 +750,7 @@ class AggregationJobDriver:
                     (verify_key, agg_param, prep_in),
                     backend=backend,
                     agg_id=0,
+                    retain_out_shares=retain_sketch,
                     task_ident=task_ident,
                     agg_param_key=getattr(agg_param, "level", None),
                 )
@@ -918,6 +938,19 @@ class AggregationJobDriver:
             self._release_resident_outcomes(outcomes)
             raise
 
+    def _release_finished_refs(self, finished_now) -> None:
+        """Release device-resident out shares held by finished-at-evaluate
+        rows (Poplar1 continue steps) after a step failure or a helper
+        rejection dropped them short of the commit."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None or not finished_now:
+            return
+        from ..executor.accumulator import ResidentRef
+
+        refs = [v for v in finished_now.values() if isinstance(v, ResidentRef)]
+        if refs:
+            store.release_refs(refs)
+
     def _release_resident_outcomes(self, outcomes) -> None:
         store = self._executor.accumulator if self._executor is not None else None
         if store is None:
@@ -930,6 +963,8 @@ class AggregationJobDriver:
                 continue
             state, _msg = outcome
             ref = getattr(getattr(state, "prep_state", None), "out_share", None)
+            if not isinstance(ref, ResidentRef):  # Poplar1 carries y_flat
+                ref = getattr(getattr(state, "prep_state", None), "y_flat", None)
             if isinstance(ref, ResidentRef):
                 refs.append(ref)
         if refs:
@@ -1005,26 +1040,36 @@ class AggregationJobDriver:
         # req.step == helper_step + 1 — i.e. exactly the leader's step.
         wire_step = AggregationJobStep(int(job.step))
         req = AggregationJobContinueReq(wire_step, conts)
-        resp = await self._send_to_helper(
-            task,
-            "POST",
-            f"aggregation_jobs/{job.aggregation_job_id}",
-            req.get_encoded(),
-            AggregationJobContinueReq.MEDIA_TYPE,
-            lease=lease,
-        )
-        await self._process_helper_resp(
-            lease,
-            task,
-            vdaf,
-            job,
-            all_ras,
-            states,
-            failed,
-            resp,
-            finished_now=finished_now,
-            next_step=AggregationJobStep(int(wire_step) + 1),
-        )
+        try:
+            resp = await self._send_to_helper(
+                task,
+                "POST",
+                f"aggregation_jobs/{job.aggregation_job_id}",
+                req.get_encoded(),
+                AggregationJobContinueReq.MEDIA_TYPE,
+                lease=lease,
+            )
+            await self._process_helper_resp(
+                lease,
+                task,
+                vdaf,
+                job,
+                all_ras,
+                states,
+                failed,
+                resp,
+                finished_now=finished_now,
+                next_step=AggregationJobStep(int(wire_step) + 1),
+            )
+        except BaseException:
+            # A failure between evaluate and commit must not pin the flush
+            # matrices this step's device-resident rows (Poplar1 y refs
+            # riding in finished_now) reference: redelivery re-evaluates
+            # the persisted transition, and a then-dead ref fails closed
+            # into the per-report oracle replay.  Release is idempotent —
+            # rows a partial commit already consumed are unaffected.
+            self._release_finished_refs(finished_now)
+            raise
 
     # ------------------------------------------------------------------
     async def _process_helper_resp(
@@ -1139,12 +1184,29 @@ class AggregationJobDriver:
         # spills the delta NOW (one O(OUT) readback per batch bucket);
         # deferred mode leaves it resident and persists a journal row in
         # the tx instead (crash recovery replays from the datastore).
+        # finished-at-evaluate rows the helper rejected never reached
+        # out_shares: their device-resident refs (Poplar1) must release or
+        # the retained sketch matrix never frees
+        self._release_finished_refs(
+            {
+                rid: v
+                for rid, v in finished_now.items()
+                if rid not in out_shares
+            }
+        )
         (
             accumulator_deltas,
             journal_entries,
             touched_buckets,
         ) = await self._commit_resident_shares(
-            task, vdaf, job, all_ras, states, out_shares
+            task, vdaf, job, all_ras, states, out_shares,
+            # WAITING rows (multi-round VDAFs) keep their refs alive: the
+            # next step's transition evaluation finishes them
+            waiting_rids={
+                ra.report_id.data
+                for ra in new_ras
+                if ra.state == ReportAggregationState.WAITING_LEADER
+            },
         )
 
         if journal_entries:
@@ -1275,7 +1337,7 @@ class AggregationJobDriver:
         return await self.datastore.run_tx_async("accum_collected_check", check)
 
     async def _commit_resident_shares(
-        self, task, vdaf, job, all_ras, states, out_shares
+        self, task, vdaf, job, all_ras, states, out_shares, waiting_rids=frozenset()
     ) -> Tuple[
         Optional[Dict[bytes, Tuple[Sequence[int], frozenset]]],
         Optional[Dict[bytes, frozenset]],
@@ -1312,11 +1374,17 @@ class AggregationJobDriver:
             rid: v for rid, v in out_shares.items() if isinstance(v, ResidentRef)
         }
         # release the never-finished rows' refs regardless of outcome below
+        # — but NOT the WAITING rows': a multi-round VDAF's pending rows
+        # carry their refs through the persisted transition into the next
+        # step (releasing them here would strand every Poplar1 row on the
+        # dead-ref oracle path at round 1)
         leftover = []
         for rid, st in states.items():
-            if rid in out_shares:
+            if rid in out_shares or rid in waiting_rids:
                 continue
             ref = getattr(getattr(st, "prep_state", None), "out_share", None)
+            if not isinstance(ref, ResidentRef):  # Poplar1 carries y_flat
+                ref = getattr(getattr(st, "prep_state", None), "y_flat", None)
             if isinstance(ref, ResidentRef):
                 leftover.append(ref)
         if leftover:
@@ -1348,9 +1416,12 @@ class AggregationJobDriver:
 
         backend = self._backend_for(task, vdaf)
         shape_key = self._vdaf_shape_key(vdaf)
-        field = vdaf.field_for_agg_param(
+        agg_param = (
             vdaf.decode_agg_param(job.aggregation_parameter)
+            if getattr(vdaf, "REQUIRES_AGG_PARAM", False)
+            else None
         )
+        field = vdaf.field_for_agg_param(agg_param)
         loop = asyncio.get_running_loop()
 
         # Pre-tx collected check: reports aimed at an already-collected
@@ -1444,7 +1515,8 @@ class AggregationJobDriver:
                 replayed = await loop.run_in_executor(
                     None,
                     lambda rids=sorted(replay_rids): self._oracle_out_shares(
-                        task, vdaf, backend, [ra_by_rid[r] for r in rids]
+                        task, vdaf, backend, [ra_by_rid[r] for r in rids],
+                        agg_param=agg_param,
                     ),
                 )
                 out_shares.update(replayed)
@@ -1567,17 +1639,19 @@ class AggregationJobDriver:
                 out_shares[rid] = ResidentRef(-1, i)
         return None, journal_entries or None, touched
 
-    def _oracle_out_shares(self, task, vdaf, backend, ras):
+    def _oracle_out_shares(self, task, vdaf, backend, ras, agg_param=None):
         """Bit-exact CPU replay of finished reports' out shares (backend
         contract: oracle == device, tests/test_backend.py).  Canonical
         backends replay through the TASK's oracle (oracle_for), never the
-        bucket twin's.  The replay runs inside the task's cost scope, so
-        crash-recovery CPU time shows on the task's ``path="oracle"``
-        series like any other oracle work."""
+        bucket twin's.  Agg-param VDAFs (Poplar1) replay per report at
+        the job's OWN parameter — ``prep_init(...).y_flat`` is the value
+        vector the FINISHED verdict already vouched for (the sketch
+        verified before the ref was minted).  The replay runs inside the
+        task's cost scope, so crash-recovery CPU time shows on the task's
+        ``path="oracle"`` series like any other oracle work."""
         from ..core import costs
         from ..vdaf.backend import OracleBackend, oracle_backend_for
 
-        oracle = oracle_backend_for(backend, vdaf) or OracleBackend(vdaf)
         rows = []
         for ra in ras:
             rows.append(
@@ -1588,6 +1662,18 @@ class AggregationJobDriver:
                 )
             )
         out = {}
+        if getattr(vdaf, "REQUIRES_AGG_PARAM", False):
+            def poplar_replay():
+                res = {}
+                for rid, public, share in rows:  # the report id IS the nonce
+                    state, _sh = vdaf.prep_init(
+                        task.vdaf_verify_key, 0, agg_param, rid, public, share
+                    )
+                    res[rid] = list(state.y_flat)
+                return res
+
+            return costs.run_in_task_scope(task.task_id.data, poplar_replay)
+        oracle = oracle_backend_for(backend, vdaf) or OracleBackend(vdaf)
         replayed = costs.run_in_task_scope(
             task.task_id.data,
             lambda: oracle.prep_init_batch(task.vdaf_verify_key, 0, rows),
@@ -1637,9 +1723,15 @@ class AggregationJobDriver:
         if store is None or not getattr(store.config, "deferred", False):
             return 0
         # the shared store may also hold 7-tuple drain-at-commit keys
-        # (helper requests in the same process); only this driver's
-        # 5-tuple deferred keys are cadence-drainable
-        keys = [k for k in store.due_buckets(store.config.drain_interval_s) if len(k) == 5]
+        # (helper requests in the same process) and the HELPER's 5-tuple
+        # deferred CONTINUE buckets (aggregator.py owns those — it merges
+        # into the helper datastore); only this driver's LEADER-role
+        # 5-tuple deferred keys are cadence-drainable here
+        keys = [
+            k
+            for k in store.due_buckets(store.config.drain_interval_s)
+            if len(k) == 5 and k[0] == "leader"
+        ]
         if not keys:
             return 0
         loop = asyncio.get_running_loop()
@@ -1746,12 +1838,15 @@ class AggregationJobDriver:
 
     def _spill_sink(self, key: tuple, vector, journal) -> None:
         """shutdown(drain=True) target: spill one committed-but-unspilled
-        bucket durably.  Only deferred buckets (5-tuple keys) with
-        persisted journal rows are mergeable; job-scoped drain-at-commit
-        buckets still resident at shutdown belong to transactions that
-        never committed — merging them would double-count after the
-        lease redelivers, so they are dropped loudly instead."""
-        if len(key) != 5 or not journal:
+        bucket durably.  Only LEADER deferred buckets (5-tuple keys) with
+        persisted journal rows are mergeable here; job-scoped
+        drain-at-commit buckets still resident at shutdown belong to
+        transactions that never committed — merging them would
+        double-count after the lease redelivers — and a co-resident
+        HELPER's deferred buckets belong to the helper datastore (its
+        journal replay at aggregate-share time re-derives them), so both
+        are dropped loudly instead."""
+        if len(key) != 5 or key[0] != "leader" or not journal:
             logger.warning(
                 "dropping un-journaled resident delta for bucket %r "
                 "(%d job(s)); lease redelivery re-derives it",

@@ -115,6 +115,9 @@ class Config:
     #: Device field-arithmetic layout ("vpu" | "mxu"); None = process
     #: default (JANUS_TPU_FIELD_BACKEND or "vpu").
     field_backend: Optional[str] = None
+    #: Poplar1 AES-walk backend ("host" | "jax"); None = process default
+    #: (JANUS_TPU_POPLAR_BACKEND or "host").
+    poplar_backend: Optional[str] = None
     collection_job_retry_after: int = 10
     #: Aggregation-job size for agg-param VDAFs (Poplar1), whose jobs are
     #: created by the collection request (_create_agg_param_jobs) rather
@@ -140,11 +143,13 @@ class TaskAggregator:
         task: AggregatorTask,
         backend_name: str,
         field_backend: Optional[str] = None,
+        poplar_backend: Optional[str] = None,
     ):
         self.task = task
         self.vdaf = task.vdaf_instance()
         self.backend_name = backend_name
         self.field_backend = field_backend
+        self.poplar_backend = poplar_backend
         self._backend = None
 
     @property
@@ -152,7 +157,10 @@ class TaskAggregator:
         if self._backend is None:
             try:
                 self._backend = make_backend(
-                    self.vdaf, self.backend_name, field_backend=self.field_backend
+                    self.vdaf,
+                    self.backend_name,
+                    field_backend=self.field_backend,
+                    poplar_backend=self.poplar_backend,
                 )
             except VdafError:
                 # e.g. HMAC-XOF instances have no device path yet
@@ -231,7 +239,12 @@ class Aggregator:
         )
         if task is None:
             raise UnrecognizedTask(str(task_id))
-        ta = TaskAggregator(task, self.config.vdaf_backend, self.config.field_backend)
+        ta = TaskAggregator(
+            task,
+            self.config.vdaf_backend,
+            self.config.field_backend,
+            poplar_backend=self.config.poplar_backend,
+        )
         self._task_cache[key] = (_t.monotonic() + self.config.task_cache_ttl, ta)
         return ta
 
@@ -1386,17 +1399,77 @@ class Aggregator:
         ):
             job = job.with_state(AggregationJobState.FINISHED)
 
+        # Helper-side deferred accumulation (ISSUE 13 satellite): CONTINUE
+        # rounds of agg-param VDAFs (Poplar1's round-1 finishers) route
+        # their per-request host vectors through the store's deferred
+        # buckets like the leader's — N continue requests at one tree
+        # level merge as ONE datastore vector write on the cadence drain,
+        # with the journal row written in this tx as the exactly-once
+        # fence (replayable at aggregate-share time after a crash from
+        # the retained helper_prep_state).
+        journal_entries = None
+        touched: List[tuple] = []
+        orig_shares = dict(out_shares)
+        store = self._executor.accumulator if self._executor is not None else None
+        if (
+            store is not None
+            and getattr(store.config, "deferred", False)
+            and getattr(ta.vdaf, "REQUIRES_AGG_PARAM", False)
+            and out_shares
+        ):
+            (
+                journal_entries,
+                touched,
+                new_ras,
+            ) = await self._commit_helper_deferred_host_shares(
+                ta, job, by_id, new_ras, out_shares
+            )
+
+        from ..executor.accumulator import StaleAccumulatorDelta
+
         writer = AggregationJobWriter(
             task,
             ta.vdaf,
             batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
             initial_write=False,
             backend=ta.backend,
+            journal_entries=journal_entries,
         )
         writer.put(job, new_ras, out_shares)
-        failures = await self.datastore.run_tx_async(
-            "agg_cont_write", lambda tx: writer.write(tx)
-        )
+        try:
+            failures = await self.datastore.run_tx_async(
+                "agg_cont_write", lambda tx: writer.write(tx)
+            )
+        except StaleAccumulatorDelta:
+            # a journaled report failed in-tx (its batch was collected
+            # under our feet): discard the touched buckets — their journal
+            # rows never committed (journal_entries cleared so the metric
+            # and the drain scan below never see phantom rows) — and
+            # retry once merging this request's vectors directly (no
+            # deferral; still exactly-once)
+            self._discard_helper_deferred(touched)
+            journal_entries = None
+            out_shares = orig_shares
+            writer = AggregationJobWriter(
+                task,
+                ta.vdaf,
+                batch_aggregation_shard_count=self.config.batch_aggregation_shard_count,
+                initial_write=False,
+                backend=ta.backend,
+            )
+            writer.put(job, new_ras, out_shares)
+            failures = await self.datastore.run_tx_async(
+                "agg_cont_write", lambda tx: writer.write(tx)
+            )
+        except BaseException:
+            self._discard_helper_deferred(touched)
+            raise
+        if journal_entries:
+            from ..core.metrics import GLOBAL_METRICS
+
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.accumulator_journal_entries.inc(len(journal_entries))
+            await self._maybe_drain_helper_due(ta)
         if failures:
             resps = [
                 PrepareResp(r.report_id, PrepareStepResult.reject(failures[r.report_id.data]))
@@ -1405,6 +1478,358 @@ class Aggregator:
                 for r in resps
             ]
         return AggregationJobResp(resps)
+
+    async def _commit_helper_deferred_host_shares(
+        self, ta: TaskAggregator, job, by_id, new_ras, out_shares
+    ):
+        """The helper twin of the driver's ``_commit_deferred_host_shares``:
+        per batch bucket, sum this request's finished vectors into the
+        store's agg-param-keyed HELPER host mirror (commit_host_rows) and
+        hand the writer journal entries instead of shares.  Journaled
+        rows' out_shares become sentinel refs so the writer defers them;
+        their FINISHED report aggregations RETAIN ``helper_prep_state``
+        (the round-1 prepare state whose ``y_flat`` IS the vector) as the
+        crash-replay window.  A store failure leaves this request's
+        vectors merging directly — exactly-once either way.  Returns
+        (journal_entries, touched bucket keys, new_ras)."""
+        import dataclasses
+
+        from ..datastore import BatchAggregationState
+        from ..datastore.query_type import strategy_for
+        from ..executor.accumulator import ResidentRef
+        from ..vdaf.backend import vdaf_shape_key
+
+        store = self._executor.accumulator
+        task = ta.task
+        vdaf = ta.vdaf
+        strategy = strategy_for(task)
+        shape_key = vdaf_shape_key(vdaf)
+        field = vdaf.field_for_agg_param(
+            vdaf.decode_agg_param(job.aggregation_parameter)
+        )
+        ra_by_rid = {ra.report_id.data: ra for ra in new_ras}
+
+        def ident_for(ra):
+            if job.partial_batch_identifier is not None:
+                return job.partial_batch_identifier.get_encoded()
+            return strategy.to_batch_identifier(task, ra.time)
+
+        by_ident: Dict[bytes, List[bytes]] = {}
+        for rid in out_shares:
+            by_ident.setdefault(ident_for(ra_by_rid[rid]), []).append(rid)
+
+        # Pre-tx collected check (same rationale as the leader's):
+        # journaling a report the writer tx will fail guarantees a
+        # StaleAccumulatorDelta abort on every retry.
+        def check(tx):
+            out = set()
+            for ident in by_ident:
+                bas = tx.get_batch_aggregations_for_batch(
+                    task.task_id, ident, job.aggregation_parameter
+                )
+                if any(
+                    ba.state != BatchAggregationState.AGGREGATING for ba in bas
+                ):
+                    out.add(ident)
+            return out
+
+        collected = await self.datastore.run_tx_async(
+            "helper_accum_collected_check", check
+        )
+
+        loop = asyncio.get_running_loop()
+        journal_entries: Dict[bytes, frozenset] = {}
+        touched: List[tuple] = []
+        for ident, rids in by_ident.items():
+            if ident in collected:
+                continue  # writer fails these in-tx; vectors merge nowhere
+            bucket_key = (
+                "helper",
+                task.task_id.data,
+                shape_key,
+                ident,
+                job.aggregation_parameter,
+            )
+            vectors = [out_shares[rid] for rid in rids]
+
+            def commit(bucket_key=bucket_key, vectors=vectors, rids=rids):
+                store.commit_host_rows(
+                    bucket_key,
+                    field,
+                    vectors,
+                    job_token=job.aggregation_job_id.data,
+                    report_ids=rids,
+                )
+
+            try:
+                await loop.run_in_executor(None, commit)
+            except Exception as e:
+                logger.warning(
+                    "helper deferred accumulator commit failed for bucket "
+                    "%r; merging this request's %d vector(s) directly: %s",
+                    bucket_key,
+                    len(rids),
+                    e,
+                )
+                continue
+            journal_entries[ident] = frozenset(rids)
+            touched.append(bucket_key)
+            for i, rid in enumerate(rids):
+                out_shares[rid] = ResidentRef(-1, i)
+
+        if journal_entries:
+            # replay window: journaled FINISHED rows keep the stored
+            # round-1 prepare state (its y_flat is exactly the deferred
+            # vector) — the aggregate-share-time replay decodes it after
+            # a crash loses the store's host mirror
+            journaled = set().union(*journal_entries.values())
+            new_ras = [
+                dataclasses.replace(
+                    ra, helper_prep_state=by_id[ra.report_id.data].helper_prep_state
+                )
+                if ra.report_id.data in journaled
+                and ra.state == ReportAggregationState.FINISHED
+                else ra
+                for ra in new_ras
+            ]
+        return journal_entries or None, touched, new_ras
+
+    def _discard_helper_deferred(self, touched) -> None:
+        """Drop helper deferred buckets whose journal rows never committed
+        (failed tx); OTHER requests' persisted journal rows stay
+        replayable at aggregate-share time."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None or not touched:
+            return
+        for key in touched:
+            journal = store.discard(key)
+            if journal:
+                logger.warning(
+                    "discarded helper bucket %r with %d journaled "
+                    "request(s) after a failed tx; persisted journal rows "
+                    "will replay at aggregate-share time",
+                    key,
+                    len(journal),
+                )
+
+    async def _maybe_drain_helper_due(self, ta: TaskAggregator) -> int:
+        """Cadence scan for the HELPER's deferred buckets (the helper has
+        no driver loop — drains ride request completions and the
+        aggregate-share barrier): merge every due bucket's vector into
+        batch_aggregations, consuming its journal rows exactly once."""
+        store = self._executor.accumulator if self._executor is not None else None
+        if store is None or not getattr(store.config, "deferred", False):
+            return 0
+        task_id = ta.task.task_id
+        keys = [
+            k
+            for k in store.due_buckets(store.config.drain_interval_s)
+            if len(k) == 5 and k[0] == "helper" and k[1] == task_id.data
+        ]
+        for key in keys:
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._drain_helper_bucket, ta, key
+                )
+            except Exception:
+                logger.exception("helper deferred drain failed for %r", key)
+        return len(keys)
+
+    def _drain_helper_bucket(self, ta: TaskAggregator, key: tuple) -> None:
+        from ..executor.accumulator import AccumulatorError
+
+        vdaf = ta.vdaf
+        _role, _task_id_b, _shape, ident, param = key
+        field = vdaf.field_for_agg_param(vdaf.decode_agg_param(param))
+        try:
+            out = self._executor.accumulator.drain_with_journal(key, field)
+        except AccumulatorError as e:
+            journal = self._executor.accumulator.discard(key)
+            logger.warning(
+                "helper deferred drain failed for bucket %r; %d journal "
+                "row(s) stay persisted for the aggregate-share replay: %s",
+                key,
+                len(journal),
+                e,
+            )
+            return
+        if out is None:
+            return
+        vector, journal = out
+        self._merge_helper_drained(ta, field, ident, param, vector, journal)
+
+    def _merge_helper_drained(
+        self, ta: TaskAggregator, field, ident, param, vector, journal
+    ) -> None:
+        """Merge one drained helper vector, consuming its journal rows in
+        the same tx (exactly-once: the DELETE decides the winner against
+        a concurrent aggregate-share replay)."""
+        from ..messages import AggregationJobId
+        from .aggregation_job_writer import merge_share_delta
+
+        class _RowMissing(Exception):
+            pass
+
+        task = ta.task
+
+        def tx_fn(tx):
+            for job_token, _rids in journal:
+                if not tx.delete_accumulator_journal_entry(
+                    task.task_id, ident, param, AggregationJobId(job_token)
+                ):
+                    raise _RowMissing(job_token)
+            merge_share_delta(
+                tx,
+                task,
+                field,
+                ident,
+                param,
+                vector,
+                shard_count=self.config.batch_aggregation_shard_count,
+            )
+
+        try:
+            self.datastore.run_tx("helper_accumulator_drain", tx_fn)
+        except _RowMissing as e:
+            logger.warning(
+                "helper bucket (%r, %r) journal row %s already consumed "
+                "(replayed); dropping the drained vector",
+                ident,
+                param,
+                e,
+            )
+            return
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.accumulator_journal_consumed.labels(path="drain").inc(
+                len(journal)
+            )
+
+    async def _flush_helper_deferred(self, ta: TaskAggregator, ident: bytes, param: bytes) -> None:
+        """The aggregate-share barrier: before the helper computes a
+        batch's share, (1) drain every resident deferred bucket for this
+        task (regardless of age — collection is the deadline), then (2)
+        replay any journal rows still outstanding for the collection's
+        batches (a crashed process's buckets died with it; the rows name
+        FINISHED reports whose retained ``helper_prep_state`` carries the
+        vector).  Mirrors the leader's collection-time replay fence."""
+        store = self._executor.accumulator if self._executor is not None else None
+        task = ta.task
+        if store is not None:
+            keys = [
+                k
+                for k in store.bucket_keys()
+                if len(k) == 5 and k[0] == "helper" and k[1] == task.task_id.data
+            ]
+            for key in keys:
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._drain_helper_bucket, ta, key
+                    )
+                except Exception:
+                    logger.exception("helper pre-share drain failed for %r", key)
+        # journal rows orphaned by a crash (or lost buckets): replay
+        if not await self.datastore.run_tx_async(
+            "helper_journal_probe",
+            lambda tx: tx.count_accumulator_journal_entries(task.task_id),
+        ):
+            return
+        strategy = strategy_for(task)
+
+        def load(tx):
+            entries = []
+            for bident in strategy.batch_identifiers_for_collection_identifier(
+                task, ident
+            ):
+                entries.extend(
+                    e
+                    for e in tx.get_accumulator_journal_entries(task.task_id, bident)
+                    if e.aggregation_parameter == param
+                )
+            return entries
+
+        entries = await self.datastore.run_tx_async("helper_journal_scan", load)
+        for entry in entries:
+            await self._replay_helper_journal_entry(ta, entry)
+
+    async def _replay_helper_journal_entry(self, ta: TaskAggregator, entry) -> None:
+        """Re-derive one orphaned helper journal row's vector from the
+        retained round-1 prepare states and merge it, deleting the row in
+        the same tx (exactly-once against any concurrent drain)."""
+        from ..core import costs
+
+        vdaf = ta.vdaf
+        ras = await self.datastore.run_tx_async(
+            "helper_replay_load_ras",
+            lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                ta.task.task_id, entry.aggregation_job_id
+            ),
+        )
+        by_rid = {ra.report_id.data: ra for ra in ras}
+        field = vdaf.field_for_agg_param(
+            vdaf.decode_agg_param(entry.aggregation_parameter)
+        )
+
+        def recompute():
+            total = None
+            for rid in entry.report_ids:
+                ra = by_rid.get(rid)
+                if ra is None or ra.helper_prep_state is None:
+                    raise RuntimeError(
+                        f"helper journal entry for job {entry.aggregation_job_id}"
+                        f" names report {rid.hex()} without a retained state"
+                    )
+                state = vdaf.ping_pong_decode_state(ra.helper_prep_state)
+                y = list(state.y_flat)
+                total = y if total is None else field.vec_add(total, y)
+            return total
+
+        total = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: costs.run_in_task_scope(ta.task.task_id.data, recompute),
+        )
+        self._merge_replayed_helper_entry(ta, field, entry, total)
+
+    def _merge_replayed_helper_entry(self, ta, field, entry, total) -> None:
+        from .aggregation_job_writer import merge_share_delta
+
+        task = ta.task
+
+        def tx_fn(tx):
+            if not tx.delete_accumulator_journal_entry(
+                task.task_id,
+                entry.batch_identifier,
+                entry.aggregation_parameter,
+                entry.aggregation_job_id,
+            ):
+                return False
+            if total is not None:
+                merge_share_delta(
+                    tx,
+                    task,
+                    field,
+                    entry.batch_identifier,
+                    entry.aggregation_parameter,
+                    total,
+                    shard_count=self.config.batch_aggregation_shard_count,
+                )
+            return True
+
+        merged = self.datastore.run_tx("helper_journal_replay", tx_fn)
+        if merged:
+            logger.warning(
+                "helper oracle-replayed %d report(s) of job %s from the "
+                "datastore journal (owner never drained)",
+                len(entry.report_ids),
+                entry.aggregation_job_id,
+            )
+            from ..core.metrics import GLOBAL_METRICS
+
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.accumulator_journal_consumed.labels(
+                    path="replay"
+                ).inc()
 
     def _helper_continue_batch(self, ta: TaskAggregator, job, req, by_id):
         """Step WaitingHelper reports with the leader's continue messages."""
@@ -1751,6 +2176,22 @@ class Aggregator:
         req = AggregateShareReq.get_decoded(body, ta.query_class)
         strategy = strategy_for(task)
         ident = req.batch_selector.batch_identifier.get_encoded()
+
+        # Deferred-drain barrier (ISSUE 13 satellite): resident helper
+        # buckets drain and orphaned journal rows replay BEFORE the share
+        # is computed — the helper twin of the leader's collection-time
+        # journal fence.
+        if getattr(ta.vdaf, "REQUIRES_AGG_PARAM", False):
+            try:
+                await self._flush_helper_deferred(
+                    ta, ident, req.aggregation_parameter
+                )
+            except Exception:
+                # a failed drain leaves rows journaled; the share below
+                # would under-count — fail the request loudly, the leader
+                # retries
+                logger.exception("helper deferred flush failed")
+                raise AggregatorError("deferred share flush failed")
 
         def tx_fn(tx):
             cached = tx.get_aggregate_share_job(

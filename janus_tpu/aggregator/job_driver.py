@@ -124,6 +124,39 @@ async def partition_excused(datastore, task_id, retry_max_delay_s: float) -> boo
     )
 
 
+def suspect_task_ids(tx, job_type: str = "job") -> Optional[List[bytes]]:
+    """Task ids whose peer is currently SUSPECT — the peer-health-aware
+    acquisition filter (runs INSIDE the acquirer's transaction, sharing
+    it).  During a partition, a suspect peer's jobs used to be acquired
+    and immediately released by the step's peer gate, burning one lease
+    tx round trip per job per discovery poll; filtering them at the
+    acquire query spares that churn while the dwell lasts.  PROBING peers
+    are deliberately NOT filtered: a probing peer's job delivery IS the
+    half-open probe that can heal the partition.  The no-partition common
+    case pays one in-memory check and touches nothing."""
+    from ..core import peer_health
+    from ..core.peer_health import PEER_SUSPECT
+
+    tracker = peer_health.tracker()
+    if not tracker.partition_signal(0.0):
+        return None
+    ids = [
+        task_id
+        for task_id, url in tx.get_task_peer_index()
+        # strictly SUSPECT (tracker.is_suspect would also match probing)
+        if url and tracker.state(url) == PEER_SUSPECT
+    ]
+    if not ids:
+        return None
+    from ..core.metrics import GLOBAL_METRICS
+
+    if GLOBAL_METRICS.registry is not None:
+        GLOBAL_METRICS.job_acquisition_suspect_filtered.labels(
+            job_type=job_type
+        ).inc()
+    return ids
+
+
 def helper_request_deadline(lease, datastore):
     """Monotonic deadline for one peer exchange, shared by BOTH job
     drivers: 80% of the remaining lease (floor 1s), so a blackholed peer

@@ -340,6 +340,12 @@ class AggregatorConfig:
     #: runs on the matrix units).  Bit-exact either way — the A/B toggle
     #: for ops/field_jax.py's MXU contraction layer.
     field_backend: str = "vpu"
+    #: Poplar1 AES-walk backend: "host" (cryptography/AES-NI, numpy
+    #: soft-AES fallback — the legacy path) or "jax" (the jitted kernel in
+    #: ops/aes_jax.py: table AES over u8 byte planes, the IDPF frontier
+    #: and sketch vectors device-resident).  Bit-exact either way — the
+    #: A/B toggle for the device-resident IDPF walk.
+    poplar_backend: str = "host"
     #: Aggregation-job size for agg-param VDAFs (Poplar1), whose jobs are
     #: created by the collection request rather than the periodic creator.
     #: Small values cost nothing at prepare time with the executor on —
@@ -377,6 +383,9 @@ class JobDriverBinaryConfig:
     #: Device field-arithmetic layout ("vpu" | "mxu") — see
     #: AggregatorConfig.field_backend.
     field_backend: str = "vpu"
+    #: Poplar1 AES-walk backend ("host" | "jax") — see
+    #: AggregatorConfig.poplar_backend.
+    poplar_backend: str = "host"
     #: Continuous cross-job batching for device prepare (default off).
     device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
     #: While a shape's executable is still warming (background compile),

@@ -339,6 +339,7 @@ def run_aggregator(config_path: Optional[str]) -> None:
             task_counter_shard_count=cfg.task_counter_shard_count,
             vdaf_backend=cfg.vdaf_backend,
             field_backend=cfg.field_backend,
+            poplar_backend=cfg.poplar_backend,
             max_agg_param_job_size=cfg.max_agg_param_job_size,
             device_executor=cfg.device_executor.to_executor_config()
             if cfg.device_executor.enabled
@@ -511,6 +512,7 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
                 retry_max_delay_s=cfg.job_driver.retry_max_delay_s,
                 vdaf_backend=cfg.vdaf_backend,
                 field_backend=cfg.field_backend,
+                poplar_backend=cfg.poplar_backend,
                 device_executor=exec_cfg,
                 warmup_wait_s=cfg.warmup_wait_s,
                 http_retry=HttpRetryPolicy(
@@ -566,9 +568,17 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             ).start()
 
         async def acquirer(duration, limit):
+            from ..aggregator.job_driver import suspect_task_ids
+
             return await datastore.run_tx_async(
                 "acquire_agg",
-                lambda tx: tx.acquire_incomplete_aggregation_jobs(duration, limit),
+                # suspect-peer tasks filter at the query (task -> peer
+                # index, same tx) instead of acquire-then-release churn
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    duration,
+                    limit,
+                    exclude_task_ids=suspect_task_ids(tx, "aggregation"),
+                ),
             )
 
         async def reaper():
@@ -603,9 +613,15 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
         )
 
         async def acquirer(duration, limit):
+            from ..aggregator.job_driver import suspect_task_ids
+
             return await datastore.run_tx_async(
                 "acquire_coll",
-                lambda tx: tx.acquire_incomplete_collection_jobs(duration, limit),
+                lambda tx: tx.acquire_incomplete_collection_jobs(
+                    duration,
+                    limit,
+                    exclude_task_ids=suspect_task_ids(tx, "collection"),
+                ),
             )
 
         async def reaper():

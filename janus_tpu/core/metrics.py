@@ -477,6 +477,34 @@ class Metrics:
             "LRU/memory-pressure evictions of resident accumulator state",
             registry=self.registry,
         )
+        # Device-resident IDPF (ops/poplar1_batch.py): which backend walks
+        # the Poplar1 AES tree (host AES-NI/soft-AES vs the jax kernel),
+        # and how many device-walked rows had their sketch y vectors
+        # materialized back to host — the device-resident path keeps the
+        # readback at 0 (states carry ResidentRefs; drains read ONE vector
+        # per level bucket).
+        self.poplar_walk_rows = Counter(
+            "janus_poplar_walk_rows_total",
+            "Poplar1 IDPF tree-walk rows by AES backend (host|jax)",
+            ["backend"],
+            registry=self.registry,
+        )
+        self.poplar_sketch_readback_rows = Counter(
+            "janus_poplar_sketch_readback_rows_total",
+            "Device-walked Poplar1 rows whose sketch y vectors were read "
+            "back to host (0 on the device-resident path)",
+            registry=self.registry,
+        )
+        # Peer-health-aware acquisition (job_driver.suspect_task_ids): jobs
+        # of suspect-peer tasks are filtered at the acquire query instead
+        # of acquired-then-released, sparing tx churn during partitions.
+        self.job_acquisition_suspect_filtered = Counter(
+            "janus_job_acquisition_suspect_filtered_total",
+            "Job acquisition passes that excluded suspect-peer tasks at "
+            "the query, by job type",
+            ["job_type"],
+            registry=self.registry,
+        )
         # Crash recovery: leases that expired WITHOUT release are holders
         # that died or wedged — the reaper (job_driver.py) clears them so
         # redelivery is prompt and the death is visible on a dashboard.

@@ -892,36 +892,68 @@ class Transaction:
             ) in rows
         ]
 
+    def get_task_peer_index(self) -> List[Tuple[bytes, str]]:
+        """(task_id bytes, peer aggregator endpoint) for every task — the
+        task -> peer index behind peer-health-aware job acquisition
+        (job_driver.suspect_task_ids): tasks of a suspect peer are
+        filtered at the acquire query instead of acquired-then-released."""
+        return [
+            (r[0], r[1])
+            for r in self.conn.execute(
+                "SELECT task_id, peer_aggregator_endpoint FROM tasks"
+            ).fetchall()
+        ]
+
+    def _task_exclusion_clause(self, exclude_task_ids):
+        """(SQL fragment, params) excluding jobs of the named tasks from an
+        acquisition pick.  Empty/None excludes nothing."""
+        ids = list(exclude_task_ids or ())
+        if not ids:
+            return "", []
+        marks = ",".join("?" * len(ids))
+        return (
+            f" AND task_id NOT IN (SELECT id FROM tasks WHERE task_id IN ({marks}))",
+            ids,
+        )
+
     def acquire_incomplete_aggregation_jobs(
-        self, lease_duration: Duration, limit: int
+        self,
+        lease_duration: Duration,
+        limit: int,
+        exclude_task_ids: Optional[Sequence[bytes]] = None,
     ) -> List[Lease]:
         """Lease InProgress jobs whose lease expired — the reference's
         ``FOR UPDATE … SKIP LOCKED`` loop (datastore.rs:1916-1985), expressed
-        as one atomic UPDATE under SQLite's single-writer transaction."""
+        as one atomic UPDATE under SQLite's single-writer transaction.
+        ``exclude_task_ids`` filters suspect-peer tasks AT THE QUERY
+        (peer-health-aware acquisition): their jobs stay acquirable by
+        replicas that still reach the peer, without this replica paying an
+        acquire-then-release tx round trip per job per poll."""
         now = self._now_s()
         expiry = now + lease_duration.seconds
         token = secrets.token_bytes(16)
+        excl_sql, excl_params = self._task_exclusion_clause(exclude_task_ids)
         if self.ds.backend.supports_returning:
             rows = self.conn.execute(
-                """UPDATE aggregation_jobs
+                f"""UPDATE aggregation_jobs
                    SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
                        updated_at = ?
                    WHERE id IN (
                        SELECT id FROM aggregation_jobs
-                       WHERE state = 'InProgress' AND lease_expiry <= ?
+                       WHERE state = 'InProgress' AND lease_expiry <= ?{excl_sql}
                        ORDER BY id LIMIT ? /*skip-locked*/)
                    RETURNING task_id, aggregation_job_id, lease_attempts,
                              trace_id, created_at""",
-                (expiry, token, now, now, limit),
+                (expiry, token, now, now, *excl_params, limit),
             ).fetchall()
         else:
             picked = self.conn.execute(
-                """SELECT id, task_id, aggregation_job_id, lease_attempts,
+                f"""SELECT id, task_id, aggregation_job_id, lease_attempts,
                           trace_id, created_at
                    FROM aggregation_jobs
-                   WHERE state = 'InProgress' AND lease_expiry <= ?
+                   WHERE state = 'InProgress' AND lease_expiry <= ?{excl_sql}
                    ORDER BY id LIMIT ?""",
-                (now, limit),
+                (now, *excl_params, limit),
             ).fetchall()
             self.conn.executemany(
                 """UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = ?,
@@ -1526,33 +1558,38 @@ class Transaction:
         return row[0]
 
     def acquire_incomplete_collection_jobs(
-        self, lease_duration: Duration, limit: int
+        self,
+        lease_duration: Duration,
+        limit: int,
+        exclude_task_ids: Optional[Sequence[bytes]] = None,
     ) -> List[Lease]:
-        """reference: datastore.rs:3295"""
+        """reference: datastore.rs:3295.  ``exclude_task_ids``: the same
+        suspect-peer acquisition filter as the aggregation form."""
         now = self._now_s()
         expiry = now + lease_duration.seconds
         token = secrets.token_bytes(16)
+        excl_sql, excl_params = self._task_exclusion_clause(exclude_task_ids)
         if self.ds.backend.supports_returning:
             rows = self.conn.execute(
-                """UPDATE collection_jobs
+                f"""UPDATE collection_jobs
                    SET lease_expiry = ?, lease_token = ?, lease_attempts = lease_attempts + 1,
                        updated_at = ?
                    WHERE id IN (
                        SELECT id FROM collection_jobs
-                       WHERE state = 'Start' AND lease_expiry <= ?
+                       WHERE state = 'Start' AND lease_expiry <= ?{excl_sql}
                        ORDER BY id LIMIT ? /*skip-locked*/)
                    RETURNING task_id, collection_job_id, lease_attempts, step_attempts,
                              trace_id, created_at""",
-                (expiry, token, now, now, limit),
+                (expiry, token, now, now, *excl_params, limit),
             ).fetchall()
         else:
             picked = self.conn.execute(
-                """SELECT id, task_id, collection_job_id, lease_attempts, step_attempts,
+                f"""SELECT id, task_id, collection_job_id, lease_attempts, step_attempts,
                           trace_id, created_at
                    FROM collection_jobs
-                   WHERE state = 'Start' AND lease_expiry <= ?
+                   WHERE state = 'Start' AND lease_expiry <= ?{excl_sql}
                    ORDER BY id LIMIT ?""",
-                (now, limit),
+                (now, *excl_params, limit),
             ).fetchall()
             self.conn.executemany(
                 """UPDATE collection_jobs SET lease_expiry = ?, lease_token = ?,

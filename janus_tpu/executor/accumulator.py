@@ -272,6 +272,12 @@ class DeviceAccumulatorStore:
             if bucket is None:
                 bucket = _Bucket(bucket_key, mint)
                 self._buckets[bucket_key] = bucket
+            elif bucket.backend is None:
+                # the bucket was opened by a host-vector commit
+                # (commit_host_rows — e.g. a Poplar1 oracle-fallback row at
+                # the same level); adopt the minting backend so the drain
+                # can read the device buffer this commit is about to create
+                bucket.backend = mint
             if bucket.poisoned:
                 raise AccumulatorUnavailable(
                     f"bucket {bucket_key!r} poisoned by an earlier launch failure"
@@ -300,6 +306,11 @@ class DeviceAccumulatorStore:
                 ) from e
             # journal under the SAME lock as the buffer update, so a
             # drain's snapshot can never see the delta without its entry
+            # agg-param planes (Poplar1 sketch matrices) carry their drain
+            # field explicitly — the eviction/drain_all field resolution
+            # for backends with no vdaf.flp face
+            if bucket.field is None:
+                bucket.field = getattr(mint, "accum_field", None)
             with self._lock:
                 if bucket.buffer_nbytes == 0:
                     bucket.buffer_nbytes = self._buffer_nbytes(mint)
@@ -365,6 +376,9 @@ class DeviceAccumulatorStore:
 
     @staticmethod
     def _buffer_nbytes(backend) -> int:
+        explicit = getattr(backend, "accum_buffer_nbytes", None)
+        if explicit:
+            return int(explicit)
         try:
             flp = backend.vdaf.flp
             # mesh backends keep one (OUT, n) partial-sum row PER DEVICE
@@ -536,7 +550,11 @@ class DeviceAccumulatorStore:
                 t0 = time.monotonic()
                 drained = victim.backend.read_accum_buffer(victim.buffer)
                 self._attribute_drain(victim.key, time.monotonic() - t0)
-                field = victim.backend.vdaf.flp.field
+                field = (
+                    victim.field
+                    or getattr(victim.backend, "accum_field", None)
+                    or victim.backend.vdaf.flp.field
+                )
                 victim.spilled_host = (
                     drained
                     if victim.spilled_host is None

@@ -1115,34 +1115,50 @@ class DeviceExecutor:
                     # multi-request walk — submissions sharing an agg param
                     # (different jobs, one level) run as ONE bulk-AES walk
                     # + ONE device sketch with per-row verify keys.  The
-                    # host-AES half dominates, so the whole flush runs on
-                    # the launch thread like combine (no stage/launch split
-                    # to double-buffer) — and, unlike prep_init (whose
-                    # staged padding already covers expired rows), the walk
-                    # runs ONLY the still-live submissions: paying bulk AES
-                    # for deadline-rejected rows would amplify exactly the
-                    # overload that expired them.  Results are re-expanded
-                    # to live-alignment ([] placeholders) for the shared
-                    # resolution loop below.
+                    # walk (host AES or the jax kernel) is the STAGE half
+                    # and the sketch launch the LAUNCH half, on the same
+                    # stage/launch threads as prep_init — flush k+1's tree
+                    # walk overlaps flush k's sketch launch (the ISSUE 13
+                    # double buffering; expired-at-launch rows now pay the
+                    # walk, the price of the overlap — their refs release
+                    # in the resolution loop).  Device-resident sketches:
+                    # when every submission opted in and the backend's walk
+                    # is jax, the flush's y matrices are adopted by the
+                    # accumulator store and states carry ResidentRefs.
+                    if (
+                        self.accumulator is not None
+                        and all(s.retain for s in live)
+                        and getattr(
+                            bucket.backend, "supports_resident_sketch", False
+                        )
+                    ):
+                        retain = self.accumulator
+                    t_stage = time.monotonic()
+                    staged = await loop.run_in_executor(
+                        stage_pool,
+                        lambda: bucket.backend.stage_poplar_init_multi(
+                            bucket.agg_id, [s.payload for s in live]
+                        ),
+                    )
+                    t_launch = time.monotonic()
+                    stage_s = t_launch - t_stage
+
                     def launch():
                         still = self._reject_expired(bucket, live)
                         if not still:
                             return None, []
-                        still_ids = {id(s) for s in still}
-                        outs_still = iter(
-                            bucket.backend.prep_init_multi_poplar(
-                                bucket.agg_id, [s.payload for s in still]
+                        if retain is not None:
+                            return (
+                                bucket.backend.launch_poplar_init_multi(
+                                    staged, retain_store=retain
+                                ),
+                                still,
                             )
-                        )
                         return (
-                            [
-                                next(outs_still) if id(s) in still_ids else []
-                                for s in live
-                            ],
+                            bucket.backend.launch_poplar_init_multi(staged),
                             still,
                         )
 
-                    t_launch = time.monotonic()
                     outs, still = await loop.run_in_executor(launch_pool, launch)
                 else:  # KIND_COMBINE: concatenate rows, launch once, slice
                     concat = [row for s in live for row in s.payload]
@@ -1287,7 +1303,8 @@ class DeviceExecutor:
     @staticmethod
     def _release_dropped_refs(store, outcomes) -> None:
         """Release the ResidentRefs inside a dropped submission's prepare
-        outcomes (each is (state, share) or a VdafError)."""
+        outcomes (each is (state, share) or a VdafError).  Prio3 states
+        carry the ref as ``out_share``; Poplar1 states as ``y_flat``."""
         from .accumulator import ResidentRef
 
         refs = []
@@ -1295,6 +1312,8 @@ class DeviceExecutor:
             if not isinstance(o, tuple) or not o:
                 continue
             ref = getattr(o[0], "out_share", None)
+            if not isinstance(ref, ResidentRef):
+                ref = getattr(o[0], "y_flat", None)
             if isinstance(ref, ResidentRef):
                 refs.append(ref)
         if refs:

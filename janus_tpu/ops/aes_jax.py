@@ -1,0 +1,158 @@
+"""Fixed-key AES-128-ECB as a JAX kernel: the device half of the IDPF walk.
+
+The Poplar1 tree walk's bulk compute is AES-128 over (N, 16) u8 blocks
+(xof.XofFixedKeyAes128 hash_block, draft-irtf-cfrg-vdaf-08 §6.2.2).  The
+host path runs it on AES-NI (``cryptography``) or numpy table AES
+(utils/softaes.py); this module re-expresses the same table-based layout
+as jitted jnp ops — u8 byte planes, S-box/xtime gathers, ShiftRows as a
+static column permutation — so the walk can run where the sketch math
+already lives and the per-level frontier never round-trips host memory.
+The NTT-on-matrix-unit playbook (PAPERS.md: Low-Cost Multi-Precision
+Systolic Arrays; Hermes) is the blueprint: byte-granular modular
+arithmetic mapped onto wide integer units, exactly the limb-plane trick
+ops/field_jax.py uses for field matmuls.
+
+Two call forms:
+
+* :class:`JaxAes128Ecb` — duck-type of ``Cipher(AES(key), ECB()).encryptor()``
+  (``.update(bytes) -> bytes``), selected by the ``poplar_backend: jax``
+  seam in ``utils.softaes.aes128_ecb_encryptor``.
+* :func:`encrypt_blocks_multikey` — the batched walk form: per-REPORT
+  round keys (B, 11, 16) over (B, K, 16) blocks in ONE vmapped launch,
+  with K padded to a power of two so a whole tree walk compiles O(log)
+  executables instead of one per frontier width.
+
+Correctness is anchored to the FIPS-197 appendix C.1 vector at import
+time (like softaes: a table or layout bug must fail loudly, never walk a
+wrong tree) and fuzzed against softaes in tests/test_aes_jax.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# The host tables are generated from the GF(2^8) construction in softaes
+# (no transcription risk); this module only re-hosts them as device
+# constants.  _expand_key is reused verbatim — key schedules are tiny and
+# per-report, host territory.
+from ..utils.softaes import _MUL2, _MUL3, _SBOX, _SHIFT, _expand_key
+
+__all__ = [
+    "JaxAes128Ecb",
+    "encrypt_blocks_jax",
+    "encrypt_blocks_multikey",
+    "expand_keys",
+]
+
+_J_SBOX = jnp.asarray(_SBOX)
+_J_MUL2 = jnp.asarray(_MUL2)
+_J_MUL3 = jnp.asarray(_MUL3)
+#: ShiftRows as a flat gather over the 16-byte state (softaes layout:
+#: byte i sits at (row = i % 4, col = i // 4)).
+_J_SHIFT = jnp.asarray(np.asarray(_SHIFT, dtype=np.int32))
+
+
+def _sub_shift(s):
+    """SubBytes + ShiftRows on (..., 16) u8 state."""
+    return _J_SBOX[s][..., _J_SHIFT]
+
+
+def _mix_columns(s):
+    """MixColumns on (..., 16) u8 state, reshaped (..., 4 cols, 4 rows)."""
+    a = s.reshape(s.shape[:-1] + (4, 4))
+    a0, a1, a2, a3 = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    out = jnp.stack(
+        [
+            _J_MUL2[a0] ^ _J_MUL3[a1] ^ a2 ^ a3,
+            a0 ^ _J_MUL2[a1] ^ _J_MUL3[a2] ^ a3,
+            a0 ^ a1 ^ _J_MUL2[a2] ^ _J_MUL3[a3],
+            _J_MUL3[a0] ^ a1 ^ a2 ^ _J_MUL2[a3],
+        ],
+        axis=-1,
+    )
+    return out.reshape(s.shape)
+
+
+def _encrypt_core(round_keys, blocks):
+    """AES-128 over (..., 16) u8 blocks with (11, 16) u8 round keys.
+
+    The round loop is unrolled (10 rounds is a fixed, tiny depth) so the
+    whole cipher fuses into one executable of table gathers + XORs.
+    """
+    s = blocks ^ round_keys[0]
+    for rnd in range(1, 10):
+        s = _sub_shift(s)
+        s = _mix_columns(s) ^ round_keys[rnd]
+    return _sub_shift(s) ^ round_keys[10]
+
+
+@jax.jit
+def encrypt_blocks_jax(round_keys, blocks):
+    """Single-key form: (11, 16) u8 round keys, (N, 16) u8 blocks."""
+    return _encrypt_core(round_keys, blocks)
+
+
+@jax.jit
+def encrypt_blocks_multikey(round_keys, blocks):
+    """Per-report form: (B, 11, 16) round keys over (B, K, 16) blocks —
+    the IDPF walk's shape (two key schedules per report, every frontier
+    node of every report in one launch)."""
+    return jax.vmap(_encrypt_core)(round_keys, blocks)
+
+
+def expand_keys(keys) -> np.ndarray:
+    """(B, 11, 16) u8 round-key schedules for a sequence of 16-byte keys."""
+    return np.stack([_expand_key(bytes(k)) for k in keys])
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def encrypt_blocks_multikey_padded(round_keys, blocks):
+    """The walk's dispatch face: pads the block axis (and the batch axis)
+    to powers of two before the jitted multikey launch, so a level-by-level
+    walk with growing frontiers compiles O(log) executables, then slices
+    the result back.  Accepts numpy or jax arrays; returns a DEVICE array
+    (callers keep the frontier resident across levels)."""
+    rks = jnp.asarray(round_keys, dtype=jnp.uint8)
+    blk = jnp.asarray(blocks, dtype=jnp.uint8)
+    b, k = blk.shape[0], blk.shape[1]
+    pb, pk = _next_pow2(b), _next_pow2(k)
+    if pb != b or pk != k:
+        blk = jnp.pad(blk, ((0, pb - b), (0, pk - k), (0, 0)))
+        if pb != b:
+            rks = jnp.pad(rks, ((0, pb - b), (0, 0), (0, 0)))
+    out = encrypt_blocks_multikey(rks, blk)
+    return out[:b, :k, :]
+
+
+class JaxAes128Ecb:
+    """Duck-type of ``Cipher(AES(key), ECB()).encryptor()`` over the jitted
+    kernel: stateless ECB, ``update`` encrypts every 16-byte block.  The
+    per-call host<->device byte round trip makes this the API-compat face
+    only — the batched walk uses the array forms above directly."""
+
+    def __init__(self, key: bytes):
+        self._rk = jnp.asarray(_expand_key(key))
+
+    def update(self, data: bytes) -> bytes:
+        if len(data) % 16:
+            raise ValueError("ECB input must be a multiple of 16 bytes")
+        if not data:
+            return b""
+        blocks = np.frombuffer(data, dtype=np.uint8).reshape(-1, 16)
+        return np.asarray(encrypt_blocks_jax(self._rk, blocks)).tobytes()
+
+
+# -- import-time anchor (FIPS-197 C.1) ---------------------------------------
+_vec = JaxAes128Ecb(bytes(range(16))).update(
+    bytes.fromhex("00112233445566778899aabbccddeeff")
+)
+if _vec != bytes.fromhex("69c4e0d86a7b0430d8cdb78070b4c55a"):  # pragma: no cover
+    raise AssertionError("aes_jax self-test failed (table/layout corruption)")
+del _vec

@@ -25,6 +25,7 @@ accelerated dispatch as Prio3).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,22 +35,106 @@ from ..vdaf.prio3 import VdafError
 from ..xof import _fixed_key_aes128
 
 
-def _ciphers_for(nonces: Sequence[bytes]):
+def _ciphers_for(nonces: Sequence[bytes], backend: Optional[str] = None):
     """Per-report ECB encryptors for the two IDPF usages (extend/convert).
 
     The fixed key depends on (dst, nonce) only — two key schedules per
-    report for the WHOLE walk.  Encryptors resolve through the softaes
-    seam: `cryptography` (AES-NI) when present, numpy soft-AES otherwise."""
-    from ..utils.softaes import aes128_ecb_encryptor
+    report for the WHOLE walk.  ``backend`` is the ``poplar_backend:
+    jax|host`` seam (None resolves the process default): host encryptors
+    resolve through softaes (`cryptography`/AES-NI when present, numpy
+    soft-AES otherwise); "jax" returns ONE :class:`_JaxWalkKeys` carrying
+    the whole batch's round-key schedules for the jitted device kernel
+    (ops/aes_jax.py) — the walk then runs every report in one launch per
+    level instead of per-report ``update`` calls."""
+    from ..utils.softaes import aes128_ecb_encryptor, poplar_backend
 
+    if (backend or poplar_backend()) == "jax":
+        try:
+            return _JaxWalkKeys(nonces)
+        except Exception:  # pragma: no cover - jax-less host
+            import logging
+
+            logging.getLogger("janus_tpu.poplar1_batch").warning(
+                "poplar_backend=jax unavailable; walking on host", exc_info=True
+            )
     enc = []
     for nonce in nonces:
         pair = []
         for usage in (0, 1):
             key = _fixed_key_aes128(_dst(usage), nonce)
-            pair.append(aes128_ecb_encryptor(key))
+            pair.append(aes128_ecb_encryptor(key, backend="host"))
         enc.append(pair)
     return enc
+
+
+class _JaxWalkKeys:
+    """The batch's AES round-key schedules for the device walk: (B, 11, 16)
+    u8 per IDPF usage (0 = extend, 1 = convert).  Key derivation stays on
+    host (one cached TurboSHAKE per (usage, nonce), tiny); only the bulk
+    block cipher moves onto the device."""
+
+    def __init__(self, nonces: Sequence[bytes]):
+        from .aes_jax import expand_keys  # proves the jax kernel imports
+
+        self.rk = [
+            expand_keys([_fixed_key_aes128(_dst(usage), n) for n in nonces])
+            for usage in (0, 1)
+        ]
+
+
+@dataclass
+class _WalkResult:
+    """One agg-param group's staged walk: the per-(report, prefix) value
+    shares plus everything the sketch launch needs.  Under the jax walk
+    the values stay DEVICE-RESIDENT limbs (``y_limbs``, (B, P, n) u32) —
+    ``y_host`` is materialized lazily and counted as sketch readback."""
+
+    ok: np.ndarray  # (B,) — False: rejection-sampled value, redo on oracle
+    abc: List[Tuple[int, int, int]]
+    field: type
+    y_host: Optional[np.ndarray] = None  # (B, P) object ints (host walk)
+    y_limbs: Optional[object] = None  # (B, P, n) u32 device array (jax walk)
+    jf: Optional[object] = None
+
+
+@dataclass
+class _StagedPoplar:
+    """A staged poplar mega-batch: per-agg-param groups with their walks
+    done, awaiting the sketch launch (the executor's stage/launch seam —
+    walk k+1 overlaps sketch k on the stage/launch threads)."""
+
+    agg_id: int
+    n_requests: int
+    #: (agg_param, idxs, per-request row counts, vks, rows, _WalkResult|None)
+    groups: List[tuple]
+
+
+class _PoplarSketchPlane:
+    """The accumulator store's minting-backend face for device-resident
+    sketch vectors: per-(field, prefix-count) psum/readback launches over
+    (B, P, n) u32 limb matrices, mirroring TpuBackend.accumulate_rows /
+    read_accum_buffer for Prio3 out shares.  Level fencing is the bucket
+    key's job (it carries the encoded agg param), so one plane instance
+    serves every flush of its (field, P) shape."""
+
+    def __init__(self, jf, field: type, prefixes_len: int):
+        self.jf = jf
+        #: drain-time field for the store (accumulator._evict / drain_all)
+        self.accum_field = field
+        self.prefixes_len = prefixes_len
+        #: resident-byte accounting for the store's budget
+        self.accum_buffer_nbytes = prefixes_len * jf.n * 4
+
+    def accumulate_rows(self, buffer, matrix, mask):
+        import jax.numpy as jnp
+
+        m = jnp.asarray(matrix)  # host mirror after eviction device_puts back
+        sel = jnp.where(jnp.asarray(mask)[:, None, None], m, jnp.zeros_like(m))
+        delta = self.jf.sum(sel, axis=0)  # (P, n) canonical
+        return delta if buffer is None else self.jf.add(buffer, delta)
+
+    def read_accum_buffer(self, buffer) -> List[int]:
+        return self.jf.from_limbs(np.asarray(buffer))
 
 
 def _hash_blocks(enc, blocks: np.ndarray) -> np.ndarray:
@@ -79,12 +164,31 @@ def _xof_stream(enc, seeds: np.ndarray, nblocks: int) -> np.ndarray:
 
 
 class BatchedPoplar1:
-    """Level-synchronous batched IDPF eval + device sketch for one Poplar1."""
+    """Level-synchronous batched IDPF eval + device sketch for one Poplar1.
 
-    def __init__(self, poplar1):
+    ``poplar_backend`` selects the AES-walk backend ("host" | "jax"; None
+    resolves the process default from utils/softaes).  The jax walk keeps
+    the per-level frontier (seeds + control bits) and the final value
+    shares device-resident — the sketch consumes the (B, P, n) limb
+    matrix in place, and with a retain store attached the prepare states
+    carry ResidentRefs instead of host vectors (zero sketch readback)."""
+
+    def __init__(self, poplar1, poplar_backend: Optional[str] = None):
         self.vdaf = poplar1
         self.idpf = poplar1.idpf
         self._jf: Dict[type, object] = {}
+        self._planes: Dict[tuple, _PoplarSketchPlane] = {}
+        self._poplar_backend = poplar_backend
+        #: rows whose device-walked sketch vectors were materialized back
+        #: to host (bench/acceptance counter: the device-resident path
+        #: keeps this at 0 — states carry refs, drains read ONE vector)
+        self.sketch_readback_rows = 0
+
+    @property
+    def walk_backend(self) -> str:
+        from ..utils.softaes import poplar_backend
+
+        return self._poplar_backend or poplar_backend()
 
     def _jfield(self, field):
         jf = self._jf.get(field)
@@ -94,6 +198,14 @@ class BatchedPoplar1:
             jf = JField(field)
             self._jf[field] = jf
         return jf
+
+    def _plane(self, field, prefixes_len: int) -> _PoplarSketchPlane:
+        key = (field, prefixes_len)
+        plane = self._planes.get(key)
+        if plane is None:
+            plane = _PoplarSketchPlane(self._jfield(field), field, prefixes_len)
+            self._planes[key] = plane
+        return plane
 
     # -- batched IDPF eval ------------------------------------------------
     def eval_batch(
@@ -111,6 +223,20 @@ class BatchedPoplar1:
         node frontier at level l is the set of distinct l-bit ancestors of
         ``prefixes`` (shared-prefix memoization, same trick as the oracle's
         per-report memo, but across the batch)."""
+        enc = _ciphers_for(nonces, backend=self.walk_backend)
+        if isinstance(enc, _JaxWalkKeys):
+            y_limbs, ok, jf = self._eval_batch_dev(
+                agg_id, public_shares, keys, level, prefixes, enc
+            )
+            return self._materialize_y(y_limbs, jf), ok
+        return self._eval_batch_host(
+            agg_id, public_shares, keys, level, prefixes, nonces, enc
+        )
+
+    def _eval_batch_host(
+        self, agg_id, public_shares, keys, level, prefixes, nonces, enc
+    ):
+        """The numpy/host-AES walk (the original eval_batch body)."""
         B = len(keys)
         P = len(prefixes)
         bits = self.idpf.BITS
@@ -119,7 +245,6 @@ class BatchedPoplar1:
         for p in prefixes:
             if p >> (level + 1):
                 raise VdafError("prefix out of range for level")
-        enc = _ciphers_for(nonces)
 
         # ancestor frontiers per level (shared across reports)
         frontier = [
@@ -215,6 +340,170 @@ class BatchedPoplar1:
                 y[b, j] = col[b]
         return y, ok
 
+    def _materialize_y(self, y_limbs, jf) -> np.ndarray:
+        """Read a device-walked (B, P, n) limb matrix back to host ints —
+        the readback the resident path exists to avoid; counted so the
+        bench row can assert 0 on the device-resident path."""
+        B, P = int(y_limbs.shape[0]), int(y_limbs.shape[1])
+        ints = jf.from_limbs(np.asarray(y_limbs))
+        y = np.empty((B, P), dtype=object)
+        for b in range(B):
+            for j in range(P):
+                y[b, j] = ints[b * P + j]
+        self.sketch_readback_rows += B
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.poplar_sketch_readback_rows.inc(B)
+        return y
+
+    # -- device-resident IDPF walk (poplar_backend: jax) -------------------
+    def _eval_batch_dev(
+        self,
+        agg_id: int,
+        public_shares: Sequence,
+        keys: Sequence[bytes],
+        level: int,
+        prefixes: Sequence[int],
+        walk_keys: "_JaxWalkKeys",
+    ):
+        """The jax twin of :meth:`eval_batch`: same level-synchronous walk,
+        but the frontier seeds/controls live as device arrays across
+        levels and the final values come out as a (B, P, n) u32 canonical
+        limb matrix — the sketch (and the resident store) consume it in
+        place.  Bit-exact with the host walk: identical AES stream,
+        identical rejection-sample masking (a rejected first candidate
+        flags the row for the oracle), identical correction-word and sign
+        handling.  Returns (y_limbs, ok, jf)."""
+        import jax.numpy as jnp
+
+        from ..fields import next_power_of_2
+        from .aes_jax import encrypt_blocks_multikey_padded
+        from .field_jax import _sbb, _u32
+
+        B = len(keys)
+        bits = self.idpf.BITS
+        if not 0 <= level < bits:
+            raise VdafError("level out of range")
+        for p in prefixes:
+            if p >> (level + 1):
+                raise VdafError("prefix out of range for level")
+        frontier = [
+            sorted({p >> (level - l) for p in prefixes}) for l in range(level + 1)
+        ]
+
+        def xof_blocks(rks, seeds, nblocks: int):
+            """XofFixedKeyAes128 stream for (B, K, 16) seeds -> hashed
+            (B, K, nblocks, 16): block i = hash(seed ^ le128(i)), the
+            whole frontier in ONE padded multikey AES launch."""
+            idx = np.zeros((nblocks, 16), dtype=np.uint8)
+            for i in range(nblocks):
+                idx[i, :8] = np.frombuffer(
+                    int(i).to_bytes(8, "little"), dtype=np.uint8
+                )
+            blocks = seeds[:, :, None, :] ^ jnp.asarray(idx)[None, None, :, :]
+            k = blocks.shape[1]
+            blocks = blocks.reshape(B, k * nblocks, 16)
+            sigma = jnp.concatenate(
+                [blocks[..., 8:], blocks[..., :8] ^ blocks[..., 8:]], axis=-1
+            )
+            out = encrypt_blocks_multikey_padded(rks, sigma) ^ sigma
+            return out.reshape(B, k, nblocks, 16)
+
+        def cond_sub_p(jf, w):
+            """(w mod p, w >= p) for masked w < 2^(32 n) < 2 p."""
+            limbs = [w[..., i] for i in range(jf.n)]
+            pl = [_u32(int(x)) for x in jf.p_np]
+            borrow = _u32(0)
+            d = []
+            for i in range(jf.n):
+                di, borrow = _sbb(limbs[i], pl[i], borrow)
+                d.append(di)
+            geq = borrow == 0
+            out = jnp.stack(
+                [jnp.where(geq, d[i], limbs[i]) for i in range(jf.n)], axis=-1
+            )
+            return out, geq
+
+        parent_seed = {
+            (-1, 0): jnp.asarray(
+                np.frombuffer(b"".join(keys), dtype=np.uint8).reshape(B, 16)
+            )
+        }
+        parent_ctrl = {(-1, 0): jnp.full((B,), agg_id, dtype=jnp.uint8)}
+        y_limbs = ok_dev = jf = None
+        for l in range(level + 1):
+            field = self.idpf.field_at(l)
+            elem = field.ENCODED_SIZE
+            conv_blocks = -(-(KEY_SIZE + elem) // 16)
+            seed_cw = jnp.asarray(
+                np.stack(
+                    [np.frombuffer(ps[l].seed_cw, dtype=np.uint8) for ps in public_shares]
+                )
+            )  # (B, 16)
+            ctrl_cw = jnp.asarray(
+                np.array(
+                    [[ps[l].ctrl_cw[0], ps[l].ctrl_cw[1]] for ps in public_shares],
+                    dtype=np.uint8,
+                )
+            )  # (B, 2)
+            w_cw = [int(ps[l].w_cw[0]) for ps in public_shares]
+
+            parents = sorted({node >> 1 for node in frontier[l]})
+            pseed = jnp.stack(
+                [parent_seed[(l - 1, par)] for par in parents], axis=1
+            )  # (B, NP, 16)
+            pctrl = jnp.stack(
+                [parent_ctrl[(l - 1, par)] for par in parents], axis=1
+            )  # (B, NP)
+            ext = xof_blocks(walk_keys.rk[0], pseed, 2)  # (B, NP, 2, 16)
+            t = ext[..., 0] & 1  # (B, NP, 2)
+            s = ext.at[..., 0].set(ext[..., 0] & 0xFE)
+            applied = pctrl.astype(bool)[:, :, None, None]
+            s = jnp.where(applied, s ^ seed_cw[:, None, None, :], s)
+            t = jnp.where(pctrl.astype(bool)[:, :, None], t ^ ctrl_cw[:, None, :], t)
+
+            nodes = frontier[l]
+            pi = np.array([parents.index(n >> 1) for n in nodes])
+            bit = np.array([n & 1 for n in nodes])
+            x = s[:, pi, bit, :]  # (B, NF, 16)
+            ctrl = t[:, pi, bit]  # (B, NF)
+            conv = xof_blocks(walk_keys.rk[1], x, conv_blocks).reshape(
+                B, len(nodes), conv_blocks * 16
+            )
+            parent_seed = {
+                (l, node): conv[:, i, :KEY_SIZE] for i, node in enumerate(nodes)
+            }
+            parent_ctrl = {(l, node): ctrl[:, i] for i, node in enumerate(nodes)}
+            if l == level:
+                jf = self._jfield(field)
+                raw = conv[:, :, KEY_SIZE : KEY_SIZE + elem]  # (B, NF, elem)
+                r = raw.astype(jnp.uint32).reshape(B, len(nodes), jf.n, 4)
+                limbs = (
+                    r[..., 0]
+                    | (r[..., 1] << 8)
+                    | (r[..., 2] << 16)
+                    | (r[..., 3] << 24)
+                )
+                mask = next_power_of_2(field.MODULUS) - 1
+                mask_l = jnp.asarray(
+                    np.array(
+                        [(mask >> (32 * i)) & 0xFFFFFFFF for i in range(jf.n)],
+                        dtype=np.uint32,
+                    )
+                )
+                limbs = limbs & mask_l
+                w, geq = cond_sub_p(jf, limbs)
+                corrected = jf.add(w, jnp.asarray(jf.to_limbs(w_cw))[:, None, :])
+                w = jnp.where(ctrl.astype(bool)[..., None], corrected, w)
+                if agg_id == 1:
+                    w = jf.neg(w)
+                colmap = {node: i for i, node in enumerate(nodes)}
+                sel = np.array([colmap[p] for p in prefixes])
+                y_limbs = w[:, sel, :]
+                ok_dev = ~jnp.any(geq, axis=1)
+        return y_limbs, np.asarray(ok_dev).copy(), jf
+
     # -- batched sketch ---------------------------------------------------
     def sketch_batch(
         self,
@@ -222,8 +511,10 @@ class BatchedPoplar1:
         agg_id: int,
         agg_param,
         nonces: Sequence[bytes],
-        y: np.ndarray,  # (B, P) object ints
+        y: np.ndarray,  # (B, P) object ints; or None with y_limbs
         abc: Sequence[Tuple[int, int, int]],
+        y_limbs=None,  # (B, P, n) u32 device limbs (jax walk): consumed
+        # in place — the y vectors never leave the device
     ):
         """(z, zs) shares per report via one device launch.
 
@@ -240,7 +531,7 @@ class BatchedPoplar1:
         vdaf = self.vdaf
         field = vdaf.field_for_agg_param(agg_param)
         jf = self._jfield(field)
-        B, P = y.shape
+        B, P = (y.shape if y is not None else y_limbs.shape[:2])
         vks = (
             verify_key
             if not isinstance(verify_key, (bytes, bytearray))
@@ -250,8 +541,12 @@ class BatchedPoplar1:
             vdaf._verify_rands(vk, nonce, agg_param)
             for vk, nonce in zip(vks, nonces)
         ]  # (B, P) ints
-        y_l = jnp.asarray(
-            jf.to_limbs([int(v) for row in y for v in row]).reshape(B, P, jf.n)
+        y_l = (
+            jnp.asarray(y_limbs)
+            if y_limbs is not None
+            else jnp.asarray(
+                jf.to_limbs([int(v) for row in y for v in row]).reshape(B, P, jf.n)
+            )
         )
         r_l = jnp.asarray(
             jf.to_limbs([int(v) for row in rs for v in row]).reshape(B, P, jf.n)
@@ -288,31 +583,18 @@ class BatchedPoplar1:
             [verify_key] * len(reports), agg_id, agg_param, reports
         )
 
-    def _prep_rows(
-        self,
-        verify_keys: Sequence[bytes],
-        agg_id: int,
-        agg_param,
-        reports: Sequence[Tuple[bytes, object, object]],
-    ):
-        """The per-row-verify-key core: ONE bulk-AES tree walk + ONE device
-        sketch launch for rows that may span multiple tasks (each row uses
-        its own verify key for the sketch randomness)."""
-        from ..vdaf.poplar1 import (
-            Poplar1PrepareShare,
-            Poplar1PrepareState,
-            _field_tag,
-        )
-
+    def _walk_rows(self, agg_id: int, agg_param, reports) -> _WalkResult:
+        """The WALK half: the bulk-AES IDPF eval (host or jax per the
+        ``poplar_backend`` seam) plus the host correlated-randomness
+        triples — everything the sketch launch half consumes.  Under the
+        jax backend the value shares come back as device-resident limbs."""
         vdaf = self.vdaf
         level = agg_param.level
         prefixes = list(agg_param.prefixes)
-        field = vdaf.field_for_agg_param(agg_param)
         nonces = [r[0] for r in reports]
         pubs = [r[1] for r in reports]
         keys = [r[2].idpf_key for r in reports]
-
-        y, ok = self.eval_batch(agg_id, pubs, keys, level, prefixes, nonces)
+        field = vdaf.field_for_agg_param(agg_param)
 
         abc = []
         for nonce, _pub, share in reports:
@@ -322,36 +604,202 @@ class BatchedPoplar1:
                 inner, leaf = share.corr_inner, share.corr_leaf
             abc.append(leaf if level == vdaf.bits - 1 else inner[level])
 
-        zzs = self.sketch_batch(verify_keys, agg_id, agg_param, nonces, y, abc)
-        out = []
-        for b, ((z, zs), (a, bb, c)) in enumerate(zip(zzs, abc)):
-            if not ok[b]:
-                # Exact-path fallback: first rejection-sampling candidate
-                # for some tree value was non-canonical.
-                out.append(
-                    vdaf.prep_init(
-                        verify_keys[b], agg_id, agg_param,
-                        reports[b][0], reports[b][1], reports[b][2],
-                    )
+        enc = _ciphers_for(nonces, backend=self.walk_backend)
+        from ..core.metrics import GLOBAL_METRICS
+
+        if isinstance(enc, _JaxWalkKeys):
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.poplar_walk_rows.labels(backend="jax").inc(
+                    len(reports)
                 )
-                continue
-            state = Poplar1PrepareState(
-                agg_id=agg_id,
-                level=level,
-                round=0,
-                y_flat=[int(v) for v in y[b]],
-                a=a,
-                b=bb,
-                c=c,
-                zs_share=zs,
+            y_limbs, ok, jf = self._eval_batch_dev(
+                agg_id, pubs, keys, level, prefixes, enc
             )
-            out.append((state, Poplar1PrepareShare(_field_tag(field), [z, zs])))
+            return _WalkResult(ok=ok, abc=abc, field=field, y_limbs=y_limbs, jf=jf)
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.poplar_walk_rows.labels(backend="host").inc(len(reports))
+        y, ok = self._eval_batch_host(
+            agg_id, pubs, keys, level, prefixes, nonces, enc
+        )
+        return _WalkResult(ok=ok, abc=abc, field=field, y_host=y)
+
+    def _sketch_rows(
+        self,
+        agg_id: int,
+        agg_param,
+        verify_keys: Sequence[bytes],
+        reports,
+        walk: _WalkResult,
+        retain_store=None,
+    ):
+        """The SKETCH half: one device launch for the (z, z*) inner
+        products over the staged walk, then per-row state assembly.  With
+        ``retain_store`` attached and a device-walked group, the (B, P, n)
+        value matrix is adopted by the store and the prepare states carry
+        :class:`~janus_tpu.executor.accumulator.ResidentRef` rows instead
+        of host vectors — the sketch y values never leave the device (the
+        commit psums rows in place; the drain reads ONE vector per
+        bucket)."""
+        from ..vdaf.poplar1 import (
+            Poplar1PrepareShare,
+            Poplar1PrepareState,
+            _field_tag,
+        )
+
+        vdaf = self.vdaf
+        level = agg_param.level
+        P = len(agg_param.prefixes)
+        nonces = [r[0] for r in reports]
+        B = len(reports)
+        field = walk.field
+        zzs = self.sketch_batch(
+            verify_keys, agg_id, agg_param, nonces, walk.y_host, walk.abc,
+            y_limbs=walk.y_limbs,
+        )
+        fid = None
+        if retain_store is not None and walk.y_limbs is not None:
+            plane = self._plane(field, P)
+            fid = retain_store.retain_flush(
+                plane, walk.y_limbs, rows=B, nbytes=B * plane.accum_buffer_nbytes
+            )
+        y_host = walk.y_host
+        if fid is None and y_host is None:
+            y_host = self._materialize_y(walk.y_limbs, walk.jf)
+        if fid is not None:
+            from ..executor.accumulator import ResidentRef
+        out = []
+        dead = []
+        try:
+            for b, ((z, zs), (a, bb, c)) in enumerate(zip(zzs, walk.abc)):
+                if not walk.ok[b]:
+                    # Exact-path fallback: first rejection-sampling
+                    # candidate for some tree value was non-canonical.
+                    # Its retained row is never referenced — release it
+                    # so the matrix can free.
+                    if fid is not None:
+                        dead.append(ResidentRef(fid, b))
+                    out.append(
+                        vdaf.prep_init(
+                            verify_keys[b], agg_id, agg_param,
+                            reports[b][0], reports[b][1], reports[b][2],
+                        )
+                    )
+                    continue
+                y_val = (
+                    ResidentRef(fid, b)
+                    if fid is not None
+                    else [int(v) for v in y_host[b]]
+                )
+                state = Poplar1PrepareState(
+                    agg_id=agg_id,
+                    level=level,
+                    round=0,
+                    y_flat=y_val,
+                    a=a,
+                    b=bb,
+                    c=c,
+                    zs_share=zs,
+                )
+                out.append(
+                    (state, Poplar1PrepareShare(_field_tag(field), [z, zs]))
+                )
+        except BaseException:
+            # a post-retain failure (e.g. the oracle fallback raising)
+            # must not pin the whole retained matrix: no caller ever saw
+            # these refs, so release every row before surfacing
+            if fid is not None:
+                retain_store.release_refs(
+                    [ResidentRef(fid, b) for b in range(B)]
+                )
+            raise
+        if dead:
+            retain_store.release_refs(dead)
         return out
+
+    def _prep_rows(
+        self,
+        verify_keys: Sequence[bytes],
+        agg_id: int,
+        agg_param,
+        reports: Sequence[Tuple[bytes, object, object]],
+        retain_store=None,
+    ):
+        """The per-row-verify-key core: ONE bulk-AES tree walk + ONE device
+        sketch launch for rows that may span multiple tasks (each row uses
+        its own verify key for the sketch randomness)."""
+        walk = self._walk_rows(agg_id, agg_param, reports)
+        return self._sketch_rows(
+            agg_id, agg_param, verify_keys, reports, walk, retain_store=retain_store
+        )
+
+    def stage_init_multi(self, agg_id: int, requests) -> _StagedPoplar:
+        """The WALK half of :meth:`prep_init_multi`: group the flush's
+        submissions by aggregation parameter and run each group's bulk-AES
+        tree walk, leaving the value shares staged (device-resident under
+        the jax backend) for the sketch launch.  The executor runs this on
+        its STAGING thread so walk k+1 overlaps sketch launch k — the
+        Prio3 marshal/launch double-buffering, applied to heavy hitters."""
+        groups_idx: Dict[object, List[int]] = {}
+        for i, (_vk, agg_param, _reports) in enumerate(requests):
+            groups_idx.setdefault(agg_param, []).append(i)
+        groups = []
+        for agg_param, idxs in groups_idx.items():
+            vks: List[bytes] = []
+            rows: List[Tuple[bytes, object, object]] = []
+            counts: List[int] = []
+            for i in idxs:
+                vk, _p, reports = requests[i]
+                vks.extend([vk] * len(reports))
+                rows.extend(reports)
+                counts.append(len(reports))
+            walk = self._walk_rows(agg_id, agg_param, rows) if rows else None
+            groups.append((agg_param, idxs, counts, vks, rows, walk))
+        return _StagedPoplar(agg_id, len(requests), groups)
+
+    def launch_init_multi(self, staged: _StagedPoplar, retain_store=None):
+        """The SKETCH half: per-group device sketch launches + per-row
+        state assembly over an already-staged walk.  Results return per
+        request, byte-identical to separate prep_init_batch calls.  A
+        later group's failure releases every EARLIER group's retained
+        rows (their refs were never handed to any caller, so nothing
+        else would ever free those matrices) before re-raising — the
+        flush then fails uniformly and redelivery re-mints."""
+        results: List[Optional[list]] = [None] * staged.n_requests
+        try:
+            for agg_param, idxs, counts, vks, rows, walk in staged.groups:
+                outs = (
+                    self._sketch_rows(
+                        staged.agg_id, agg_param, vks, rows, walk,
+                        retain_store=retain_store,
+                    )
+                    if rows
+                    else []
+                )
+                start = 0
+                for i, n in zip(idxs, counts):
+                    results[i] = outs[start : start + n]
+                    start += n
+        except BaseException:
+            if retain_store is not None:
+                from ..executor.accumulator import ResidentRef
+
+                refs = [
+                    st.y_flat
+                    for outs in results
+                    if outs
+                    for st, _sh in (o for o in outs if isinstance(o, tuple))
+                    if isinstance(st.y_flat, ResidentRef)
+                ]
+                if refs:
+                    retain_store.release_refs(refs)
+            raise
+        return results
 
     def prep_init_multi(
         self,
         agg_id: int,
         requests: Sequence[Tuple[bytes, object, Sequence[Tuple[bytes, object, object]]]],
+        retain_store=None,
     ):
         """ONE walk serving rows from MULTIPLE jobs/tasks: the executor's
         poplar_init mega-batch form.
@@ -369,21 +817,6 @@ class BatchedPoplar1:
         """
         if not requests:
             return []
-        groups: Dict[object, List[int]] = {}
-        for i, (_vk, agg_param, _reports) in enumerate(requests):
-            groups.setdefault(agg_param, []).append(i)
-        results: List[Optional[list]] = [None] * len(requests)
-        for agg_param, idxs in groups.items():
-            vks: List[bytes] = []
-            rows: List[Tuple[bytes, object, object]] = []
-            for i in idxs:
-                vk, _p, reports = requests[i]
-                vks.extend([vk] * len(reports))
-                rows.extend(reports)
-            outs = self._prep_rows(vks, agg_id, agg_param, rows) if rows else []
-            start = 0
-            for i in idxs:
-                n = len(requests[i][2])
-                results[i] = outs[start : start + n]
-                start += n
-        return results
+        return self.launch_init_multi(
+            self.stage_init_multi(agg_id, requests), retain_store=retain_store
+        )

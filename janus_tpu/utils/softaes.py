@@ -118,11 +118,61 @@ class SoftAes128Ecb:
         return encrypt_blocks(self._rk, blocks).tobytes()
 
 
-def aes128_ecb_encryptor(key: bytes):
-    """An AES-128-ECB encryptor: `cryptography` (AES-NI) when its Cipher
-    is importable AND functional, the numpy fallback otherwise.  The
-    functional probe matters: the dev-container crypto shim imports fine
-    but raises at Cipher construction."""
+#: Process default for the Poplar1 AES-walk backend ("host" | "jax"),
+#: resolved lazily from JANUS_TPU_POPLAR_BACKEND.  "host" is the legacy
+#: path: `cryptography` (AES-NI) when functional, numpy soft-AES
+#: otherwise.  "jax" routes through the jitted kernel in ops/aes_jax.py —
+#: the device-resident IDPF walk — and falls back to host loudly if the
+#: jax kernel cannot import.  The binaries' `poplar_backend` config is
+#: threaded PER BACKEND (make_backend -> Poplar1Backend), deliberately
+#: leaving this process default alone: the per-report oracle and
+#: XofFixedKeyAes128 keep the host path regardless of how the batched
+#: walk is configured.  set_poplar_backend exists for tests and for
+#: operators who want the env-equivalent programmatically.
+_POPLAR_BACKEND = None
+POPLAR_BACKENDS = ("host", "jax")
+
+
+def poplar_backend() -> str:
+    global _POPLAR_BACKEND
+    if _POPLAR_BACKEND is None:
+        import os
+
+        env = os.environ.get("JANUS_TPU_POPLAR_BACKEND", "host")
+        _POPLAR_BACKEND = env if env in POPLAR_BACKENDS else "host"
+    return _POPLAR_BACKEND
+
+
+def set_poplar_backend(name: str) -> None:
+    if name not in POPLAR_BACKENDS:
+        raise ValueError(f"unknown poplar backend {name!r}")
+    global _POPLAR_BACKEND
+    _POPLAR_BACKEND = name
+
+
+def aes128_ecb_encryptor(key: bytes, backend: str = None):
+    """An AES-128-ECB encryptor behind the ``poplar_backend: jax|host``
+    seam.  ``backend`` None resolves the process default.  Host prefers
+    `cryptography` (AES-NI) when its Cipher is importable AND functional,
+    the numpy fallback otherwise — the functional probe matters: the
+    dev-container crypto shim imports fine but raises at Cipher
+    construction.  "jax" returns the jitted-kernel duck-type (bit-exact,
+    FIPS-anchored at ops/aes_jax import) and degrades to host if the jax
+    stack is unavailable — a missing accelerator dep must never take the
+    Poplar1 tier down."""
+    if (backend or poplar_backend()) == "jax":
+        try:
+            from ..ops.aes_jax import JaxAes128Ecb
+
+            return JaxAes128Ecb(key)
+        except Exception:  # pragma: no cover - jax-less host
+            import logging
+
+            logging.getLogger("janus_tpu.softaes").warning(
+                "poplar_backend=jax but the jax AES kernel is unavailable; "
+                "serving the host path",
+                exc_info=True,
+            )
     try:
         from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
 

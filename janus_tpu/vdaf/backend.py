@@ -1129,14 +1129,33 @@ class Poplar1Backend:
 
     name = "poplar1-batch"
 
-    def __init__(self, vdaf):
+    def __init__(self, vdaf, poplar_backend: Optional[str] = None):
         from ..ops.poplar1_batch import BatchedPoplar1
 
         self.vdaf = vdaf
-        self.bp = BatchedPoplar1(vdaf)
+        #: AES-walk backend seam ("host" | "jax"; None = process default)
+        self.bp = BatchedPoplar1(vdaf, poplar_backend=poplar_backend)
         #: bit-exact per-report CPU fallback (breaker open / replay), the
         #: same contract as the Prio3 backends' .oracle
         self.oracle = Poplar1Oracle(vdaf)
+
+    @property
+    def poplar_backend(self) -> str:
+        return self.bp.walk_backend
+
+    @property
+    def supports_resident_sketch(self) -> bool:
+        """Whether flushes may retain the sketch y matrices on device and
+        hand back ResidentRefs: requires the jax walk (host-walked values
+        are born in host memory — retaining them would be a readback in
+        reverse)."""
+        return self.bp.walk_backend == "jax"
+
+    @property
+    def sketch_readback_rows(self) -> int:
+        """Device-walked rows whose y vectors were materialized to host
+        (the acceptance counter: 0 on the device-resident path)."""
+        return self.bp.sketch_readback_rows
 
     def oracle_for(self, vdaf=None) -> "Poplar1Oracle":
         """Uniform fallback-resolution face (oracle_backend_for): Poplar1
@@ -1150,16 +1169,25 @@ class Poplar1Backend:
             agg_id, [(verify_key, agg_param, reports)]
         )[0]
 
-    def prep_init_multi_poplar(self, agg_id, requests):
-        """ONE bulk-AES walk + sketch launch for rows from MULTIPLE jobs
-        (``requests``: (verify_key, agg_param, reports) per submission —
-        the executor's poplar_init flush form).  Same failure domain as
-        the Prio3 device launches: the named fault points fire here so the
-        per-shape circuit breaker (and chaos coverage) treats a sick
-        sketch/walk path exactly like a sick XLA launch."""
+    def stage_poplar_init_multi(self, agg_id, requests):
+        """The WALK half of a poplar flush: bulk-AES IDPF eval per
+        agg-param group, value shares staged (device-resident under the
+        jax walk).  Runs on the executor's STAGING thread so walk k+1
+        overlaps sketch launch k (the stage/launch double buffering).  A
+        walk failure surfaces through the flush like a stage failure on
+        the Prio3 path — the breaker counts it."""
+        return self.bp.stage_init_multi(agg_id, requests)
+
+    def launch_poplar_init_multi(self, staged, retain_store=None):
+        """The SKETCH half: device inner products + state assembly over a
+        staged walk.  The named fault points fire here so the per-shape
+        circuit breaker (and chaos coverage) treats a sick sketch/walk
+        path exactly like a sick XLA launch.  ``retain_store`` (the
+        device accumulator store) adopts device-walked y matrices: states
+        then carry ResidentRefs and the flush pays zero sketch readback."""
         faults.fire("backend.launch")
         faults.fire("backend.device_lost")
-        rows = sum(len(r[2]) for r in requests)
+        rows = sum(len(r) for _p, _i, _c, _v, r, _w in staged.groups)
         from ..core.metrics import GLOBAL_METRICS
 
         if GLOBAL_METRICS.registry is not None:
@@ -1169,9 +1197,20 @@ class Poplar1Backend:
 
         t0 = time.monotonic()
         with trace_span("prep_launch", cat="device", backend=self.name, batch=rows):
-            out = self.bp.prep_init_multi(agg_id, requests)
+            out = self.bp.launch_init_multi(staged, retain_store=retain_store)
         _observe_prepare(self.name, "init", rows, time.monotonic() - t0)
         return out
+
+    def prep_init_multi_poplar(self, agg_id, requests, retain_store=None):
+        """ONE bulk-AES walk + sketch launch for rows from MULTIPLE jobs
+        (``requests``: (verify_key, agg_param, reports) per submission —
+        the executor's poplar_init flush form).  Composed from the
+        stage/launch halves; direct (non-executor) callers pay them
+        back-to-back."""
+        return self.launch_poplar_init_multi(
+            self.stage_poplar_init_multi(agg_id, requests),
+            retain_store=retain_store,
+        )
 
 
 BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend, "mesh": MeshBackend}
@@ -1266,16 +1305,19 @@ def make_backend(
     backend: str = "oracle",
     field_backend: Optional[str] = None,
     canonical: bool = False,
+    poplar_backend: Optional[str] = None,
 ):
     """Backend factory — the dispatch gate named in the north star.
 
     ``field_backend`` ("vpu" | "mxu", None = JANUS_TPU_FIELD_BACKEND or
     "vpu") selects the device backends' field-arithmetic layout; the
     oracle and Poplar1 paths have no device field layer and ignore it.
-    ``canonical`` marks ``vdaf`` as a bucket's padded twin
-    (vdaf/canonical.py) — device backends then expect 3-tuple requests
-    and emit the per-row mask input; only device Prio3 backends honor it
-    (the oracle/hybrid/Poplar1 paths are never canonicalized).
+    ``poplar_backend`` ("host" | "jax", None = JANUS_TPU_POPLAR_BACKEND
+    or "host") selects the Poplar1 AES-walk backend; only the Poplar1
+    path reads it.  ``canonical`` marks ``vdaf`` as a bucket's padded
+    twin (vdaf/canonical.py) — device backends then expect 3-tuple
+    requests and emit the per-row mask input; only device Prio3 backends
+    honor it (the oracle/hybrid/Poplar1 paths are never canonicalized).
     """
     try:
         cls = BACKENDS[backend]
@@ -1284,7 +1326,7 @@ def make_backend(
     if backend != "oracle" and type(vdaf).__name__ == "Poplar1":
         # Heavy hitters: the device configs route Poplar1 through the
         # batched AES/sketch path instead of the Prio3-shaped backends.
-        return Poplar1Backend(vdaf)
+        return Poplar1Backend(vdaf, poplar_backend=poplar_backend)
     if (
         backend != "oracle"
         and isinstance(vdaf, Prio3)

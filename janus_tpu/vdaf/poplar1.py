@@ -170,7 +170,11 @@ class Poplar1PrepareState:
     agg_id: int
     level: int
     round: int  # 0 = sketch broadcast pending, 1 = decision pending
-    y_flat: List[int]  # this party's prefix value shares
+    #: this party's prefix value shares — a List[int], or (device-resident
+    #: IDPF) an executor.accumulator.ResidentRef naming the row of a
+    #: retained (B, P, n) sketch matrix; the ping-pong layer passes it
+    #: through untouched, exactly like Prio3's resident out shares
+    y_flat: object
     a: int
     b: int
     c: int
@@ -411,7 +415,11 @@ class Poplar1:
             return ("continue", next_state, share.encode())
         if prep_msg:
             raise VdafError("unexpected decision payload")
-        return ("finish", list(prep_state.y_flat))
+        if isinstance(prep_state.y_flat, list):
+            return ("finish", list(prep_state.y_flat))
+        # device-resident sketch: the ref travels out verbatim; only the
+        # accumulator store can resolve it (commit psums the row in place)
+        return ("finish", prep_state.y_flat)
 
     def ping_pong_encode_prep_share(self, share: Poplar1PrepareShare) -> bytes:
         return share.encode()
@@ -423,8 +431,33 @@ class Poplar1:
             raise VdafError("bad prepare share length for round")
         return share
 
+    #: y-count sentinel marking a persisted state whose sketch vector is a
+    #: device-resident ref (flush id + row) instead of inline field
+    #: elements.  The value is unreachable for real prefix counts (the
+    #: encoded agg param caps count at u32, and a 2^32-prefix frontier
+    #: cannot exist), so legacy states decode unchanged.
+    _RESIDENT_Y = 0xFFFFFFFF
+
     def ping_pong_encode_state(self, state: Poplar1PrepareState) -> bytes:
         field = self.idpf.field_at(state.level)
+        if not isinstance(state.y_flat, list):
+            # device-resident sketch: persist the ref, not the vector —
+            # the WAITING_LEADER -> FINISHED hop never round-trips the
+            # y values through host memory.  A ref that outlives its
+            # process decodes fine and fails closed at commit time
+            # (AccumulatorUnavailable -> per-report oracle replay from the
+            # retained report payloads).
+            ref = state.y_flat
+            head = struct.pack(
+                ">BHBI", state.agg_id, state.level, state.round, self._RESIDENT_Y
+            )
+            return (
+                head
+                + struct.pack(">qI", int(ref.flush_id), int(ref.row))
+                + field.encode_vec(
+                    [state.a, state.b, state.c, state.zs_share]
+                )
+            )
         head = struct.pack(
             ">BHBI", state.agg_id, state.level, state.round, len(state.y_flat)
         )
@@ -437,6 +470,20 @@ class Poplar1:
             raise VdafError("truncated prepare state")
         agg_id, level, round_, n = struct.unpack(">BHBI", data[:8])
         field = self.idpf.field_at(level)
+        if n == self._RESIDENT_Y:
+            from ..executor.accumulator import ResidentRef
+
+            if len(data) < 20:
+                raise VdafError("truncated resident prepare state")
+            flush_id, row = struct.unpack(">qI", data[8:20])
+            vals = field.decode_vec(data[20:])
+            if len(vals) != 4:
+                raise VdafError("bad resident prepare state length")
+            return Poplar1PrepareState(
+                agg_id=agg_id, level=level, round=round_,
+                y_flat=ResidentRef(flush_id, row),
+                a=vals[0], b=vals[1], c=vals[2], zs_share=vals[3],
+            )
         vals = field.decode_vec(data[8:])
         if len(vals) != n + 4:
             raise VdafError("bad prepare state length")

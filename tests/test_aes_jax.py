@@ -1,0 +1,110 @@
+"""The jitted AES-128 kernel behind the device-resident IDPF walk
+(janus_tpu/ops/aes_jax.py, ISSUE 13).
+
+Cheap by design (this file sorts early in the tier-1 alphabet): known
+FIPS-197 vectors, a bounded random-key fuzz against the numpy soft-AES
+reference, the padded multikey batch form, and the ``poplar_backend``
+seam in ``aes128_ecb_encryptor`` / ``_ciphers_for``.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from janus_tpu.ops import aes_jax  # noqa: E402
+from janus_tpu.ops.poplar1_batch import _ciphers_for, _JaxWalkKeys  # noqa: E402
+from janus_tpu.utils import softaes  # noqa: E402
+
+# FIPS-197 known-answer vectors for AES-128: appendix C.1 (the worked
+# example) and appendix B (the cipher example).
+_FIPS_VECTORS = [
+    (
+        bytes(range(16)),
+        "00112233445566778899aabbccddeeff",
+        "69c4e0d86a7b0430d8cdb78070b4c55a",
+    ),
+    (
+        bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c"),
+        "3243f6a8885a308d313198a2e0370734",
+        "3925841d02dc09fbdc118597196a0b32",
+    ),
+]
+
+
+def test_fips197_known_answers():
+    for key, pt_hex, ct_hex in _FIPS_VECTORS:
+        enc = aes_jax.JaxAes128Ecb(key)
+        assert enc.update(bytes.fromhex(pt_hex)) == bytes.fromhex(ct_hex)
+        # ECB statelessness: three blocks of the same plaintext
+        assert (
+            enc.update(bytes.fromhex(pt_hex) * 3) == bytes.fromhex(ct_hex) * 3
+        )
+
+
+def test_random_key_fuzz_matches_softaes():
+    rng = random.Random(0xAE5)
+    for _ in range(8):
+        key = rng.randbytes(16)
+        data = rng.randbytes(16 * rng.randrange(1, 17))
+        assert (
+            aes_jax.JaxAes128Ecb(key).update(data)
+            == softaes.SoftAes128Ecb(key).update(data)
+        )
+
+
+def test_multikey_padded_batch_matches_per_key_softaes():
+    """The walk's dispatch form: non-pow2 (B, K) pads to pow2 shapes and
+    slices back; every row matches its own key's soft-AES stream."""
+    rng = random.Random(7)
+    for b, k in [(1, 1), (3, 5), (5, 3), (8, 4)]:
+        keys = [rng.randbytes(16) for _ in range(b)]
+        blocks = np.frombuffer(rng.randbytes(b * k * 16), dtype=np.uint8).reshape(
+            b, k, 16
+        )
+        out = np.asarray(
+            aes_jax.encrypt_blocks_multikey_padded(
+                aes_jax.expand_keys(keys), blocks
+            )
+        )
+        assert out.shape == (b, k, 16)
+        for i in range(b):
+            want = softaes.SoftAes128Ecb(keys[i]).update(blocks[i].tobytes())
+            assert out[i].tobytes() == want, (b, k, i)
+
+
+def test_update_rejects_partial_blocks():
+    with pytest.raises(ValueError):
+        aes_jax.JaxAes128Ecb(b"\x00" * 16).update(b"\x01" * 15)
+    assert aes_jax.JaxAes128Ecb(b"\x00" * 16).update(b"") == b""
+
+
+def test_poplar_backend_seam():
+    """aes128_ecb_encryptor / _ciphers_for honor the jax|host selection
+    (explicit arg beats the process default; unknown names are rejected)."""
+    assert isinstance(
+        softaes.aes128_ecb_encryptor(b"\x00" * 16, backend="jax"),
+        aes_jax.JaxAes128Ecb,
+    )
+    host = softaes.aes128_ecb_encryptor(b"\x00" * 16, backend="host")
+    assert not isinstance(host, aes_jax.JaxAes128Ecb)
+    with pytest.raises(ValueError):
+        softaes.set_poplar_backend("tpu")
+    prev = softaes.poplar_backend()
+    try:
+        softaes.set_poplar_backend("jax")
+        assert isinstance(
+            softaes.aes128_ecb_encryptor(b"\x00" * 16), aes_jax.JaxAes128Ecb
+        )
+    finally:
+        softaes.set_poplar_backend(prev)
+    # the walk form: one batched key-schedule object per usage
+    wk = _ciphers_for([b"\x01" * 16, b"\x02" * 16], backend="jax")
+    assert isinstance(wk, _JaxWalkKeys)
+    assert wk.rk[0].shape == (2, 11, 16) and wk.rk[1].shape == (2, 11, 16)
+    pairs = _ciphers_for([b"\x01" * 16], backend="host")
+    assert len(pairs) == 1 and len(pairs[0]) == 2

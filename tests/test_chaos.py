@@ -1429,6 +1429,159 @@ def test_partition_soak_asymmetric_heal_exactly_once():
         reset_global_executor()
 
 
+@pytest.mark.slow
+def test_partition_flap_soak_suspect_dwell_restart_exactly_once():
+    """./ci.sh chaos partition, FLAPPING-LINK stage (ISSUE 13 satellite):
+    instead of a clean blackhole, the leader->helper direction flaps on a
+    deterministic schedule — while "up" (partitioned) exchanges RESET
+    mid-flight, while "down" they flow.  Half-open probes land in both
+    phases: a probe in an up phase fails and RESTARTS the suspect dwell,
+    a probe in a down phase succeeds and heals — the tracker must ride
+    the churn (several suspect transitions) without a single abandoned
+    job or expired lease.  Once the link settles: every job finishes and
+    collection counts are exactly-once."""
+    pytest.importorskip("cryptography")
+    from urllib.parse import urlsplit
+
+    from janus_tpu.core import peer_health
+    from janus_tpu.core.metrics import GLOBAL_METRICS
+
+    reset_global_executor()
+    harness = ChaosHarness(
+        n_tasks=2,
+        driver_overrides=dict(
+            max_step_attempts=2,
+            retry_initial_delay_s=1.0,
+            retry_max_delay_s=2.0,
+            peer_failure_threshold=1,
+            peer_suspect_dwell_s=0.15,
+            http_retry=HttpRetryPolicy(
+                0.001, 0.01, 2.0, 0.2, 2, attempt_timeout=0.1
+            ),
+        ),
+    )
+    measurements = {0: [1, 0, 1, 1], 1: [1, 1, 0, 1]}
+    leases_expired_before = sum(
+        GLOBAL_METRICS.get_sample_value(
+            "janus_job_leases_expired_total", {"job_type": jt}
+        )
+        or 0
+        for jt in ("aggregation", "collection")
+    )
+
+    async def flow():
+        await harness.start()
+        try:
+            helper_netloc = urlsplit(
+                harness.tasks[0][1].peer_aggregator_endpoint
+            ).netloc
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)
+            await harness.create_jobs()
+
+            # -- flapping link: short phases, mid-exchange resets -------
+            faults.configure(
+                [
+                    FaultSpec(
+                        "http.request",
+                        "flap",
+                        1.0,
+                        target=helper_netloc,
+                        # phases of ~0.2-0.6s: wide enough that the >=1s
+                        # redelivery cadence (step_retry_delay's floor)
+                        # lands probes in BOTH phases over the churn window
+                        flap_period_s=0.4,
+                    )
+                ],
+                seed=SEED,
+            )
+
+            def reap():
+                return harness.leader_ds.datastore.run_tx(
+                    "reap", lambda tx: tx.reap_expired_aggregation_job_leases()
+                )
+
+            reaped_total = 0
+            # churn window: up to ~8s of flapping (a dozen-plus up/down
+            # phases) under SUSTAINED delivery pressure — fresh reports
+            # keep arriving, so a down-phase heal is always followed by
+            # up-phase traffic that re-suspects the peer (the dwell
+            # restart this soak exists to exercise)
+            for i in range(28):
+                if i % 4 == 3:
+                    for t in measurements:
+                        await harness.upload(t, 1)
+                        measurements[t].append(1)
+                    await harness.create_jobs()
+                await harness.drive_round()
+                reaped_total += reap()
+                await asyncio.sleep(0.25)
+                stats = peer_health.tracker().stats()
+                if (
+                    stats.get(helper_netloc, {}).get("suspect_transitions", 0)
+                    >= 2
+                ):
+                    break  # churn proven; don't stretch the soak
+            states = harness.agg_job_states()
+            assert states, "jobs must exist"
+            assert "Abandoned" not in states, (
+                "flap churn consumed the attempt budget",
+                states,
+            )
+            assert reaped_total == 0, (
+                f"{reaped_total} lease(s) expired under the flapping link"
+            )
+            stats = peer_health.tracker().stats()
+            # the dwell-restart path under churn: the peer went suspect
+            # MORE than once (fail -> dwell -> probe/heal -> fail again)
+            assert stats[helper_netloc]["suspect_transitions"] >= 2, stats
+            ex = harness.drivers[0]._executor
+            assert all(
+                s["trips"] == 0 for s in ex.circuit_stats().values()
+            ), "a flapping HTTP link must never trip the DEVICE breaker"
+
+            # -- link settles -------------------------------------------
+            faults.clear()
+            await asyncio.sleep(0.3)  # past the suspect dwell
+            for _ in range(40):
+                await harness.drive_round()
+                reaped_total += reap()
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert states and all(s == "Finished" for s in states), states
+            assert reaped_total == 0
+            assert (
+                peer_health.tracker().stats()[helper_netloc]["state"]
+                == "healthy"
+            )
+
+            # -- exactly-once collection --------------------------------
+            for t, ms in measurements.items():
+                result = await harness.collect_task(t)
+                assert result.report_count == len(ms), (t, result)
+                assert result.aggregate_result == sum(ms), (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    try:
+        _run(flow(), timeout=280.0)
+        leases_expired_after = sum(
+            GLOBAL_METRICS.get_sample_value(
+                "janus_job_leases_expired_total", {"job_type": jt}
+            )
+            or 0
+            for jt in ("aggregation", "collection")
+        )
+        assert leases_expired_after == leases_expired_before
+    finally:
+        reset_global_executor()
+
+
 def _sql_scalar(path, query):
     conn = sqlite3.connect(path, timeout=10.0)
     try:

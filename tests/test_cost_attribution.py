@@ -610,6 +610,36 @@ def test_bench_compare_structured_skips_and_failures_are_neutral():
     assert v["ok"] and any("environmental" in n for n in v["neutral"])
 
 
+def test_bench_compare_gates_poplar_ab_row_on_headline_unit():
+    """The ISSUE 13 poplar1_hh row carries jax-vs-host A/B sub-fields
+    (jax_walk_reports_s, jax_resident, ...): the gate must compare ONLY
+    the headline (value, unit) pair — a regression in `value` is caught,
+    while the auxiliary fields never confuse row_value, and an error row
+    stays neutral."""
+    from tools.bench_compare import compare, row_value
+
+    ab_row = {
+        "value": 100.0,
+        "unit": "reports/s",
+        "host_walk_reports_s": 100.0,
+        "jax_walk_reports_s": 190.0,
+        "jax_vs_host_walk": 1.9,
+        "jax_resident": {"available": True, "sketch_readback_rows": 0},
+    }
+    assert row_value(ab_row) == (100.0, "reports/s")
+    assert row_value({"error": "parity broke", "jax_resident": {}}) is None
+    runs = [
+        _mk_run(1, {"poplar1_hh": dict(ab_row)}),
+        _mk_run(2, {"poplar1_hh": dict(ab_row, value=80.0)}),
+    ]
+    verdict = compare(runs, tolerance=0.10)
+    assert not verdict["ok"]
+    assert any(r["config"] == "poplar1_hh" for r in verdict["regressions"])
+    # within tolerance passes
+    runs[1] = _mk_run(2, {"poplar1_hh": dict(ab_row, value=95.0)})
+    assert compare(runs, tolerance=0.10)["ok"]
+
+
 def test_bench_compare_baseline_and_unit_mismatch():
     from tools.bench_compare import compare
 

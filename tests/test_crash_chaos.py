@@ -829,6 +829,12 @@ job_driver:
   retry_max_delay_s: 2.0
   lease_reap_interval_s: 0.1
 vdaf_backend: tpu
+# the drivers walk Poplar1 on the jitted device kernel with DEFERRED
+# drains: sketch refs are minted on device, cross the WAITING_LEADER
+# persistence hop, and DIE with every SIGKILL — the soak then proves the
+# dead-ref recovery story end to end (retained payloads -> per-report
+# oracle replay; journal rows -> collection-time replay, exactly once)
+poplar_backend: jax
 device_executor:
   enabled: true
   flush_window_ms: 20

@@ -499,7 +499,9 @@ class _PoplarPair:
     (test_integration_pair.InProcessPair specialized to Poplar1 + the
     executor-routed heavy-hitters path)."""
 
-    def __init__(self, exec_cfg: ExecutorConfig, bits=4, job_size=2):
+    def __init__(
+        self, exec_cfg: ExecutorConfig, bits=4, job_size=2, poplar_backend=None
+    ):
         from janus_tpu.aggregator import Aggregator, Config
         from janus_tpu.core.auth_tokens import AuthenticationToken
         from janus_tpu.core.hpke import HpkeKeypair
@@ -509,6 +511,7 @@ class _PoplarPair:
 
         self.exec_cfg = exec_cfg
         self.bits = bits
+        self.poplar_backend = poplar_backend
         self.clock = MockClock(Time(NOW_S))
         self.leader_ds = EphemeralDatastore(self.clock)
         self.helper_ds = EphemeralDatastore(self.clock)
@@ -519,11 +522,13 @@ class _PoplarPair:
             vdaf_backend="tpu",
             max_upload_batch_write_delay=0.02,
             max_agg_param_job_size=job_size,
+            poplar_backend=poplar_backend,
         )
         helper_cfg = Config(
             vdaf_backend="tpu",
             max_upload_batch_write_delay=0.02,
             device_executor=exec_cfg,
+            poplar_backend=poplar_backend,
         )
         self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, leader_cfg)
         self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, helper_cfg)
@@ -612,6 +617,7 @@ class _PoplarPair:
             DriverConfig(
                 vdaf_backend="tpu",
                 device_executor=self.exec_cfg,
+                poplar_backend=self.poplar_backend,
                 http_retry=HttpRetryPolicy(0.01, 0.1, 2.0, 1.0, 3),
             ),
         )
@@ -885,4 +891,532 @@ def test_poplar1_deferred_journal_crash_replay_exactly_once():
             await pair.stop()
 
     _run(flow(), timeout=300.0)
+    reset_global_executor()
+
+
+# -- device-resident IDPF (ISSUE 13) ------------------------------------------
+
+
+def test_resident_state_codec_roundtrips_refs_and_legacy_states():
+    """ping_pong_encode_state/decode_state carry a ResidentRef through the
+    WAITING_LEADER persistence hop; legacy list states are byte-stable."""
+    from janus_tpu.executor.accumulator import ResidentRef
+    from janus_tpu.vdaf.poplar1 import Poplar1PrepareState
+
+    vdaf = Poplar1(bits=4)
+    ref_state = Poplar1PrepareState(
+        agg_id=0, level=1, round=1, y_flat=ResidentRef(7, 3),
+        a=11, b=22, c=33, zs_share=44,
+    )
+    enc = vdaf.ping_pong_encode_state(ref_state)
+    dec = vdaf.ping_pong_decode_state(enc)
+    assert dec.y_flat == ResidentRef(7, 3)
+    assert (dec.a, dec.b, dec.c, dec.zs_share) == (11, 22, 33, 44)
+    assert (dec.agg_id, dec.level, dec.round) == (0, 1, 1)
+    # the finish step must pass the ref through verbatim
+    kind, out = vdaf.ping_pong_prep_next(dec, b"", 1)
+    assert kind == "finish" and out == ResidentRef(7, 3)
+    # legacy list states are unaffected
+    legacy = Poplar1PrepareState(
+        agg_id=1, level=1, round=1, y_flat=[1, 2, 3], a=0, b=0, c=0, zs_share=0
+    )
+    dec2 = vdaf.ping_pong_decode_state(vdaf.ping_pong_encode_state(legacy))
+    assert dec2.y_flat == [1, 2, 3]
+
+
+def test_jax_walk_resident_refs_through_executor_flush():
+    """An executor poplar flush with the jax walk + retain opt-in mints
+    ResidentRefs; committing them psums on device and drains to the same
+    vector the host walk produces — with zero sketch readback."""
+    from janus_tpu.executor import AccumulatorConfig
+    from janus_tpu.executor.accumulator import ResidentRef
+
+    reset_global_executor()
+    vdaf = Poplar1(bits=4)
+    backend = make_backend(vdaf, "tpu", poplar_backend="jax")
+    assert backend.supports_resident_sketch
+    host = make_backend(vdaf, "tpu", poplar_backend="host")
+    assert not host.supports_resident_sketch
+    ap = Poplar1AggregationParam(1, (0, 1, 2, 3))
+    field = vdaf.field_for_agg_param(ap)
+    key = vdaf_shape_key(vdaf)
+    measurements = [0b1011, 0b1011, 0b0100, 0b1111]
+    rows = _shard_rows(vdaf, measurements, "resident", 0)
+    ex = DeviceExecutor(
+        ExecutorConfig(
+            flush_window_s=0.01,
+            accumulator=AccumulatorConfig(enabled=True, drain_interval_s=3600.0),
+        )
+    )
+
+    async def go():
+        return await ex.submit(
+            key, KIND_POPLAR_INIT, (b"\x2a" * 16, ap, rows),
+            backend=backend, agg_id=0, retain_out_shares=True,
+            agg_param_key=ap.level,
+        )
+
+    outs = _run(go())
+    refs = [st.y_flat for st, _sh in outs]
+    assert all(isinstance(r, ResidentRef) for r in refs)
+    assert backend.sketch_readback_rows == 0
+    store = ex.accumulator
+    assert store.stats()["flushes_resident"] == 1
+    # the sketch shares are byte-identical to the host walk's
+    want = host.prep_init_batch_poplar(b"\x2a" * 16, 0, ap, rows)
+    for (gs, gsh), (ws, wsh) in zip(outs, want):
+        assert gsh.encode() == wsh.encode()
+    # commit + drain: ONE vector, equal to the host-walk sum
+    bucket_key = ("leader", b"t", key, b"ident", vdaf.encode_agg_param(ap))
+    store.commit_rows(
+        bucket_key, backend, refs, job_token=b"j",
+        report_ids=[b"%d" % i for i in range(len(refs))],
+    )
+    vec, _journal = store.drain_with_journal(bucket_key, field)
+    expect = None
+    for ws, _wsh in want:
+        expect = (
+            list(ws.y_flat) if expect is None else field.vec_add(expect, ws.y_flat)
+        )
+    assert vec == expect
+    # matrix freed once every row was consumed
+    assert store.stats()["flushes_resident"] == 0
+    assert backend.sketch_readback_rows == 0
+    ex.shutdown()
+    reset_global_executor()
+
+
+def test_dead_ref_commit_fails_closed_into_oracle_replay_contract():
+    """A ref that outlives its flush (process restart / eviction past
+    recall) must make commit_rows raise AccumulatorUnavailable — the
+    driver's replay contract — never silently merge garbage."""
+    from janus_tpu.executor import AccumulatorConfig
+    from janus_tpu.executor.accumulator import (
+        AccumulatorUnavailable,
+        DeviceAccumulatorStore,
+        ResidentRef,
+    )
+    from janus_tpu.fields import Field64
+
+    store = DeviceAccumulatorStore(AccumulatorConfig(enabled=True))
+    with pytest.raises(AccumulatorUnavailable):
+        store.commit_rows(
+            ("leader", b"t", ("k",), b"i", b"p"),
+            None,
+            [ResidentRef(99, 0)],
+            job_token=b"j",
+            report_ids=[b"r"],
+        )
+
+
+def test_breaker_mid_walk_falls_back_to_oracle_bit_exact():
+    """A failure INSIDE the jax walk (stage half) is a launch failure to
+    the breaker; once the circuit opens, the driver serves the job on the
+    per-report host Poplar1Oracle, bit-exact."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        DriverConfig,
+        JobStepError,
+    )
+    from janus_tpu.ops.poplar1_batch import BatchedPoplar1
+
+    reset_global_executor()
+    vdaf = Poplar1(bits=4)
+    backend = make_backend(vdaf, "tpu", poplar_backend="jax")
+    ap = Poplar1AggregationParam(2, (0, 3, 5))
+    rows = _shard_rows(vdaf, [0b1011, 0b0100], "midwalk", 0)
+    driver = AggregationJobDriver(
+        datastore=None,
+        session_factory=None,
+        config=DriverConfig(
+            vdaf_backend="tpu",
+            poplar_backend="jax",
+            device_executor=ExecutorConfig(
+                enabled=True,
+                flush_window_s=0.005,
+                breaker_failure_threshold=1,
+                breaker_reset_timeout_s=60.0,
+            ),
+        ),
+    )
+    real_walk = BatchedPoplar1._walk_rows
+
+    def broken_walk(self, agg_id, agg_param, reports):
+        raise RuntimeError("device lost mid-walk (level 1)")
+
+    BatchedPoplar1._walk_rows = broken_walk
+    try:
+        async def go():
+            with pytest.raises(JobStepError) as exc_info:
+                await driver._coalesced_poplar_init(backend, b"\x11" * 16, ap, rows)
+            assert exc_info.value.retryable
+            # circuit now open: redelivery serves on the oracle even
+            # though the walk is still broken
+            return await driver._coalesced_poplar_init(backend, b"\x11" * 16, ap, rows)
+
+        got = _run(go())
+    finally:
+        BatchedPoplar1._walk_rows = real_walk
+    want = backend.oracle.prep_init_batch_poplar(b"\x11" * 16, 0, ap, rows)
+    _assert_outcomes_equal(got, want)
+    (st,) = driver._executor.circuit_stats().values()
+    assert st["state"] == "open" and st["trips"] == 1
+    reset_global_executor()
+
+
+def test_poplar_flush_double_buffers_walk_against_sketch_launch():
+    """Ordering regression for the stage/launch split: flush k+1's WALK
+    (stage thread) must start while flush k's SKETCH (launch thread) is
+    still running — the Prio3 double-buffering, applied to poplar."""
+    import threading
+    import time as _time
+
+    reset_global_executor()
+    events = []
+    launch_gate = threading.Event()
+
+    class _Recorder:
+        """Minimal poplar-shaped backend recording stage/launch ordering."""
+
+        vdaf = None
+        supports_resident_sketch = False
+
+        def stage_poplar_init_multi(self, agg_id, requests):
+            events.append(("stage", _time.monotonic(), len(requests)))
+            return ("staged", requests)
+
+        def launch_poplar_init_multi(self, staged, retain_store=None):
+            events.append(("launch_start", _time.monotonic(), None))
+            # first launch blocks until the second flush has STAGED
+            if not launch_gate.is_set():
+                launch_gate.wait(timeout=10.0)
+            events.append(("launch_end", _time.monotonic(), None))
+            _tag, requests = staged
+            return [[("s", "sh")] * len(r[2]) for r in requests]
+
+    backend = _Recorder()
+    ex = DeviceExecutor(ExecutorConfig(flush_window_s=0.01, breaker_failure_threshold=0))
+    ap0 = Poplar1AggregationParam(0, (0,))
+    key = ("poplar-recorder",)
+
+    async def go():
+        first = asyncio.ensure_future(
+            ex.submit(
+                key, KIND_POPLAR_INIT, (b"k", ap0, [1]), backend=backend,
+                agg_id=0, agg_param_key=0,
+            )
+        )
+        # wait for flush 1 to reach its launch
+        while not any(e[0] == "launch_start" for e in events):
+            await asyncio.sleep(0.005)
+        second = asyncio.ensure_future(
+            ex.submit(
+                key, KIND_POPLAR_INIT, (b"k", ap0, [2]), backend=backend,
+                agg_id=0, agg_param_key=0,
+            )
+        )
+        # flush 2's WALK must complete while flush 1's launch is blocked
+        for _ in range(1000):
+            if sum(1 for e in events if e[0] == "stage") >= 2:
+                break
+            await asyncio.sleep(0.005)
+        assert sum(1 for e in events if e[0] == "stage") >= 2, events
+        assert not any(e[0] == "launch_end" for e in events), (
+            "flush 2 staged only after flush 1's launch finished — "
+            "no overlap: %r" % (events,)
+        )
+        launch_gate.set()
+        await first
+        await second
+
+    _run(go())
+    ex.shutdown()
+    reset_global_executor()
+
+
+def test_resident_sketch_e2e_deferred_drain_exactly_once():
+    """THE ISSUE 13 ACCEPTANCE FLOW: leader prep through the jax walk with
+    the deferred store — states carry refs across the WAITING_LEADER hop,
+    the commit journals device refs (no host vectors), the cadence drain
+    reads ONE vector per level bucket, the helper's CONTINUE rounds route
+    through ITS deferred store, and the collected heavy-hitter counts are
+    exact with both journals empty and ZERO sketch readback rows."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.executor import AccumulatorConfig
+
+    reset_global_executor()
+    exec_cfg = ExecutorConfig(
+        enabled=True,
+        flush_window_s=0.15,
+        flush_max_rows=4096,
+        accumulator=AccumulatorConfig(enabled=True, drain_interval_s=0.2),
+    )
+    pair = _PoplarPair(exec_cfg, bits=4, job_size=2, poplar_backend="jax")
+    measurements = [0b1011, 0b1011, 0b0100, 0b1111]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            driver = pair.make_driver()
+            ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+            r1 = await pair.collect_level(ap1, driver)
+            expect1 = [0, 0, 0, 0]
+            for m in measurements:
+                expect1[m >> 2] += 1
+            assert r1.aggregate_result == expect1, (r1.aggregate_result, expect1)
+            assert r1.report_count == len(measurements)
+
+            # ZERO sketch readback on the leader's device-resident path.
+            # The in-process pair SHARES one backend between the leader
+            # driver and the helper aggregator, and the helper's walk is
+            # not resident (its y values land in helper_prep_state bytes),
+            # so the counter reads exactly the helper's 4 rows — the
+            # leader's 4 rows contributed NOTHING.
+            ex = driver._executor
+            shape_key = vdaf_shape_key(pair.leader_task.vdaf_instance())
+            leader_backend = ex.cached_backend(shape_key)
+            assert leader_backend is not None
+            assert getattr(leader_backend, "sketch_readback_rows", -1) == len(
+                measurements
+            ), "leader rows must contribute zero sketch readback"
+            # both journals fully consumed (exactly-once)
+            for ds in (pair.leader_ds.datastore, pair.helper_ds.datastore):
+                assert (
+                    ds.run_tx(
+                        "count",
+                        lambda tx: tx.count_accumulator_journal_entries(
+                            pair.task_id
+                        ),
+                    )
+                    == 0
+                )
+            await driver.close()
+        finally:
+            await pair.stop()
+
+    _run(flow(), timeout=300.0)
+    reset_global_executor()
+
+
+def test_helper_continue_routes_through_deferred_store():
+    """Helper-side satellite: with the deferred store on, a Poplar1
+    CONTINUE round journals its host vectors (batching the helper's
+    datastore writes) and the aggregate-share barrier drains them —
+    observable as helper journal rows between the two phases."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.executor import AccumulatorConfig
+    from janus_tpu.messages import Duration
+
+    reset_global_executor()
+    exec_cfg = ExecutorConfig(
+        enabled=True,
+        flush_window_s=0.15,
+        flush_max_rows=4096,
+        # cadence long enough that request-completion drains never fire
+        # during the test: the aggregate-share barrier must do the work
+        accumulator=AccumulatorConfig(enabled=True, drain_interval_s=3600.0),
+    )
+    pair = _PoplarPair(exec_cfg, bits=4, job_size=2, poplar_backend="host")
+    measurements = [0b1011, 0b1011, 0b0100, 0b1111]
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in measurements:
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            driver = pair.make_driver()
+            ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+
+            # phase 1: create the collection (which creates the jobs) and
+            # step ONLY aggregation to Finished
+            import aiohttp
+
+            from janus_tpu.collector import Collector
+            from janus_tpu.messages import CollectionJobId, Interval, Query, Time
+
+            vdaf = pair.leader_task.vdaf_instance()
+            collector = Collector(
+                task_id=pair.task_id,
+                leader_endpoint=pair.leader_url,
+                vdaf=vdaf,
+                auth_token=pair.col_token,
+                hpke_keypair=pair.collector_keys,
+                poll_interval=0.05,
+                max_poll_time=60.0,
+            )
+            query = Query.new_time_interval(Interval(Time(NOW_S), Duration(3600)))
+            job_id = CollectionJobId.random()
+            session = aiohttp.ClientSession()
+            await collector.create_job(
+                query, job_id, vdaf.encode_agg_param(ap1), session=session
+            )
+            for _ in range(20):
+                leases = await pair.leader_ds.datastore.run_tx_async(
+                    "acquire",
+                    lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                        Duration(600), 10
+                    ),
+                )
+                if not leases:
+                    break
+                await asyncio.gather(
+                    *(driver.step_aggregation_job(l) for l in leases),
+                    return_exceptions=True,
+                )
+                pair.clock.advance(Duration(30))
+
+            # the helper's CONTINUE rounds journaled their vectors
+            helper_rows = pair.helper_ds.datastore.run_tx(
+                "count",
+                lambda tx: tx.count_accumulator_journal_entries(pair.task_id),
+            )
+            assert helper_rows == 2, (
+                "expected one helper journal row per continue request "
+                "(2 jobs), got %d" % helper_rows
+            )
+
+            # phase 2: collect — the aggregate-share barrier drains the
+            # helper's buckets; counts exact, journal empty
+            from janus_tpu.aggregator.collection_job_driver import (
+                CollectionJobDriver,
+            )
+
+            coll_driver = CollectionJobDriver(
+                pair.leader_ds.datastore, aiohttp.ClientSession
+            )
+
+            async def drive_collection():
+                for _ in range(20):
+                    await asyncio.sleep(0.1)
+                    leases = await pair.leader_ds.datastore.run_tx_async(
+                        "acquire_coll",
+                        lambda tx: tx.acquire_incomplete_collection_jobs(
+                            Duration(600), 10
+                        ),
+                    )
+                    for lease in leases:
+                        await coll_driver.step_collection_job(lease)
+                    pair.clock.advance(Duration(30))
+                await coll_driver.close()
+
+            async def poll():
+                for _ in range(200):
+                    out, _retry = await collector.poll_once(
+                        query, job_id, vdaf.encode_agg_param(ap1), session=session
+                    )
+                    if out is not None:
+                        return out
+                    await asyncio.sleep(0.05)
+                raise AssertionError("collection never completed")
+
+            try:
+                result, _ = await asyncio.gather(poll(), drive_collection())
+            finally:
+                await session.close()
+            expect = [0, 0, 0, 0]
+            for m in measurements:
+                expect[m >> 2] += 1
+            assert result.aggregate_result == expect
+            assert (
+                pair.helper_ds.datastore.run_tx(
+                    "count",
+                    lambda tx: tx.count_accumulator_journal_entries(pair.task_id),
+                )
+                == 0
+            ), "aggregate-share barrier must consume every helper row"
+            await driver.close()
+        finally:
+            await pair.stop()
+
+    _run(flow(), timeout=300.0)
+    reset_global_executor()
+
+
+def test_suspect_peer_tasks_filtered_at_acquisition_query():
+    """Peer-health-aware acquisition (ISSUE 13 satellite): a suspect
+    peer's tasks are excluded AT the acquire query; probing/healthy peers
+    keep acquiring (a probing peer's delivery is the half-open probe)."""
+    pytest.importorskip("cryptography")
+    from janus_tpu.aggregator.job_driver import suspect_task_ids
+    from janus_tpu.core import peer_health
+    from janus_tpu.messages import Duration
+
+    reset_global_executor()
+    peer_health.reset_peer_health()
+    exec_cfg = ExecutorConfig(enabled=True, flush_window_s=0.05)
+    pair = _PoplarPair(exec_cfg, bits=4, job_size=2)
+
+    async def flow():
+        await pair.start()
+        try:
+            for m in (0b1011, 0b0100):
+                await pair.upload(m)
+            await asyncio.sleep(0.1)
+            # create the level's aggregation jobs via a collection PUT
+            import aiohttp
+
+            from janus_tpu.collector import Collector
+            from janus_tpu.messages import CollectionJobId, Interval, Query, Time
+
+            vdaf = pair.leader_task.vdaf_instance()
+            ap1 = Poplar1AggregationParam(1, (0, 1, 2, 3))
+            collector = Collector(
+                task_id=pair.task_id,
+                leader_endpoint=pair.leader_url,
+                vdaf=vdaf,
+                auth_token=pair.col_token,
+                hpke_keypair=pair.collector_keys,
+                poll_interval=0.05,
+                max_poll_time=60.0,
+            )
+            query = Query.new_time_interval(Interval(Time(NOW_S), Duration(3600)))
+            session = aiohttp.ClientSession()
+            try:
+                await collector.create_job(
+                    query, CollectionJobId.random(),
+                    vdaf.encode_agg_param(ap1), session=session,
+                )
+            finally:
+                await session.close()
+
+            ds = pair.leader_ds.datastore
+            tracker = peer_health.tracker()
+            tracker.configure(failure_threshold=1, suspect_dwell_s=60.0)
+            url = pair.leader_task.peer_aggregator_endpoint
+
+            def acquire(tx):
+                return tx.acquire_incomplete_aggregation_jobs(
+                    Duration(1), 10,
+                    exclude_task_ids=suspect_task_ids(tx, "aggregation"),
+                )
+
+            # healthy peer: jobs acquire normally
+            leases = ds.run_tx("acq1", acquire)
+            assert leases, "healthy-peer acquisition must find the jobs"
+            for lease in leases:
+                ds.run_tx("rel", lambda tx: tx.release_aggregation_job(lease))
+
+            # suspect peer: the SAME query returns nothing
+            tracker.record_transport_failure(url)
+            assert tracker.is_suspect(url)
+            assert ds.run_tx("acq2", acquire) == []
+
+            # other-task jobs are unaffected by this peer's suspicion —
+            # and once the dwell elapses (probing), acquisition resumes
+            tracker.configure(failure_threshold=1, suspect_dwell_s=0.0)
+            tracker.record_transport_failure(url)
+            import time as _time
+
+            _time.sleep(0.01)
+            leases = ds.run_tx("acq3", acquire)
+            assert leases, "a PROBING peer's jobs must stay acquirable"
+        finally:
+            await pair.stop()
+
+    _run(flow(), timeout=120.0)
+    peer_health.reset_peer_health()
     reset_global_executor()
