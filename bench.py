@@ -1293,6 +1293,135 @@ def _sumvec_xof_evidence(vdaf, batch: int) -> dict:
     }
 
 
+def run_upload_frontdoor_config(args, scaled: bool = False) -> dict:
+    """Upload front-door row (ISSUE 14): batched vs inline HPKE opens/s
+    (the DAP default suite, X25519 / AES-128-GCM) with a parity fence,
+    plus a short in-process loadgen pass recording the reports/s the
+    full upload pipeline sustains with its SLO burn below the
+    sustainable pace and zero sheds."""
+    import asyncio
+    import secrets
+
+    from janus_tpu.core.hpke import (
+        HpkeApplicationInfo,
+        HpkeKeypair,
+        Label,
+        open_,
+        seal,
+    )
+    from janus_tpu.core.hpke_batch import open_batch
+    from janus_tpu.messages import Role
+
+    B = 128 if scaled else 512
+    info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    kp = HpkeKeypair.generate(1)
+    batch = []
+    for _ in range(B):
+        pt = secrets.token_bytes(120)
+        aad = secrets.token_bytes(48)
+        batch.append((kp, info, seal(kp.config, info, pt, aad), aad))
+
+    # parity fence BEFORE timing: a throughput number with broken parity
+    # must never be recorded
+    got = open_batch(batch)
+    want = [open_(k, i, c, a) for (k, i, c, a) in batch]
+    assert got == want, "batched open parity broke"
+
+    def best_of(fn, n=3):
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.monotonic()
+            fn()
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    t_batched = best_of(lambda: open_batch(batch))
+    t_inline = best_of(lambda: [open_(k, i, c, a) for (k, i, c, a) in batch])
+    result = {
+        "config": f"upload front door: {B} HPKE opens, batched vs inline",
+        "value": round(B / t_batched, 1),
+        "unit": "opens/s",
+        "batch": B,
+        "inline_opens_s": round(B / t_inline, 1),
+        "batched_vs_inline": round(t_inline / t_batched, 2),
+    }
+
+    # -- loadgen reports/s at SLO (in-process leader, real HTTP) ---------
+    try:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from janus_tpu.aggregator import Aggregator, Config
+        from janus_tpu.aggregator.http_handlers import aggregator_app
+        from janus_tpu.core.metrics import GLOBAL_METRICS
+        from janus_tpu.core.slo import SloEvaluator, targets_from_config
+        from janus_tpu.core.time import MockClock
+        from janus_tpu.datastore.test_util import EphemeralDatastore
+        from janus_tpu.messages import Time
+
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from loadgen import run_load
+
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+        from test_aggregator_handlers import make_pair_tasks
+
+        NOW = Time(1_600_002_000)
+        leader, _helper, _ = make_pair_tasks({"type": "Prio3Count"})
+        eds = EphemeralDatastore(MockClock(NOW))
+        eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        agg = Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(vdaf_backend="oracle", upload_open_backend="batched"),
+        )
+        evaluator = SloEvaluator(
+            targets_from_config(
+                {"upload_to_commit": {"objective": 0.95, "threshold_s": 10}}
+            ),
+            metrics=GLOBAL_METRICS,
+        )
+        evaluator.tick()
+        rate = 25 if scaled else 200
+
+        async def flow():
+            client = TestClient(TestServer(aggregator_app(agg)))
+            await client.start_server()
+            try:
+                return await run_load(
+                    str(client.make_url("/")).rstrip("/"),
+                    leader.task_id,
+                    {"type": "Prio3Count"},
+                    rate=rate,
+                    duration_s=4.0,
+                    ramp_s=0.5,
+                    concurrency=64,
+                    now_fn=lambda: NOW,
+                )
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            summary = loop.run_until_complete(flow())
+        finally:
+            loop.close()
+            eds.cleanup()
+        verdict = evaluator.tick()["upload_to_commit"]
+        slo_green = (
+            summary["outcomes"]["shed"] == 0
+            and verdict["burn_rate"]["fast"] < 1.0
+            and verdict["breaches"] == 0
+        )
+        result["loadgen_reports_s"] = summary["accepted_rate"]
+        result["loadgen_target_rate"] = rate
+        result["loadgen_slo_green"] = slo_green
+        result["loadgen_outcomes"] = summary["outcomes"]
+        if not slo_green:
+            result["error"] = "loadgen pass breached its SLO or shed"
+    except Exception as e:  # the opens/s halves still record
+        result["loadgen_skipped"] = f"{type(e).__name__}: {str(e)[:200]}"
+    return result
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch", type=int, default=16384)
@@ -1303,7 +1432,7 @@ def main() -> int:
         default="all",
         choices=["all"]
         + list(CONFIGS)
-        + ["executor16", "accum16", "mesh8", "coldtask", "poplar1_hh"],
+        + ["executor16", "accum16", "mesh8", "coldtask", "poplar1_hh", "upload_frontdoor"],
         help="one config, or 'all' for every BASELINE.md row (default); "
         "executor16 is the device-executor concurrent-task row, accum16 "
         "the same shape with the device-resident accumulator store, "
@@ -1311,7 +1440,9 @@ def main() -> int:
         "coldtask the shape-churn row (cold task joins a busy fleet: "
         "canonical buckets + background warmup vs exact-shape compile), "
         "poplar1_hh the heavy-hitters row (Poplar1 jobs coalescing at one "
-        "IDPF level through the executor vs the legacy per-job path)",
+        "IDPF level through the executor vs the legacy per-job path), "
+        "upload_frontdoor the front-door row (batched vs inline HPKE "
+        "opens/s + an in-process loadgen pass at SLO)",
     )
     parser.add_argument(
         "--side",
@@ -1380,10 +1511,19 @@ def main() -> int:
     run_mesh_row = args.config in ("all", "mesh8")
     run_coldtask_row = args.config in ("all", "coldtask")
     run_poplar_row = args.config in ("all", "poplar1_hh")
+    run_frontdoor_row = args.config in ("all", "upload_frontdoor")
     names = [
         n
         for n in names
-        if n not in ("executor16", "accum16", "mesh8", "coldtask", "poplar1_hh")
+        if n
+        not in (
+            "executor16",
+            "accum16",
+            "mesh8",
+            "coldtask",
+            "poplar1_hh",
+            "upload_frontdoor",
+        )
     ]
     # Leader-side rows for the configs whose explicit-share inputs fit the
     # tunnel comfortably; sumvec100k's leader would ship ~1.6 GB of host
@@ -1454,6 +1594,17 @@ def main() -> int:
             results["poplar1_hh"] = run_poplar_config(args, scaled=scaled)
         except Exception as e:
             _record_row_failure(results, "poplar1_hh", e)
+    if run_frontdoor_row:
+        # Upload front door (ISSUE 14): batched vs inline HPKE opens/s
+        # (parity-fenced) + loadgen reports/s with the SLO judge green;
+        # environmental failures record the structured skip like every
+        # other row.
+        try:
+            results["upload_frontdoor"] = run_upload_frontdoor_config(
+                args, scaled=scaled
+            )
+        except Exception as e:
+            _record_row_failure(results, "upload_frontdoor", e)
 
     # Headline: the north-star config when measured, else the first row
     # that produced a number (a skipped/errored headline must not zero out

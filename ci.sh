@@ -90,6 +90,21 @@
 #                      help-text audit, and the golden metric-name/label
 #                      manifest (tests/metric_manifest.txt) that catches
 #                      silent metric renames.
+#   ./ci.sh load       upload front-door gate (ISSUE 14): the SLO-judged
+#                      load soak — tools/loadgen.py drives real HTTP
+#                      uploads against a leader+helper+creator+driver
+#                      fleet of _BOOT binaries at a host-scaled target
+#                      rate (breach-free upload_to_commit/commit_age burn
+#                      rates, zero sheds), then past the shed threshold
+#                      (a queue-starved leader replica with a wedged open
+#                      stage: 503 + Retry-After, janus_upload_shed_total
+#                      moving, admitted reports' SLOs still green), then
+#                      exactly-once collection of every admitted report
+#                      and a complete upload->commit->flush->collection
+#                      merged-trace critical path.  `./ci.sh load fast`
+#                      runs only the scaled-down in-process smoke plus
+#                      the front-door unit suite (batched-open parity,
+#                      shed paths, flush-race regression).
 #   ./ci.sh benchdiff  bench-trajectory regression gate (ISSUE 12): runs
 #                      tools/bench_compare.py over the checked-in
 #                      BENCH_r*.json rows (newest run vs best prior per
@@ -239,6 +254,17 @@ case "$tier" in
     exec python -m pytest tests/test_observability.py tests/test_slo.py \
       tests/test_cost_attribution.py -q
     ;;
+  load)
+    # Upload front-door gate (ISSUE 14).  The full stage spawns a real
+    # binary fleet and sustains minutes of traffic (slow-marked); the
+    # fast variant is the in-process smoke + the unit suite.
+    if [ "${2:-}" = "fast" ]; then
+      exec python -m pytest tests/test_upload_frontdoor.py \
+        "tests/test_load_soak.py::test_loadgen_fast_smoke" -q
+    fi
+    RUN_SLOW=1 exec python -m pytest tests/test_load_soak.py \
+      tests/test_upload_frontdoor.py -q
+    ;;
   benchdiff)
     # Bench-trajectory regression gate (ISSUE 12).  Two halves: (1) the
     # checked-in trajectory must pass (neutral rows — structured skips,
@@ -290,7 +316,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|coldstart|obs|benchdiff|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|coldstart|obs|load|load fast|benchdiff|dryrun]" >&2
     exit 2
     ;;
 esac

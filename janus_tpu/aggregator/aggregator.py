@@ -102,6 +102,20 @@ class Config:
 
     max_upload_batch_size: int = 100
     max_upload_batch_write_delay: float = 0.25
+    #: Upload HPKE-open backend (ISSUE 14): "batched" groups concurrent
+    #: uploads' opens into one vectorized pass on a worker thread
+    #: (core/hpke_batch.py — bit-exact vs inline, per-report fallback on
+    #: any batch-level error); "inline" is the legacy per-report open on
+    #: the handler's event loop.
+    upload_open_backend: str = "batched"
+    #: open-batch size/delay (the ReportWriteBatcher pattern)
+    upload_open_batch_size: int = 64
+    upload_open_batch_delay: float = 0.005
+    #: Admission control: shed uploads (503 + Retry-After) once this many
+    #: opens are pending (staged + in flight), or once the oldest STAGED
+    #: open has waited upload_shed_delay_s.  <= 0 disables either signal.
+    upload_queue_max: int = 1024
+    upload_shed_delay_s: float = 2.0
     batch_aggregation_shard_count: int = 8
     task_counter_shard_count: int = 8
     task_cache_ttl: float = 30.0
@@ -206,6 +220,24 @@ class Aggregator:
             max_batch_size=self.config.max_upload_batch_size,
             max_batch_write_delay=self.config.max_upload_batch_write_delay,
             counter_shard_count=self.config.task_counter_shard_count,
+        )
+        # Front-door open stage (ISSUE 14): the batched-HPKE pipeline +
+        # admission control.  Constructed unconditionally so /statusz and
+        # the shed gate exist even under upload_open_backend: inline.
+        if self.config.upload_open_backend not in ("batched", "inline"):
+            # a typo'd backend must fail construction loudly, not silently
+            # serve the legacy path
+            raise ValueError(
+                f"unknown upload_open_backend "
+                f"{self.config.upload_open_backend!r} (batched|inline)"
+            )
+        from .report_writer import UploadOpenBatcher
+
+        self.upload_opener = UploadOpenBatcher(
+            max_batch_size=self.config.upload_open_batch_size,
+            max_batch_delay=self.config.upload_open_batch_delay,
+            max_queue=self.config.upload_queue_max,
+            shed_delay_s=self.config.upload_shed_delay_s,
         )
         # Helper-side executor routing: share the process-wide continuous
         # batcher (and its per-shape circuit breakers) with the drivers.
@@ -355,18 +387,56 @@ class Aggregator:
         # creation can link prepare back to client ingress.
         trace_id = current_trace().get("trace_id") or new_trace_id()
         with trace_scope(trace_id=trace_id), trace_span("upload", cat="upload"):
+            # Admission control (ISSUE 14): shed BEFORE any per-upload
+            # crypto or datastore work — past the front-door budget the
+            # cheapest correct answer is the retryable 503.
+            self.upload_opener.admit()
             ta = await self.task_aggregator_for(task_id)
             task = ta.task
             if task.role != Role.LEADER:
                 raise UnrecognizedTask("upload to non-leader")
             try:
-                stored = self._validate_and_open_report(ta, report)
+                keypair, info, aad = self._validate_report_pre_open(ta, report)
+            except ReportRejection as rej:
+                await self.report_writer.write_rejection(task_id, rej)
+                raise rej.to_error()
+            # The expensive open: batched (grouped with concurrent
+            # uploads, KEM on a worker thread, one vectorized AES-GCM
+            # pass) or the legacy inline call.  Either way the SAME
+            # plaintext comes back — bit-exactness is the seam contract.
+            try:
+                if self.config.upload_open_backend == "batched":
+                    plaintext = await self.upload_opener.open(
+                        keypair, info, report.leader_encrypted_input_share, aad
+                    )
+                else:
+                    import time as _time
+
+                    from ..core.metrics import GLOBAL_METRICS
+
+                    t0 = _time.monotonic()
+                    plaintext = open_(
+                        keypair, info, report.leader_encrypted_input_share, aad
+                    )
+                    if GLOBAL_METRICS.registry is not None:
+                        GLOBAL_METRICS.upload_open_seconds.labels(
+                            backend="inline"
+                        ).observe(_time.monotonic() - t0)
+            except HpkeError:
+                rej = ReportRejection(ReportRejection.DECRYPT_FAILURE, "decrypt failed")
+                await self.report_writer.write_rejection(task_id, rej)
+                raise rej.to_error()
+            try:
+                stored = self._decode_opened_report(ta, report, plaintext)
             except ReportRejection as rej:
                 await self.report_writer.write_rejection(task_id, rej)
                 raise rej.to_error()
             await self.report_writer.write_report(stored)
 
-    def _validate_and_open_report(self, ta: TaskAggregator, report: Report) -> LeaderStoredReport:
+    def _validate_report_pre_open(self, ta: TaskAggregator, report: Report):
+        """The CHEAP upload checks, run inline before the open is queued:
+        clock skew / expiry / public-share decode / key lookup.  Returns
+        (keypair, application info, aad) for the open stage."""
         task = ta.task
         now = self.clock.now()
         t = report.metadata.time
@@ -387,7 +457,6 @@ class Aggregator:
         except Exception:
             raise ReportRejection(ReportRejection.DECODE_FAILURE, "bad public share")
 
-        # HPKE-open the leader input share (task keys; reference :1587-1662)
         keypair = task.hpke_keypair_for(report.leader_encrypted_input_share.config_id)
         if keypair is None:
             raise ReportRejection(
@@ -398,10 +467,13 @@ class Aggregator:
             task.task_id, report.metadata, report.public_share
         ).get_encoded()
         info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
-        try:
-            plaintext = open_(keypair, info, report.leader_encrypted_input_share, aad)
-        except HpkeError:
-            raise ReportRejection(ReportRejection.DECRYPT_FAILURE, "decrypt failed")
+        return keypair, info, aad
+
+    def _decode_opened_report(
+        self, ta: TaskAggregator, report: Report, plaintext: bytes
+    ) -> LeaderStoredReport:
+        """Post-open decode (cheap, inline): plaintext share -> stored row."""
+        task = ta.task
         try:
             plain = PlaintextInputShare.get_decoded(plaintext)
             _check_extensions(plain.extensions)
@@ -417,6 +489,17 @@ class Aggregator:
             leader_input_share=plain.payload,
             helper_encrypted_input_share=report.helper_encrypted_input_share,
         )
+
+    def _validate_and_open_report(self, ta: TaskAggregator, report: Report) -> LeaderStoredReport:
+        """The legacy single-call inline path (pre-open checks + open +
+        decode in one synchronous pass) — kept as the reference the
+        batched pipeline is parity-tested against."""
+        keypair, info, aad = self._validate_report_pre_open(ta, report)
+        try:
+            plaintext = open_(keypair, info, report.leader_encrypted_input_share, aad)
+        except HpkeError:
+            raise ReportRejection(ReportRejection.DECRYPT_FAILURE, "decrypt failed")
+        return self._decode_opened_report(ta, report, plaintext)
 
     # ------------------------------------------------------------------
     # helper aggregate init (reference: aggregator.rs:1720 handle_aggregate_init_generic)

@@ -37,6 +37,14 @@ class ServiceUnavailable(AggregatorError):
     retry_after = 1
 
 
+class UploadShed(ServiceUnavailable):
+    """Front-door load shedding (ISSUE 14): the bounded upload queue is
+    past its depth or delay budget, so this report is refused BEFORE any
+    datastore or crypto work with the DAP-retryable 503 + Retry-After —
+    overload becomes client retry pressure instead of event-loop
+    collapse.  Counted in janus_upload_shed_total."""
+
+
 class UnrecognizedTask(AggregatorError):
     problem = DapProblemType.UNRECOGNIZED_TASK
     status = 404

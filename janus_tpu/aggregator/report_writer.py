@@ -1,4 +1,4 @@
-"""Batched upload writer.
+"""Batched upload writer + the batched HPKE-open stage.
 
 The analog of ``ReportWriteBatcher`` (reference:
 aggregator/src/aggregator/report_writer.rs:39-246): uploaded reports from all
@@ -8,6 +8,16 @@ tasks are funneled into one background batcher that commits up to
 waiting upload handlers.  In-batch duplicates by (task, report id) are
 resolved to a single write.  Rejected uploads increment the task's sharded
 upload counters (reference: report_writer.rs:324 TaskUploadCounters).
+
+ISSUE 14 adds the front door's OTHER batcher: :class:`UploadOpenBatcher`
+applies the same size/delay pattern to the expensive half of upload
+validation — the HPKE open.  Concurrent uploads' opens queue here, flush
+as ONE ``core/hpke_batch.open_batch`` call on a worker thread (per-report
+KEM off the event loop, all AES-GCM bodies as one vectorized pass), and
+its bounded queue is the admission-control point: past the depth or
+delay budget, :meth:`UploadOpenBatcher.admit` sheds with the
+DAP-retryable 503 + Retry-After instead of letting the event loop
+drown (counted in ``janus_upload_shed_total``, visible in /statusz).
 """
 
 from __future__ import annotations
@@ -20,7 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..datastore import Datastore, LeaderStoredReport, TaskUploadCounter, TxConflict
 from ..messages import TaskId
-from .error import ReportRejection
+from .error import ReportRejection, UploadShed
 
 
 class ReportWriteBatcher:
@@ -39,6 +49,14 @@ class ReportWriteBatcher:
         #: janus_report_upload_to_commit_seconds and the upload_commit span
         self._queue: List[Tuple[object, asyncio.Future, float]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
+        #: flush generation (ISSUE 14 satellite): a call_later-scheduled
+        #: _flush can interleave with a size-triggered _flush_locked — by
+        #: the time the timer task wins the lock, its cohort was already
+        #: flushed and a NEW cohort's timer may be armed.  The stale task
+        #: must neither cancel that live timer nor flush the new cohort
+        #: early, so each armed timer carries the generation it was armed
+        #: for and a fired flush whose generation has moved on is a no-op.
+        self._flush_gen = 0
         self._lock = asyncio.Lock()
 
     # ------------------------------------------------------------------
@@ -65,9 +83,10 @@ class ReportWriteBatcher:
                 await self._flush_locked()
             elif self._flush_handle is None:
                 loop = asyncio.get_running_loop()
+                gen = self._flush_gen
                 self._flush_handle = loop.call_later(
                     self.max_batch_write_delay,
-                    lambda: asyncio.ensure_future(self._flush()),
+                    lambda: asyncio.ensure_future(self._flush(gen)),
                 )
         await fut
 
@@ -85,11 +104,18 @@ class ReportWriteBatcher:
 
         await self.datastore.run_tx_async("upload_rejection", tx_fn)
 
-    async def _flush(self) -> None:
+    async def _flush(self, gen: Optional[int] = None) -> None:
         async with self._lock:
+            if gen is not None and gen != self._flush_gen:
+                # stale timer: its cohort was already size-flushed while
+                # this task waited on the lock.  Returning (instead of
+                # flushing) keeps it from cancelling the NEW cohort's
+                # timer and draining that cohort before its delay.
+                return
             await self._flush_locked()
 
     async def _flush_locked(self) -> None:
+        self._flush_gen += 1
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
@@ -180,3 +206,233 @@ class ReportWriteBatcher:
                     fut.set_exception(outcome)
         if have_metrics:
             GLOBAL_METRICS.upload_outcomes.labels(decision="accepted").inc(accepted)
+
+
+# ---------------------------------------------------------------------------
+# the batched HPKE-open stage (ISSUE 14 tentpole)
+
+
+#: The process's front-door open batcher, registered at construction so
+#: /statusz can render queue depth / shed counts without holding the
+#: Aggregator (one aggregator binary per process; tests that build
+#: several see the most recent, which is the serving one).
+_FRONTDOOR: Optional["UploadOpenBatcher"] = None
+
+
+def frontdoor_stats() -> Optional[dict]:
+    """The /statusz "upload" section (None when no opener exists —
+    driver/creator binaries)."""
+    return _FRONTDOOR.stats() if _FRONTDOOR is not None else None
+
+
+class UploadOpenBatcher:
+    """Size/delay batcher for upload HPKE opens + the front door's
+    admission-control point.
+
+    ``open()`` enqueues one report's open; a batch flushes when
+    ``max_batch_size`` opens are pending or ``max_batch_delay`` elapses,
+    as ONE ``hpke_batch.open_batch`` call on a worker thread — the KEM
+    leaves the event loop, the AES-GCM bodies fuse into one vectorized
+    pass, and per-report error slots keep one malformed ciphertext from
+    touching its batchmates.  Multiple flushes may be in flight at once
+    (the lock covers only queue surgery, never crypto).
+
+    ``admit()`` is the load-shedding gate: callers invoke it BEFORE any
+    per-upload work; past ``max_queue`` pending opens, or once the oldest
+    pending open has waited ``shed_delay_s``, it raises
+    :class:`UploadShed` (503 + Retry-After).  Both signals mean the open
+    stage is not keeping up — refusing new work with a retryable error is
+    strictly cheaper than queueing it to time out."""
+
+    def __init__(
+        self,
+        max_batch_size: int = 64,
+        max_batch_delay: float = 0.005,
+        max_queue: int = 1024,
+        shed_delay_s: float = 2.0,
+    ):
+        self.max_batch_size = max_batch_size
+        self.max_batch_delay = max_batch_delay
+        self.max_queue = max_queue
+        self.shed_delay_s = shed_delay_s
+        #: (request 4-tuple, waiter, enqueue-monotonic)
+        self._queue: List[Tuple[tuple, asyncio.Future, float]] = []
+        #: detached-but-unresolved batches: seq -> (rows, oldest enqueue).
+        #: Admission control MUST count these — the staging queue drains
+        #: into flight at max_batch_size/max_batch_delay granularity, so
+        #: on its own it can never reach a real queue bound while a slow
+        #: open stage piles work up on the thread pool.
+        self._inflight: Dict[int, Tuple[int, float]] = {}
+        self._batch_seq = 0
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._flush_gen = 0
+        self._lock = asyncio.Lock()
+        self._sheds = {"queue_full": 0, "queue_delay": 0}
+        self._batches = 0
+        self._opened = 0
+        global _FRONTDOOR
+        _FRONTDOOR = self
+
+    # -- admission control ----------------------------------------------
+    def queue_depth(self) -> int:
+        """Opens pending anywhere in the front door: staged + in flight.
+        The DEPTH bound must count detached-but-unresolved batches — the
+        staging queue drains into flight at batch-size granularity, so
+        on its own it could never reach a real bound while a slow open
+        stage piles work up on the thread pool."""
+        return len(self._queue) + sum(n for n, _enq in self._inflight.values())
+
+    def oldest_wait_s(self) -> float:
+        """Age of the oldest STAGED open.  Deliberately excludes
+        in-flight batches: their age spikes transiently on one-off costs
+        (a cold XLA compile of a new pow2 kernel shape) that the depth
+        bound already covers — a staged entry aging past budget, by
+        contrast, means flushes have stopped being picked up at all
+        (event-loop or timer starvation), which is exactly the collapse
+        the delay shed exists to catch."""
+        return time.monotonic() - self._queue[0][2] if self._queue else 0.0
+
+    def admit(self) -> None:
+        """Raise :class:`UploadShed` when the front door is past budget;
+        counted per reason in janus_upload_shed_total."""
+        reason = None
+        if self.max_queue > 0 and self.queue_depth() >= self.max_queue:
+            reason = "queue_full"
+        elif self.shed_delay_s > 0 and self.oldest_wait_s() > self.shed_delay_s:
+            reason = "queue_delay"
+        if reason is None:
+            return
+        from ..core.metrics import GLOBAL_METRICS
+
+        self._sheds[reason] += 1
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.upload_sheds.labels(reason=reason).inc()
+        raise UploadShed(f"upload front door over {reason} budget; retry")
+
+    # -- the open stage --------------------------------------------------
+    async def open(self, keypair, info, ciphertext, aad) -> bytes:
+        """Resolve to the plaintext when this report's batch opens;
+        raises HpkeError on a per-report decrypt failure."""
+        fut = asyncio.get_running_loop().create_future()
+        async with self._lock:
+            self._queue.append(((keypair, info, ciphertext, aad), fut, time.monotonic()))
+            self._publish_depth()
+            if len(self._queue) >= self.max_batch_size:
+                await self._flush_locked()
+            elif self._flush_handle is None:
+                loop = asyncio.get_running_loop()
+                gen = self._flush_gen
+                self._flush_handle = loop.call_later(
+                    self.max_batch_delay,
+                    lambda: asyncio.ensure_future(self._flush(gen)),
+                )
+        return await fut
+
+    async def _flush(self, gen: Optional[int] = None) -> None:
+        async with self._lock:
+            if gen is not None and gen != self._flush_gen:
+                return  # stale timer (see ReportWriteBatcher._flush)
+            await self._flush_locked()
+
+    async def _flush_locked(self) -> None:
+        """Detach the pending cohort and launch its open off-lock: the
+        lock guards queue surgery only, so several batches can be in
+        flight on the thread pool at once."""
+        self._flush_gen += 1
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        batch, self._queue = self._queue, []
+        if not batch:
+            self._publish_depth()
+            return
+        seq = self._batch_seq
+        self._batch_seq += 1
+        self._inflight[seq] = (len(batch), batch[0][2])
+        self._publish_depth()
+        asyncio.ensure_future(self._run_batch(batch, seq))
+
+    async def _run_batch(self, batch, seq: int) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        requests = [item for item, _fut, _enq in batch]
+        t0 = time.monotonic()
+        try:
+            loop = asyncio.get_running_loop()
+            try:
+                results = await loop.run_in_executor(
+                    None, _open_batch_worker, requests
+                )
+            except Exception:
+                # batch-LEVEL failure: per-report fallback — STILL on the
+                # thread pool (a batch bug, or an injected upload.open
+                # error, must reject nothing the inline path would
+                # accept, and must not dump a batch of serial crypto
+                # onto the event loop either)
+                results = await loop.run_in_executor(
+                    None, _open_fallback_worker, requests
+                )
+            took = time.monotonic() - t0
+            self._batches += 1
+            self._opened += len(batch)
+            if GLOBAL_METRICS.registry is not None:
+                GLOBAL_METRICS.upload_open_batch_rows.observe(len(batch))
+                GLOBAL_METRICS.upload_open_seconds.labels(backend="batched").observe(took)
+        except BaseException as e:
+            # nothing above should throw, but a stranded upload handler
+            # (future never resolved) is the one unacceptable outcome
+            for _item, fut, _enq in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        e if isinstance(e, Exception) else RuntimeError(str(e))
+                    )
+            raise
+        finally:
+            self._inflight.pop(seq, None)
+            self._publish_depth()
+        for (_item, fut, _enq), result in zip(batch, results):
+            if fut.done():
+                continue
+            if isinstance(result, Exception):
+                fut.set_exception(result)
+            else:
+                fut.set_result(result)
+
+    def _publish_depth(self) -> None:
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.upload_queue_depth.set(self.queue_depth())
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self.queue_depth(),
+            "staged": len(self._queue),
+            "inflight": sum(n for n, _enq in self._inflight.values()),
+            "oldest_wait_s": round(self.oldest_wait_s(), 4),
+            "max_queue": self.max_queue,
+            "shed_delay_s": self.shed_delay_s,
+            "sheds": dict(self._sheds),
+            "batches": self._batches,
+            "opened": self._opened,
+        }
+
+
+def _open_batch_worker(requests):
+    """Thread-pool body of one open batch; the ``upload.open`` fault
+    point lets chaos wedge the open stage (delay mode backs the queue up
+    into sheds; error mode exercises the per-report fallback)."""
+    from ..core import faults
+    from ..core.hpke_batch import open_batch
+
+    faults.fire("upload.open")
+    return open_batch(requests)
+
+
+def _open_fallback_worker(requests):
+    """Per-report inline opens (errors as values) — the batch-level
+    failure fallback, also on the thread pool."""
+    from ..core.hpke_batch import _open_one
+
+    return [_open_one(*r) for r in requests]

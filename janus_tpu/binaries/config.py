@@ -330,6 +330,19 @@ class AggregatorConfig:
     listen_address: str = "0.0.0.0:8080"
     max_upload_batch_size: int = 100
     max_upload_batch_write_delay_ms: int = 250
+    #: Upload HPKE-open backend (ISSUE 14): "batched" groups concurrent
+    #: uploads' expensive opens into one vectorized AES-GCM pass on a
+    #: worker thread (bit-exact vs inline, per-report fallback on any
+    #: batch-level error); "inline" keeps the legacy per-report open.
+    upload_open_backend: str = "batched"
+    upload_open_batch_size: int = 64
+    upload_open_batch_delay_ms: int = 5
+    #: Front-door admission control: past this many pending opens — or
+    #: once the oldest pending open has waited upload_shed_delay_s —
+    #: uploads shed with the DAP-retryable 503 + Retry-After (counted in
+    #: janus_upload_shed_total) instead of drowning the event loop.
+    upload_queue_max: int = 1024
+    upload_shed_delay_s: float = 2.0
     batch_aggregation_shard_count: int = 8
     task_counter_shard_count: int = 8
     #: "tpu" routes whole-job prepare through one batched device launch.

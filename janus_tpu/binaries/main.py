@@ -335,6 +335,11 @@ def run_aggregator(config_path: Optional[str]) -> None:
         Config(
             max_upload_batch_size=cfg.max_upload_batch_size,
             max_upload_batch_write_delay=cfg.max_upload_batch_write_delay_ms / 1000.0,
+            upload_open_backend=cfg.upload_open_backend,
+            upload_open_batch_size=cfg.upload_open_batch_size,
+            upload_open_batch_delay=cfg.upload_open_batch_delay_ms / 1000.0,
+            upload_queue_max=cfg.upload_queue_max,
+            upload_shed_delay_s=cfg.upload_shed_delay_s,
             batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
             task_counter_shard_count=cfg.task_counter_shard_count,
             vdaf_backend=cfg.vdaf_backend,

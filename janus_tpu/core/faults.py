@@ -20,6 +20,8 @@ tests/test_chaos.py cross-checks them):
     ``backend.device_lost``  same site, impersonating a lost mesh device
     ``backend.combine``      in ``TpuBackend.prep_shares_to_prep_batch``
     ``clock.skew``           sampled by ``SkewedClock.now``
+    ``upload.open``          head of each batched HPKE-open pass
+                             (UploadOpenBatcher worker thread)
     ``report_writer.flush``  before a ReportWriteBatcher batch commit
     ``gc.run``               per-task GC pass (GarbageCollector._gc_task)
     ``key_rotator.run``      at the head of an HpkeKeyRotator tick
@@ -98,6 +100,11 @@ KNOWN_POINTS = (
     # mesh-backed shape shares one circuit) is what this point exercises.
     "backend.device_lost",
     "clock.skew",
+    # upload front door (ISSUE 14): head of each batched HPKE-open pass
+    # (UploadOpenBatcher's worker thread) — delay mode backs the bounded
+    # queue up into load sheds, error mode exercises the per-report
+    # inline fallback
+    "upload.open",
     # maintenance loops (ISSUE 3 satellite: ROADMAP chaos follow-on)
     "report_writer.flush",
     "gc.run",

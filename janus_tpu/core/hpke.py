@@ -72,6 +72,21 @@ except ImportError:  # pragma: no cover - baked into the prod image
     PrivateFormat = _MissingCryptography("PrivateFormat")
     PublicFormat = _MissingCryptography("PublicFormat")
 
+# De-shim (ISSUE 14): the HPKE tier no longer DIES without a functional
+# `cryptography` — pure-Python RFC 7748 X25519 + P-256 ECDH
+# (utils/purecurves.py) and soft AES-GCM / ChaCha20-Poly1305
+# (utils/gcm.py) carry every supported suite, KAT-anchored by the same
+# RFC 9180 vendored vectors.  HAVE_FUNCTIONAL_CRYPTOGRAPHY is a
+# known-answer probe, not an import check: dev-container shims that
+# import fine but compute garbage land on the fallbacks too.  The real
+# library is preferred whenever it actually works (AES-NI, constant-time
+# curves); the fallbacks are NOT constant-time and exist for dev/test
+# hosts, never as a production preference.
+from ..utils import purecurves as _curves
+from ..utils.gcm import HAVE_FUNCTIONAL_CRYPTOGRAPHY
+from ..utils.gcm import aesgcm as _aesgcm
+from ..utils.gcm import chacha20poly1305 as _chacha20poly1305
+
 from ..messages import (
     HpkeAeadId,
     HpkeCiphertext,
@@ -155,18 +170,35 @@ class _X25519Kem:
     def _suite_id(cls) -> bytes:
         return b"KEM" + cls.ID.value.to_bytes(2, "big")
 
+    @staticmethod
+    def _exchange(sk_bytes: bytes, pk_bytes: bytes) -> bytes:
+        if HAVE_FUNCTIONAL_CRYPTOGRAPHY:
+            sk = X25519PrivateKey.from_private_bytes(sk_bytes)
+            return sk.exchange(X25519PublicKey.from_public_bytes(pk_bytes))
+        dh = _curves.x25519(sk_bytes, pk_bytes)
+        # mirror the real library: an all-zero shared secret (small-order
+        # peer point) is rejected, not silently key-scheduled
+        if dh == b"\x00" * 32:
+            raise ValueError("X25519 produced an all-zero shared secret")
+        return dh
+
     @classmethod
     def generate_keypair(cls) -> Tuple[bytes, bytes]:
-        sk = X25519PrivateKey.generate()
-        return (
-            sk.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption()),
-            sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw),
-        )
+        if HAVE_FUNCTIONAL_CRYPTOGRAPHY:
+            sk = X25519PrivateKey.generate()
+            return (
+                sk.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption()),
+                sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw),
+            )
+        sk_bytes = os.urandom(32)
+        return sk_bytes, _curves.x25519_public(sk_bytes)
 
     @classmethod
     def public_from_private(cls, sk_bytes: bytes) -> bytes:
-        sk = X25519PrivateKey.from_private_bytes(sk_bytes)
-        return sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        if HAVE_FUNCTIONAL_CRYPTOGRAPHY:
+            sk = X25519PrivateKey.from_private_bytes(sk_bytes)
+            return sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        return _curves.x25519_public(sk_bytes)
 
     @classmethod
     def _extract_and_expand(cls, dh: bytes, kem_context: bytes) -> bytes:
@@ -177,20 +209,19 @@ class _X25519Kem:
     @classmethod
     def encap(cls, pk_r: bytes, ephemeral_sk: Optional[bytes] = None) -> Tuple[bytes, bytes]:
         """Returns (shared_secret, enc).  ephemeral_sk injectable for KATs."""
-        sk_e = (
-            X25519PrivateKey.from_private_bytes(ephemeral_sk)
-            if ephemeral_sk is not None
-            else X25519PrivateKey.generate()
-        )
-        enc = sk_e.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
-        dh = sk_e.exchange(X25519PublicKey.from_public_bytes(pk_r))
+        sk_e_bytes = ephemeral_sk if ephemeral_sk is not None else os.urandom(32)
+        enc = cls.public_from_private(sk_e_bytes)
+        dh = cls._exchange(sk_e_bytes, pk_r)
         return cls._extract_and_expand(dh, enc + pk_r), enc
 
     @classmethod
-    def decap(cls, enc: bytes, sk_r: bytes) -> bytes:
-        sk = X25519PrivateKey.from_private_bytes(sk_r)
-        dh = sk.exchange(X25519PublicKey.from_public_bytes(enc))
-        pk_r = sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    def decap(cls, enc: bytes, sk_r: bytes, pk_r: Optional[bytes] = None) -> bytes:
+        """``pk_r`` (the recipient public key, which every HpkeKeypair
+        already carries) skips re-deriving it from the private scalar —
+        one whole ladder per open on the pure-Python path."""
+        dh = cls._exchange(sk_r, enc)
+        if pk_r is None:
+            pk_r = cls.public_from_private(sk_r)
         return cls._extract_and_expand(dh, enc + pk_r)
 
 
@@ -209,16 +240,25 @@ class _P256Kem:
 
     @classmethod
     def generate_keypair(cls) -> Tuple[bytes, bytes]:
-        sk = ec.generate_private_key(cls._curve)
-        return (
-            sk.private_numbers().private_value.to_bytes(32, "big"),
-            sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint),
-        )
+        if HAVE_FUNCTIONAL_CRYPTOGRAPHY:
+            sk = ec.generate_private_key(cls._curve)
+            return (
+                sk.private_numbers().private_value.to_bytes(32, "big"),
+                sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint),
+            )
+        while True:
+            sk_bytes = os.urandom(32)
+            try:
+                return sk_bytes, _curves.p256_public(sk_bytes)
+            except ValueError:  # pragma: no cover - scalar == 0 mod n
+                continue
 
     @classmethod
     def public_from_private(cls, sk_bytes: bytes) -> bytes:
-        sk = ec.derive_private_key(int.from_bytes(sk_bytes, "big"), cls._curve)
-        return sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
+        if HAVE_FUNCTIONAL_CRYPTOGRAPHY:
+            sk = ec.derive_private_key(int.from_bytes(sk_bytes, "big"), cls._curve)
+            return sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
+        return _curves.p256_public(sk_bytes)
 
     @classmethod
     def _extract_and_expand(cls, dh: bytes, kem_context: bytes) -> bytes:
@@ -227,32 +267,40 @@ class _P256Kem:
         return _labeled_expand(cls._hash, suite, eae_prk, b"shared_secret", kem_context, cls.N_SECRET)
 
     @classmethod
+    def _exchange(cls, sk_bytes: bytes, pk_bytes: bytes) -> bytes:
+        if HAVE_FUNCTIONAL_CRYPTOGRAPHY:
+            sk = ec.derive_private_key(int.from_bytes(sk_bytes, "big"), cls._curve)
+            peer = ec.EllipticCurvePublicKey.from_encoded_point(cls._curve, pk_bytes)
+            return sk.exchange(ec.ECDH(), peer)
+        return _curves.p256_ecdh(sk_bytes, pk_bytes)
+
+    @classmethod
     def encap(cls, pk_r: bytes, ephemeral_sk: Optional[bytes] = None) -> Tuple[bytes, bytes]:
-        sk_e = (
-            ec.derive_private_key(int.from_bytes(ephemeral_sk, "big"), cls._curve)
-            if ephemeral_sk is not None
-            else ec.generate_private_key(cls._curve)
-        )
-        enc = sk_e.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
-        peer = ec.EllipticCurvePublicKey.from_encoded_point(cls._curve, pk_r)
-        dh = sk_e.exchange(ec.ECDH(), peer)
+        if ephemeral_sk is None:
+            ephemeral_sk, enc = cls.generate_keypair()
+        else:
+            enc = cls.public_from_private(ephemeral_sk)
+        dh = cls._exchange(ephemeral_sk, pk_r)
         return cls._extract_and_expand(dh, enc + pk_r), enc
 
     @classmethod
-    def decap(cls, enc: bytes, sk_r: bytes) -> bytes:
-        sk = ec.derive_private_key(int.from_bytes(sk_r, "big"), cls._curve)
-        peer = ec.EllipticCurvePublicKey.from_encoded_point(cls._curve, enc)
-        dh = sk.exchange(ec.ECDH(), peer)
-        pk_r = sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
+    def decap(cls, enc: bytes, sk_r: bytes, pk_r: Optional[bytes] = None) -> bytes:
+        dh = cls._exchange(sk_r, enc)
+        if pk_r is None:
+            pk_r = cls.public_from_private(sk_r)
         return cls._extract_and_expand(dh, enc + pk_r)
 
 
 _KEMS = {k.ID: k for k in (_X25519Kem, _P256Kem)}
 
+#: aead_id -> (key len, nonce len, AEAD factory).  The factories are the
+#: utils/gcm.py seam: `cryptography`'s implementations when functional,
+#: the KAT-anchored soft fallbacks otherwise — either way the returned
+#: object answers .encrypt/.decrypt(nonce, data, aad).
 _AEAD_PARAMS = {
-    HpkeAeadId.AES_128_GCM: (16, 12, AESGCM),
-    HpkeAeadId.AES_256_GCM: (32, 12, AESGCM),
-    HpkeAeadId.CHACHA20_POLY1305: (32, 12, ChaCha20Poly1305),
+    HpkeAeadId.AES_128_GCM: (16, 12, _aesgcm),
+    HpkeAeadId.AES_256_GCM: (32, 12, _aesgcm),
+    HpkeAeadId.CHACHA20_POLY1305: (32, 12, _chacha20poly1305),
 }
 
 
@@ -347,7 +395,11 @@ def open_(
         raise HpkeError("unsupported HPKE configuration")
     kem = _KEMS[config.kem_id]
     try:
-        shared_secret = kem.decap(ciphertext.encapsulated_key, recipient_keypair.private_key)
+        shared_secret = kem.decap(
+            ciphertext.encapsulated_key,
+            recipient_keypair.private_key,
+            pk_r=config.public_key.raw,
+        )
         key, base_nonce = _key_schedule(
             config.kem_id, config.kdf_id, config.aead_id, shared_secret, application_info.raw
         )

@@ -624,6 +624,43 @@ class Metrics:
             registry=self.registry,
             buckets=_LATENCY_BUCKETS,
         )
+        # -- upload front door (ISSUE 14 tentpole) -----------------------
+        # Load shedding: uploads refused at the bounded front-door queue
+        # (503 + Retry-After, the DAP-retryable shape) by reason —
+        # queue_full is depth pressure, queue_delay is the oldest pending
+        # open blowing its latency budget.  Overload degrades into client
+        # retry pressure instead of event-loop collapse; this counter is
+        # the alertable signal that it is happening.
+        self.upload_sheds = Counter(
+            "janus_upload_shed_total",
+            "Uploads shed at the front-door queue (503 + Retry-After) by "
+            "reason (queue_full|queue_delay)",
+            ["reason"],
+            registry=self.registry,
+        )
+        # Batched HPKE open (core/hpke_batch.py): how many opens each
+        # vectorized pass carried (amortization is the whole point), how
+        # long the open stage takes per backend, and the live front-door
+        # queue depth the shed decision reads.
+        self.upload_open_batch_rows = Histogram(
+            "janus_upload_open_batch_rows",
+            "HPKE opens per batched front-door open pass",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+            registry=self.registry,
+        )
+        self.upload_open_seconds = Histogram(
+            "janus_upload_open_duration_seconds",
+            "Upload HPKE-open stage wall time by backend "
+            "(batched: per batch pass; inline: per report)",
+            ["backend"],
+            buckets=_LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.upload_queue_depth = Gauge(
+            "janus_upload_queue_depth",
+            "Front-door uploads pending in the batched HPKE-open queue",
+            registry=self.registry,
+        )
         # -- SLO evaluation plane (core/slo.py) --------------------------
         # Burn rate = window error rate / error budget: 1.0 means the SLO
         # spends its budget exactly at the sustainable pace, >1 means it

@@ -48,6 +48,10 @@ def runtime_status() -> dict:
         # state + failure counts — the first thing to check when a soak
         # quiesces (partition pressure vs a bug)
         "peers": _peer_stats(),
+        # Upload front door (ISSUE 14): batched-open queue depth, shed
+        # counts per reason, and batch/open totals — the overload story
+        # at a glance (None on binaries that serve no uploads)
+        "upload": _upload_stats(),
     }
 
     from ..executor import peek_global_executor
@@ -93,6 +97,18 @@ def _peer_stats() -> dict:
         return tracker().stats()
     except Exception:
         logger.exception("peer-health stats unavailable")
+        return {"error": "unavailable"}
+
+
+def _upload_stats():
+    """Front-door open-batcher stats (aggregator/report_writer.py);
+    failure-tolerant like every other section."""
+    try:
+        from ..aggregator.report_writer import frontdoor_stats
+
+        return frontdoor_stats()
+    except Exception:
+        logger.exception("upload front-door stats unavailable")
         return {"error": "unavailable"}
 
 

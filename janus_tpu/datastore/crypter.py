@@ -5,6 +5,12 @@ aggregator_core/src/datastore.rs:5622-5720): values are sealed with
 AAD = (table, row-identifier, column) so ciphertexts cannot be swapped
 between rows/columns; multiple keys support rotation — the first key
 encrypts, every key is tried on decrypt.
+
+The AEAD comes from the utils/gcm.py seam (ISSUE 14 de-shim):
+`cryptography`'s AESGCM whenever it is importable AND functional
+(known-answer probed — AES-NI in production), the KAT-anchored soft
+fallback otherwise, so the datastore — and every suite that needs one —
+runs on cryptography-less dev hosts too.
 """
 
 from __future__ import annotations
@@ -13,18 +19,11 @@ import os
 import secrets
 from typing import List, Sequence
 
-try:
-    from cryptography.exceptions import InvalidTag
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from ..utils.gcm import INVALID_TAG_EXCEPTIONS, aesgcm
 
-    HAVE_CRYPTOGRAPHY = True
-except ImportError:  # pragma: no cover - baked into the prod image
-    # Import gate for environments without the ``cryptography`` wheel
-    # (compute-only containers): the datastore package — and everything
-    # that imports it, e.g. the job drivers — stays importable; building
-    # an actual Crypter fails loudly below.
-    HAVE_CRYPTOGRAPHY = False
-    InvalidTag = AESGCM = None
+#: Kept for callers that used to gate on the wheel: the AEAD seam always
+#: works now (soft fallback), so this is about which BACKEND serves.
+from ..utils.gcm import HAVE_FUNCTIONAL_CRYPTOGRAPHY as HAVE_CRYPTOGRAPHY  # noqa: F401
 
 KEY_LEN = 16
 NONCE_LEN = 12
@@ -40,17 +39,12 @@ def generate_key() -> bytes:
 
 class Crypter:
     def __init__(self, keys: Sequence[bytes]):
-        if not HAVE_CRYPTOGRAPHY:
-            raise ModuleNotFoundError(
-                "the 'cryptography' package is required for datastore "
-                "column encryption"
-            )
         if not keys:
             raise CrypterError("Crypter requires at least one key")
         for k in keys:
             if len(k) != KEY_LEN:
                 raise CrypterError(f"datastore keys must be {KEY_LEN} bytes")
-        self._aeads: List[AESGCM] = [AESGCM(k) for k in keys]
+        self._aeads: List[object] = [aesgcm(k) for k in keys]
 
     @staticmethod
     def _aad(table: str, row: bytes, column: str) -> bytes:
@@ -69,6 +63,6 @@ class Crypter:
         for aead in self._aeads:
             try:
                 return aead.decrypt(nonce, ct, aad)
-            except InvalidTag:
+            except INVALID_TAG_EXCEPTIONS:
                 continue
         raise CrypterError(f"unable to decrypt {table}.{column}")

@@ -258,7 +258,8 @@ class XofHmacSha256Aes128(Xof):
     def __init__(self, seed: bytes, dst: bytes, binder: bytes):
         import hmac as _hmac
         import hashlib as _hashlib
-        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        from .utils.softaes import aes128_ctr_encryptor
 
         if len(seed) != self.SEED_SIZE:
             raise ValueError("bad seed size")
@@ -269,8 +270,10 @@ class XofHmacSha256Aes128(Xof):
         mac.update(dst)
         mac.update(binder)
         key_block = mac.digest()
-        cipher = Cipher(algorithms.AES(key_block[:16]), modes.CTR(key_block[16:]))
-        self._enc = cipher.encryptor()
+        # `cryptography`'s AES-NI CTR when functional, the numpy soft-AES
+        # fallback otherwise (ISSUE 14 de-shim): HMAC-XOF VDAF instances
+        # no longer die on cryptography-less hosts.
+        self._enc = aes128_ctr_encryptor(key_block[:16], key_block[16:])
 
     def next(self, length: int) -> bytes:
         return self._enc.update(b"\x00" * length)

@@ -121,6 +121,7 @@ def test_every_known_point_is_wired():
         "backend.device_lost": "janus_tpu/vdaf/backend.py",
         "backend.combine": "janus_tpu/vdaf/backend.py",
         "clock.skew": "janus_tpu/core/faults.py",
+        "upload.open": "janus_tpu/aggregator/report_writer.py",
         "report_writer.flush": "janus_tpu/aggregator/report_writer.py",
         "gc.run": "janus_tpu/aggregator/garbage_collector.py",
         "key_rotator.run": "janus_tpu/aggregator/key_rotator.py",
@@ -498,7 +499,6 @@ def test_collection_budget_releases_with_backoff_then_abandons():
 def test_injected_tx_faults_are_absorbed_by_run_tx():
     """Transaction-boundary faults at p=0.5 look like lock contention:
     every transaction still commits (run_tx's retry loop absorbs them)."""
-    pytest.importorskip("cryptography")
     from janus_tpu.datastore.test_util import EphemeralDatastore
 
     eph = EphemeralDatastore()
@@ -812,7 +812,6 @@ def test_chaos_soak_two_replicas_multitask():
     """THE ACCEPTANCE SOAK: all injection points at p~=0.2 over a
     2-replica 2-task run; every job terminal, breaker trip AND recovery
     observable in the /metrics payload, aggregates exactly the oracle's."""
-    pytest.importorskip("cryptography")
     from janus_tpu.core.metrics import GLOBAL_METRICS
 
     reset_global_executor()
@@ -923,7 +922,6 @@ def test_poplar1_chaos_device_lost_oracle_fallback_exactly_once():
     owning store "crashes" before draining, and the collection-time
     replay re-derives the level's shares from the datastore: heavy-hitter
     counts bit-exact, journal empty, nothing double-merged."""
-    pytest.importorskip("cryptography")
     from test_poplar_executor import NOW_S, _PoplarPair
 
     from janus_tpu.executor import AccumulatorConfig
@@ -1116,7 +1114,6 @@ def test_helper_datastore_unreachable_returns_503_with_retry_after():
     503 (+ Retry-After) — not 500 — so the leader's lease machinery
     redelivers instead of burning failure budget on the split-brain
     window."""
-    pytest.importorskip("cryptography")
     from janus_tpu.aggregator import Aggregator, Config, aggregator_app
     from janus_tpu.datastore.test_util import EphemeralDatastore
     from janus_tpu.messages import TaskId
@@ -1160,7 +1157,6 @@ def test_helper_redelivery_after_503_is_exactly_once():
     succeeds on redelivery, and a SECOND redelivery of the same body (the
     partition ate the leader's response) returns the stored response
     without double-accumulating — report counts stay exactly-once."""
-    pytest.importorskip("cryptography")
     from test_aggregator_handlers import (
         AGG_TOKEN,
         NOW as HANDLER_NOW,
@@ -1248,7 +1244,6 @@ def test_partition_soak_asymmetric_heal_exactly_once():
     stays zero).  After the heal: every job finishes, collection counts
     are exactly-once against the oracle sums, and the soak's own SLO
     evaluation shows zero false breaches."""
-    pytest.importorskip("cryptography")
     from urllib.parse import urlsplit
 
     from janus_tpu.core import peer_health
@@ -1440,7 +1435,6 @@ def test_partition_flap_soak_suspect_dwell_restart_exactly_once():
     the churn (several suspect transitions) without a single abandoned
     job or expired lease.  Once the link settles: every job finishes and
     collection counts are exactly-once."""
-    pytest.importorskip("cryptography")
     from urllib.parse import urlsplit
 
     from janus_tpu.core import peer_health
@@ -1598,7 +1592,6 @@ def test_mesh_chaos_device_lost_opens_per_mesh_breaker_oracle_exact():
     mid-launch) opens the PER-MESH circuit breaker, jobs degrade to the
     bit-exact CPU oracle, and collection still returns exactly-once
     counts."""
-    pytest.importorskip("cryptography")
     import jax
 
     if len(jax.devices()) < 2:

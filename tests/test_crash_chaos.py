@@ -63,7 +63,6 @@ import urllib.request
 
 import pytest
 
-pytest.importorskip("cryptography")
 
 from janus_tpu.core.hpke import HpkeApplicationInfo, HpkeKeypair, Label, open_
 from janus_tpu.core.time import RealClock

@@ -87,6 +87,39 @@ def test_rfc9180_base_mode_kat(vec):
     assert pt == bytes.fromhex(first["pt"])
 
 
+@pytest.mark.parametrize("vec", KAT_VECTORS, ids=_vec_id)
+def test_rfc9180_kat_forced_soft_fallback(vec, monkeypatch):
+    """The SAME vectors through the pure-Python fallback tier (ISSUE 14
+    de-shim: utils/purecurves.py + utils/gcm.py), with the functional-
+    cryptography probes forced off — so hosts that HAVE the real wheel
+    still prove the fallback, and cryptography-less hosts prove it twice.
+    """
+    import janus_tpu.core.hpke as hpke_mod
+    import janus_tpu.utils.gcm as gcm_mod
+
+    monkeypatch.setattr(hpke_mod, "HAVE_FUNCTIONAL_CRYPTOGRAPHY", False)
+    monkeypatch.setattr(gcm_mod, "HAVE_FUNCTIONAL_CRYPTOGRAPHY", False)
+
+    kem_id = HpkeKemId(vec["kem_id"])
+    kdf_id = HpkeKdfId(vec["kdf_id"])
+    aead_id = HpkeAeadId(vec["aead_id"])
+    kem = _KEMS[kem_id]
+    pk_r = bytes.fromhex(vec["pkRm"])
+    sk_r = bytes.fromhex(vec["skRm"])
+    assert kem.public_from_private(sk_r) == pk_r
+    config = HpkeConfig(1, kem_id, kdf_id, aead_id, HpkePublicKey(pk_r))
+    keypair = HpkeKeypair(config, sk_r)
+    first = vec["encryptions"][0]
+    ct = HpkeCiphertext(1, bytes.fromhex(vec["enc"]), bytes.fromhex(first["ct"]))
+    info = HpkeApplicationInfo(bytes.fromhex(vec["info"]))
+    assert open_(keypair, info, ct, bytes.fromhex(first["aad"])) == bytes.fromhex(
+        first["pt"]
+    )
+    # and a full seal/open round trip on the fallback primitives
+    sealed = seal(config, info, b"fallback round trip", b"aad")
+    assert open_(keypair, info, sealed, b"aad") == b"fallback round trip"
+
+
 def test_seal_open_roundtrip_all_suites():
     app_info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
     for kem_id in (HpkeKemId.X25519_HKDF_SHA256, HpkeKemId.P256_HKDF_SHA256):

@@ -431,7 +431,6 @@ def test_golden_metric_manifest():
 
 
 def test_job_age_and_trace_id_surface_at_acquire(tmp_path):
-    pytest.importorskip("cryptography")
     from janus_tpu.core.time import RealClock
     from janus_tpu.datastore import (
         AggregationJob,
@@ -481,7 +480,6 @@ def test_job_age_and_trace_id_surface_at_acquire(tmp_path):
 
 
 def test_report_commit_age_observed_on_upload_batch(tmp_path):
-    pytest.importorskip("cryptography")
     from janus_tpu.aggregator.report_writer import ReportWriteBatcher
     from janus_tpu.core.time import RealClock
     from janus_tpu.datastore import (
@@ -532,7 +530,6 @@ def test_upload_trace_minted_and_persisted_through_writer(tmp_path):
     """ISSUE 9 tentpole: every report committed through the writer carries
     an upload trace id — adopted from the bound context when one exists,
     minted otherwise — persisted on its client_reports row."""
-    pytest.importorskip("cryptography")
     from janus_tpu.aggregator.report_writer import ReportWriteBatcher
     from janus_tpu.core.time import RealClock
     from janus_tpu.datastore import Crypter, Datastore, LeaderStoredReport, generate_key
@@ -584,7 +581,6 @@ def test_job_create_span_links_upload_traces(tmp_path):
     whose ``links`` carry the packed reports' upload trace ids — the
     stitch point between client ingress and the job's cross-process
     timeline."""
-    pytest.importorskip("cryptography")
     import asyncio
 
     from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
@@ -690,7 +686,6 @@ def test_idle_executor_buckets_and_circuits_retire():
 
 @pytest.fixture
 def health_server(tmp_path):
-    pytest.importorskip("cryptography")
     from janus_tpu.binaries.main import _serve_health
     from janus_tpu.core.time import RealClock
     from janus_tpu.datastore import Crypter, Datastore, generate_key

@@ -688,7 +688,6 @@ def test_poplar1_e2e_multi_level_through_executor():
     helper prep served by the shared executor, cross-job coalescing
     observable in its stats, per-level buckets isolated, heavy-hitter
     counts exact at both levels."""
-    pytest.importorskip("cryptography")
     reset_global_executor()
     exec_cfg = ExecutorConfig(
         enabled=True, flush_window_s=0.15, flush_max_rows=4096
@@ -749,7 +748,6 @@ def test_poplar1_deferred_journal_crash_replay_exactly_once():
     the collection-time replay re-derives the level's shares from the
     datastore — heavy-hitter counts bit-exact, journal empty after, and
     the second drain path (cadence) finds nothing to double-merge."""
-    pytest.importorskip("cryptography")
     reset_global_executor()
     exec_cfg = ExecutorConfig(
         enabled=True,
@@ -1141,7 +1139,6 @@ def test_resident_sketch_e2e_deferred_drain_exactly_once():
     reads ONE vector per level bucket, the helper's CONTINUE rounds route
     through ITS deferred store, and the collected heavy-hitter counts are
     exact with both journals empty and ZERO sketch readback rows."""
-    pytest.importorskip("cryptography")
     from janus_tpu.executor import AccumulatorConfig
 
     reset_global_executor()
@@ -1206,7 +1203,6 @@ def test_helper_continue_routes_through_deferred_store():
     CONTINUE round journals its host vectors (batching the helper's
     datastore writes) and the aggregate-share barrier drains them —
     observable as helper journal rows between the two phases."""
-    pytest.importorskip("cryptography")
     from janus_tpu.executor import AccumulatorConfig
     from janus_tpu.messages import Duration
 
@@ -1340,7 +1336,6 @@ def test_suspect_peer_tasks_filtered_at_acquisition_query():
     """Peer-health-aware acquisition (ISSUE 13 satellite): a suspect
     peer's tasks are excluded AT the acquire query; probing/healthy peers
     keep acquiring (a probing peer's delivery is the half-open probe)."""
-    pytest.importorskip("cryptography")
     from janus_tpu.aggregator.job_driver import suspect_task_ids
     from janus_tpu.core import peer_health
     from janus_tpu.messages import Duration

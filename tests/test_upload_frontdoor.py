@@ -1,0 +1,529 @@
+"""Upload front door (ISSUE 14): batched HPKE open + load shedding.
+
+Covers the tentpole's three contracts and the satellites' failure modes:
+
+* BIT-EXACTNESS — ``core/hpke_batch.open_batch`` vs the inline
+  ``open_`` across every supported suite (fuzz), the vendored RFC 9180
+  vectors through the batched path, and a corrupted ciphertext inside a
+  healthy batch rejecting ONLY its own report.
+* THE PIPELINE — ``handle_upload`` under ``upload_open_backend:
+  batched`` stores byte-identical rows to the inline backend, and an
+  ``upload.open`` error fault degrades to the per-report fallback
+  without rejecting anything.
+* ADMISSION CONTROL — past the bounded queue (depth or delay budget)
+  uploads shed with 503 + Retry-After, counted in
+  ``janus_upload_shed_total`` and visible in /statusz, while admitted
+  reports still commit.
+* the ReportWriteBatcher flush-timer race regression (stale timer task
+  must neither cancel a fresh cohort's timer nor flush it early).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import secrets
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.error import ReportRejectedError, UploadShed
+from janus_tpu.aggregator.http_handlers import aggregator_app
+from janus_tpu.aggregator.report_writer import ReportWriteBatcher, UploadOpenBatcher
+from janus_tpu.client import prepare_report
+from janus_tpu.core import faults
+from janus_tpu.core.hpke import (
+    HpkeApplicationInfo,
+    HpkeError,
+    HpkeKeypair,
+    Label,
+    open_,
+    seal,
+)
+from janus_tpu.core.hpke_batch import open_batch
+from janus_tpu.core.metrics import GLOBAL_METRICS
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import (
+    HpkeAeadId,
+    HpkeCiphertext,
+    HpkeConfig,
+    HpkeKdfId,
+    HpkeKemId,
+    HpkePublicKey,
+    Role,
+)
+
+from test_aggregator_handlers import NOW, TIME_PRECISION, make_pair_tasks
+
+INFO = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _sample(name, labels=None):
+    return GLOBAL_METRICS.get_sample_value(name, labels or {}) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness
+
+
+@pytest.mark.parametrize("vector_pass", ["1", "0"])
+def test_open_batch_parity_fuzz_all_suites(vector_pass, monkeypatch):
+    """Every supported suite in ONE batch, batched == inline per slot,
+    and a corrupted AES-128-GCM row rejects only itself — under BOTH
+    GCM branches (the wide table-AES kernel, and the per-report AEAD
+    branch a cryptography-equipped CPU host prefers)."""
+    monkeypatch.setenv("JANUS_TPU_UPLOAD_VECTOR_GCM", vector_pass)
+    rng = secrets.SystemRandom()
+    requests, want = [], []
+    for kem in (HpkeKemId.X25519_HKDF_SHA256, HpkeKemId.P256_HKDF_SHA256):
+        for aead in (
+            HpkeAeadId.AES_128_GCM,
+            HpkeAeadId.AES_256_GCM,
+            HpkeAeadId.CHACHA20_POLY1305,
+        ):
+            kp = HpkeKeypair.generate(rng.randrange(256), kem_id=kem, aead_id=aead)
+            for n in range(3):
+                pt = secrets.token_bytes(1 + 37 * n)  # ragged, sub-block to multi-block
+                aad = secrets.token_bytes(n * 11)
+                requests.append((kp, INFO, seal(kp.config, INFO, pt, aad), aad))
+                want.append(pt)
+    bad = 2  # an AES-128-GCM row
+    kp, info, ct, aad = requests[bad]
+    requests[bad] = (
+        kp,
+        info,
+        HpkeCiphertext(
+            ct.config_id,
+            ct.encapsulated_key,
+            ct.payload[:-1] + bytes([ct.payload[-1] ^ 1]),
+        ),
+        aad,
+    )
+    results = open_batch(requests)
+    inline = []
+    for keypair, info, ciphertext, aad in requests:
+        try:
+            inline.append(open_(keypair, info, ciphertext, aad))
+        except HpkeError as e:
+            inline.append(e)
+    assert len(results) == len(want)
+    for i, (got, ref) in enumerate(zip(results, inline)):
+        if i == bad:
+            assert isinstance(got, HpkeError) and isinstance(ref, HpkeError)
+        else:
+            assert got == ref == want[i], f"slot {i} diverged"
+
+
+def test_rfc9180_vectors_through_batched_path():
+    """The vendored CFRG vectors open correctly through open_batch —
+    including the AES-128-GCM ones that ride the vectorized pass (all
+    batched together so the wide kernel engages)."""
+    path = os.path.join(os.path.dirname(__file__), "data", "rfc9180-test-vectors.json")
+    with open(path) as f:
+        vectors = json.load(f)
+    requests, want = [], []
+    for v in vectors:
+        if v["mode"] != 0 or v["kem_id"] not in (0x20, 0x10):
+            continue
+        if v["kdf_id"] not in (1, 2, 3) or v["aead_id"] not in (1, 2, 3):
+            continue
+        config = HpkeConfig(
+            1,
+            HpkeKemId(v["kem_id"]),
+            HpkeKdfId(v["kdf_id"]),
+            HpkeAeadId(v["aead_id"]),
+            HpkePublicKey(bytes.fromhex(v["pkRm"])),
+        )
+        keypair = HpkeKeypair(config, bytes.fromhex(v["skRm"]))
+        first = v["encryptions"][0]
+        requests.append(
+            (
+                keypair,
+                HpkeApplicationInfo(bytes.fromhex(v["info"])),
+                HpkeCiphertext(1, bytes.fromhex(v["enc"]), bytes.fromhex(first["ct"])),
+                bytes.fromhex(first["aad"]),
+            )
+        )
+        want.append(bytes.fromhex(first["pt"]))
+    # the published file carries one vector per (kem, kdf, aead) combo it
+    # covers; both KEMs and all three AEADs must be represented, with
+    # enough AES-128-GCM rows to engage the vectorized pass
+    assert len(requests) >= 10
+    assert sum(1 for r in requests if r[0].config.aead_id == HpkeAeadId.AES_128_GCM) >= 2
+    results = open_batch(requests)
+    for got, pt in zip(results, want):
+        assert got == pt
+
+
+# ---------------------------------------------------------------------------
+# the upload pipeline
+
+
+def _make_leader_env(config: Config):
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    eds = EphemeralDatastore(MockClock(NOW))
+    eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+    agg = Aggregator(eds.datastore, eds.clock, config)
+    return eds, agg, leader, helper
+
+
+def _reports(leader, helper, n):
+    vdaf = leader.vdaf_instance()
+    return [
+        prepare_report(
+            vdaf,
+            leader.task_id,
+            leader.hpke_keys[0].config,
+            helper.hpke_keys[0].config,
+            TIME_PRECISION,
+            1,
+            time=NOW,
+        )
+        for _ in range(n)
+    ]
+
+
+def _stored_rows(datastore, task_id):
+    from janus_tpu.messages import Duration, Interval, Time
+
+    whole = Interval(Time(0), Duration(NOW.seconds * 2))
+    return datastore.run_tx(
+        "rows",
+        lambda tx: sorted(
+            (
+                r.report_id.data,
+                r.public_share,
+                r.leader_input_share,
+                r.helper_encrypted_input_share.payload,
+            )
+            for r in tx.get_client_reports_for_interval(task_id, whole, 10_000)
+        ),
+    )
+
+
+def test_upload_e2e_batched_matches_inline_and_isolates_corrupt(loop):
+    """The SAME sealed reports through both backends (each into its own
+    fresh datastore, same task keys) store byte-identical rows; a
+    corrupted ciphertext in the concurrent batch rejects only itself."""
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    reports = _reports(leader, helper, 6)
+    corrupt = reports[3]
+    from dataclasses import replace
+
+    bad_share = HpkeCiphertext(
+        corrupt.leader_encrypted_input_share.config_id,
+        corrupt.leader_encrypted_input_share.encapsulated_key,
+        corrupt.leader_encrypted_input_share.payload[:-1]
+        + bytes([corrupt.leader_encrypted_input_share.payload[-1] ^ 1]),
+    )
+    reports[3] = replace(corrupt, leader_encrypted_input_share=bad_share)
+
+    stored = {}
+    for backend in ("inline", "batched"):
+        eds = EphemeralDatastore(MockClock(NOW))
+        eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        agg = Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(
+                vdaf_backend="oracle",
+                upload_open_backend=backend,
+                upload_open_batch_delay=0.002,
+            ),
+        )
+
+        async def flow():
+            return await asyncio.gather(
+                *(agg.handle_upload(leader.task_id, r) for r in reports),
+                return_exceptions=True,
+            )
+
+        results = loop.run_until_complete(flow())
+        assert isinstance(results[3], ReportRejectedError), results[3]
+        for i, r in enumerate(results):
+            if i != 3:
+                assert r is None, (backend, i, r)
+        rows = _stored_rows(eds.datastore, leader.task_id)
+        assert len(rows) == 5
+        stored[backend] = rows
+        eds.cleanup()
+    # identical inputs -> byte-identical stored rows (incl. the decoded
+    # leader share): the batched open is bit-exact vs inline end to end
+    assert stored["batched"] == stored["inline"]
+
+
+def test_upload_open_error_fault_falls_back_per_report(loop):
+    """An ``upload.open`` error fault (batch-level failure) must degrade
+    to per-report inline opens — every valid upload still lands."""
+    eds, agg, leader, helper = _make_leader_env(
+        Config(vdaf_backend="oracle", upload_open_backend="batched")
+    )
+    faults.configure([faults.FaultSpec("upload.open", "error", 1.0)], seed=7)
+    reports = _reports(leader, helper, 4)
+
+    async def flow():
+        await asyncio.gather(*(agg.handle_upload(leader.task_id, r) for r in reports))
+
+    loop.run_until_complete(flow())
+    assert len(_stored_rows(eds.datastore, leader.task_id)) == 4
+    assert agg.upload_opener.stats()["batches"] >= 1
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+
+
+def test_upload_shed_returns_503_retry_after_and_counts(loop):
+    """Queue-depth sheds: with the open stage wedged (upload.open delay)
+    and a 2-deep queue, concurrent uploads past the bound get the
+    DAP-retryable 503 + Retry-After; admitted ones still commit; the
+    shed counter and /statusz move."""
+    eds, agg, leader, helper = _make_leader_env(
+        Config(
+            vdaf_backend="oracle",
+            upload_open_backend="batched",
+            upload_open_batch_size=64,
+            upload_open_batch_delay=0.05,
+            upload_queue_max=2,
+        )
+    )
+    faults.configure(
+        [faults.FaultSpec("upload.open", "delay", 1.0, delay_s=0.3)], seed=7
+    )
+    app = aggregator_app(agg)
+    reports = _reports(leader, helper, 6)
+    shed_before = _sample("janus_upload_shed_total", {"reason": "queue_full"})
+
+    async def flow():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+
+            async def put(r, delay):
+                await asyncio.sleep(delay)
+                resp = await client.put(
+                    f"/tasks/{leader.task_id}/reports", data=r.get_encoded()
+                )
+                return resp.status, resp.headers.get("Retry-After")
+
+            return await asyncio.gather(
+                *(put(r, 0.01 * i) for i, r in enumerate(reports))
+            )
+        finally:
+            await client.close()
+
+    outcomes = loop.run_until_complete(flow())
+    accepted = [s for s, _ra in outcomes if s == 201]
+    shed = [(s, ra) for s, ra in outcomes if s == 503]
+    assert shed, outcomes  # overload was refused...
+    assert accepted, outcomes  # ...but bounded: admitted uploads landed
+    for _s, retry_after in shed:
+        assert retry_after is not None and int(retry_after) >= 1
+    assert len(_stored_rows(eds.datastore, leader.task_id)) == len(accepted)
+    assert (
+        _sample("janus_upload_shed_total", {"reason": "queue_full"}) - shed_before
+        >= len(shed)
+    )
+    from janus_tpu.core.statusz import runtime_status
+
+    up = runtime_status()["upload"]
+    assert up["sheds"]["queue_full"] >= len(shed)
+    assert up["opened"] >= len(accepted)
+    eds.cleanup()
+
+
+def test_upload_shed_queue_delay_budget(loop):
+    """Delay sheds: an oldest-pending open past upload_shed_delay_s sheds
+    even when the queue is not full."""
+    eds, agg, leader, helper = _make_leader_env(
+        Config(
+            vdaf_backend="oracle",
+            upload_open_backend="batched",
+            upload_open_batch_delay=10.0,  # the timer never fires in-test
+            upload_queue_max=1000,
+            upload_shed_delay_s=0.05,
+        )
+    )
+    reports = _reports(leader, helper, 2)
+
+    async def flow():
+        fut = asyncio.ensure_future(agg.handle_upload(leader.task_id, reports[0]))
+        await asyncio.sleep(0.15)  # the pending open is now past budget
+        with pytest.raises(UploadShed):
+            await agg.handle_upload(leader.task_id, reports[1])
+        # unwedge: flush the pending open so the first upload completes
+        await agg.upload_opener._flush()
+        await fut
+
+    loop.run_until_complete(flow())
+    assert agg.upload_opener.stats()["sheds"]["queue_delay"] >= 1
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the ReportWriteBatcher flush-timer race (satellite)
+
+
+class _RecordingDatastore:
+    """Just enough datastore surface for ReportWriteBatcher: records each
+    flushed batch."""
+
+    def __init__(self):
+        self.batches = []
+
+    async def run_tx_async(self, _name, tx_fn):
+        tx = self
+
+        class _Tx:
+            def put_client_report(self, report):
+                pass
+
+            def increment_task_upload_counter(self, *a):
+                pass
+
+        outcomes = tx_fn(_Tx())
+        self.batches.append(outcomes)
+        return outcomes
+
+    def now(self):
+        from janus_tpu.messages import Time
+
+        return Time(NOW.seconds)
+
+
+def _fake_report(i):
+    import types
+
+    return types.SimpleNamespace(
+        task_id=types.SimpleNamespace(data=b"T" * 32),
+        report_id=types.SimpleNamespace(data=i.to_bytes(16, "big")),
+        time=NOW,
+        trace_id="ab" * 16,
+    )
+
+
+def test_report_write_batcher_stale_timer_race(loop):
+    """A timer-fired _flush that lost the race to a size-triggered flush
+    must be a NO-OP: it may not cancel the next cohort's live timer nor
+    flush that cohort before its delay."""
+
+    async def flow():
+        ds = _RecordingDatastore()
+        b = ReportWriteBatcher(ds, max_batch_size=2, max_batch_write_delay=60.0)
+        # cohort 1: first report arms the timer; record its generation
+        # exactly like the armed callback did
+        w1 = asyncio.ensure_future(b.write_report(_fake_report(0)))
+        await asyncio.sleep(0.01)
+        stale_gen = b._flush_gen
+        assert b._flush_handle is not None
+        # size-trigger: second report flushes cohort 1 synchronously
+        await b.write_report(_fake_report(1))
+        await w1
+        assert len(ds.batches) == 1 and len(ds.batches[0]) == 2
+        # cohort 2 queues and arms a NEW timer
+        w2 = asyncio.ensure_future(b.write_report(_fake_report(2)))
+        await asyncio.sleep(0.01)
+        live_handle = b._flush_handle
+        assert live_handle is not None
+        # the STALE timer task (armed for cohort 1) finally runs
+        await b._flush(stale_gen)
+        # ...and must have done nothing: cohort 2 still queued, its timer
+        # still armed (not cancelled), nothing flushed early
+        assert len(ds.batches) == 1
+        assert len(b._queue) == 1
+        assert b._flush_handle is live_handle and not live_handle.cancelled()
+        # the CURRENT generation flush drains cohort 2
+        await b._flush(b._flush_gen)
+        await w2
+        assert len(ds.batches) == 2 and len(ds.batches[1]) == 1
+
+    loop.run_until_complete(flow())
+
+
+def test_unknown_upload_open_backend_rejected():
+    """A typo'd backend must fail Aggregator construction loudly, never
+    silently serve the legacy inline path."""
+    eds = EphemeralDatastore(MockClock(NOW))
+    with pytest.raises(ValueError, match="upload_open_backend"):
+        Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(vdaf_backend="oracle", upload_open_backend="Batched"),
+        )
+    eds.cleanup()
+
+
+def test_admission_counts_inflight_opens(loop):
+    """The shed gate must see DETACHED-but-unresolved batches: with the
+    open stage wedged and every pending open already in flight (staging
+    queue empty), admit() still sheds on depth."""
+    eds, agg, leader, helper = _make_leader_env(
+        Config(
+            vdaf_backend="oracle",
+            upload_open_backend="batched",
+            upload_open_batch_size=1,  # every upload detaches immediately
+            upload_open_batch_delay=0.001,
+            upload_queue_max=3,
+        )
+    )
+    faults.configure(
+        [faults.FaultSpec("upload.open", "delay", 1.0, delay_s=0.4)], seed=7
+    )
+    reports = _reports(leader, helper, 4)
+
+    async def flow():
+        futs = [
+            asyncio.ensure_future(agg.handle_upload(leader.task_id, r))
+            for r in reports[:3]
+        ]
+        await asyncio.sleep(0.1)
+        # all three opens are IN FLIGHT now (batch size 1); the staging
+        # queue is empty — the old staging-only gate would admit here
+        st = agg.upload_opener.stats()
+        assert st["staged"] == 0 and st["inflight"] == 3, st
+        with pytest.raises(UploadShed):
+            await agg.handle_upload(leader.task_id, reports[3])
+        await asyncio.gather(*futs)
+
+    loop.run_until_complete(flow())
+    assert agg.upload_opener.stats()["sheds"]["queue_full"] >= 1
+    eds.cleanup()
+
+
+def test_upload_frontdoor_config_yaml_roundtrip():
+    from janus_tpu.binaries.config import AggregatorConfig, load_config
+
+    cfg = load_config(
+        AggregatorConfig,
+        text="""
+upload_open_backend: inline
+upload_open_batch_size: 32
+upload_open_batch_delay_ms: 2
+upload_queue_max: 64
+upload_shed_delay_s: 0.5
+""",
+    )
+    assert cfg.upload_open_backend == "inline"
+    assert cfg.upload_open_batch_size == 32
+    assert cfg.upload_open_batch_delay_ms == 2
+    assert cfg.upload_queue_max == 64
+    assert cfg.upload_shed_delay_s == 0.5
