@@ -1034,6 +1034,129 @@ def _reexec_on_cpu(**extra_env) -> None:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
+def run_fpvec_config(args, scaled: bool) -> dict:
+    """The ``fpvec`` row (ISSUE 15): Prio3FixedPointBoundedL2VecSum —
+    the federated-learning gradient-sum workload — through the
+    multi-gadget device plane vs the scalar CPU oracle.
+
+    The real regime is big-vector/few-shapes (bits=16, entries >= 1000:
+    exactly the chunked-ParallelSum shape the MXU limb-plane matmul path
+    was built for); the CPU-scaled variant shrinks to a shape XLA:CPU can
+    compile in minutes.  A per-row parity fence (both aggregator sides,
+    every prepare artifact, device combine verdicts) gates the number —
+    parity drift records an error, never a throughput value.  The oracle
+    rate is measured over a small report slice (the scalar two-gadget
+    query is seconds/report at full size) — same-unit reports/s either
+    way, so the device_vs_oracle ratio is direct."""
+    import jax
+
+    from janus_tpu.flp import FixedPointBoundedL2VecSum, FlpGeneric
+    from janus_tpu.vdaf.backend import OracleBackend, make_backend
+    from janus_tpu.vdaf.prio3 import (
+        ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM,
+        Prio3,
+    )
+
+    if scaled:
+        bits, entries, chunk = 2, 2, 2
+        batch, iters, oracle_rows = 64, 2, 8
+        desc = "Prio3FixedPointBoundedL2VecSum bits=2 entries=2 (cpu-scaled)"
+    else:
+        bits, entries, chunk = 16, 1000, 127
+        batch, iters, oracle_rows = min(args.batch, 2048), args.iters, 8
+        desc = "Prio3FixedPointBoundedL2VecSum bits=16 entries=1000 chunk=127"
+    vdaf = Prio3(
+        FlpGeneric(
+            FixedPointBoundedL2VecSum(
+                bits_per_entry=bits, entries=entries, chunk_length=chunk
+            )
+        ),
+        ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM,
+    )
+    import random as _random
+
+    rng = _random.Random(15)
+    vk = rng.randbytes(vdaf.VERIFY_KEY_SIZE)
+
+    def shard_rows(n):
+        rows = []
+        scale = 1 << (bits - 1)
+        for _ in range(n):
+            vec = [
+                rng.randrange(-scale // 2, scale // 2) / scale
+                for _ in range(entries)
+            ]
+            nonce = rng.randbytes(vdaf.NONCE_SIZE)
+            public, shares = vdaf.shard(vec, nonce, rng.randbytes(vdaf.RAND_SIZE))
+            rows.append((nonce, public, shares))
+        return rows
+
+    backend = make_backend(vdaf, "tpu")
+    oracle = OracleBackend(vdaf)
+
+    # parity fence: BOTH aggregator sides + device combine on real rows
+    fence = shard_rows(2)
+    got_sides = []
+    for agg_id in (0, 1):
+        sub = [(n, p, sh[agg_id]) for (n, p, sh) in fence]
+        got = backend.prep_init_batch(vk, agg_id, sub)
+        want = oracle.prep_init_batch(vk, agg_id, sub)
+        for (gs, gsh), (ws, wsh) in zip(got, want):
+            assert gs.out_share == ws.out_share, "fpvec out-share parity broke"
+            assert (
+                gsh.verifiers_share == wsh.verifiers_share
+            ), "fpvec verifier parity broke"
+            assert gsh.joint_rand_part == wsh.joint_rand_part
+            assert gs.corrected_joint_rand_seed == ws.corrected_joint_rand_seed
+        got_sides.append(got)
+    pairs = [
+        [got_sides[0][b][1], got_sides[1][b][1]] for b in range(len(fence))
+    ]
+    assert backend.prep_shares_to_prep_batch(pairs) == oracle.prep_shares_to_prep_batch(
+        pairs
+    ), "fpvec prepare-message parity broke"
+
+    # timed helper-side prepare: `oracle_rows` sharded reports tiled to
+    # the batch (throughput is content-independent; distinct nonces per
+    # slot keep the XOF work honest)
+    base = shard_rows(oracle_rows)
+    tiled = []
+    for i in range(batch):
+        n, p, sh = base[i % len(base)]
+        tiled.append((rng.randbytes(vdaf.NONCE_SIZE), p, sh[1]))
+    t0 = time.monotonic()
+    out = backend.prep_init_batch(vk, 1, tiled)
+    compile_s = time.monotonic() - t0
+    assert len(out) == batch
+    t0 = time.monotonic()
+    for _ in range(iters):
+        backend.prep_init_batch(vk, 1, tiled)
+    device_elapsed = time.monotonic() - t0
+    device_rate = batch * iters / device_elapsed
+
+    # oracle rate over the small slice (scalar two-gadget query)
+    osub = [(n, p, sh[1]) for (n, p, sh) in base]
+    t0 = time.monotonic()
+    oracle.prep_init_batch(vk, 1, osub)
+    oracle_elapsed = time.monotonic() - t0
+    oracle_rate = len(osub) / oracle_elapsed
+
+    return {
+        "config": desc,
+        "side": "helper",
+        "value": round(device_rate, 1),
+        "unit": "reports/s",
+        "batch": batch,
+        "iters": iters,
+        "compile_s": round(compile_s, 1),
+        "oracle_reports_s": round(oracle_rate, 1),
+        "device_vs_oracle": round(device_rate / oracle_rate, 2)
+        if oracle_rate
+        else None,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 CONFIGS = {
     # BASELINE.md rows; histogram1024 is the north-star config.
     "count": ("Prio3Count", "prio3_count", {}),
@@ -1432,7 +1555,15 @@ def main() -> int:
         default="all",
         choices=["all"]
         + list(CONFIGS)
-        + ["executor16", "accum16", "mesh8", "coldtask", "poplar1_hh", "upload_frontdoor"],
+        + [
+            "executor16",
+            "accum16",
+            "mesh8",
+            "coldtask",
+            "poplar1_hh",
+            "upload_frontdoor",
+            "fpvec",
+        ],
         help="one config, or 'all' for every BASELINE.md row (default); "
         "executor16 is the device-executor concurrent-task row, accum16 "
         "the same shape with the device-resident accumulator store, "
@@ -1442,7 +1573,10 @@ def main() -> int:
         "poplar1_hh the heavy-hitters row (Poplar1 jobs coalescing at one "
         "IDPF level through the executor vs the legacy per-job path), "
         "upload_frontdoor the front-door row (batched vs inline HPKE "
-        "opens/s + an in-process loadgen pass at SLO)",
+        "opens/s + an in-process loadgen pass at SLO), "
+        "fpvec the gradient-aggregation row (fixed-point bounded-L2 "
+        "vector sum through the multi-gadget device plane vs the CPU "
+        "oracle, parity-fenced)",
     )
     parser.add_argument(
         "--side",
@@ -1512,6 +1646,10 @@ def main() -> int:
     run_coldtask_row = args.config in ("all", "coldtask")
     run_poplar_row = args.config in ("all", "poplar1_hh")
     run_frontdoor_row = args.config in ("all", "upload_frontdoor")
+    # fpvec pays XLA compiles even scaled-down: on a cpu-only "all" run it
+    # records a structured skip like the full-size CONFIGS rows; a by-name
+    # request always runs it.
+    run_fpvec_row = args.config == "fpvec" or (args.config == "all" and not scaled)
     names = [
         n
         for n in names
@@ -1523,6 +1661,7 @@ def main() -> int:
             "coldtask",
             "poplar1_hh",
             "upload_frontdoor",
+            "fpvec",
         )
     ]
     # Leader-side rows for the configs whose explicit-share inputs fit the
@@ -1605,6 +1744,20 @@ def main() -> int:
             )
         except Exception as e:
             _record_row_failure(results, "upload_frontdoor", e)
+    if run_fpvec_row:
+        # Gradient aggregation (ISSUE 15): fpvec device-vs-oracle
+        # reports/s, parity-fenced; platform loss records the structured
+        # skip like every other row.
+        try:
+            results["fpvec"] = run_fpvec_config(args, scaled=scaled)
+        except Exception as e:
+            _record_row_failure(results, "fpvec", e)
+    elif args.config == "all" and scaled:
+        results["fpvec"] = {
+            "skipped": "cpu-only run: fpvec pays full XLA compiles even "
+            "scaled; request --config fpvec explicitly to record the "
+            "cpu-scaled row"
+        }
 
     # Headline: the north-star config when measured, else the first row
     # that produced a number (a skipped/errored headline must not zero out

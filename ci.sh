@@ -35,10 +35,13 @@
 #                      budget units plus the 2-replica soak with every
 #                      injection point firing at p~=0.2, the mesh-enabled
 #                      device-lost run (per-mesh breaker -> oracle fallback,
-#                      exactly-once counts), and the Poplar1 device-lost case
+#                      exactly-once counts), the Poplar1 device-lost case
 #                      (ISSUE 10: breaker -> per-report CPU oracle ->
 #                      bit-exact heavy-hitter counts with exactly-once
-#                      accumulation across the agg-param-keyed journal).
+#                      accumulation across the agg-param-keyed journal),
+#                      and the fpvec device-lost case (ISSUE 15: the
+#                      gradient family degrades to the multi-gadget scalar
+#                      oracle and collects exactly once).
 #   ./ci.sh poplar     heavy-hitters gate (ISSUE 10 + 13): the jitted AES
 #                      kernel (tests/test_aes_jax.py — FIPS-197 vectors,
 #                      soft-AES fuzz, the poplar_backend seam), the
@@ -69,6 +72,16 @@
 #                      restarts under churn, exactly-once after settle),
 #                      plus the peer-health / deadline-budget / Retry-After
 #                      unit suite (tests/test_peer_health.py).
+#   ./ci.sh fpvec      gradient-aggregation gate (ISSUE 15): the
+#                      multi-gadget device FLP plane — fpvec device-vs-
+#                      oracle bit-exact fuzz (vpu + mxu, leader + helper,
+#                      canonical-padded mixed batches, adversarial
+#                      broken-bit and norm-violating reports), the e2e
+#                      gradient scenario (task API -> real drivers ->
+#                      executor coalescing -> ZCdpDiscreteGaussian
+#                      collect), and the dispatch-classification suite
+#                      (tests/test_backend_fallback.py).  XLA-compile
+#                      bound (~15-30 min on CPU).
 #   ./ci.sh coldstart  shape-churn gate (ISSUE 8): pow2 canonicalization
 #                      oracle-parity sweep (tests/test_shape_canonical.py,
 #                      incl. the RUN_SLOW matrix: all circuit families x
@@ -244,6 +257,15 @@ case "$tier" in
     # the warmup/compile-cache machinery.
     RUN_SLOW=1 exec python -m pytest tests/test_shape_canonical.py tests/test_warmup.py -q
     ;;
+  fpvec)
+    # Gradient-aggregation gate (ISSUE 15): the multi-gadget device FLP
+    # plane, bit-exactness asserted never assumed — fuzz (both field
+    # layouts, both sides, canonical-padded mixed batches, adversarial
+    # reports), the e2e gradient scenario with real DP noise, and the
+    # routing/classification suite.
+    RUN_SLOW=1 exec python -m pytest tests/test_fpvec_device.py \
+      tests/test_backend_fallback.py -q
+    ;;
   obs)
     # Observability gate (ISSUE 5 + 9): runs everywhere — the pure-Python
     # metrics fallback keeps the metric assertions meaningful even where
@@ -316,7 +338,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|coldstart|obs|load|load fast|benchdiff|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|coldstart|fpvec|obs|load|load fast|benchdiff|dryrun]" >&2
     exit 2
     ;;
 esac

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -94,6 +95,8 @@ from .error import (
     UnrecognizedTask,
 )
 from .report_writer import ReportWriteBatcher
+
+logger = logging.getLogger("janus_tpu.aggregator")
 
 
 @dataclass
@@ -559,17 +562,62 @@ class Aggregator:
         )
         replay_set = set(replay_ids)
         now = self.clock.now()
+        # Batched HPKE open (ROADMAP front-door follow-on): the helper's
+        # aggregate-init report-share opens are the same embarrassingly-
+        # batchable shape as upload — cheap per-report validation inline,
+        # then ONE core/hpke_batch.open_batch call on a worker thread
+        # (per-report KEM decap + one vectorized AES-128-GCM pass), with
+        # per-report inline fallback on any batch-LEVEL error.
         decoded: List[Tuple[int, tuple]] = []  # (idx, (nonce, public, share, msg))
+        to_open: List[Tuple[int, object]] = []  # (idx, OpenRequest)
         for idx, pi in enumerate(req.prepare_inits):
             err = self._helper_validate_report_share(ta, pi, replay_set, now)
             if err is not None:
                 failed[idx] = err
                 continue
-            item = self._helper_decode_report_share(ta, pi)
-            if isinstance(item, PrepareError):
-                failed[idx] = item
+            prepared = self._helper_open_request(ta, pi)
+            if isinstance(prepared, PrepareError):
+                failed[idx] = prepared
             else:
-                decoded.append((idx, item))
+                to_open.append((idx, prepared))
+        if to_open:
+            loop = asyncio.get_running_loop()
+            if self.config.upload_open_backend == "batched":
+                from ..core.hpke_batch import open_batch
+
+                def run_opens():
+                    try:
+                        return open_batch([r for _i, r in to_open])
+                    except Exception:
+                        # batch-LEVEL failure: per-report inline opens —
+                        # the batched path must never reject a report the
+                        # inline path would accept
+                        logger.exception(
+                            "batched aggregate-init open failed; falling "
+                            "back to per-report opens"
+                        )
+                        from ..core.hpke_batch import _open_one
+
+                        return [_open_one(*r) for _i, r in to_open]
+
+                opened = await loop.run_in_executor(None, run_opens)
+            else:
+                from ..core.hpke_batch import _open_one
+
+                opened = await loop.run_in_executor(
+                    None, lambda: [_open_one(*r) for _i, r in to_open]
+                )
+            for (idx, _req), plaintext in zip(to_open, opened):
+                if isinstance(plaintext, Exception) or plaintext is None:
+                    failed[idx] = PrepareError.HPKE_DECRYPT_ERROR
+                    continue
+                item = self._helper_decode_opened_share(
+                    ta, req.prepare_inits[idx], plaintext
+                )
+                if isinstance(item, PrepareError):
+                    failed[idx] = item
+                else:
+                    decoded.append((idx, item))
 
         # Batched prepare: ONE device launch for the whole job (north star).
         try:
@@ -784,9 +832,10 @@ class Aggregator:
             return PrepareError.REPORT_TOO_EARLY
         return None
 
-    def _helper_decode_report_share(self, ta: TaskAggregator, pi):
-        """HPKE open + decode; returns (nonce, public_parts, input_share,
-        leader_msg) or a PrepareError."""
+    def _helper_open_request(self, ta: TaskAggregator, pi):
+        """The pre-open half of a report-share decode: key lookup + AAD
+        assembly.  Returns a core/hpke_batch OpenRequest tuple, or the
+        PrepareError that rejects the share before any crypto is paid."""
         task = ta.task
         meta = pi.report_share.metadata
         keypair = task.hpke_keypair_for(pi.report_share.encrypted_input_share.config_id)
@@ -796,10 +845,12 @@ class Aggregator:
             task.task_id, meta, pi.report_share.public_share
         ).get_encoded()
         info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER)
-        try:
-            plaintext = open_(keypair, info, pi.report_share.encrypted_input_share, aad)
-        except HpkeError:
-            return PrepareError.HPKE_DECRYPT_ERROR
+        return (keypair, info, pi.report_share.encrypted_input_share, aad)
+
+    def _helper_decode_opened_share(self, ta: TaskAggregator, pi, plaintext):
+        """The post-open half: plaintext + wire decode and ping-pong
+        variant checks."""
+        meta = pi.report_share.metadata
         try:
             plain = PlaintextInputShare.get_decoded(plaintext)
             _check_extensions(plain.extensions)

@@ -1610,13 +1610,17 @@ class DeviceExecutor:
 
 def _synthetic_measurement(vdaf):
     """A valid all-zero measurement for warmup sharding: scalar circuits
-    (Count/Sum/Histogram) take 0; vector circuits take [0]*length."""
+    (Count/Sum/Histogram) take 0; vector circuits take [0]*length (the
+    fixed-point family sizes by ``entries`` — the all-zero vector has
+    norm 0, valid in every family)."""
     flp = vdaf.flp
     try:
         flp.encode(0)
         return 0
     except Exception:
-        length = getattr(flp.valid, "length", 1)
+        length = getattr(flp.valid, "length", None)
+        if length is None:
+            length = getattr(flp.valid, "entries", 1)
         return [0] * length
 
 

@@ -47,7 +47,13 @@ import numpy as np
 from jax import lax
 
 from ..fields import next_power_of_2
-from ..flp.circuits import Count, Histogram, Sum, SumVec
+from ..flp.circuits import (
+    Count,
+    FixedPointBoundedL2VecSum,
+    Histogram,
+    Sum,
+    SumVec,
+)
 from ..vdaf.prio3 import (
     USAGE_JOINT_RAND_PART,
     USAGE_JOINT_RAND_SEED,
@@ -77,8 +83,32 @@ def bytes_to_limbs(jf: JField, data: jnp.ndarray, num_elems: int) -> jnp.ndarray
     return words.reshape(words.shape[:-1] + (num_elems, jf.n))
 
 
+class _GadgetPlan:
+    """Static shape of ONE gadget inside a device circuit: its call count,
+    wire arity/degree, interpolation modulus P = next_pow2(1 + calls), and
+    gadget-polynomial length.  The proof and verifier wire formats are the
+    concatenation of per-gadget segments in declaration order — exactly
+    the scalar ``flp/generic.py`` layout."""
+
+    __slots__ = ("calls", "arity", "degree", "P", "glen")
+
+    def __init__(self, calls: int, arity: int, degree: int):
+        self.calls = calls
+        self.arity = arity
+        self.degree = degree
+        self.P = next_power_of_2(1 + calls)
+        self.glen = degree * (self.P - 1) + 1
+
+
 class _DeviceCircuit:
-    """Device twin of one FLP validity circuit (all have exactly one gadget).
+    """Device twin of one FLP validity circuit.
+
+    Circuits hold a PER-GADGET plan list (``self.plans``); the original
+    single-gadget families are the trivial 1-plan case and keep their
+    gadget-0 attribute aliases (``calls``/``arity``/``P``/``glen``) so the
+    planar Pallas paths — which only serve single-gadget circuits — read
+    them unchanged.  Multi-gadget circuits (FixedPointBoundedL2VecSum)
+    override the ``*_g`` per-gadget hooks.
 
     ``mxu=True`` routes the K-axis field contractions (wire Lagrange
     evaluation, weighted truncates, joint-rand verifier folds) through the
@@ -89,12 +119,16 @@ class _DeviceCircuit:
     def __init__(self, valid, mxu: bool = False):
         self.valid = valid
         self.mxu = mxu
-        self.calls = valid.GADGET_CALLS[0]
-        (g,) = valid.new_gadgets()
-        self.arity = g.ARITY
-        self.degree = g.DEGREE
-        self.P = next_power_of_2(1 + self.calls)
-        self.glen = self.degree * (self.P - 1) + 1
+        self.plans = [
+            _GadgetPlan(calls, g.ARITY, g.DEGREE)
+            for g, calls in zip(valid.new_gadgets(), valid.GADGET_CALLS)
+        ]
+        p0 = self.plans[0]
+        self.calls = p0.calls
+        self.arity = p0.arity
+        self.degree = p0.degree
+        self.P = p0.P
+        self.glen = p0.glen
 
     # subclasses: inputs(), v(), truncate(), gadget_eval_scaled().
     # Convention: meas/gk/wires canonical; jr_m Montgomery; consts as noted.
@@ -106,6 +140,32 @@ class _DeviceCircuit:
         Chunked circuits: ceil(meas_len / chunk)."""
         chunk = getattr(self.valid, "chunk_length", 1)
         return (meas_len + (chunk - 1)) // chunk
+
+    # -- per-gadget hooks (multi-gadget circuits override) ---------------
+    def calls_live_list(self, meas_len):
+        """Per-GADGET live-call counts for a per-row measurement length
+        (canonical masking, vdaf/canonical.py) — one entry per plan."""
+        return [self.calls_from_meas_len(meas_len)]
+
+    def wire_evals_g(self, gi, jf, meas_m, jr_m, lag, seeds, consts, ml=None):
+        """Wire evaluations for gadget ``gi``; the single-gadget default
+        delegates to the circuit's ``wire_evals``.  ``ml`` (B,) i32 is the
+        per-row true measurement length under canonical padding (None on
+        exact-shape graphs) — only length-dependent gadget inputs (the
+        fixed-point entry recomposition) consume it."""
+        assert gi == 0
+        return self.wire_evals(jf, meas_m, jr_m, lag, seeds, consts)
+
+    def gadget_eval_scaled_g(self, gi, jf, x):
+        """Direct gadget evaluation (scaled by R^-1) for gadget ``gi`` on
+        its combined wire evaluations — the decide-side check."""
+        return self.gadget_eval_scaled(jf, x)
+
+    def v_multi(self, jf, gks, meas_m, jr_m, consts, ml=None):
+        """Circuit output from the per-gadget output lists (``gks`` has
+        one (B, calls_g, n) tensor per plan).  Single-gadget default
+        delegates to ``v``."""
+        return self.v(jf, gks[0], meas_m, jr_m, consts)
 
     def wire_evals(self, jf, meas_m, jr_m, lag, seeds, consts):
         """Wire-polynomial evaluations at t: (B, arity, n) canonical.
@@ -132,7 +192,7 @@ class _DCount(_DeviceCircuit):
     def v(self, jf, gk, meas_m, jr_m, consts):
         return jf.sub(gk[:, 0], meas_m[:, 0])
 
-    def truncate(self, jf, meas_m, consts):
+    def truncate(self, jf, meas_m, consts, ml=None):
         return meas_m
 
     def gadget_eval_scaled(self, jf, x):
@@ -153,7 +213,7 @@ class _DSum(_DeviceCircuit):
             return jnp.squeeze(jf.dot_mont(gk[:, :, None, :], r_pows), axis=1)
         return jf.sum(jf.mont_mul(r_pows, gk), axis=1)  # canonical
 
-    def truncate(self, jf, meas_m, consts):
+    def truncate(self, jf, meas_m, consts, ml=None):
         w = consts["pow2_m"]  # (bits, n) Montgomery constants 2^b*R
         if self.mxu:
             # bit-weight contraction against the shared constant vector
@@ -249,7 +309,7 @@ class _DSumVec(_DChunked):
     def v(self, jf, gk, meas_m, jr_m, consts):
         return jf.sum(gk, axis=1)
 
-    def truncate(self, jf, meas_m, consts):
+    def truncate(self, jf, meas_m, consts, ml=None):
         if self.valid.bits == 1:
             # sum over a single bit weighted 2^0 is the identity; skip the
             # MEAS_LEN-wide multiply (len=100k circuits pay for it).
@@ -349,8 +409,166 @@ class _DHistogram(_DChunked):
         ccorr = jf.mont_mul(c, lag_sum)
         return kl, lagk, lag0, ccorr, r_ch
 
-    def truncate(self, jf, meas_m, consts):
+    def truncate(self, jf, meas_m, consts, ml=None):
         return meas_m
+
+
+class _DFixedPointL2(_DChunked):
+    """Device twin of FixedPointBoundedL2VecSum — the first TWO-gadget
+    circuit on the device plane (the jax_graft gradient-sum workload).
+
+    Gadget 0 is the SumVec-pattern bit-range check over all MEAS_LEN
+    positions (per-call joint-rand weights, power resetting each call);
+    gadget 1 is the entry-squares ParallelSum(Mul) whose inputs are the
+    fixed-point entries RECOMPOSED IN-GRAPH from the bit planes
+    (X_i = sum_b 2^b * meas[i*n + b]) — no entry tensor ever crosses the
+    host boundary.  The norm-equality affine combination and the
+    Schwartz-Zippel fold live in ``v_multi``.  Under canonical padding
+    (vdaf/canonical.py) every length-dependent site is per-row: the entry
+    count d derives from ``ml``, padded entries mask to zero (the columns
+    past a row's entry region hold its NORM bits — live data), the
+    claimed-norm bits gather at the row's own offset d*n, and the
+    Schwartz-Zippel combiner r_n selects joint_rand[bit_calls(row)].
+    """
+
+    def __init__(self, valid, mxu: bool = False):
+        super().__init__(valid, mxu)  # chunk + gadget-0 pad over MEAS_LEN
+        self.nbits = valid.bits_per_entry
+        self.entries = valid.entries
+        self.norm_bits = valid.bits_for_norm
+        self.pad_len1 = self.plans[1].calls * self.chunk - valid.entries
+
+    # -- canonical-shape helpers ----------------------------------------
+    def entries_from_meas_len(self, ml):
+        return (ml - self.norm_bits) // self.nbits
+
+    def calls_live_list(self, ml):
+        chunk = self.chunk
+        return [
+            (ml + chunk - 1) // chunk,
+            (self.entries_from_meas_len(ml) + chunk - 1) // chunk,
+        ]
+
+    def _entries_from_meas(self, jf, meas_m, consts, entries_live=None):
+        """(B, entries, n) canonical X_i = sum_b 2^b * meas[i*n + b].
+
+        ``entries_live`` (B,) zeroes entries at/past the row's own count:
+        a canonical-padded row's columns past its entry region hold its
+        norm bits, so the recomposition there is garbage that must not
+        reach the squares gadget, the norm sums, or the out share."""
+        B = meas_m.shape[0]
+        m = meas_m[:, : self.entries * self.nbits].reshape(
+            B, self.entries, self.nbits, jf.n
+        )
+        w = consts["pow2_m"]  # (nbits, n) Montgomery
+        if self.mxu:
+            x = jf.dot_mont(jnp.swapaxes(m, 1, 2), w)  # (B, entries, n)
+        else:
+            x = jf.sum(jf.mont_mul(m, w[None, None]), axis=2)
+        if entries_live is not None:
+            e = jnp.arange(self.entries, dtype=jnp.int32)[None, :]
+            x = jnp.where((e < entries_live[:, None])[:, :, None], x, 0)
+        return x
+
+    # -- per-gadget wire evaluations ------------------------------------
+    def wire_evals_g(self, gi, jf, meas_m, jr_m, lag, seeds, consts, ml=None):
+        if gi == 0:
+            return self._wire_evals_bits(jf, meas_m, jr_m, lag, seeds, consts)
+        return self._wire_evals_squares(
+            jf, meas_m, lag, seeds, consts, ml=ml
+        )
+
+    def _wire_evals_bits(self, jf, meas_m, jr_m, lag, seeds, consts):
+        """Fused SumVec-pattern wires: evens[u] = sum_k lag_{k+1} * m[k,u]
+        * jr_k^(u+1) (jr slice: one weight per bit chunk), odds/seed via
+        the shared _DChunked machinery.  Identical math to _DSumVec."""
+        B = meas_m.shape[0]
+        calls0 = self.plans[0].calls
+        m = self._pad(jf, meas_m).reshape(B, calls0, self.chunk, jf.n)
+        lag0, lagk = lag[:, 0], lag[:, 1:]
+        jr_b = jnp.broadcast_to(jr_m[:, :calls0, None, :], m.shape)
+        r_pows = jf.cumprod_mont(jr_b, axis=2)  # jr_k^(u+1) * R
+        rl = jf.mont_mul(r_pows, jnp.broadcast_to(lagk[:, :, None, :], m.shape))
+        evens = jf.sum(jf.mont_mul(m, rl), axis=1)  # (B, chunk, n)
+        odds, se = self._odds_and_seed(jf, m, lagk, lag0, seeds, consts)
+        return self._zip_wires(jf, evens, odds, se)
+
+    def _wire_evals_squares(self, jf, meas_m, lag, seeds, consts, ml=None):
+        """Gadget-1 wires: both wires of pair u evaluate to
+        seed*lag_0 + sum_k X[k,u]*lag_{k+1} — the (X_i, X_i) input pairs
+        share one contraction, emitted to the even AND odd slots."""
+        B = meas_m.shape[0]
+        calls1 = self.plans[1].calls
+        el = self.entries_from_meas_len(ml) if ml is not None else None
+        x = self._entries_from_meas(jf, meas_m, consts, entries_live=el)
+        if self.pad_len1:
+            x = jnp.concatenate(
+                [x, jnp.zeros((B, self.pad_len1, jf.n), dtype=_U32)], axis=1
+            )
+        xm = x.reshape(B, calls1, self.chunk, jf.n)
+        lag0, lagk = lag[:, 0], lag[:, 1:]
+        if self.mxu:
+            s = jf.dot_mont(xm, lagk)  # (B, chunk, n)
+        else:
+            s = jf.sum(jf.mont_mul(xm, lagk[:, :, None, :]), axis=1)
+        se = jf.mont_mul(seeds, lag0[:, None, :])  # (B, arity, n)
+        pair = jnp.stack([s, s], axis=2).reshape(B, 2 * self.chunk, jf.n)
+        return jf.add(se, pair)
+
+    # -- circuit output ---------------------------------------------------
+    def v_multi(self, jf, gks, meas_m, jr_m, consts, ml=None):
+        gk_bits, gk_sq = gks
+        B = meas_m.shape[0]
+        bit_check = jf.sum(gk_bits, axis=1)  # (B, n) canonical
+        sumsq = jf.sum(gk_sq, axis=1)
+        el = self.entries_from_meas_len(ml) if ml is not None else None
+        x = self._entries_from_meas(jf, meas_m, consts, entries_live=el)
+        sum_x = jf.sum(x, axis=1)
+        # claimed norm: the (2n-2)-bit decomposition at the row's offset.
+        w = consts["pow2_norm_m"]  # (norm_bits, n) Montgomery
+        if ml is None:
+            norm_m = meas_m[:, self.entries * self.nbits :]
+        else:
+            cols = (el * self.nbits)[:, None] + jnp.arange(
+                self.norm_bits, dtype=jnp.int32
+            )[None, :]
+            norm_m = jnp.take_along_axis(meas_m, cols[:, :, None], axis=1)
+        if self.mxu:
+            claimed = jnp.squeeze(jf.dot_mont(norm_m[:, :, None, :], w), axis=1)
+        else:
+            claimed = jf.sum(jf.mont_mul(norm_m, w[None]), axis=1)
+        # computed = sumsq - 2^n * sum_x + shares_inv * d * 2^(2n-2)
+        two_n = jnp.broadcast_to(consts["pow2n_m"], sum_x.shape)
+        if ml is None:
+            off = jnp.broadcast_to(consts["offset_sq_c"], sum_x.shape)
+        else:
+            d_limbs = jnp.concatenate(
+                [
+                    el.astype(_U32)[:, None],
+                    jnp.zeros((B, jf.n - 1), dtype=_U32),
+                ],
+                axis=1,
+            )
+            off = jf.mont_mul(d_limbs, jnp.broadcast_to(consts["offsq_m"], d_limbs.shape))
+        computed = jf.add(jf.sub(sumsq, jf.mont_mul(sum_x, two_n)), off)
+        norm_check = jf.sub(computed, claimed)
+        # Schwartz-Zippel: r_n = joint_rand[bit_calls] (per-row index under
+        # canonical padding — the row's OWN stream position).
+        if ml is None:
+            rn = jr_m[:, self.plans[0].calls]
+        else:
+            cl0 = (ml + self.chunk - 1) // self.chunk
+            rn = jnp.squeeze(
+                jnp.take_along_axis(jr_m, cl0[:, None, None], axis=1), axis=1
+            )
+        return jf.add(
+            jf.mont_mul(rn, bit_check),
+            jf.mont_mul(jf.mont_mul(rn, rn), norm_check),
+        )
+
+    def truncate(self, jf, meas_m, consts, ml=None):
+        el = self.entries_from_meas_len(ml) if ml is not None else None
+        return self._entries_from_meas(jf, meas_m, consts, entries_live=el)
 
 
 def _device_circuit(valid, mxu: bool = False) -> _DeviceCircuit:
@@ -362,6 +580,8 @@ def _device_circuit(valid, mxu: bool = False) -> _DeviceCircuit:
         return _DSumVec(valid, mxu)
     if isinstance(valid, Histogram):
         return _DHistogram(valid, mxu)
+    if isinstance(valid, FixedPointBoundedL2VecSum):
+        return _DFixedPointL2(valid, mxu)
     raise NotImplementedError(f"no device circuit for {type(valid).__name__}")
 
 
@@ -405,64 +625,120 @@ class BatchedPrio3:
         def mont_np(x: int) -> np.ndarray:
             return jf._int_to_limbs_np((x % p) * (1 << (32 * jf.n)) % p)
 
-        # Host-precomputed Montgomery constants.
-        w = field.root(circ.P)
-        p_inv = pow(circ.P, p - 2, p)
         self.consts: Dict[str, jnp.ndarray] = {}
         # Canonical: subtracted from / compared with canonical tensors.
         self.consts["shares_inv_c"] = jnp.asarray(
             jf._int_to_limbs_np(pow(prio3.num_shares, p - 2, p))
         )
-        # alpha^k for k=1..calls (gadget poly eval points).
-        self.alpha_pows_m = jnp.asarray(
-            np.stack([mont_np(pow(w, k, p)) for k in range(1, circ.calls + 1)])
-        )
-        # Barycentric constants w^k / P for k=0..calls.
-        self.bary_c_m = jnp.asarray(
-            np.stack([mont_np(pow(w, k, p) * p_inv % p) for k in range(circ.calls + 1)])
-        )
-        self.roots_m = jnp.asarray(
-            np.stack([mont_np(pow(w, k, p)) for k in range(circ.calls + 1)])
-        )
-        # ALL P root differences feed the inversion-free barycentric weights
-        # (prod over j != k of (t - w^k) spans every P-th root, used or not).
-        self.roots_all_m = jnp.asarray(
-            np.stack([mont_np(pow(w, k, p)) for k in range(circ.P)])
-        )
-        if hasattr(self.flp.valid, "bits"):
-            bits = self.flp.valid.bits
+        # Host-precomputed PER-GADGET Montgomery constants: each gadget g
+        # has its own interpolation modulus P_g, hence its own root of
+        # unity, alpha powers, barycentric weights, and (optionally) NTT
+        # twiddles.  Single-gadget circuits see exactly the constants the
+        # pre-multi-gadget code built.
+        #
+        # Gadget-poly evaluation strategy per gadget: the verifier needs
+        # gpoly(alpha^k) for k=1..calls, alpha a P-th root of unity.  For
+        # small P a Horner scan over the glen coefficients is cheapest;
+        # for the wide-vector circuits (P >= 64, e.g. SumVec len=100k
+        # chunk=316 -> P=512, glen=1023) Horner costs calls*glen
+        # multiplies per report while a fold to P coefficients + P-point
+        # NTT costs P*log2(P)/2 — ~70x fewer.  Both produce identical
+        # limbs (exact integer math).  ``ntt_min_p`` exists so parity
+        # tests can force this branch at tiny P and check it
+        # byte-for-byte against the oracle.
+        self._gc: List[Dict[str, object]] = []
+        for plan in circ.plans:
+            w = field.root(plan.P)
+            p_inv = pow(plan.P, p - 2, p)
+            gc: Dict[str, object] = {
+                # alpha^k for k=1..calls (gadget poly eval points).
+                "alpha_pows_m": jnp.asarray(
+                    np.stack(
+                        [mont_np(pow(w, k, p)) for k in range(1, plan.calls + 1)]
+                    )
+                ),
+                # Barycentric constants w^k / P for k=0..calls.
+                "bary_c_m": jnp.asarray(
+                    np.stack(
+                        [
+                            mont_np(pow(w, k, p) * p_inv % p)
+                            for k in range(plan.calls + 1)
+                        ]
+                    )
+                ),
+                "roots_m": jnp.asarray(
+                    np.stack([mont_np(pow(w, k, p)) for k in range(plan.calls + 1)])
+                ),
+                # ALL P root differences feed the inversion-free
+                # barycentric weights (prod over j != k of (t - w^k)
+                # spans every P-th root, used or not).
+                "roots_all_m": jnp.asarray(
+                    np.stack([mont_np(pow(w, k, p)) for k in range(plan.P)])
+                ),
+                "log2_P": plan.P.bit_length() - 1,
+                "ntt": None,
+            }
+            if plan.P >= ntt_min_p:
+                P = plan.P
+                logp = P.bit_length() - 1
+                bitrev = np.zeros(P, dtype=np.int32)
+                for i in range(P):
+                    bitrev[i] = int(format(i, f"0{logp}b")[::-1], 2)
+                tw_stages = []
+                m = 2
+                while m <= P:
+                    w_m = pow(w, P // m, p)
+                    tw_stages.append(
+                        jnp.asarray(
+                            np.stack(
+                                [mont_np(pow(w_m, j, p)) for j in range(m // 2)]
+                            )
+                        )
+                    )
+                    m *= 2
+                gc["ntt"] = (bitrev, tw_stages)
+            self._gc.append(gc)
+        # Gadget-0 aliases: the planar Pallas paths (single-gadget
+        # circuits only) read these under the historical names.
+        gc0 = self._gc[0]
+        self.alpha_pows_m = gc0["alpha_pows_m"]
+        self.bary_c_m = gc0["bary_c_m"]
+        self.roots_m = gc0["roots_m"]
+        self.roots_all_m = gc0["roots_all_m"]
+        self._log2_P = gc0["log2_P"]
+        self._ntt = gc0["ntt"]
+        self._alpha_mat_cache: Dict[int, np.ndarray] = {}
+
+        valid = self.flp.valid
+        if hasattr(valid, "bits"):
+            bits = valid.bits
             self.consts["pow2_m"] = jnp.asarray(
                 np.stack([mont_np(1 << b) for b in range(bits)])
             )
-        self._log2_P = circ.P.bit_length() - 1
-
-        # Gadget-poly evaluation strategy: the verifier needs gpoly(alpha^k)
-        # for k=1..calls, alpha a P-th root of unity.  For small P a Horner
-        # scan over the glen coefficients is cheapest; for the wide-vector
-        # circuits (P >= 64, e.g. SumVec len=100k chunk=316 -> P=512,
-        # glen=1023) Horner costs calls*glen multiplies per report while a
-        # fold to P coefficients + P-point NTT costs P*log2(P)/2 — ~70x
-        # fewer.  Both produce identical limbs (exact integer math).
-        # ``ntt_min_p`` exists so parity tests can force this branch at tiny
-        # P and check it byte-for-byte against the oracle.
-        self._ntt = None
-        if circ.P >= ntt_min_p:
-            P = circ.P
-            logp = P.bit_length() - 1
-            bitrev = np.zeros(P, dtype=np.int32)
-            for i in range(P):
-                bitrev[i] = int(format(i, f"0{logp}b")[::-1], 2)
-            tw_stages = []
-            m = 2
-            while m <= P:
-                w_m = pow(w, P // m, p)
-                tw_stages.append(
-                    jnp.asarray(
-                        np.stack([mont_np(pow(w_m, j, p)) for j in range(m // 2)])
-                    )
+        if isinstance(valid, FixedPointBoundedL2VecSum):
+            nb = valid.bits_per_entry
+            shares_inv = pow(prio3.num_shares, p - 2, p)
+            # entry-bit recomposition weights 2^b (b < bits_per_entry)
+            self.consts["pow2_m"] = jnp.asarray(
+                np.stack([mont_np(1 << b) for b in range(nb)])
+            )
+            # claimed-norm decomposition weights 2^b (b < 2n-2)
+            self.consts["pow2_norm_m"] = jnp.asarray(
+                np.stack([mont_np(1 << b) for b in range(valid.bits_for_norm)])
+            )
+            # 2^n (the cross-term weight of the norm expansion)
+            self.consts["pow2n_m"] = jnp.asarray(mont_np(1 << nb))
+            # shares_inv * 2^(2n-2): multiplied by the per-row entry count
+            # d on canonical graphs (offset term of the norm identity)
+            self.consts["offsq_m"] = jnp.asarray(
+                mont_np(shares_inv * (1 << (2 * nb - 2)))
+            )
+            # the exact-shape constant offset shares_inv * d * 2^(2n-2)
+            self.consts["offset_sq_c"] = jnp.asarray(
+                jf._int_to_limbs_np(
+                    shares_inv * (valid.entries % p) * (1 << (2 * nb - 2)) % p
                 )
-                m *= 2
-            self._ntt = (bitrev, tw_stages)
+            )
 
     # -- XOF helpers ----------------------------------------------------
     def _dst(self, usage: int) -> bytes:
@@ -504,8 +780,9 @@ class BatchedPrio3:
         )
         return meas, proofs, ok1 & ok2
 
-    def _lagrange_coeffs(self, t_m):
-        """Barycentric Lagrange coefficients at t over the P-th roots.
+    def _lagrange_coeffs(self, t_m, gi: int = 0):
+        """Barycentric Lagrange coefficients at t over gadget ``gi``'s
+        P-th roots.
 
         Inversion-free form: z/(t - w^k) = prod_{j != k} (t - w^j) exactly
         (t^P - 1 factors over ALL P roots), so the coefficients need only
@@ -515,16 +792,17 @@ class BatchedPrio3:
         t_ok for host recompute, as before.
         Returns (lag (B, calls+1, n) Montgomery, t_ok (B,)).
         """
-        jf, circ = self.jf, self.circ
+        jf = self.jf
+        plan, gc = self.circ.plans[gi], self._gc[gi]
         t_pow = t_m
-        for _ in range(self._log2_P):
+        for _ in range(gc["log2_P"]):
             t_pow = jf.mont_mul(t_pow, t_pow)
         z = jf.sub(t_pow, jnp.broadcast_to(jf.mont_one(), t_pow.shape))  # t^P - 1
         t_ok = ~jf.is_zero(z)
-        K = circ.calls + 1
-        denom_all = jf.sub(t_m[:, None, :], self.roots_all_m[None])  # (B, P, n)
+        K = plan.calls + 1
+        denom_all = jf.sub(t_m[:, None, :], gc["roots_all_m"][None])  # (B, P, n)
         others = jf.mutual_products_mont(denom_all, axis=1)
-        lag = jf.mont_mul(others[:, :K], self.bary_c_m[None])  # (B, K, n)
+        lag = jf.mont_mul(others[:, :K], gc["bary_c_m"][None])  # (B, K, n)
         return lag, t_ok
 
     def _gpoly_at(self, gpoly, t_m):
@@ -539,84 +817,108 @@ class BatchedPrio3:
             return jf.poly_eval_mont(gpoly, t_m)
         return jf.horner_mont(gpoly, t_m)
 
-    def _gadget_outputs(self, gpoly, B):
-        """gk (B, calls, n): the gadget polynomial at alpha^1..alpha^calls."""
-        jf, circ = self.jf, self.circ
+    def _gadget_outputs(self, gpoly, B, gi: int = 0):
+        """gk (B, calls, n): gadget ``gi``'s polynomial at alpha^1..alpha^calls."""
+        jf = self.jf
+        plan, gc = self.circ.plans[gi], self._gc[gi]
         if self.field_backend == "mxu":
             # Vandermonde-style matmul: gk[b, k] = sum_j gpoly[b, j] * w^(kj)
             # with the alpha-power table a host-precomputed Montgomery
             # constant shared by every report — ONE dot_general across calls
             # replaces the NTT butterfly stages / the Horner scan, and the
             # canonical residues are identical (exact integer math).
-            amat = self._alpha_mat_m()  # (calls, glen, n) Montgomery, host
+            amat = self._alpha_mat_m(gi)  # (calls, glen, n) Montgomery, host
             w = jnp.asarray(np.ascontiguousarray(amat.transpose(1, 0, 2)))
             return jnp.squeeze(jf.mat_mul_mont(gpoly[:, :, None, :], w), axis=1)
-        if self._ntt is not None:
-            P = circ.P
+        if gc["ntt"] is not None:
+            P = plan.P
             hi = gpoly[:, P:]
             hi = jnp.concatenate(
                 [hi, jnp.zeros((B, P - hi.shape[1], jf.n), dtype=_U32)], axis=1
             )
             folded = jf.add(gpoly[:, :P], hi)
-            evals = jf.ntt_eval_mont(folded, *self._ntt)
-            return evals[:, 1 : circ.calls + 1]
+            evals = jf.ntt_eval_mont(folded, *gc["ntt"])
+            return evals[:, 1 : plan.calls + 1]
 
         def horner_step(acc, c):
             return (
-                jf.add(jf.mont_mul(acc, self.alpha_pows_m[None]), c[:, None, :]),
+                jf.add(
+                    jf.mont_mul(acc, gc["alpha_pows_m"][None]), c[:, None, :]
+                ),
                 None,
             )
 
         coeffs_rev = jnp.moveaxis(jnp.flip(gpoly, axis=1), 1, 0)
-        acc0 = jnp.zeros((B, circ.calls, jf.n), dtype=_U32)
+        acc0 = jnp.zeros((B, plan.calls, jf.n), dtype=_U32)
         gk, _ = lax.scan(horner_step, acc0, coeffs_rev)
         return _scan_fence(gk)
 
     # -- FLP query (one proof) ------------------------------------------
-    def _query_one(self, meas_m, proof_m, jr_m, t_m, calls_live=None):
-        """Device FLP query for one proof.
+    def _query_one(self, meas_m, proof_m, jr_m, t_m, calls_live=None, ml=None):
+        """Device FLP query for one proof, over EVERY gadget.
 
         meas_m (B,MEAS_LEN,n) CANONICAL, proof_m (B,PROOF_LEN,n) CANONICAL,
-        jr_m (B,JR_LEN,n) Montgomery, t_m (B,n) Montgomery ->
+        jr_m (B,JR_LEN,n) Montgomery, t_m (B,QUERY_RAND_LEN,n) Montgomery
+        (one query point per gadget) ->
         (verifier (B,VERIFIER_LEN,n) CANONICAL, t_ok (B,)).
         Every mont_mul pairs one canonical bulk tensor with one Montgomery
         scalar/constant, so products stay canonical (see module docstring).
-        Oracle twin: FlpGeneric.query.
+        The proof splits into per-gadget segments (wire seeds + gadget
+        polynomial) and the verifier concatenates [v] + per-gadget
+        [wire evals, gpoly(t)] — exactly the scalar FlpGeneric.query
+        layout.  Oracle twin: FlpGeneric.query.
 
-        ``calls_live`` (B,) i32 is the canonical-shape mask boundary
-        (vdaf/canonical.py): this graph is compiled for the BUCKET's call
-        count, and rows from a shorter task zero their padded calls out of
-        (a) the gadget-output fold — an adversarial gadget polynomial is
-        NOT zero at unused evaluation points, so gk must be masked before
-        v — and (b) the barycentric coefficient vector, which reproduces
-        the actual circuit's wire polynomial exactly (its values at unused
-        P-th roots are zero BY DEFINITION, and every fused wire path
-        consumes lag downstream of this mask).
+        ``calls_live`` (canonical masking, vdaf/canonical.py) is a
+        PER-GADGET list of (B,) i32 mask boundaries: this graph is
+        compiled for the BUCKET's call counts, and rows from a shorter
+        task zero their padded calls out of (a) each gadget-output fold —
+        an adversarial gadget polynomial is NOT zero at unused evaluation
+        points, so gk must be masked before v — and (b) each barycentric
+        coefficient vector, which reproduces the actual circuit's wire
+        polynomial exactly (its values at unused P-th roots are zero BY
+        DEFINITION, and every fused wire path consumes lag downstream of
+        this mask).  ``ml`` (B,) i32 is the row's true measurement length
+        for length-dependent gadget inputs (the fixed-point entry
+        recomposition and norm fold).
         """
         jf, circ = self.jf, self.circ
         B = meas_m.shape[0]
-        seeds = proof_m[:, : circ.arity]  # (B, arity, n)
-        gpoly = proof_m[:, circ.arity :]  # (B, glen, n)
+        ok = jnp.ones((B,), dtype=bool)
+        gks = []
+        segs = []
+        idx = 0
+        for gi, plan in enumerate(circ.plans):
+            seeds = proof_m[:, idx : idx + plan.arity]  # (B, arity_g, n)
+            gpoly = proof_m[:, idx + plan.arity : idx + plan.arity + plan.glen]
+            idx += plan.arity + plan.glen
 
-        gk = self._gadget_outputs(gpoly, B)  # (B, calls, n)
-        if calls_live is not None:
-            k = jnp.arange(circ.calls, dtype=jnp.int32)[None, :]
-            gk = jnp.where((k < calls_live[:, None])[:, :, None], gk, 0)
-        v = circ.v(jf, gk, meas_m, jr_m, self.consts)  # (B, n)
+            gk = self._gadget_outputs(gpoly, B, gi=gi)  # (B, calls_g, n)
+            cl = calls_live[gi] if calls_live is not None else None
+            if cl is not None:
+                k = jnp.arange(plan.calls, dtype=jnp.int32)[None, :]
+                gk = jnp.where((k < cl[:, None])[:, :, None], gk, 0)
+            gks.append(gk)
 
-        # Wire evaluations at t via barycentric Lagrange on the P-th roots.
-        lag, t_ok = self._lagrange_coeffs(t_m)
-        if calls_live is not None:
-            k = jnp.arange(circ.calls + 1, dtype=jnp.int32)[None, :]
-            lag = jnp.where((k <= calls_live[:, None])[:, :, None], lag, 0)
-        wire_evals = circ.wire_evals(jf, meas_m, jr_m, lag, seeds, self.consts)
+            # Wire evaluations at t_g via barycentric Lagrange on the
+            # gadget's own P-th roots.
+            t_g = t_m[:, gi]
+            lag, t_ok = self._lagrange_coeffs(t_g, gi=gi)
+            ok = ok & t_ok
+            if cl is not None:
+                k = jnp.arange(plan.calls + 1, dtype=jnp.int32)[None, :]
+                lag = jnp.where((k <= cl[:, None])[:, :, None], lag, 0)
+            wire_evals = circ.wire_evals_g(
+                gi, jf, meas_m, jr_m, lag, seeds, self.consts, ml=ml
+            )
+            gp_t = self._gpoly_at(gpoly, t_g)  # (B, n)
+            segs.append((wire_evals, gp_t))
 
-        gp_t = self._gpoly_at(gpoly, t_m)  # (B, n)
-
-        verifier = jnp.concatenate(
-            [v[:, None], wire_evals, gp_t[:, None]], axis=1
-        )  # (B, VERIFIER_LEN, n)
-        return verifier, t_ok
+        v = circ.v_multi(jf, gks, meas_m, jr_m, self.consts, ml=ml)  # (B, n)
+        parts = [v[:, None]]
+        for wire_evals, gp_t in segs:
+            parts.extend([wire_evals, gp_t[:, None]])
+        verifier = jnp.concatenate(parts, axis=1)  # (B, VERIFIER_LEN, n)
+        return verifier, ok
 
     # -- prep init ------------------------------------------------------
     def prep_init(
@@ -664,7 +966,7 @@ class BatchedPrio3:
         ml = calls_live = None
         if meas_len_u32 is not None:
             ml = meas_len_u32.astype(jnp.int32)
-            calls_live = self.circ.calls_from_meas_len(ml)
+            calls_live = self.circ.calls_live_list(ml)
             col = jnp.arange(flp.MEAS_LEN, dtype=jnp.int32)[None, :]
             meas = jnp.where((col < ml[:, None])[:, :, None], meas, 0)
 
@@ -737,19 +1039,23 @@ class BatchedPrio3:
         verifiers = []
         for i in range(prio3.num_proofs):
             pm = proofs[:, i * flp.PROOF_LEN : (i + 1) * flp.PROOF_LEN]
-            # QUERY_RAND_LEN == 1 per gadget
-            ti = jf.to_mont(qr[:, i * flp.QUERY_RAND_LEN])
+            # one query point per gadget: the full QUERY_RAND_LEN segment
+            ti = jf.to_mont(
+                qr[:, i * flp.QUERY_RAND_LEN : (i + 1) * flp.QUERY_RAND_LEN]
+            )
             ji = (
                 jr_m[:, i * flp.JOINT_RAND_LEN : (i + 1) * flp.JOINT_RAND_LEN]
                 if jr_m is not None
                 else jnp.zeros((B, 0, jf.n), dtype=_U32)
             )
-            ver, t_ok = self._query_one(meas, pm, ji, ti, calls_live=calls_live)
+            ver, t_ok = self._query_one(
+                meas, pm, ji, ti, calls_live=calls_live, ml=ml
+            )
             ok = ok & t_ok
             verifiers.append(ver)
 
         out["verifiers"] = jnp.concatenate(verifiers, axis=1)
-        out["out_share"] = self.circ.truncate(jf, meas, self.consts)
+        out["out_share"] = self.circ.truncate(jf, meas, self.consts, ml=ml)
         out["ok"] = ok
         return out
 
@@ -779,7 +1085,9 @@ class BatchedPrio3:
         verifiers = []
         for i in range(prio3.num_proofs):
             pm = proofs_limbs[:, i * flp.PROOF_LEN : (i + 1) * flp.PROOF_LEN]
-            ti = jf.to_mont(qr_limbs[:, i * flp.QUERY_RAND_LEN])
+            ti = jf.to_mont(
+                qr_limbs[:, i * flp.QUERY_RAND_LEN : (i + 1) * flp.QUERY_RAND_LEN]
+            )
             ji = (
                 jr_m[:, i * flp.JOINT_RAND_LEN : (i + 1) * flp.JOINT_RAND_LEN]
                 if jr_m is not None
@@ -804,11 +1112,14 @@ class BatchedPrio3:
             ver = combined_verifiers[
                 :, i * flp.VERIFIER_LEN : (i + 1) * flp.VERIFIER_LEN
             ]
-            v = ver[:, 0]
-            x = ver[:, 1 : 1 + circ.arity]
-            y_scaled = jf.from_mont(ver[:, 1 + circ.arity])
-            g = circ.gadget_eval_scaled(jf, x)
-            decide = decide & jf.is_zero(v) & jf.eq(g, y_scaled)
+            decide = decide & jf.is_zero(ver[:, 0])
+            idx = 1
+            for gi, plan in enumerate(circ.plans):
+                x = ver[:, idx : idx + plan.arity]
+                y_scaled = jf.from_mont(ver[:, idx + plan.arity])
+                g = circ.gadget_eval_scaled_g(gi, jf, x)
+                decide = decide & jf.eq(g, y_scaled)
+                idx += plan.arity + 1
         return decide
 
     # -- planar (limb-plane) helper prep --------------------------------
@@ -973,14 +1284,15 @@ class BatchedPrio3:
         )  # (R, n, K, 128)
         return lag_pl, t_ok
 
-    def _alpha_mat_m(self):
-        """Constant w^{k*j} Montgomery table (calls, glen, n) for the planar
-        direct-sum gadget evaluation (lazy; small-P circuits only)."""
-        mat = getattr(self, "_alpha_mat_cache", None)
+    def _alpha_mat_m(self, gi: int = 0):
+        """Constant w^{k*j} Montgomery table (calls, glen, n) per gadget for
+        the direct-sum / Vandermonde gadget evaluation (lazy)."""
+        mat = self._alpha_mat_cache.get(gi)
         if mat is None:
-            field, circ, jf = self.flp.field, self.circ, self.jf
+            field, jf = self.flp.field, self.jf
+            plan = self.circ.plans[gi]
             p = field.MODULUS
-            w = field.root(circ.P)
+            w = field.root(plan.P)
 
             def mont_np(x: int) -> np.ndarray:
                 return jf._int_to_limbs_np((x % p) * (1 << (32 * jf.n)) % p)
@@ -990,12 +1302,12 @@ class BatchedPrio3:
             mat = np.stack(
                 [
                     np.stack(
-                        [mont_np(pow(w, k * j, p)) for j in range(circ.glen)]
+                        [mont_np(pow(w, k * j, p)) for j in range(plan.glen)]
                     )
-                    for k in range(1, circ.calls + 1)
+                    for k in range(1, plan.calls + 1)
                 ]
             )  # (calls, glen, n)
-            self._alpha_mat_cache = mat
+            self._alpha_mat_cache[gi] = mat
         return mat
 
     def _gadget_planes(self, gp_pl, t_pl):
@@ -1689,13 +2001,16 @@ class BatchedPrio3:
         decide = jnp.ones((B,), dtype=bool)
         for i in range(prio3.num_proofs):
             ver = combined[:, i * flp.VERIFIER_LEN : (i + 1) * flp.VERIFIER_LEN]
-            v = ver[:, 0]
-            x = ver[:, 1 : 1 + circ.arity]  # canonical wire evaluations
-            # Compare g*R^-1 == y*R^-1 (R invertible => same predicate as
-            # g == y) to skip the to_mont pass over the arity wires.
-            y_scaled = jf.from_mont(ver[:, 1 + circ.arity])
-            g = circ.gadget_eval_scaled(jf, x)
-            decide = decide & jf.is_zero(v) & jf.eq(g, y_scaled)
+            decide = decide & jf.is_zero(ver[:, 0])
+            idx = 1
+            for gi, plan in enumerate(circ.plans):
+                x = ver[:, idx : idx + plan.arity]  # canonical wire evals
+                # Compare g*R^-1 == y*R^-1 (R invertible => same predicate
+                # as g == y) to skip the to_mont pass over the arity wires.
+                y_scaled = jf.from_mont(ver[:, idx + plan.arity])
+                g = circ.gadget_eval_scaled_g(gi, jf, x)
+                decide = decide & jf.eq(g, y_scaled)
+                idx += plan.arity + 1
         out: Dict[str, jnp.ndarray] = {"decide": decide}
         if flp.JOINT_RAND_LEN > 0:
             binder = jnp.concatenate(list(joint_rand_parts_u8), axis=-1)

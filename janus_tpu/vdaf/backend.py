@@ -1256,7 +1256,10 @@ def vdaf_shape_key(vdaf) -> tuple:
 # NOT import the jax-backed kernels — a control-plane process must be able
 # to classify a VDAF without pulling in jax.  tests/test_backend_fallback.py
 # asserts this set matches _device_circuit's dispatch table.
-DEVICE_CIRCUITS = {"Count", "Sum", "SumVec", "Histogram"}
+# FixedPointBoundedL2VecSum (ISSUE 15) rides the multi-gadget device plane:
+# every TurboSHAKE Prio3 family now has a device arm — there is no
+# oracle-only Prio3 family left.
+DEVICE_CIRCUITS = {"Count", "Sum", "SumVec", "Histogram", "FixedPointBoundedL2VecSum"}
 
 
 def device_supported(vdaf) -> Tuple[bool, str]:
@@ -1297,6 +1300,11 @@ def device_path_label(vdaf) -> str:
         )
     if isinstance(vdaf, Prio3) and vdaf.xof is not XofTurboShake128:
         return "tpu-hybrid: host XOF + device FLP, executor kind=prep_init/combine"
+    if type(getattr(vdaf.flp, "valid", None)).__name__ == "FixedPointBoundedL2VecSum":
+        return (
+            "tpu: multi-gadget batched device prepare (gradient "
+            "aggregation), executor kind=prep_init/combine"
+        )
     return "tpu: batched device prepare, executor kind=prep_init/combine"
 
 

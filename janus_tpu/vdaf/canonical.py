@@ -73,13 +73,40 @@ def _canonical_calls(calls: int) -> int:
 
 def _build_canonical(vdaf):
     """The padded circuit twin, or None when no padding applies."""
-    from ..flp import FlpGeneric, Histogram, Sum, SumVec
+    from ..flp import (
+        FixedPointBoundedL2VecSum,
+        FlpGeneric,
+        Histogram,
+        Sum,
+        SumVec,
+    )
     from .prio3 import Prio3
 
     valid = vdaf.flp.valid
     calls = valid.GADGET_CALLS[0]
     c_calls = _canonical_calls(calls)
-    if isinstance(valid, Histogram):
+    if isinstance(valid, FixedPointBoundedL2VecSum):
+        # TWO gadgets chunk over different axes: bit checks over MEAS_LEN
+        # = entries*n + (2n-2), entry squares over entries.  Pad ENTRIES
+        # to the largest count that keeps BOTH gadgets' call counts within
+        # their P classes (the per-gadget rounding of _canonical_calls);
+        # _parity_preconditions then re-verifies every P from the built
+        # twin — the bucket set stays O(log N) over N entry counts.
+        chunk = valid.chunk_length
+        nb = valid.bits_per_entry
+        c_sq = _canonical_calls(valid.GADGET_CALLS[1])
+        by_bits = (c_calls * chunk - valid.bits_for_norm) // nb
+        by_sq = c_sq * chunk
+        entries = min(by_bits, by_sq)
+        if entries <= valid.entries:
+            return None
+        twin = FixedPointBoundedL2VecSum(
+            bits_per_entry=nb,
+            entries=entries,
+            chunk_length=chunk,
+            field=valid.field,
+        )
+    elif isinstance(valid, Histogram):
         length = c_calls * valid.chunk_length
         if length == valid.length:
             return None  # already canonical: keep the exact backend
@@ -114,14 +141,19 @@ def _parity_preconditions(vdaf, canon) -> Tuple[bool, str]:
     masked graph relies on; any failure means exact-shape compile."""
     a, c = vdaf.flp, canon.flp
     av, cv = a.valid, c.valid
-    if next_power_of_2(1 + av.GADGET_CALLS[0]) != next_power_of_2(
-        1 + cv.GADGET_CALLS[0]
-    ):
-        return False, "padding changed P (the interpolation roots)"
+    if len(av.GADGET_CALLS) != len(cv.GADGET_CALLS):
+        return False, "gadget count differs across the bucket"
+    for ac, cc in zip(av.GADGET_CALLS, cv.GADGET_CALLS):
+        if next_power_of_2(1 + ac) != next_power_of_2(1 + cc):
+            return False, "padding changed P (the interpolation roots)"
     if a.PROOF_LEN != c.PROOF_LEN or a.VERIFIER_LEN != c.VERIFIER_LEN:
         return False, "proof/verifier wire width differs across the bucket"
+    if a.QUERY_RAND_LEN != c.QUERY_RAND_LEN:
+        return False, "query-rand stream width differs across the bucket"
     if getattr(av, "chunk_length", None) != getattr(cv, "chunk_length", None):
         return False, "chunk_length differs (gadget arity is the wire format)"
+    if getattr(av, "bits_per_entry", None) != getattr(cv, "bits_per_entry", None):
+        return False, "bits_per_entry differs (the entry layout is the wire format)"
     if a.MEAS_LEN > c.MEAS_LEN or a.OUTPUT_LEN > c.OUTPUT_LEN:
         return False, "canonical shape smaller than actual"
     if a.JOINT_RAND_LEN > c.JOINT_RAND_LEN:

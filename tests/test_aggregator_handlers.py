@@ -384,6 +384,122 @@ class TestAggregateInit:
         assert resp.prepare_resps[1].result.variant == PrepareStepResult.REJECT
         assert resp.prepare_resps[1].result.error == PrepareError.HPKE_DECRYPT_ERROR
 
+    def test_batched_vs_inline_open_parity(self, env):
+        """ISSUE 15 satellite: the helper's aggregate-init report-share
+        opens route through core/hpke_batch.open_batch (one worker-thread
+        batch).  An ``upload_open_backend: inline`` helper fed the SAME
+        request bytes must produce an IDENTICAL response — including a
+        corrupted ciphertext rejecting only itself — and identical stored
+        report-aggregation states."""
+        ds, agg = env  # Config default: batched
+        leader, helper, collector = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, states, reports = leader_prep_inits(
+            vdaf, leader, helper, [1, 0, 1]
+        )
+        # corrupt the middle report's helper ciphertext
+        from janus_tpu.messages import HpkeCiphertext
+
+        rs = inits[1].report_share
+        bad_ct = HpkeCiphertext(
+            rs.encrypted_input_share.config_id,
+            rs.encrypted_input_share.encapsulated_key,
+            rs.encrypted_input_share.payload[:-1]
+            + bytes([rs.encrypted_input_share.payload[-1] ^ 1]),
+        )
+        inits = [
+            inits[0],
+            PrepareInit(
+                ReportShare(rs.metadata, rs.public_share, bad_ct),
+                inits[1].message,
+            ),
+            inits[2],
+        ]
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        body = req.get_encoded()
+        job_id = AggregationJobId.random()
+
+        # inline twin: same helper task, fresh datastore, inline opens
+        eds2 = EphemeralDatastore(MockClock(NOW))
+        try:
+            agg_inline = Aggregator(
+                eds2.datastore,
+                eds2.clock,
+                Config(vdaf_backend="oracle", upload_open_backend="inline"),
+            )
+            eds2.datastore.run_tx(
+                "put", lambda tx: tx.put_aggregator_task(helper)
+            )
+            resp_b = run(
+                agg.handle_aggregate_init(helper.task_id, job_id, body, AGG_TOKEN)
+            )
+            resp_i = run(
+                agg_inline.handle_aggregate_init(
+                    helper.task_id, job_id, body, AGG_TOKEN
+                )
+            )
+            assert resp_b == resp_i
+            variants = [pr.result.variant for pr in resp_b.prepare_resps]
+            assert variants == [
+                PrepareStepResult.CONTINUE,
+                PrepareStepResult.REJECT,
+                PrepareStepResult.CONTINUE,
+            ]
+            assert (
+                resp_b.prepare_resps[1].result.error
+                == PrepareError.HPKE_DECRYPT_ERROR
+            )
+            # stored aggregation states match row for row
+            for store in (ds, eds2.datastore):
+                ras = store.run_tx(
+                    "ras",
+                    lambda tx: tx.get_report_aggregations_for_aggregation_job(
+                        helper.task_id, job_id
+                    ),
+                )
+                assert [ra.state for ra in ras] == [
+                    ReportAggregationState.FINISHED,
+                    ReportAggregationState.FAILED,
+                    ReportAggregationState.FINISHED,
+                ]
+        finally:
+            eds2.cleanup()
+
+    def test_batch_level_open_failure_falls_back_inline(self, env, monkeypatch):
+        """A batch-LEVEL failure in open_batch (kernel import, shape bug)
+        must fall back to per-report opens — never reject the request."""
+        ds, agg = env
+        leader, helper, collector = make_pair_tasks({"type": "Prio3Count"})
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(helper))
+        vdaf = helper.vdaf_instance()
+        inits, _, _ = leader_prep_inits(vdaf, leader, helper, [1, 1])
+
+        from janus_tpu.core import hpke_batch
+
+        def boom(requests):
+            raise RuntimeError("injected batch-level failure")
+
+        monkeypatch.setattr(hpke_batch, "open_batch", boom)
+        req = AggregationJobInitializeReq(
+            aggregation_parameter=b"",
+            partial_batch_selector=PartialBatchSelector.new_time_interval(),
+            prepare_inits=inits,
+        )
+        resp = run(
+            agg.handle_aggregate_init(
+                helper.task_id, AggregationJobId.random(), req.get_encoded(), AGG_TOKEN
+            )
+        )
+        assert all(
+            pr.result.variant == PrepareStepResult.CONTINUE
+            for pr in resp.prepare_resps
+        )
+
 
 class TestAggregateShare:
     def test_share_flow(self, env):

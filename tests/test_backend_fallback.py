@@ -50,10 +50,19 @@ def test_device_supported_classification():
     ok, reason = device_supported(_poplar1(8))
     assert ok and reason == ""
 
+    # The fixed-point gradient family rides the multi-gadget device plane
+    # (ISSUE 15) — there is no oracle-only Prio3 family left.
     ok, reason = device_supported(
         prio3_fixedpoint_bounded_l2_vec_sum("BitSize16", length=3)
     )
-    assert not ok and "FixedPoint" in reason
+    assert ok and reason == ""
+
+    # A circuit OUTSIDE the device set still classifies as oracle-only
+    # (the loud-fallback machinery stays reachable).
+    from janus_tpu.vdaf.instances import _fake
+
+    ok, reason = device_supported(_fake())
+    assert not ok and reason
 
 
 def test_device_path_label_names_the_routing_tier():
@@ -73,18 +82,31 @@ def test_device_path_label_names_the_routing_tier():
         )
     )
     assert hybrid.startswith("tpu-hybrid")
-    oracle = device_path_label(
+    # fpvec (ISSUE 15): first-class device workload, multi-gadget plane
+    fp = device_path_label(
         prio3_fixedpoint_bounded_l2_vec_sum("BitSize16", length=3)
     )
-    assert oracle.startswith("cpu-oracle") and "FixedPoint" in oracle
+    assert fp.startswith("tpu:") and "multi-gadget" in fp
+    from janus_tpu.vdaf.instances import _fake
+
+    assert device_path_label(_fake()).startswith("cpu-oracle")
 
 
 def test_driver_fallback_is_logged(caplog):
+    """The loud-fallback machinery survives fpvec's promotion: a Prio3
+    whose circuit has NO device arm (a renamed SumVec stand-in — every
+    real TurboSHAKE family now has one) still logs + counts on first
+    dispatch and lands on the oracle."""
     from janus_tpu.aggregator.aggregation_job_driver import (
         AggregationJobDriver,
         DriverConfig,
     )
-    from tests.test_datastore import make_task
+    from janus_tpu.fields import Field128
+    from janus_tpu.flp import FlpGeneric, SumVec
+    from janus_tpu.vdaf.prio3 import ALG_PRIO3_SUMVEC, Prio3
+
+    class FrontierVec(SumVec):
+        """A circuit type outside DEVICE_CIRCUITS."""
 
     eds = EphemeralDatastore()
     driver = AggregationJobDriver(
@@ -92,11 +114,13 @@ def test_driver_fallback_is_logged(caplog):
         session_factory=lambda: None,
         config=DriverConfig(vdaf_backend="tpu"),
     )
-    # FixedPoint is the one remaining oracle-only family.
-    task = make_task(
-        vdaf={"type": "Prio3FixedPointBoundedL2VecSum", "bitsize": 16, "length": 3}
+    from tests.test_datastore import make_task
+
+    task = make_task(vdaf={"type": "Prio3Count"})
+    vdaf = Prio3(
+        FlpGeneric(FrontierVec(length=3, bits=1, chunk_length=2, field=Field128)),
+        ALG_PRIO3_SUMVEC,
     )
-    vdaf = task.vdaf_instance()
     with caplog.at_level(logging.WARNING, logger="janus_tpu.aggregation_job_driver"):
         backend = driver._backend_for(task, vdaf)
     assert backend is not None
@@ -133,6 +157,18 @@ def test_provisioning_warns_for_oracle_only_vdaf():
                 "collector_auth_token": "col-tok",
                 "collector_hpke_config": collector_cfg,
             }
+            # The Fake (test-double) VDAF has no device path: warned.
+            resp = await client.post(
+                "/tasks",
+                headers=headers,
+                json={**base, "vdaf": {"type": "Fake"}},
+            )
+            assert resp.status == 201, await resp.text()
+            doc = await resp.json()
+            assert any("CPU oracle" in w for w in doc.get("warnings", []))
+
+            # fpvec (ISSUE 15): first-class device workload — NO warning,
+            # and the device_path names the multi-gadget plane.
             resp = await client.post(
                 "/tasks",
                 headers=headers,
@@ -147,7 +183,8 @@ def test_provisioning_warns_for_oracle_only_vdaf():
             )
             assert resp.status == 201, await resp.text()
             doc = await resp.json()
-            assert any("CPU oracle" in w for w in doc.get("warnings", []))
+            assert "warnings" not in doc, doc
+            assert doc["device_path"].startswith("tpu:")
 
             resp = await client.post(
                 "/tasks", headers=headers, json={**base, "vdaf": {"type": "Prio3Count"}}
@@ -183,24 +220,32 @@ def test_device_circuits_set_matches_dispatch_table():
         "Sum": Sum(4),
         "SumVec": SumVec(length=4, bits=1, chunk_length=2),
         "Histogram": Histogram(length=4, chunk_length=2),
+        "FixedPointBoundedL2VecSum": FixedPointBoundedL2VecSum(
+            bits_per_entry=16, entries=3
+        ),
     }
     for name, valid in have_arm.items():
         assert name in DEVICE_CIRCUITS
         _device_circuit(valid)  # must not raise
-    fp = FixedPointBoundedL2VecSum(bits_per_entry=16, entries=3)
-    assert type(fp).__name__ not in DEVICE_CIRCUITS
+    assert DEVICE_CIRCUITS == set(have_arm)
+
+    class NoArm:
+        """A circuit type with no dispatch-table entry."""
+
+    assert "NoArm" not in DEVICE_CIRCUITS
     with pytest.raises(NotImplementedError):
-        _device_circuit(fp)
+        _device_circuit(NoArm())
 
 
-def test_driver_fpvec_fallback_returns_oracle_backend():
-    """A TurboShake circuit WITHOUT a device arm (fpvec) must land on the
-    oracle backend, not crash make_backend with NotImplementedError."""
+def test_driver_fpvec_resolves_device_backend():
+    """ISSUE 15: the gradient family dispatches onto the real device
+    backend through the driver's standard resolution — no oracle detour,
+    no warning (direction-3 proof: the dispatch plane needed no change)."""
     from janus_tpu.aggregator.aggregation_job_driver import (
         AggregationJobDriver,
         DriverConfig,
     )
-    from janus_tpu.vdaf.backend import OracleBackend
+    from janus_tpu.vdaf.backend import TpuBackend
     from tests.test_datastore import make_task
 
     eds = EphemeralDatastore()
@@ -217,7 +262,9 @@ def test_driver_fpvec_fallback_returns_oracle_backend():
         }
     )
     backend = driver._backend_for(task, task.vdaf_instance())
-    assert isinstance(backend, OracleBackend)
+    assert isinstance(backend, TpuBackend)
+    # resolving it again hits the cache
+    assert driver._backend_for(task, task.vdaf_instance()) is backend
     eds.cleanup()
 
 

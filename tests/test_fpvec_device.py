@@ -1,0 +1,521 @@
+"""Prio3FixedPointBoundedL2VecSum on the multi-gadget device plane (ISSUE 15).
+
+The gradient-aggregation family is the first TWO-gadget circuit served by
+ops/prepare.py: gadget 0 is the SumVec-pattern bit-range check over all
+MEAS_LEN positions, gadget 1 the entry-squares ParallelSum whose inputs
+are recomposed in-graph from the bit planes.  This suite is the bit-exact
+fence: device vs the scalar CPU oracle for every prepare artifact, both
+aggregator sides, both field layouts (vpu + mxu), canonical-padded
+lengths, and ADVERSARIAL reports (broken bits and norm-violating claimed
+norms must reject identically).  The e2e gradient scenario provisions a
+real task through the task API, aggregates through the real drivers +
+executor, and collects with ZCdpDiscreteGaussian noise applied to the
+aggregate shares — the one place the reference wires real DP noise.
+
+Budget note: one Field128 graph cold-compiles ~60-130 s on XLA:CPU, so
+the always-on tier pays for exactly ONE prep graph (helper side, vpu,
+honest + adversarial rows in one batch) + one combine graph; the full
+matrix (leader, mxu, canonical mixed batches, the e2e) is slow-marked
+and runs in ``./ci.sh fpvec``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from janus_tpu.flp import FlpGeneric, FixedPointBoundedL2VecSum
+from janus_tpu.utils.test_util import det_rng
+from janus_tpu.vdaf.backend import (
+    OracleBackend,
+    TpuBackend,
+    device_path_label,
+    device_supported,
+    make_backend,
+    vdaf_shape_key,
+)
+from janus_tpu.vdaf.canonical import (
+    canonical_vdaf_for,
+    canonicalization_reason,
+    executor_shape,
+)
+from janus_tpu.vdaf.instances import prio3_fixedpoint_bounded_l2_vec_sum
+from janus_tpu.vdaf.prio3 import (
+    ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM,
+    Prio3,
+    VdafError,
+)
+
+
+def fpvec(bits, entries, chunk, num_shares=2):
+    """Direct construction at arbitrary tiny sizes (the registry's
+    constructor accepts only the reference's BitSize16/BitSize32)."""
+    return Prio3(
+        FlpGeneric(
+            FixedPointBoundedL2VecSum(
+                bits_per_entry=bits, entries=entries, chunk_length=chunk
+            )
+        ),
+        ALG_PRIO3_FIXEDPOINT_BOUNDED_L2_VEC_SUM,
+        num_shares=num_shares,
+    )
+
+
+# ---------------------------------------------------------------------------
+# classification + canonical plan math (pure Python, free)
+
+
+def test_fpvec_is_device_supported():
+    ok, reason = device_supported(
+        prio3_fixedpoint_bounded_l2_vec_sum("BitSize16", length=3)
+    )
+    assert ok and reason == ""
+    label = device_path_label(
+        prio3_fixedpoint_bounded_l2_vec_sum("BitSize16", length=3)
+    )
+    assert label.startswith("tpu:") and "prep_init" in label
+    assert "multi-gadget" in label
+
+
+def test_fpvec_gadget_plans():
+    from janus_tpu.ops.prepare import _device_circuit
+
+    valid = FixedPointBoundedL2VecSum(bits_per_entry=2, entries=5, chunk_length=2)
+    circ = _device_circuit(valid)
+    assert len(circ.plans) == 2
+    p0, p1 = circ.plans
+    assert (p0.calls, p1.calls) == tuple(valid.GADGET_CALLS)
+    assert p0.arity == p1.arity == 2 * valid.chunk_length
+    # proof layout: per-gadget (seeds + gadget poly) segments concatenated
+    flp = FlpGeneric(valid)
+    assert flp.PROOF_LEN == sum(p.arity + p.glen for p in circ.plans)
+    assert flp.VERIFIER_LEN == 1 + sum(p.arity + 1 for p in circ.plans)
+    # per-row live-call masks for BOTH gadget folds
+    assert circ.calls_live_list(valid.MEAS_LEN) == [p0.calls, p1.calls]
+    smaller = FixedPointBoundedL2VecSum(
+        bits_per_entry=2, entries=3, chunk_length=2
+    )
+    assert circ.calls_live_list(smaller.MEAS_LEN) == [
+        smaller.GADGET_CALLS[0],
+        smaller.GADGET_CALLS[1],
+    ]
+
+
+def test_fpvec_canonical_plan_buckets_entries():
+    # bits=2, chunk=2: entries 5 (bit calls 6, P=8) pads to the class
+    # ceiling — twin entries 6 (bit calls 7 = P-1, squares calls 3 kept
+    # in its own P class)
+    fp5, fp6 = fpvec(2, 5, 2), fpvec(2, 6, 2)
+    canon = canonical_vdaf_for(fp5)
+    assert canon is not None and canon.flp.valid.entries == 6
+    assert canonical_vdaf_for(fp6) is None  # its own bucket ceiling
+    assert canonical_vdaf_for(canon) is None  # twin of twin = itself
+    k5, c5 = executor_shape(fp5)
+    assert c5 is not None and k5 == ("canon",) + vdaf_shape_key(canon)
+    # both gadgets' P classes survive the padding (the preconditions
+    # re-verify from the built twin)
+    for g in (0, 1):
+        from janus_tpu.fields import next_power_of_2
+
+        assert next_power_of_2(1 + fp5.flp.valid.GADGET_CALLS[g]) == next_power_of_2(
+            1 + canon.flp.valid.GADGET_CALLS[g]
+        )
+    # a twin-breaking parameter keeps the exact shape, with a reason
+    assert canonicalization_reason(fp6) != ""
+
+
+# ---------------------------------------------------------------------------
+# device-vs-oracle parity (device tier)
+
+#: tiny two-gadget shape: MEAS_LEN=6, bit calls 3 (P=4), square calls 1
+#: (P=2) — the cheapest graph that exercises both gadget folds
+_TINY = (2, 2, 2)
+
+#: honest fixed-point vectors for the tiny shape (norm < 4 at scale 2)
+_HONEST = [[0.5, -0.5], [0.0, 0.0], [-0.5, 0.5], [0.5, 0.5]]
+
+
+def _shard_rows(vdaf, meas_list, seed):
+    rng = det_rng(seed)
+    rows = []
+    for m in meas_list:
+        nonce = rng(vdaf.NONCE_SIZE)
+        ps, shares = vdaf.shard(m, nonce, rng(vdaf.RAND_SIZE))
+        rows.append((nonce, ps, shares))
+    return rows
+
+
+def _shard_encoded(vdaf, encoded_meas, seed):
+    """Shard a RAW encoded measurement (adversarial: the client lies)."""
+    rng = det_rng(seed)
+    vdaf.flp.encode = lambda m: list(encoded_meas)  # shadow the method
+    try:
+        nonce = rng(vdaf.NONCE_SIZE)
+        ps, shares = vdaf.shard(None, nonce, rng(vdaf.RAND_SIZE))
+    finally:
+        del vdaf.flp.encode  # restore the class method
+    return (nonce, ps, shares)
+
+
+def _adversarial_rows(vdaf, seed):
+    """Two invalid encodings: a broken (non-bit) measurement element, and
+    a norm-violating claimed norm over valid bits."""
+    valid = vdaf.flp.valid
+    pad = [0.0] * (valid.entries - 2)
+    honest = valid.encode([0.5, -0.5] + pad)
+    broken_bits = list(honest)
+    broken_bits[0] = 2  # not a bit
+    norm_lie = list(valid.encode([0.5, 0.5] + pad))
+    # claimed norm bits: flip the claim (actual norm is 2 -> claim 0)
+    for b in range(valid.bits_for_norm):
+        norm_lie[valid.entries * valid.bits_per_entry + b] = 0
+    return [
+        _shard_encoded(vdaf, broken_bits, seed + "-bb"),
+        _shard_encoded(vdaf, norm_lie, seed + "-nl"),
+    ]
+
+
+def _prep_both_and_check(vdaf, backend, rows, vk, expect_ok, device_sides=None):
+    """Run the aggregator sides through ``backend`` (``device_sides``
+    restricts which sides pay a device graph — the rest ride the oracle;
+    None = all), diff every prepare artifact against the oracle, then
+    combine and check decide."""
+    oracle = OracleBackend(vdaf)
+    got_sides, want_sides = [], []
+    for agg_id in range(vdaf.num_shares):
+        sub = [(n, p, sh[agg_id]) for (n, p, sh) in rows]
+        side_backend = (
+            backend
+            if device_sides is None or agg_id in device_sides
+            else oracle
+        )
+        got = side_backend.prep_init_batch(vk, agg_id, sub)
+        want = oracle.prep_init_batch(vk, agg_id, sub)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert g[0].out_share == w[0].out_share, (agg_id, i, "out_share")
+            assert g[1].verifiers_share == w[1].verifiers_share, (
+                agg_id,
+                i,
+                "verifier",
+            )
+            assert g[1].joint_rand_part == w[1].joint_rand_part, (agg_id, i)
+            assert (
+                g[0].corrected_joint_rand_seed == w[0].corrected_joint_rand_seed
+            ), (agg_id, i)
+        got_sides.append(got)
+        want_sides.append(want)
+    pairs = [
+        [got_sides[a][b][1] for a in range(vdaf.num_shares)]
+        for b in range(len(rows))
+    ]
+    got_msgs = backend.prep_shares_to_prep_batch(pairs)
+    want_msgs = oracle.prep_shares_to_prep_batch(pairs)
+    for b, (g, w) in enumerate(zip(got_msgs, want_msgs)):
+        assert type(g) is type(w), (b, g, w)
+        if not isinstance(g, VdafError):
+            assert g == w, b
+        assert isinstance(g, VdafError) == (not expect_ok[b]), (
+            b,
+            "decide verdict drifted from expectation",
+        )
+    return got_sides
+
+
+def test_fpvec_device_matches_oracle_with_adversarial_rows():
+    """Always-on fence (ONE prep + one combine compile: the helper side
+    pays the device graph, the leader rides the oracle here and pays its
+    graph in the slow sweep/e2e): honest rows are bit-exact and accepted,
+    broken-bit AND norm-violating reports reject identically through the
+    DEVICE combine."""
+    vdaf = fpvec(*_TINY)
+    rows = _shard_rows(vdaf, _HONEST[:2], "fp-on") + _adversarial_rows(
+        vdaf, "fp-adv"
+    )
+    expect_ok = [True, True, False, False]
+    vk = b"\x07" * vdaf.VERIFY_KEY_SIZE
+    backend = make_backend(vdaf, "tpu")
+    assert isinstance(backend, TpuBackend)
+    got = _prep_both_and_check(
+        vdaf, backend, rows, vk, expect_ok, device_sides=(1,)
+    )
+    # the accepted rows' device shares reconstruct the exact vector sums
+    accepted = [b for b, ok in enumerate(expect_ok) if ok]
+    agg = [
+        vdaf.aggregate([got[a][b][0].out_share for b in accepted])
+        for a in range(vdaf.num_shares)
+    ]
+    expect = [sum(_HONEST[b][i] for b in accepted) for i in range(2)]
+    assert vdaf.unshard(agg, len(accepted)) == expect
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("field_backend", ["vpu", "mxu"])
+def test_fpvec_parity_sweep(field_backend):
+    """Full matrix (./ci.sh fpvec): a larger two-gadget shape under both
+    field layouts, both sides, honest + adversarial, fuzzed vectors."""
+    vdaf = fpvec(3, 4, 3)  # MEAS_LEN=16, bit calls 6 (P=8), sq calls 2 (P=4)
+    rng = np.random.default_rng(20240815)
+    meas = []
+    for _ in range(5):
+        # random vectors inside the L2 ball (scale 4, norm bound 2^4)
+        v = rng.uniform(-0.6, 0.6, size=4)
+        meas.append([float(x) for x in v])
+    rows = _shard_rows(vdaf, meas, f"fp-{field_backend}") + _adversarial_rows(
+        vdaf, f"fp-{field_backend}-adv"
+    )
+    expect_ok = [True] * 5 + [False, False]
+    backend = make_backend(vdaf, "tpu", field_backend=field_backend)
+    _prep_both_and_check(
+        vdaf, backend, rows, b"\x09" * vdaf.VERIFY_KEY_SIZE, expect_ok
+    )
+
+
+@pytest.mark.slow
+def test_fpvec_canonical_padded_parity_and_mixed_batch():
+    """Canonical-padded lengths (ISSUE 15 tentpole part 3): entries=5
+    rides the entries=6 bucket twin with per-row masks on BOTH gadget
+    folds — bit-exact vs each task's own oracle for a MIXED two-task
+    mega-batch on both sides, adversarial rows included."""
+    fp5, fp6 = fpvec(2, 5, 2), fpvec(2, 6, 2)
+    canon = canonical_vdaf_for(fp5)
+    assert canon is not None and canon.flp.valid.entries == 6
+    backend = TpuBackend(canon, canonical=True)
+    m5 = [[0.5, -0.5, 0.0, 0.0, 0.0], [0.0] * 5, [-0.5, 0.5, 0.0, 0.0, 0.5]]
+    m6 = [[0.0] * 6, [0.5, -0.5, 0.0, 0.0, 0.5, 0.0]]
+    for agg_id in (0, 1):
+        vk5, vk6 = b"\x05" * 16, b"\x06" * 16
+        r5 = [
+            (n, p, sh[agg_id])
+            for (n, p, sh) in _shard_rows(fp5, m5, f"c5{agg_id}")
+        ] + [
+            (n, p, sh[agg_id])
+            for (n, p, sh) in _adversarial_rows(fp5, f"c5{agg_id}adv")
+        ]
+        r6 = [
+            (n, p, sh[agg_id])
+            for (n, p, sh) in _shard_rows(fp6, m6, f"c6{agg_id}")
+        ]
+        reqs = [(vk5, r5, fp5), (vk6, r6, fp6)]
+        got5, got6 = backend.launch_prep_init_multi(
+            backend.stage_prep_init_multi(agg_id, reqs), reqs
+        )
+        for vdaf, vk, rows, got in ((fp5, vk5, r5, got5), (fp6, vk6, r6, got6)):
+            want = OracleBackend(vdaf).prep_init_batch(vk, agg_id, rows)
+            for i, (g, w) in enumerate(zip(got, want)):
+                assert g[0].out_share == w[0].out_share, (agg_id, i)
+                assert g[1].verifiers_share == w[1].verifiers_share, (agg_id, i)
+                assert g[1].joint_rand_part == w[1].joint_rand_part
+                assert (
+                    g[0].corrected_joint_rand_seed
+                    == w[0].corrected_joint_rand_seed
+                )
+            # out shares come back at the TASK's entry count
+            assert all(len(g[0].out_share) == vdaf.flp.OUTPUT_LEN for g in got)
+    # combine through the canonical backend: adversarial rows reject
+    # identically (the per-gadget gk masks keep padded evaluation points
+    # out of an attacker's reach)
+    o = OracleBackend(fp5)
+    rows0 = [
+        (n, p, sh[0]) for (n, p, sh) in _shard_rows(fp5, m5, "cc0")
+    ] + [(n, p, sh[0]) for (n, p, sh) in _adversarial_rows(fp5, "cc0adv")]
+    rows1 = [
+        (n, p, sh[1]) for (n, p, sh) in _shard_rows(fp5, m5, "cc0")
+    ] + [(n, p, sh[1]) for (n, p, sh) in _adversarial_rows(fp5, "cc0adv")]
+    p0 = o.prep_init_batch(b"\x07" * 16, 0, rows0)
+    p1 = o.prep_init_batch(b"\x07" * 16, 1, rows1)
+    pairs = [[a[1], b[1]] for a, b in zip(p0, p1)]
+    got_c = backend.prep_shares_to_prep_batch(pairs)
+    want_c = o.prep_shares_to_prep_batch(pairs)
+    assert [type(x) for x in got_c] == [type(x) for x in want_c]
+    assert [x for x in got_c if not isinstance(x, VdafError)] == [
+        x for x in want_c if not isinstance(x, VdafError)
+    ]
+    assert any(isinstance(x, VdafError) for x in got_c)
+
+
+# ---------------------------------------------------------------------------
+# e2e gradient scenario (task API -> drivers -> executor -> DP collect)
+
+
+@pytest.mark.slow
+def test_fpvec_e2e_gradient_scenario_with_dp_noise(monkeypatch):
+    """ISSUE 15 acceptance: provision a fpvec task via the task API (no
+    oracle warning, explicit device_path), aggregate gradient reports
+    through the REAL drivers riding the standard prep_init/combine
+    executor kinds, observe cross-job coalescing in executor stats, and
+    collect with ZCdpDiscreteGaussian noise applied to the aggregate
+    shares (sigma chosen tiny so the decoded sums stay exact with
+    overwhelming probability, while a sampler spy proves the noise hook
+    actually ran on every coordinate of both shares)."""
+    import base64
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from janus_tpu.aggregator_api import aggregator_api_app
+    from janus_tpu.core import dp as dp_mod
+    from janus_tpu.core.hpke import HpkeKeypair
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.test_util import EphemeralDatastore
+    from janus_tpu.executor import reset_global_executor
+    from janus_tpu.messages import Time
+    from test_chaos import ChaosHarness, _run
+
+    fp_instance = {
+        "type": "Prio3FixedPointBoundedL2VecSum",
+        "bitsize": 16,
+        "length": 2,
+        "chunk_length": 31,  # bit calls 2 (P=4): CPU-compilable graphs
+        "dp_strategy": {
+            "dp_mechanism": "ZCdpDiscreteGaussian",
+            # sigma = 2^16 / epsilon ~= 1e-3: P[any nonzero draw] < 1e-9
+            "epsilon": [1 << 26, 1],
+        },
+    }
+
+    # --- task API provisioning: fpvec is a first-class device workload
+    eds = EphemeralDatastore(MockClock(Time(1_600_002_000)))
+    app = aggregator_api_app(eds.datastore, ["tok"])
+
+    async def provision():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            collector_cfg = (
+                base64.urlsafe_b64encode(
+                    HpkeKeypair.generate(9).config.get_encoded()
+                )
+                .rstrip(b"=")
+                .decode()
+            )
+            resp = await client.post(
+                "/tasks",
+                headers={"Authorization": "Bearer tok"},
+                json={
+                    "peer_aggregator_endpoint": "https://helper.example.com/",
+                    "role": "Leader",
+                    "min_batch_size": 3,
+                    "time_precision": 3600,
+                    "collector_auth_token": "col-tok",
+                    "collector_hpke_config": collector_cfg,
+                    "vdaf": fp_instance,
+                },
+            )
+            assert resp.status == 201, await resp.text()
+            doc = await resp.json()
+            assert "warnings" not in doc, doc
+            assert doc["device_path"].startswith("tpu:"), doc
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(provision())
+    finally:
+        loop.close()
+        eds.cleanup()
+
+    # --- real drivers + executor: the gradient aggregation itself
+    draws = []
+    real_sample = dp_mod.sample_discrete_gaussian
+
+    def spy_sample(sigma):
+        x = real_sample(sigma)
+        draws.append(x)
+        return x
+
+    monkeypatch.setattr(dp_mod, "sample_discrete_gaussian", spy_sample)
+
+    reset_global_executor()
+    harness = ChaosHarness(n_tasks=2, vdaf=fp_instance)
+    # exactly representable at 2^-15 granularity: exact decoded sums
+    measurements = {
+        0: [[0.5, -0.25], [0.25, 0.25], [-0.5, 0.125]],
+        1: [[0.125, 0.5], [0.0, -0.5], [0.25, 0.25]],
+    }
+
+    async def flow():
+        await harness.start()
+        try:
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)
+            await harness.create_jobs()
+            ex = harness.drivers[0]._executor
+            for _ in range(40):
+                await harness.drive_round()
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert states and all(s == "Finished" for s in states), states
+            # the fpvec buckets really served the jobs on the device plane
+            stats = {
+                k: v
+                for k, v in ex.stats().items()
+                if k.startswith("FixedPointBoundedL2VecSum")
+            }
+            assert stats and sum(s["flushed_rows"] for s in stats.values()) > 0
+            assert all(
+                s["trips"] == 0 for s in ex.circuit_stats().values()
+            ), ex.circuit_stats()
+
+            # cross-job coalescing observable in executor stats: two
+            # concurrent same-shape submissions share ONE flush (the
+            # compiled graphs are already warm from the driver rounds)
+            vdaf = harness.tasks[0][1].vdaf_instance()
+            from janus_tpu.vdaf.canonical import backend_shape_key
+
+            driver = next(d for d in harness.drivers if d._backends)
+            backend = driver._backend_for(harness.tasks[0][1], vdaf)
+            key = backend_shape_key(backend)
+            rows_a = [
+                (n, p, sh[0])
+                for (n, p, sh) in _shard_rows(vdaf, [[0.5, 0.25]] * 2, "coa")
+            ]
+            rows_b = [
+                (n, p, sh[0])
+                for (n, p, sh) in _shard_rows(vdaf, [[0.25, 0.5]] * 2, "cob")
+            ]
+            canonical = getattr(backend, "canonical", False)
+            req_a = (b"\x0a" * 16, rows_a, vdaf) if canonical else (b"\x0a" * 16, rows_a)
+            req_b = (b"\x0b" * 16, rows_b, vdaf) if canonical else (b"\x0b" * 16, rows_b)
+            before = {
+                k: dict(v) for k, v in ex.stats().items()
+            }
+            await asyncio.gather(
+                ex.submit(key, "prep_init", req_a, backend=backend, agg_id=0),
+                ex.submit(key, "prep_init", req_b, backend=backend, agg_id=0),
+            )
+            after = ex.stats()
+            coalesced = False
+            for label, s in after.items():
+                b = before.get(label, {"flushes": 0, "flushed_jobs": 0})
+                dflush = s["flushes"] - b["flushes"]
+                djobs = s["flushed_jobs"] - b["flushed_jobs"]
+                if djobs >= 2 and dflush == 1:
+                    coalesced = True
+            assert coalesced, (before, after)
+
+            # --- collect: exact sums, with the DP hook proven live
+            for t, ms in measurements.items():
+                draws.clear()
+                result = await harness.collect_task(t)
+                assert result.report_count == len(ms), (t, result)
+                expect = [sum(m[i] for m in ms) for i in range(2)]
+                assert result.aggregate_result == expect, (
+                    t,
+                    result.aggregate_result,
+                    expect,
+                )
+                # one draw per coordinate per share (leader + helper)
+                assert len(draws) >= 2 * len(expect), draws
+        finally:
+            await harness.stop()
+
+    try:
+        _run(flow(), timeout=900.0)
+    finally:
+        reset_global_executor()
