@@ -133,18 +133,26 @@ def suspect_task_ids(tx, job_type: str = "job") -> Optional[List[bytes]]:
     acquire query spares that churn while the dwell lasts.  PROBING peers
     are deliberately NOT filtered: a probing peer's job delivery IS the
     half-open probe that can heal the partition.  The no-partition common
-    case pays one in-memory check and touches nothing."""
+    case pays one in-memory check and touches nothing.
+
+    Fleet extension (ISSUE 16 satellite): suspects advertised by OTHER
+    fleet members' heartbeat rows are honored beside the in-memory
+    tracker, so a replica that never talked to a partitioned peer also
+    skips its tasks.  Empty set when fleet mode is off."""
     from ..core import peer_health
-    from ..core.peer_health import PEER_SUSPECT
+    from ..core.fleet import fleet_shared_suspects
+    from ..core.peer_health import PEER_SUSPECT, origin_of
 
     tracker = peer_health.tracker()
-    if not tracker.partition_signal(0.0):
+    shared = fleet_shared_suspects(tx)
+    if not shared and not tracker.partition_signal(0.0):
         return None
     ids = [
         task_id
         for task_id, url in tx.get_task_peer_index()
         # strictly SUSPECT (tracker.is_suspect would also match probing)
-        if url and tracker.state(url) == PEER_SUSPECT
+        if url
+        and (tracker.state(url) == PEER_SUSPECT or origin_of(url) in shared)
     ]
     if not ids:
         return None
@@ -155,6 +163,24 @@ def suspect_task_ids(tx, job_type: str = "job") -> Optional[List[bytes]]:
             job_type=job_type
         ).inc()
     return ids
+
+
+def acquisition_exclusions(tx, job_type: str = "job") -> Optional[List[bytes]]:
+    """The full acquisition filter both driver binaries thread into
+    ``acquire_incomplete_*_jobs(exclude_task_ids=...)``: suspect-peer
+    tasks (above) unioned with tasks the fleet router routes to another
+    replica.  Fleet off -> reduces to suspect_task_ids exactly."""
+    from ..core.fleet import fleet_router
+
+    ids = suspect_task_ids(tx, job_type) or []
+    router = fleet_router()
+    if router is not None:
+        seen = set(ids)
+        for task_id in router.not_owned_task_ids(tx) or []:
+            if task_id not in seen:
+                seen.add(task_id)
+                ids.append(task_id)
+    return ids or None
 
 
 def helper_request_deadline(lease, datastore):

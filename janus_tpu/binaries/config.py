@@ -89,6 +89,40 @@ class FaultInjectionConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet control plane (core/fleet.py).  DEFAULT OFF — when disabled
+    no router is installed, no member row is written, and the drivers'
+    acquisition filter is bit-for-bit the plain suspect filter.  Enabled,
+    each driver binary registers ``replica_id`` with a heartbeat row and
+    rendezvous-routes tasks across the live same-role members::
+
+        fleet:
+          enabled: true
+          replica_id: agg-east-1     # empty -> hostname-pid-nonce
+          heartbeat_interval_s: 2.0
+          heartbeat_ttl_s: 10.0      # member liveness horizon
+          takeover_grace_s: 5.0      # delay before acquiring absorbed tasks
+          suspect_staleness_s: 30.0  # shared-suspect advertisement horizon
+
+    TTL tuning: migration latency after a SIGKILL is bounded by
+    ``heartbeat_ttl_s + takeover_grace_s``; the TTL must comfortably
+    exceed ``heartbeat_interval_s`` (>= 3x) or routine scheduling jitter
+    reads as death and causes migration storms.
+    """
+
+    enabled: bool = False
+    #: Stable identity in the rendezvous domain.  Give restarts the SAME
+    #: id (deployment slot name) so a bounced replica re-owns its tasks —
+    #: and its warm compile cache — instead of reshuffling the fleet.
+    #: Empty = hostname-pid-nonce (unique per process start).
+    replica_id: str = ""
+    heartbeat_interval_s: float = 2.0
+    heartbeat_ttl_s: float = 10.0
+    takeover_grace_s: float = 5.0
+    suspect_staleness_s: float = 30.0
+
+
+@dataclass
 class CommonConfig:
     """reference: config.rs:31 CommonConfig"""
 
@@ -166,6 +200,9 @@ class CommonConfig:
     #: guard still applies (XLA:CPU AOT loads are poisoned; see
     #: enable_compile_cache).  Empty = no persistent cache.
     compile_cache_dir: str = ""
+    #: Fleet control plane (core/fleet.py): replica membership +
+    #: rendezvous task routing for the job drivers; fully off by default.
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 @dataclass
@@ -431,6 +468,7 @@ def _merge_dataclass(cls, data: dict):
             DeviceExecutorConfig,
             AccumulatorStoreConfig,
             FaultInjectionConfig,
+            FleetConfig,
         )
     }
     kwargs = {}

@@ -214,6 +214,48 @@ async def _serve_health(listen_address: str, datastore: Optional[Datastore] = No
     return runner
 
 
+def _start_fleet_heartbeat(stop: asyncio.Event, datastore: Datastore, common):
+    """Fleet heartbeat loop (core/fleet.py): refreshes this replica's
+    member row on the configured cadence, republishing the peer-health
+    tracker's current SUSPECT origins as the fleet-shared suspect set,
+    and deregisters gracefully on shutdown so survivors re-route without
+    waiting out the TTL.  Returns the task (or None when fleet is off)."""
+    from ..core.fleet import fleet_router
+
+    router = fleet_router()
+    if router is None:
+        return None
+    interval = max(0.1, float(getattr(common.fleet, "heartbeat_interval_s", 2.0)))
+
+    async def loop_():
+        from ..core import peer_health
+
+        while not stop.is_set():
+            try:
+                suspects = [
+                    origin
+                    for origin, s in peer_health.tracker().stats().items()
+                    if s.get("state") == "suspect"
+                ]
+                await datastore.run_tx_async(
+                    "fleet_heartbeat",
+                    lambda tx: router.heartbeat(tx, suspects),
+                )
+            except Exception:
+                # a missed beat only ages our row; the TTL absorbs it
+                logger.exception("fleet heartbeat failed")
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+        try:
+            await datastore.run_tx_async("fleet_deregister", router.deregister)
+        except Exception:
+            logger.exception("fleet deregistration failed (TTL will expire us)")
+
+    return asyncio.ensure_future(loop_())
+
+
 def _start_status_sampler(stop: asyncio.Event, datastore: Datastore, common):
     """The small sampler loop every binary runs beside its main loop
     (ISSUE 5): publishes acquirable-backlog and journal-freshness gauges
@@ -489,6 +531,29 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
         suspect_dwell_s=cfg.job_driver.peer_suspect_dwell_s,
     )
 
+    # Fleet control plane (core/fleet.py): register this replica in the
+    # per-role rendezvous domain BEFORE anything computes ownership — the
+    # warmup walk below must already see this member, or it would warm
+    # zero tasks (2-member view without self) on a cold fleet.
+    if cfg.common.fleet.enabled:
+        from ..core.fleet import configure_fleet, default_replica_id
+
+        fc = cfg.common.fleet
+        router = configure_fleet(
+            fc.replica_id or default_replica_id(),
+            kind,
+            heartbeat_ttl_s=fc.heartbeat_ttl_s,
+            takeover_grace_s=fc.takeover_grace_s,
+            suspect_staleness_s=fc.suspect_staleness_s,
+        )
+        datastore.run_tx("fleet_register", router.heartbeat)
+        logger.info(
+            "fleet member %s registered (role=%s, ttl=%.1fs)",
+            router.replica_id,
+            kind,
+            fc.heartbeat_ttl_s,
+        )
+
     import aiohttp
 
     from ..aggregator import (
@@ -536,10 +601,19 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             import threading
 
             def _registry_warmup(driver=stepper_impl):
+                from ..core.fleet import fleet_router
+
+                def _owned_tasks(tx):
+                    tasks = tx.get_aggregator_tasks()
+                    r = fleet_router()
+                    # cache affinity: only warm OWNED tasks' shapes, so
+                    # each replica's compile_stats stays scoped to its
+                    # share of the fleet (migrated-in tasks warm lazily
+                    # through the submit path's oracle fallback)
+                    return tasks if r is None else r.filter_owned(tx, tasks)
+
                 try:
-                    tasks = datastore.run_tx(
-                        "warmup_tasks", lambda tx: tx.get_aggregator_tasks()
-                    )
+                    tasks = datastore.run_tx("warmup_tasks", _owned_tasks)
                 except Exception:
                     logger.exception(
                         "warmup task-registry walk failed (serving cold)"
@@ -573,16 +647,17 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             ).start()
 
         async def acquirer(duration, limit):
-            from ..aggregator.job_driver import suspect_task_ids
+            from ..aggregator.job_driver import acquisition_exclusions
 
             return await datastore.run_tx_async(
                 "acquire_agg",
-                # suspect-peer tasks filter at the query (task -> peer
-                # index, same tx) instead of acquire-then-release churn
+                # suspect-peer and fleet-routed tasks filter at the query
+                # (task -> peer index, same tx) instead of
+                # acquire-then-release churn
                 lambda tx: tx.acquire_incomplete_aggregation_jobs(
                     duration,
                     limit,
-                    exclude_task_ids=suspect_task_ids(tx, "aggregation"),
+                    exclude_task_ids=acquisition_exclusions(tx, "aggregation"),
                 ),
             )
 
@@ -618,14 +693,14 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
         )
 
         async def acquirer(duration, limit):
-            from ..aggregator.job_driver import suspect_task_ids
+            from ..aggregator.job_driver import acquisition_exclusions
 
             return await datastore.run_tx_async(
                 "acquire_coll",
                 lambda tx: tx.acquire_incomplete_collection_jobs(
                     duration,
                     limit,
-                    exclude_task_ids=suspect_task_ids(tx, "collection"),
+                    exclude_task_ids=acquisition_exclusions(tx, "collection"),
                 ),
             )
 
@@ -660,6 +735,7 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             cfg.common.health_check_listen_address, datastore=datastore
         )
         sampler = _start_status_sampler(stop, datastore, cfg.common)
+        heartbeat = _start_fleet_heartbeat(stop, datastore, cfg.common)
         maintenance = (
             _start_accumulator_maintenance(stop, stepper_impl, cfg)
             if kind == "aggregation"
@@ -677,6 +753,8 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             await stepper_impl.shutdown()
         else:
             await stepper_impl.close()
+        if heartbeat is not None:
+            await asyncio.gather(heartbeat, return_exceptions=True)
         if sampler is not None:
             await asyncio.gather(sampler, return_exceptions=True)
         await health.cleanup()

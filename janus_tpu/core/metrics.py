@@ -565,6 +565,28 @@ class Metrics:
             ["point", "mode"],
             registry=self.registry,
         )
+        # Fleet control plane (core/fleet.py): membership and routing as
+        # seen by THIS replica's router — members it counts live in its
+        # own role's rendezvous domain, tasks it currently owns, and how
+        # many tasks it has absorbed from dead peers.  A fleet-wide burst
+        # of migrations (every replica's counter moving at once) is the
+        # migration-storm signature; see README "Fleet routing".
+        self.fleet_members = Gauge(
+            "janus_fleet_members",
+            "Live same-role fleet members in this replica's membership view",
+            registry=self.registry,
+        )
+        self.fleet_tasks_owned = Gauge(
+            "janus_fleet_tasks_owned",
+            "Tasks the rendezvous router currently assigns to this replica",
+            registry=self.registry,
+        )
+        self.fleet_migrations = Counter(
+            "janus_fleet_migrations_total",
+            "Tasks this replica took over from a member whose heartbeat "
+            "expired (live task migration events)",
+            registry=self.registry,
+        )
 
         # -- pipeline freshness / SLO metrics (ISSUE 5 tentpole) ---------
         # The operator question that defines a DAP deployment's SLO: how

@@ -48,6 +48,10 @@ def runtime_status() -> dict:
         # state + failure counts — the first thing to check when a soak
         # quiesces (partition pressure vs a bug)
         "peers": _peer_stats(),
+        # Fleet control plane (ISSUE 16): this replica's membership view,
+        # owned-task count, and migration total — disabled marker when no
+        # router is installed
+        "fleet": _fleet_stats(),
         # Upload front door (ISSUE 14): batched-open queue depth, shed
         # counts per reason, and batch/open totals — the overload story
         # at a glance (None on binaries that serve no uploads)
@@ -97,6 +101,21 @@ def _peer_stats() -> dict:
         return tracker().stats()
     except Exception:
         logger.exception("peer-health stats unavailable")
+        return {"error": "unavailable"}
+
+
+def _fleet_stats() -> dict:
+    """Fleet router view (core/fleet.py); failure-tolerant like every
+    other section."""
+    try:
+        from .fleet import fleet_router
+
+        router = fleet_router()
+        if router is None:
+            return {"enabled": False}
+        return router.stats()
+    except Exception:
+        logger.exception("fleet stats unavailable")
         return {"error": "unavailable"}
 
 

@@ -25,6 +25,7 @@ from .models import (
     BatchAggregationState,
     CollectionJob,
     CollectionJobState,
+    FleetMember,
     GlobalHpkeKeypair,
     HpkeKeyState,
     LeaderStoredReport,

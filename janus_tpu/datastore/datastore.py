@@ -73,6 +73,7 @@ from .models import (
     BatchAggregationState,
     CollectionJob,
     CollectionJobState,
+    FleetMember,
     GlobalHpkeKeypair,
     HpkeKeyState,
     Lease,
@@ -2117,6 +2118,100 @@ class Transaction:
                 "acquirable": int(acquirable or 0),
             }
         return out
+
+    # ------------------------------------------------------------------
+    # fleet control plane membership (core/fleet.py; schema.py
+    # _FLEET_MEMBERS_SCHEMA).  One row per registered driver replica;
+    # the heartbeat write doubles as the suspect-set advertisement.
+
+    def upsert_fleet_member(
+        self,
+        replica_id: str,
+        role: str,
+        suspect_peers: Sequence[str] = (),
+    ) -> None:
+        """Register ``replica_id`` or refresh its heartbeat to tx-now.
+
+        ``started_at`` is preserved across refreshes (it is only set on
+        first insert); ``suspect_peers``/``suspect_updated_at`` are
+        rewritten on every heartbeat so a healed peer un-publishes by
+        simply advertising an empty set."""
+        now = self._now_s()
+        encoded = json.dumps(sorted(set(suspect_peers)))
+        cur = self.conn.execute(
+            "UPDATE fleet_members SET role = ?, heartbeat = ?,"
+            " suspect_peers = ?, suspect_updated_at = ?"
+            " WHERE replica_id = ?",
+            (role, now, encoded, now, replica_id),
+        )
+        if cur.rowcount == 0:
+            try:
+                self.conn.execute(
+                    "INSERT INTO fleet_members (replica_id, role, heartbeat,"
+                    " started_at, suspect_peers, suspect_updated_at)"
+                    " VALUES (?, ?, ?, ?, ?, ?)",
+                    (replica_id, role, now, now, encoded, now),
+                )
+            except self.ds.backend.integrity_errors as e:
+                # Two handles racing the same replica_id's first heartbeat;
+                # the retry loop's next attempt takes the UPDATE path.
+                raise TxConflict(f"fleet member insert race: {e}") from e
+
+    def get_fleet_members(self, role: Optional[str] = None) -> List[FleetMember]:
+        """Every registered member (optionally one role), stale included —
+        liveness is the *caller's* TTL judgment, so routers and /statusz
+        can both see dead rows (the latter reports them as such)."""
+        if role is None:
+            rows = self.conn.execute(
+                "SELECT replica_id, role, heartbeat, started_at,"
+                " suspect_peers, suspect_updated_at FROM fleet_members"
+                " ORDER BY replica_id"
+            ).fetchall()
+        else:
+            rows = self.conn.execute(
+                "SELECT replica_id, role, heartbeat, started_at,"
+                " suspect_peers, suspect_updated_at FROM fleet_members"
+                " WHERE role = ? ORDER BY replica_id",
+                (role,),
+            ).fetchall()
+        out = []
+        for rid, mrole, hb, started, suspects, sus_at in rows:
+            try:
+                peers = tuple(json.loads(suspects)) if suspects else ()
+            except ValueError:
+                peers = ()
+            out.append(
+                FleetMember(
+                    replica_id=rid,
+                    role=mrole,
+                    heartbeat=Time(int(hb)),
+                    started_at=Time(int(started)),
+                    suspect_peers=peers,
+                    suspect_updated_at=(
+                        Time(int(sus_at)) if sus_at is not None else None
+                    ),
+                )
+            )
+        return out
+
+    def delete_fleet_member(self, replica_id: str) -> bool:
+        """Graceful deregistration (clean shutdown): the member drops out
+        of the rendezvous domain immediately instead of after the TTL."""
+        cur = self.conn.execute(
+            "DELETE FROM fleet_members WHERE replica_id = ?", (replica_id,)
+        )
+        return cur.rowcount > 0
+
+    def prune_fleet_members(self, older_than: Duration) -> int:
+        """Delete rows whose heartbeat is older than ``older_than`` — dead
+        replicas that never deregistered.  Routers treat stale rows as
+        non-live regardless, so pruning is pure hygiene and any live
+        replica may do it opportunistically."""
+        cutoff = self._now_s() - older_than.seconds
+        cur = self.conn.execute(
+            "DELETE FROM fleet_members WHERE heartbeat < ?", (cutoff,)
+        )
+        return cur.rowcount
 
     # ------------------------------------------------------------------
     # accumulator journal (deferred device-resident drains; see

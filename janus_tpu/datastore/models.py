@@ -428,3 +428,30 @@ class AccumulatorJournalEntry:
     aggregation_job_id: AggregationJobId
     report_ids: Tuple[bytes, ...]
     created_at: Time
+
+
+# --------------------------------------------------------------------------
+# Fleet control plane membership (core/fleet.py)
+
+
+@dataclass(frozen=True)
+class FleetMember:
+    """One registered driver replica's membership row (fleet_members).
+
+    A member is *live* iff ``now - heartbeat <= heartbeat_ttl``; the live
+    set of a role is the rendezvous-hash domain routing task_id -> replica
+    for that job type.  ``suspect_peers`` is the fleet-shared suspect set:
+    the peer origins this replica's in-memory health tracker currently
+    holds SUSPECT, JSON-encoded, refreshed (or emptied on heal) with every
+    heartbeat; ``suspect_updated_at`` bounds how stale a consumer will
+    honor that advertisement."""
+
+    replica_id: str
+    role: str
+    heartbeat: Time
+    started_at: Time
+    suspect_peers: Tuple[str, ...] = ()
+    suspect_updated_at: Optional[Time] = None
+
+    def heartbeat_age(self, now: Time) -> int:
+        return max(0, now.seconds - self.heartbeat.seconds)

@@ -282,6 +282,33 @@ _UPLOAD_TRACE_SCHEMA = """
 ALTER TABLE client_reports ADD COLUMN trace_id TEXT;
 """
 
+_FLEET_MEMBERS_SCHEMA = """
+-- Fleet control plane membership (core/fleet.py, ISSUE 16): one row per
+-- registered driver replica, heartbeat-refreshed on the replica's
+-- heartbeat cadence.  The LIVE member set (heartbeat within the TTL) is
+-- the rendezvous-hash domain for task -> replica routing; a member whose
+-- heartbeat goes stale simply drops out of the set, which re-routes its
+-- tasks to the survivors (migration) with no coordination beyond this
+-- table.  ``role`` scopes membership per job type (aggregation vs
+-- collection drivers are separate rendezvous domains — a collection
+-- replica must never absorb ownership of aggregation acquisition).
+-- ``suspect_peers`` is the fleet-shared suspect set: a JSON array of
+-- peer origins this replica's in-memory tracker currently holds SUSPECT,
+-- republished (or emptied, on heal) with every heartbeat so replicas
+-- that never talked to a partitioned peer skip its tasks too;
+-- ``suspect_updated_at`` bounds its staleness on the consumer side.
+CREATE TABLE IF NOT EXISTS fleet_members (
+    replica_id TEXT PRIMARY KEY,
+    role TEXT NOT NULL,
+    heartbeat INTEGER NOT NULL,
+    started_at INTEGER NOT NULL,
+    suspect_peers TEXT,
+    suspect_updated_at INTEGER
+);
+CREATE INDEX IF NOT EXISTS fleet_members_by_role
+    ON fleet_members(role, heartbeat);
+"""
+
 #: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
 #: edit an entry that has shipped (existing stores have already applied it).
 MIGRATIONS = [
@@ -289,6 +316,7 @@ MIGRATIONS = [
     _ACCUMULATOR_JOURNAL_SCHEMA,
     _TRACE_CONTEXT_SCHEMA,
     _UPLOAD_TRACE_SCHEMA,
+    _FLEET_MEMBERS_SCHEMA,
 ]
 
 SCHEMA_VERSION = len(MIGRATIONS)

@@ -817,6 +817,17 @@ common:
   otlp_endpoint: http://127.0.0.1:1
   slos:
     job_age_at_acquire: {{objective: 0.9, threshold_s: 1800}}
+  # fleet mode ON in the crash soak (ISSUE 16 acceptance): stable
+  # per-slot replica ids so a SIGKILL/restart re-owns its tasks (and its
+  # warm caches) instead of reshuffling; a short TTL so the kill windows
+  # exercise real migrations; routing must never cost exactly-once or
+  # convergence
+  fleet:
+    enabled: true
+    replica_id: crash-r{i}
+    heartbeat_interval_s: 0.5
+    heartbeat_ttl_s: 3.0
+    takeover_grace_s: 0.5
 job_driver:
   job_discovery_interval_s: 0.2
   max_concurrent_job_workers: 4

@@ -1,0 +1,918 @@
+"""Fleet control plane suite (ISSUE 16 tentpole).
+
+Layers, smallest to largest:
+
+* Rendezvous-hash units: determinism, order independence, balance, and
+  the minimal-reshuffle property that justifies rendezvous over a ring.
+* ``fleet_members`` row plumbing: registration/refresh preserving
+  ``started_at``, suspect-set JSON round-trip (publish and un-publish),
+  role filtering, delete, prune.
+* Ownership routing: two in-process ``FleetRouter``s partition the task
+  set disjointly and exhaustively, acquisition filtered through
+  ``not_owned_task_ids`` leases every job exactly once to its owner,
+  a stale owner's tasks MIGRATE to the survivor behind the takeover
+  grace window, and a disabled router filters nothing (the
+  ``fleet.enabled: false`` bit-for-bit parity claim).
+* Fleet-shared suspects (satellite): a SUSPECT advertisement published
+  on one member's heartbeat row is honored by the other replica's
+  ``suspect_task_ids``, bounded by advertisement staleness, and
+  un-published when the advertiser heals.
+* Two real ``JobDriver`` instances with fleet-filtered acquirers in one
+  process: every job steps exactly once, ON its rendezvous owner.
+* ``test_binary_fleet_sigkill_migration_exactly_once`` (slow) — THE
+  ACCEPTANCE CASE: two ``aggregation_job_driver`` BINARIES with
+  ``fleet.enabled`` share one datastore; /statusz shows disjoint
+  ownership (``tasks_owned == 1`` each) and per-replica compile
+  isolation (each warms ONLY its owned task's circuit); one replica is
+  SIGKILLed and its task migrates to the survivor within the heartbeat
+  TTL (+grace), every job finishes on the survivor, and collection is
+  exactly-once with exact Prio3 sums; graceful SIGTERM deregisters the
+  survivor's member row while the SIGKILLed row stays as prunable debris.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_datastore import make_task, put_job  # noqa: E402
+
+from janus_tpu.core.fleet import (
+    FleetRouter,
+    configure_fleet,
+    fleet_router,
+    fleet_shared_suspects,
+    rendezvous_owner,
+    reset_fleet,
+)
+from janus_tpu.core.peer_health import origin_of, reset_peer_health
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import AggregationJobState
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Time
+
+NOW = Time(1_600_000_000)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    reset_fleet()
+    reset_peer_health()
+    yield
+    reset_fleet()
+    reset_peer_health()
+
+
+@pytest.fixture()
+def eds():
+    e = EphemeralDatastore(MockClock(NOW))
+    yield e
+    e.cleanup()
+
+
+def _put_tasks(ds, n):
+    tasks = [make_task() for _ in range(n)]
+    for t in tasks:
+        ds.run_tx("put", lambda tx, t=t: tx.put_aggregator_task(t))
+    return tasks
+
+
+# ---------------------------------------------------------------------------
+# rendezvous units
+
+
+class TestRendezvous:
+    def test_deterministic_and_order_independent(self):
+        members = ["r0", "r1", "r2"]
+        for tid in (b"a" * 32, b"b" * 32, bytes(range(32))):
+            owner = rendezvous_owner(tid, members)
+            assert owner in members
+            assert rendezvous_owner(tid, list(reversed(members))) == owner
+            assert rendezvous_owner(tid, members[1:] + members[:1]) == owner
+
+    def test_degenerate_member_sets(self):
+        assert rendezvous_owner(b"x" * 32, []) is None
+        assert rendezvous_owner(b"x" * 32, ["only"]) == "only"
+
+    def test_balance(self):
+        """No member may be starved: over many uniform task ids each of 3
+        members owns a healthy share (expected ~1/3; assert >= 1/5)."""
+        import hashlib
+
+        members = ["r0", "r1", "r2"]
+        counts = {m: 0 for m in members}
+        for i in range(1500):
+            tid = hashlib.sha256(b"task-%d" % i).digest()
+            counts[rendezvous_owner(tid, members)] += 1
+        assert all(c >= 300 for c in counts.values()), counts
+
+    def test_minimal_reshuffle_on_member_loss(self):
+        """The rendezvous property the router leans on: removing a member
+        moves ONLY that member's tasks — every surviving assignment is
+        untouched (a ring would reshuffle neighbors too)."""
+        import hashlib
+
+        members = ["r0", "r1", "r2"]
+        tids = [hashlib.sha256(b"t-%d" % i).digest() for i in range(400)]
+        before = {tid: rendezvous_owner(tid, members) for tid in tids}
+        after = {tid: rendezvous_owner(tid, ["r0", "r1"]) for tid in tids}
+        for tid in tids:
+            if before[tid] != "r2":
+                assert after[tid] == before[tid], "a surviving assignment moved"
+            else:
+                assert after[tid] in ("r0", "r1")
+
+
+# ---------------------------------------------------------------------------
+# fleet_members rows
+
+
+class TestMemberRows:
+    def test_upsert_registers_then_refreshes_preserving_started_at(self, eds):
+        ds, clock = eds.datastore, eds.datastore.clock
+        ds.run_tx("reg", lambda tx: tx.upsert_fleet_member("r0", "aggregation"))
+        (m0,) = ds.run_tx("get", lambda tx: tx.get_fleet_members())
+        assert m0.replica_id == "r0" and m0.role == "aggregation"
+        assert m0.started_at.seconds == m0.heartbeat.seconds == NOW.seconds
+
+        clock.advance(Duration(7))
+        ds.run_tx("hb", lambda tx: tx.upsert_fleet_member("r0", "aggregation"))
+        (m1,) = ds.run_tx("get", lambda tx: tx.get_fleet_members())
+        assert m1.heartbeat.seconds == NOW.seconds + 7
+        assert m1.started_at.seconds == NOW.seconds, "refresh must keep started_at"
+        assert m1.heartbeat_age(clock.now()) == 0
+
+    def test_suspect_peers_roundtrip_and_unpublish(self, eds):
+        ds = eds.datastore
+        ds.run_tx(
+            "pub",
+            lambda tx: tx.upsert_fleet_member(
+                "r0", "aggregation", ["peer-b:80", "peer-a:80", "peer-b:80"]
+            ),
+        )
+        (m,) = ds.run_tx("get", lambda tx: tx.get_fleet_members())
+        assert m.suspect_peers == ("peer-a:80", "peer-b:80")  # sorted, deduped
+        assert m.suspect_updated_at is not None
+
+        # healed: publishing the empty set un-pins
+        ds.run_tx("heal", lambda tx: tx.upsert_fleet_member("r0", "aggregation", []))
+        (m,) = ds.run_tx("get", lambda tx: tx.get_fleet_members())
+        assert m.suspect_peers == ()
+
+    def test_role_filter_delete_and_prune(self, eds):
+        ds, clock = eds.datastore, eds.datastore.clock
+        ds.run_tx("a", lambda tx: tx.upsert_fleet_member("agg-0", "aggregation"))
+        ds.run_tx("c", lambda tx: tx.upsert_fleet_member("coll-0", "collection"))
+        aggs = ds.run_tx("get", lambda tx: tx.get_fleet_members("aggregation"))
+        assert [m.replica_id for m in aggs] == ["agg-0"]
+        assert len(ds.run_tx("all", lambda tx: tx.get_fleet_members())) == 2
+
+        assert ds.run_tx("del", lambda tx: tx.delete_fleet_member("coll-0"))
+        assert not ds.run_tx("del2", lambda tx: tx.delete_fleet_member("coll-0"))
+
+        clock.advance(Duration(500))
+        ds.run_tx("fresh", lambda tx: tx.upsert_fleet_member("agg-1", "aggregation"))
+        # agg-0's heartbeat is 500s old: pruned; agg-1 survives
+        assert ds.run_tx(
+            "prune", lambda tx: tx.prune_fleet_members(Duration(100))
+        ) == 1
+        left = ds.run_tx("get", lambda tx: tx.get_fleet_members())
+        assert [m.replica_id for m in left] == ["agg-1"]
+
+
+# ---------------------------------------------------------------------------
+# ownership routing + acquisition
+
+
+class TestOwnershipRouting:
+    def _routers(self, n=2, **kw):
+        return [FleetRouter(f"ipr-{i}", "aggregation", **kw) for i in range(n)]
+
+    def test_two_routers_partition_tasks_disjoint_and_exhaustive(self, eds):
+        ds = eds.datastore
+        tasks = _put_tasks(ds, 8)
+        r0, r1 = self._routers()
+        ds.run_tx("hb0", r0.heartbeat)
+        ds.run_tx("hb1", r1.heartbeat)
+
+        def views(tx):
+            return (
+                set(r0.not_owned_task_ids(tx) or []),
+                set(r1.not_owned_task_ids(tx) or []),
+                [(t, r0.owns(tx, t.task_id.data), r1.owns(tx, t.task_id.data)) for t in tasks],
+                r0.filter_owned(tx, tasks),
+                r1.filter_owned(tx, tasks),
+            )
+
+        ex0, ex1, owns, own0, own1 = ds.run_tx("views", views)
+        all_ids = {t.task_id.data for t in tasks}
+        # every task excluded by exactly one of the two replicas
+        assert ex0 | ex1 == all_ids and ex0 & ex1 == set()
+        for t, o0, o1 in owns:
+            assert o0 != o1
+            assert o0 == (t.task_id.data not in ex0)
+        # warmup filter partitions the registry the same way
+        assert {t.task_id.data for t in own0} == all_ids - ex0
+        assert {t.task_id.data for t in own1} == all_ids - ex1
+        assert r0.stats()["tasks_owned"] + r1.stats()["tasks_owned"] == len(tasks)
+
+    def test_acquisition_filtered_to_owner_exactly_once(self, eds):
+        ds = eds.datastore
+        tasks = _put_tasks(ds, 6)
+        jobs = {t.task_id.data: put_job(ds, t) for t in tasks}
+        r0, r1 = self._routers()
+        ds.run_tx("hb0", r0.heartbeat)
+        ds.run_tx("hb1", r1.heartbeat)
+
+        def acquire(tx, router):
+            return tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 10, exclude_task_ids=router.not_owned_task_ids(tx)
+            )
+
+        leases0 = ds.run_tx("acq0", lambda tx: acquire(tx, r0))
+        leases1 = ds.run_tx("acq1", lambda tx: acquire(tx, r1))
+        got0 = {bytes(l.leased.task_id.data) for l in leases0}
+        got1 = {bytes(l.leased.task_id.data) for l in leases1}
+        assert got0 & got1 == set(), "a job leased by a non-owner"
+        assert got0 | got1 == set(jobs), "a job no replica could acquire"
+        # and the second poll finds nothing left
+        assert ds.run_tx("acq0b", lambda tx: acquire(tx, r0)) == []
+        assert ds.run_tx("acq1b", lambda tx: acquire(tx, r1)) == []
+
+    def test_migration_behind_takeover_grace(self, eds):
+        ds, clock = eds.datastore, eds.datastore.clock
+        tasks = _put_tasks(ds, 8)
+        r0, r1 = self._routers(heartbeat_ttl_s=10.0, takeover_grace_s=5.0)
+        ds.run_tx("hb0", r0.heartbeat)
+        ds.run_tx("hb1", r1.heartbeat)
+        ex1 = set(ds.run_tx("v", lambda tx: r1.not_owned_task_ids(tx) or []))
+        r0_tasks = ex1  # what r1 must absorb when r0 dies
+        assert r0_tasks and r1.stats()["migrations_total"] == 0
+
+        # r0 stops heartbeating; r1 keeps going past the TTL
+        clock.advance(Duration(11))
+        ds.run_tx("hb1b", r1.heartbeat)
+        ex_graced = set(ds.run_tx("v2", lambda tx: r1.not_owned_task_ids(tx) or []))
+        # migration DETECTED (counter moves) but the grace window still
+        # excludes the absorbed tasks from this acquisition round
+        assert r1.stats()["migrations_total"] == len(r0_tasks)
+        assert ex_graced == r0_tasks
+
+        clock.advance(Duration(6))  # past takeover_grace_s
+        assert ds.run_tx("v3", lambda tx: r1.not_owned_task_ids(tx)) is None
+        assert r1.stats()["tasks_owned"] == len(tasks)
+        # no double counting on later polls
+        assert r1.stats()["migrations_total"] == len(r0_tasks)
+
+    def test_deregister_reroutes_without_waiting_for_ttl(self, eds):
+        ds = eds.datastore
+        _put_tasks(ds, 5)
+        r0, r1 = self._routers(takeover_grace_s=0.0)
+        ds.run_tx("hb0", r0.heartbeat)
+        ds.run_tx("hb1", r1.heartbeat)
+        ds.run_tx("v", r1.not_owned_task_ids)
+        ds.run_tx("bye", r0.deregister)
+        # immediately (no clock advance): r0's row is gone, r1 owns all
+        assert ds.run_tx("v2", r1.not_owned_task_ids) is None
+        assert r1.stats()["tasks_owned"] == 5
+
+    def test_self_always_live_despite_stale_own_heartbeat(self, eds):
+        ds, clock = eds.datastore, eds.datastore.clock
+        _put_tasks(ds, 3)
+        (r0,) = self._routers(1)
+        ds.run_tx("hb", r0.heartbeat)
+        clock.advance(Duration(3600))  # own row long stale, never refreshed
+        # a wedged local heartbeat must degrade toward too-much-work,
+        # never self-eviction: alone in the fleet, r0 still owns everything
+        assert ds.run_tx("v", r0.not_owned_task_ids) is None
+        assert r0.stats()["tasks_owned"] == 3
+
+    def test_disabled_router_is_bit_for_bit_no_filter(self, eds):
+        ds = eds.datastore
+        tasks = _put_tasks(ds, 4)
+        r = FleetRouter("off-0", "aggregation", enabled=False)
+        ds.run_tx("hb", r.heartbeat)  # must write nothing
+        assert ds.run_tx("rows", lambda tx: tx.get_fleet_members()) == []
+        assert ds.run_tx("v", r.not_owned_task_ids) is None
+        assert ds.run_tx("own", lambda tx: r.owns(tx, tasks[0].task_id.data))
+        assert ds.run_tx("f", lambda tx: r.filter_owned(tx, tasks)) == tasks
+        assert ds.run_tx("s", r.shared_suspects) == set()
+
+
+# ---------------------------------------------------------------------------
+# fleet-shared suspect set (satellite)
+
+
+class TestSharedSuspects:
+    def test_shared_from_other_members_only_and_unpublish(self, eds):
+        ds = eds.datastore
+        me = FleetRouter("me", "aggregation")
+        other = FleetRouter("other", "collection")  # suspects cross roles
+        ds.run_tx("hb_me", me.heartbeat)
+        ds.run_tx("hb_o", lambda tx: other.heartbeat(tx, ["peer-x:80"]))
+        assert ds.run_tx("s", me.shared_suspects) == {"peer-x:80"}
+        # an advertisement is never reflected back at its publisher
+        assert ds.run_tx("s_o", other.shared_suspects) == set()
+        # heal: the advertiser republishes the empty set
+        ds.run_tx("heal", other.heartbeat)
+        assert ds.run_tx("s2", me.shared_suspects) == set()
+
+    def test_dead_advertiser_and_stale_advertisement_ignored(self, eds):
+        ds, clock = eds.datastore, eds.datastore.clock
+        other = FleetRouter("other", "aggregation", heartbeat_ttl_s=10.0)
+        ds.run_tx("hb_o", lambda tx: other.heartbeat(tx, ["peer-x:80"]))
+
+        # consumer with a staleness bound TIGHTER than its liveness ttl:
+        # the advertiser's row is still "live" but its advertisement has
+        # aged out — a dead-ish advertiser must not suspect-pin a healthy
+        # peer beyond the bound
+        me = FleetRouter(
+            "me", "aggregation", heartbeat_ttl_s=100.0, suspect_staleness_s=5.0
+        )
+        ds.run_tx("hb_me", me.heartbeat)
+        assert ds.run_tx("s0", me.shared_suspects) == {"peer-x:80"}
+        clock.advance(Duration(8))
+        assert ds.run_tx("s1", me.shared_suspects) == set(), "stale advert honored"
+
+        # and a dead advertiser (heartbeat past the ttl) is ignored even
+        # with a generous staleness bound
+        me2 = FleetRouter(
+            "me2", "aggregation", heartbeat_ttl_s=3.0, suspect_staleness_s=3600.0
+        )
+        assert ds.run_tx("s2", me2.shared_suspects) == set()
+
+    def test_suspect_task_ids_honors_fleet_advertisements(self, eds):
+        """The consumption seam: a peer advertised SUSPECT by ANOTHER
+        member excludes that peer's tasks from this replica's acquisition
+        even though the local tracker never saw a failure."""
+        from janus_tpu.aggregator.job_driver import (
+            acquisition_exclusions,
+            suspect_task_ids,
+        )
+
+        ds = eds.datastore
+        tasks = _put_tasks(ds, 3)
+        peer_origin = origin_of(tasks[0].peer_aggregator_endpoint)
+
+        # fleet off: no shared set, no local suspects -> no filter at all
+        assert ds.run_tx("none", lambda tx: suspect_task_ids(tx)) is None
+        assert ds.run_tx("none2", lambda tx: acquisition_exclusions(tx)) is None
+
+        me = configure_fleet("me", "aggregation")
+        other = FleetRouter("other", "aggregation")
+        ds.run_tx("hb_me", me.heartbeat)
+        ds.run_tx("hb_o", lambda tx: other.heartbeat(tx, [peer_origin]))
+        assert ds.run_tx("fss", fleet_shared_suspects) == {peer_origin}
+        # every task points at the same peer endpoint (make_task default),
+        # so the advertisement excludes them all
+        sus = ds.run_tx("sus", lambda tx: suspect_task_ids(tx))
+        assert set(sus) == {t.task_id.data for t in tasks}
+        # acquisition_exclusions unions the same ids (owned or not, a
+        # suspect peer's task never acquires here)
+        excl = ds.run_tx("excl", lambda tx: acquisition_exclusions(tx))
+        assert set(excl) >= {t.task_id.data for t in tasks}
+
+    def test_statusz_fleet_section(self, eds):
+        from janus_tpu.core.statusz import runtime_status
+
+        assert runtime_status()["fleet"] == {"enabled": False}
+        me = configure_fleet("statusz-me", "aggregation")
+        eds.datastore.run_tx("hb", me.heartbeat)
+        doc = runtime_status()["fleet"]
+        assert doc["enabled"] is True
+        assert doc["replica_id"] == "statusz-me"
+        assert doc["role"] == "aggregation"
+        assert [m["replica_id"] for m in doc["members"]] == ["statusz-me"]
+        assert doc["members"][0]["live"] is True
+        assert fleet_router() is me
+
+
+# ---------------------------------------------------------------------------
+# two real JobDrivers, one process, fleet-routed acquisition
+
+
+class TestInProcessTwoReplicaDrivers:
+    def test_jobs_step_exactly_once_on_their_owner(self, eds):
+        from janus_tpu.aggregator.job_driver import JobDriver
+
+        ds = eds.datastore
+        tasks = _put_tasks(ds, 6)
+        for t in tasks:
+            put_job(ds, t)
+        routers = {n: FleetRouter(n, "aggregation") for n in ("drv-a", "drv-b")}
+        stepped = {n: [] for n in routers}
+        # register BOTH members before any driver polls: without this the
+        # first poller's live set is just itself and it (safely, but
+        # nondeterministically) absorbs the other's tasks for one round
+        for r in routers.values():
+            ds.run_tx("prereg", r.heartbeat)
+
+        def make_acquirer(router):
+            async def acquire(duration, limit):
+                def q(tx):
+                    router.heartbeat(tx)
+                    return tx.acquire_incomplete_aggregation_jobs(
+                        duration, limit,
+                        exclude_task_ids=router.not_owned_task_ids(tx),
+                    )
+
+                return await ds.run_tx_async("acquire", q)
+
+            return acquire
+
+        def make_stepper(name):
+            async def step(lease):
+                def fin(tx):
+                    job = tx.get_aggregation_job(
+                        lease.leased.task_id, lease.leased.aggregation_job_id
+                    )
+                    tx.update_aggregation_job(
+                        job.with_state(AggregationJobState.FINISHED)
+                    )
+                    tx.release_aggregation_job(lease)
+
+                await ds.run_tx_async("fin", fin)
+                stepped[name].append(bytes(lease.leased.task_id.data))
+
+            return step
+
+        drivers = [
+            JobDriver(
+                ds.clock,
+                make_acquirer(routers[n]),
+                make_stepper(n),
+                job_discovery_interval=0.02,
+                job_type="aggregation",
+            )
+            for n in routers
+        ]
+
+        def unfinished(tx):
+            return sum(
+                1
+                for t in tasks
+                for j in tx.get_aggregation_jobs_for_task(t.task_id)
+                if j.state == AggregationJobState.IN_PROGRESS
+            )
+
+        async def flow():
+            stop = asyncio.Event()
+            runs = [asyncio.ensure_future(d.run(stop)) for d in drivers]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if await ds.run_tx_async("cnt", unfinished) == 0:
+                    break
+                await asyncio.sleep(0.02)
+            stop.set()
+            await asyncio.gather(*runs)
+            return await ds.run_tx_async("cnt", unfinished)
+
+        loop = asyncio.new_event_loop()
+        try:
+            remaining = loop.run_until_complete(asyncio.wait_for(flow(), 60))
+        finally:
+            loop.close()
+        assert remaining == 0, "jobs never converged under fleet routing"
+
+        everything = stepped["drv-a"] + stepped["drv-b"]
+        assert len(everything) == len(tasks), "a job stepped twice or dropped"
+        assert len(set(everything)) == len(tasks)
+        members = sorted(routers)
+        for name, ids in stepped.items():
+            for tid in ids:
+                assert rendezvous_owner(tid, members) == name, (
+                    "a job stepped on a replica that does not own its task"
+                )
+
+
+# ---------------------------------------------------------------------------
+# THE ACCEPTANCE CASE: binary-level fleet, SIGKILL migration, exactly-once
+
+
+@pytest.mark.slow
+def test_binary_fleet_sigkill_migration_exactly_once(tmp_path):
+    """Two ``aggregation_job_driver`` BINARIES with ``fleet.enabled``
+    share one datastore.  Proves, end to end: (1) disjoint ownership —
+    each replica's /statusz fleet section reports ``tasks_owned == 1``;
+    (2) per-replica compile isolation — each replica's warmup compiles
+    ONLY its owned task's circuit (Count on r0, Sum on r1), observable
+    via the /statusz compile ledger; (3) SIGKILLing r0 migrates its task
+    to the survivor within the heartbeat TTL (+takeover grace), the
+    survivor's migration counter moves, every job finishes on the
+    survivor, and collection in this process is exactly-once with exact
+    Prio3 count/sum results; (4) graceful SIGTERM deregisters the
+    survivor's member row while the SIGKILLed replica's row stays behind
+    as prunable debris."""
+    import base64
+    import json
+    import signal
+    import sqlite3  # noqa: F401  (via _sql)
+    import subprocess
+    import urllib.request
+
+    from test_crash_chaos import (
+        _BOOT,
+        _free_port,
+        _metric_total,
+        _scrape,
+        _sql,
+        _wait_http,
+        REPO,
+        TIME_PRECISION,
+    )
+
+    import aiohttp
+
+    from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
+    from janus_tpu.aggregator.collection_job_driver import (
+        CollectionDriverConfig,
+        CollectionJobDriver,
+    )
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.client import prepare_report
+    from janus_tpu.core.auth_tokens import AuthenticationToken
+    from janus_tpu.core.hpke import HpkeApplicationInfo, HpkeKeypair, Label, open_
+    from janus_tpu.core.time import RealClock
+    from janus_tpu.datastore import (
+        AggregatorTask,
+        CollectionJob,
+        CollectionJobState,
+        Crypter,
+        Datastore,
+        LeaderStoredReport,
+        TaskQueryType,
+        generate_key,
+    )
+    from janus_tpu.messages import (
+        AggregateShareAad,
+        BatchSelector,
+        CollectionJobId,
+        InputShareAad,
+        Interval,
+        PlaintextInputShare,
+        Query,
+        Role,
+        TaskId,
+    )
+
+    REPLICAS = ("fleet-r0", "fleet-r1")
+    HB_INTERVAL, HB_TTL, GRACE = 0.3, 2.0, 0.3
+
+    key = generate_key()
+    leader_db = str(tmp_path / "leader.sqlite3")
+    helper_db = str(tmp_path / "helper.sqlite3")
+    helper_port, helper_health = _free_port(), _free_port()
+    driver_health = [_free_port(), _free_port()]
+
+    clock = RealClock()
+    leader_ds = Datastore(leader_db, Crypter([key]), clock)
+    helper_ds = Datastore(helper_db, Crypter([key]), clock)
+    agg_token = AuthenticationToken.new_bearer("agg-token-fleet")
+    collector_keys = HpkeKeypair.generate(9)
+    now = clock.now()
+    report_time = Time(now.seconds - now.seconds % TIME_PRECISION.seconds)
+    interval = Interval(report_time, TIME_PRECISION)
+
+    def pick_task_id(owner):
+        """A task id that rendezvous-routes to ``owner`` — makes the
+        ownership split (and the compile-isolation assertion) exact."""
+        while True:
+            tid = TaskId.random()
+            if rendezvous_owner(tid.data, list(REPLICAS)) == owner:
+                return tid
+
+    # one distinctly-shaped VDAF per replica: the compile ledgers must
+    # stay disjoint BY CIRCUIT, not just by digest
+    plans = {
+        0: ({"type": "Prio3Count"}, "Count", "fleet-r0", [1, 0, 1, 1]),
+        1: ({"type": "Prio3Sum", "bits": 4}, "Sum", "fleet-r1", [3, 5, 2, 7]),
+    }
+    tasks, keypairs = [], []
+    for t, (vdaf, _circuit, owner, _ms) in plans.items():
+        task_id = pick_task_id(owner)
+        common = dict(
+            task_id=task_id,
+            query_type=TaskQueryType.time_interval(),
+            vdaf=vdaf,
+            vdaf_verify_key=bytes([0x60 + t]) * 16,
+            min_batch_size=3,
+            time_precision=TIME_PRECISION,
+            collector_hpke_config=collector_keys.config,
+        )
+        leader_kp, helper_kp = HpkeKeypair.generate(1), HpkeKeypair.generate(2)
+        leader_task = AggregatorTask(
+            peer_aggregator_endpoint=f"http://127.0.0.1:{helper_port}/",
+            role=Role.LEADER,
+            aggregator_auth_token=agg_token,
+            hpke_keys=[leader_kp],
+            **common,
+        )
+        helper_task = AggregatorTask(
+            peer_aggregator_endpoint="http://127.0.0.1:1/",  # never called
+            role=Role.HELPER,
+            aggregator_auth_token_hash=agg_token.hash(),
+            hpke_keys=[helper_kp],
+            **common,
+        )
+        leader_ds.run_tx("putl", lambda tx, lt=leader_task: tx.put_aggregator_task(lt))
+        helper_ds.run_tx("puth", lambda tx, ht=helper_task: tx.put_aggregator_task(ht))
+        tasks.append((task_id, leader_task))
+        keypairs.append((leader_kp, helper_kp))
+
+    def seed_report(t, m):
+        task_id, leader_task = tasks[t]
+        leader_kp, helper_kp = keypairs[t]
+        vdaf = leader_task.vdaf_instance()
+        report = prepare_report(
+            vdaf,
+            task_id,
+            leader_kp.config,
+            helper_kp.config,
+            TIME_PRECISION,
+            m,
+            time=report_time,
+        )
+        aad = InputShareAad(
+            task_id, report.metadata, report.public_share
+        ).get_encoded()
+        info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+        plain = PlaintextInputShare.get_decoded(
+            open_(leader_kp, info, report.leader_encrypted_input_share, aad)
+        )
+        stored = LeaderStoredReport(
+            task_id=task_id,
+            metadata=report.metadata,
+            public_share=report.public_share,
+            leader_extensions=[],
+            leader_input_share=plain.payload,
+            helper_encrypted_input_share=report.helper_encrypted_input_share,
+        )
+        asyncio.run(
+            ReportWriteBatcher(leader_ds, max_batch_size=1).write_report(stored)
+        )
+
+    for t, (_v, _c, _o, ms) in plans.items():
+        for m in ms:
+            seed_report(t, m)
+
+    # pre-register BOTH member rows, future-dated past the binaries' slow
+    # boot (jax import): the first driver's warmup must already see a
+    # 2-member fleet or it would warm (and own) everything for one round.
+    # Each driver's synchronous startup registration overwrites its own
+    # row with a real-clock heartbeat, so the skew evaporates on boot.
+    future = Datastore(
+        leader_db, Crypter([key]), MockClock(Time(clock.now().seconds + 600))
+    )
+
+    def prereg(tx):
+        for r in REPLICAS:
+            tx.upsert_fleet_member(r, "aggregation")
+
+    future.run_tx("prereg", prereg)
+    future.close()
+
+    def driver_yaml(i):
+        return f"""
+common:
+  database: {{path: {leader_db}}}
+  health_check_listen_address: 127.0.0.1:{driver_health[i]}
+  status_sample_interval_s: 0.5
+  fleet:
+    enabled: true
+    replica_id: {REPLICAS[i]}
+    heartbeat_interval_s: {HB_INTERVAL}
+    heartbeat_ttl_s: {HB_TTL}
+    takeover_grace_s: {GRACE}
+job_driver:
+  job_discovery_interval_s: 0.2
+  max_concurrent_job_workers: 4
+  worker_lease_duration_s: 5
+  worker_lease_clock_skew_allowance_s: 1
+  maximum_attempts_before_failure: 100000
+  max_step_attempts: 100000
+  lease_reap_interval_s: 0.1
+vdaf_backend: tpu
+device_executor:
+  enabled: true
+  flush_window_ms: 20
+  flush_max_rows: 4096
+  breaker_failure_threshold: 0
+  warmup_rows: 8
+"""
+
+    helper_yaml = f"""
+common:
+  database: {{path: {helper_db}}}
+  health_check_listen_address: 127.0.0.1:{helper_health}
+listen_address: 127.0.0.1:{helper_port}
+"""
+    cfg_paths = []
+    for i in range(2):
+        p = tmp_path / f"driver{i}.yaml"
+        p.write_text(driver_yaml(i))
+        cfg_paths.append(p)
+    helper_cfg = tmp_path / "helper.yaml"
+    helper_cfg.write_text(helper_yaml)
+
+    env = dict(os.environ)
+    env["DATASTORE_KEYS"] = base64.urlsafe_b64encode(key).decode().rstrip("=")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(binary, cfg, tag):
+        log = open(tmp_path / f"{tag}.log", "wb")
+        return subprocess.Popen(
+            [sys.executable, "-c", _BOOT, binary, "--config-file", str(cfg)],
+            env=env,
+            cwd=str(REPO),
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def statusz(port):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=5
+        ) as r:
+            return json.loads(r.read().decode())
+
+    def wait_statusz(port, pred, what, deadline_s=120):
+        deadline = time.monotonic() + deadline_s
+        doc = None
+        while time.monotonic() < deadline:
+            try:
+                doc = statusz(port)
+                if pred(doc):
+                    return doc
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(f"{what}: last={doc and doc.get('fleet')}")
+
+    procs = [None, None, None]  # driver0, driver1, helper
+    try:
+        procs[2] = spawn("aggregator", helper_cfg, "helper")
+        _wait_http(f"http://127.0.0.1:{helper_health}/healthz", 120)
+        for i in range(2):
+            procs[i] = spawn("aggregation_job_driver", cfg_paths[i], f"driver{i}")
+        for i in range(2):
+            _wait_http(f"http://127.0.0.1:{driver_health[i]}/healthz", 120)
+
+        # -- phase 1: disjoint ownership + compile isolation ---------------
+        docs = [
+            wait_statusz(
+                driver_health[i],
+                lambda d: d["fleet"].get("tasks_owned") == 1
+                and d["executor"]["compile"],
+                f"replica {i} never settled on 1 owned task + a warm ledger",
+            )
+            for i in range(2)
+        ]
+        for i, doc in enumerate(docs):
+            fleet = doc["fleet"]
+            assert fleet["enabled"] is True
+            assert fleet["replica_id"] == REPLICAS[i]
+            assert fleet["migrations_total"] == 0
+            live = [m["replica_id"] for m in fleet["members"] if m["live"]]
+            assert sorted(live) == list(REPLICAS), fleet["members"]
+            # compile isolation: ONLY the owned task's circuit was warmed
+            circuits = {lbl.split("#")[0] for lbl in doc["executor"]["compile"]}
+            assert circuits == {plans[i][1]}, (i, circuits)
+
+        # -- phase 2: SIGKILL r0, then create the jobs ---------------------
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+        t_kill = time.monotonic()
+
+        creator = AggregationJobCreator(
+            leader_ds,
+            CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=4),
+        )
+        n_jobs = asyncio.run(creator.run_once())
+        assert n_jobs >= 2, n_jobs
+
+        # migration within the TTL: the survivor's ownership flips once
+        # r0's heartbeat ages past HB_TTL and the takeover grace passes.
+        # Budget = TTL + grace + heartbeat/discovery/poll cadences, padded
+        # generously for CI scheduling jitter — but still the same order
+        # of magnitude as the TTL itself.
+        doc = wait_statusz(
+            driver_health[1],
+            lambda d: d["fleet"].get("tasks_owned") == 2,
+            "survivor never absorbed the dead replica's task",
+            deadline_s=60,
+        )
+        migrated_after = time.monotonic() - t_kill
+        budget = HB_TTL + GRACE + 3 * (HB_INTERVAL + 0.2 + 0.2) + 5.0
+        assert migrated_after <= budget, (migrated_after, budget)
+        assert doc["fleet"]["migrations_total"] >= 1, doc["fleet"]
+        scraped = _scrape(driver_health[1])
+        assert _metric_total(scraped, "janus_fleet_migrations_total") >= 1
+        # the survivor's live same-role member count is now just itself
+        assert _metric_total(scraped, "janus_fleet_members") == 1
+
+        # -- every job finishes on the survivor ----------------------------
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            rows = dict(
+                _sql(
+                    leader_db,
+                    "SELECT state, COUNT(*) FROM aggregation_jobs GROUP BY state",
+                )
+            )
+            if rows.get("InProgress", 0) == 0:
+                break
+            time.sleep(0.5)
+        assert rows.get("InProgress", 0) == 0, rows
+        assert rows.get("Finished", 0) == n_jobs, (rows, n_jobs)
+
+        # -- graceful SIGTERM deregisters the survivor's row ---------------
+        # (the SIGKILLed replica's debris row is reaped by the survivor's
+        # opportunistic prune after PRUNE_TTLS heartbeat TTLs, so by now
+        # it may be present or already gone — but never the survivor's)
+        procs[1].send_signal(signal.SIGTERM)
+        assert procs[1].wait(timeout=120) == 0, "survivor SIGTERM must be clean"
+        members = _sql(leader_db, "SELECT replica_id FROM fleet_members")
+        assert ("fleet-r1",) not in members, members
+        assert members in ([], [("fleet-r0",)]), members
+
+        # -- collection in THIS process: exactly-once, exact sums ----------
+        async def collect():
+            results = {}
+            driver = CollectionJobDriver(
+                leader_ds,
+                aiohttp.ClientSession,
+                CollectionDriverConfig(retry_initial_delay=Duration(1)),
+            )
+            try:
+                for t, (task_id, _lt) in enumerate(tasks):
+                    job = CollectionJob(
+                        task_id=task_id,
+                        collection_job_id=CollectionJobId.random(),
+                        query=Query.new_time_interval(interval),
+                        aggregation_parameter=b"",
+                        batch_identifier=interval.get_encoded(),
+                        state=CollectionJobState.START,
+                    )
+                    leader_ds.run_tx(
+                        "putc", lambda tx, j=job: tx.put_collection_job(j)
+                    )
+                    deadline = time.monotonic() + 120
+                    while time.monotonic() < deadline:
+                        leases = await leader_ds.run_tx_async(
+                            "acqc",
+                            lambda tx: tx.acquire_incomplete_collection_jobs(
+                                Duration(600), 4
+                            ),
+                        )
+                        for lease in leases:
+                            await driver.step_collection_job(lease)
+                        got = leader_ds.run_tx(
+                            "getc",
+                            lambda tx, j=job: tx.get_collection_job(
+                                j.task_id, j.collection_job_id, "TimeInterval"
+                            ),
+                        )
+                        if got.state == CollectionJobState.FINISHED:
+                            results[t] = got
+                            break
+                        await asyncio.sleep(0.3)
+                    else:
+                        raise TimeoutError(f"collection for task {t} never finished")
+            finally:
+                await driver.close()
+            return results
+
+        results = asyncio.run(collect())
+        for t, (task_id, leader_task) in enumerate(tasks):
+            got = results[t]
+            measurements = plans[t][3]
+            vdaf = leader_task.vdaf_instance()
+            field = vdaf.field_for_agg_param(vdaf.decode_agg_param(b""))
+            leader_share = field.decode_vec(got.leader_aggregate_share)
+            aad = AggregateShareAad(
+                task_id, b"", BatchSelector.new_time_interval(interval)
+            ).get_encoded()
+            info = HpkeApplicationInfo.new(
+                Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR
+            )
+            helper_share = field.decode_vec(
+                open_(collector_keys, info, got.helper_aggregate_share, aad)
+            )
+            result = vdaf.unshard([leader_share, helper_share], got.report_count)
+            # exactly-once: Prio3 aggregation is exact, so report_count and
+            # sum equality ARE the no-double/no-drop proof across the
+            # SIGKILL + migration
+            assert got.report_count == len(measurements), (t, got.report_count)
+            assert result == sum(measurements), (t, result, measurements)
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        leader_ds.close()
+        helper_ds.close()

@@ -1,0 +1,186 @@
+"""GarbageCollector unit suite (ISSUE 16 satellite: the GC module had
+zero direct tests).
+
+Covers the per-task batch limits, the ``report_expiry_age is None``
+opt-out, the contained-failure path in ``run_once`` (one bad task must
+not stop the pass), and — above all — the outstanding-journal-row fence
+in ``delete_expired_aggregation_artifacts``: an expired Finished job with
+an unconsumed accumulator-journal row holds the only payloads the
+deferred-drain replay can re-derive its shares from, so GC must skip it
+until the replay consumes the row.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+from dataclasses import replace
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_datastore import make_report, make_task, put_job  # noqa: E402
+
+from janus_tpu.aggregator.garbage_collector import GarbageCollector, GcConfig
+from janus_tpu.core import faults
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import AggregationJobState
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Time
+
+#: Well past every report/job timestamp the helpers below write
+#: (make_report defaults to client time 1_600_000_000).
+NOW = Time(1_600_010_000)
+
+
+@pytest.fixture()
+def ds():
+    eds = EphemeralDatastore(MockClock(NOW))
+    yield eds.datastore
+    eds.cleanup()
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _expiring_task(ds, age_s=100):
+    """A task whose report_expiry_age makes everything at the make_report
+    default timestamp already expired at NOW."""
+    task = replace(make_task(), report_expiry_age=Duration(age_s))
+    ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+    return task
+
+
+def _report_count(ds, task):
+    from janus_tpu.messages import Interval
+
+    window = Interval(Time(1_599_999_000), Duration(10_000))
+    return ds.run_tx(
+        "count",
+        lambda tx: tx.count_client_reports_for_interval(task.task_id, window),
+    )
+
+
+def _put_reports(ds, task, n):
+    for i in range(n):
+        ds.run_tx(
+            "putr",
+            lambda tx, i=i: tx.put_client_report(
+                make_report(task.task_id, 1_600_000_000 + i)
+            ),
+        )
+
+
+class TestRunOnce:
+    def test_per_task_report_limit_bounds_each_pass(self, ds):
+        task = _expiring_task(ds)
+        _put_reports(ds, task, 5)
+        gc = GarbageCollector(ds, GcConfig(report_limit=2))
+        assert run(gc.run_once()) == 2
+        assert _report_count(ds, task) == 3
+        assert run(gc.run_once()) == 2
+        assert run(gc.run_once()) == 1
+        assert _report_count(ds, task) == 0
+        # drained: further passes are no-ops
+        assert run(gc.run_once()) == 0
+
+    def test_task_without_expiry_age_is_skipped(self, ds):
+        task = make_task()  # report_expiry_age=None: retention is opt-in
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+        _put_reports(ds, task, 3)
+        assert run(GarbageCollector(ds).run_once()) == 0
+        assert _report_count(ds, task) == 3
+
+    def test_one_failing_task_does_not_stop_the_pass(self, ds, monkeypatch):
+        bad = _expiring_task(ds)
+        good = _expiring_task(ds)
+        _put_reports(ds, bad, 2)
+        _put_reports(ds, good, 2)
+        gc = GarbageCollector(ds)
+        orig = GarbageCollector._gc_task
+
+        def boom(self, tx, task):
+            if task.task_id == bad.task_id:
+                raise RuntimeError("injected per-task GC failure")
+            return orig(self, tx, task)
+
+        monkeypatch.setattr(GarbageCollector, "_gc_task", boom)
+        # contained: run_once neither raises nor skips the healthy task
+        assert run(gc.run_once()) == 2
+        assert _report_count(ds, bad) == 2
+        assert _report_count(ds, good) == 0
+
+    def test_injected_gc_fault_is_contained(self, ds):
+        """The chaos seam: an armed gc.run fault fails the per-task tx but
+        run_once still returns (and a disarmed rerun drains the backlog)."""
+        task = _expiring_task(ds)
+        _put_reports(ds, task, 2)
+        gc = GarbageCollector(ds)
+        faults.configure(
+            [faults.FaultSpec(point="gc.run", mode="error", probability=1.0)],
+            seed=7,
+        )
+        try:
+            assert run(gc.run_once()) == 0
+            assert _report_count(ds, task) == 2
+        finally:
+            faults.clear()
+        assert run(gc.run_once()) == 2
+        assert _report_count(ds, task) == 0
+
+
+class TestJournalFence:
+    def _finished_expired_job(self, ds, task):
+        """An aggregation job whose whole client-timestamp interval is
+        before the GC expiry horizon, advanced out of InProgress."""
+        job = put_job(ds, task)
+        done = job.with_state(AggregationJobState.FINISHED)
+        ds.run_tx("fin", lambda tx: tx.update_aggregation_job(done))
+        return done
+
+    def _job_exists(self, ds, task, job):
+        return (
+            ds.run_tx(
+                "getj",
+                lambda tx: tx.get_aggregation_job(
+                    task.task_id, job.aggregation_job_id
+                ),
+            )
+            is not None
+        )
+
+    def test_outstanding_journal_row_fences_deletion(self, ds):
+        task = _expiring_task(ds)
+        job = self._finished_expired_job(ds, task)
+        ds.run_tx(
+            "j_put",
+            lambda tx: tx.put_accumulator_journal_entry(
+                task.task_id, b"batch-1", b"", job.aggregation_job_id, [b"\x01" * 16]
+            ),
+        )
+        gc = GarbageCollector(ds)
+        # the row holds the replay's only source material: job survives
+        assert run(gc.run_once()) == 0
+        assert self._job_exists(ds, task, job)
+
+        # replay consumes the row -> the next pass collects the job
+        assert ds.run_tx(
+            "j_del",
+            lambda tx: tx.delete_accumulator_journal_entry(
+                task.task_id, b"batch-1", b"", job.aggregation_job_id
+            ),
+        )
+        assert run(gc.run_once()) >= 1
+        assert not self._job_exists(ds, task, job)
+
+    def test_in_progress_job_is_never_collected(self, ds):
+        task = _expiring_task(ds)
+        job = put_job(ds, task)  # stays InProgress; interval fully expired
+        assert run(GarbageCollector(ds).run_once()) == 0
+        assert self._job_exists(ds, task, job)

@@ -93,6 +93,84 @@ def test_key_rotator_lifecycle():
     eds.cleanup()
 
 
+def test_rotation_overlap_keeps_opening_sealed_uploads():
+    """ISSUE 16 satellite (ROADMAP direction-4 claim, previously asserted
+    nowhere): an upload sealed under the OUTGOING active key keeps
+    opening through the batched front door (``open_batch`` via
+    UploadOpenBatcher) across the promote tick (both keys ACTIVE) and
+    the retire tick (old key EXPIRED = decrypt-only grace), and only
+    stops resolving once the reap removes the key entirely."""
+    from janus_tpu.aggregator.report_writer import UploadOpenBatcher
+    from janus_tpu.core.hpke import HpkeApplicationInfo, Label, seal
+    from janus_tpu.messages import Role
+
+    clock = MockClock(Time(1_000_000))
+    eds = EphemeralDatastore(clock)
+    ds = eds.datastore
+    rotator = HpkeKeyRotator(
+        ds,
+        KeyRotatorConfig(
+            pending_duration=Duration(100),
+            active_duration=Duration(1000),
+            expired_duration=Duration(50),
+        ),
+    )
+    rotator.run_sync()  # bootstrap: one ACTIVE key
+    (old_id,) = _states(ds)
+
+    info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    aad = b"upload-aad"
+    keypair_by_id = {
+        kp.config.id: HpkeKeypair(kp.config, kp.private_key)
+        for kp in ds.run_tx("get", lambda tx: tx.get_global_hpke_keypairs())
+    }
+    sealed = seal(keypair_by_id[old_id].config, info, b"client share", aad)
+    assert sealed.config_id == old_id
+
+    def open_via_frontdoor():
+        """Resolve the keypair the way the upload path does — from the
+        datastore by the ciphertext's config id — then open through the
+        batched stage.  None when the config id no longer resolves."""
+        keypairs = {
+            kp.config.id: HpkeKeypair(kp.config, kp.private_key)
+            for kp in ds.run_tx("get", lambda tx: tx.get_global_hpke_keypairs())
+        }
+        kp = keypairs.get(sealed.config_id)
+        if kp is None:
+            return None
+        loop = asyncio.new_event_loop()
+        try:
+            batcher = UploadOpenBatcher(max_batch_size=4, max_batch_delay=0.001)
+            return loop.run_until_complete(batcher.open(kp, info, sealed, aad))
+        finally:
+            loop.close()
+
+    # pre-stage + promote: old and new are BOTH active — the overlap
+    # window — and the old-key upload still opens.
+    clock.advance(Duration(950))
+    rotator.run_sync()
+    clock.advance(Duration(100))
+    rotator.run_sync()
+    states = _states(ds)
+    assert states[old_id] == HpkeKeyState.ACTIVE and len(states) == 2
+    assert open_via_frontdoor() == b"client share"
+
+    # retire: old key EXPIRED (advertised nowhere, decrypt-only) — an
+    # in-flight upload sealed just before the flip must still open.
+    clock.advance(Duration(100))
+    rotator.run_sync()
+    assert _states(ds)[old_id] == HpkeKeyState.EXPIRED
+    assert open_via_frontdoor() == b"client share"
+
+    # reap: past the decrypt grace the key is gone and the ciphertext
+    # stops resolving (the client has long since refetched /hpke_config).
+    clock.advance(Duration(50))
+    rotator.run_sync()
+    assert old_id not in _states(ds)
+    assert open_via_frontdoor() is None
+    eds.cleanup()
+
+
 def test_taskprov_peer_crud_routes():
     eds = EphemeralDatastore(MockClock(Time(1_600_002_000)))
     app = aggregator_api_app(eds.datastore, [TOKEN])

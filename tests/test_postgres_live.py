@@ -94,9 +94,8 @@ def test_schema_init_and_task_roundtrip(pg_datastore):
 def test_lease_exactly_once_across_handles(pg_datastore):
     """Two handles racing FOR UPDATE SKIP LOCKED acquisition: every job is
     leased exactly once (the multi-replica invariant, live)."""
-    from test_datastore import make_task
     from janus_tpu.datastore import AggregationJob, AggregationJobState
-    from janus_tpu.messages import AggregationJobId, Interval, Time
+    from janus_tpu.messages import AggregationJobId, AggregationJobStep, Interval, Time
 
     ds, key, clock = pg_datastore
     task = _make_task()
@@ -108,10 +107,10 @@ def test_lease_exactly_once_across_handles(pg_datastore):
             task_id=task.task_id,
             aggregation_job_id=AggregationJobId.random(),
             aggregation_parameter=b"",
-            batch_id=None,
+            partial_batch_identifier=None,
             client_timestamp_interval=Interval(Time(0), Duration(3600)),
             state=AggregationJobState.IN_PROGRESS,
-            step=0,
+            step=AggregationJobStep(0),
         )
         jobs.append(job)
 
@@ -137,7 +136,7 @@ def test_lease_exactly_once_across_handles(pg_datastore):
     t2 = threading.Thread(target=worker, args=(ds2,))
     t1.start(); t2.start(); t1.join(); t2.join()
     ds2.close()
-    ids = [l.aggregation_job_id for l in acquired]
+    ids = [l.leased.aggregation_job_id for l in acquired]
     assert len(ids) == 8 and len(set(ids)) == 8, "a job was double-leased or lost"
 
 
@@ -149,3 +148,144 @@ def test_tx_conflict_maps_integrity_error(pg_datastore):
     ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
     with pytest.raises(TxConflict):
         ds.run_tx("dup", lambda tx: tx.put_aggregator_task(task))
+
+
+# ---------------------------------------------------------------------------
+# fleet control plane against live Postgres (ISSUE 16 satellite: the
+# contended-acquisition suites must also run where contention is real —
+# MVCC + FOR UPDATE SKIP LOCKED — not just under SQLite's single writer)
+
+
+def _put_fleet_jobs(ds, n_tasks):
+    """n tasks, one InProgress aggregation job each; returns the tasks."""
+    from janus_tpu.datastore import AggregationJob, AggregationJobState
+    from janus_tpu.messages import AggregationJobId, AggregationJobStep, Interval, Time
+
+    tasks = [_make_task() for _ in range(n_tasks)]
+    for task in tasks:
+        ds.run_tx("put", lambda tx, t=task: tx.put_aggregator_task(t))
+        job = AggregationJob(
+            task_id=task.task_id,
+            aggregation_job_id=AggregationJobId.random(),
+            aggregation_parameter=b"",
+            partial_batch_identifier=None,
+            client_timestamp_interval=Interval(Time(0), Duration(3600)),
+            state=AggregationJobState.IN_PROGRESS,
+            step=AggregationJobStep(0),
+        )
+        ds.run_tx("putj", lambda tx, j=job: tx.put_aggregation_job(j))
+    return tasks
+
+
+def test_fleet_member_upsert_race_across_handles(pg_datastore):
+    """Two handles racing the same replica's first registration: the
+    insert race maps to TxConflict (exactly one row wins) and a plain
+    refresh beat never conflicts."""
+    from janus_tpu.datastore.datastore import TxConflict
+
+    ds, key, clock = pg_datastore
+    ds2 = Datastore(DSN, Crypter([key]), clock)
+    barrier = threading.Barrier(2)
+    conflicts = []
+
+    def register(handle):
+        barrier.wait(timeout=30)
+        try:
+            handle.run_tx(
+                "reg", lambda tx: tx.upsert_fleet_member("pg-r0", "aggregation")
+            )
+        except TxConflict:
+            conflicts.append(1)
+
+    t1 = threading.Thread(target=register, args=(ds,))
+    t2 = threading.Thread(target=register, args=(ds2,))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    rows = ds.run_tx("get", lambda tx: tx.get_fleet_members())
+    assert [m.replica_id for m in rows] == ["pg-r0"]
+    assert len(conflicts) <= 1
+    # refresh beats from both handles are conflict-free UPDATEs
+    ds.run_tx("hb1", lambda tx: tx.upsert_fleet_member("pg-r0", "aggregation"))
+    ds2.run_tx("hb2", lambda tx: tx.upsert_fleet_member("pg-r0", "aggregation"))
+    ds2.close()
+
+
+def test_fleet_ownership_filtered_acquisition_contended(pg_datastore):
+    """The fleet invariant under real MVCC contention: two replicas'
+    fleet-filtered acquirers race on separate connections, and every job
+    is leased exactly once, BY its rendezvous owner."""
+    from janus_tpu.core.fleet import FleetRouter, rendezvous_owner
+
+    ds, key, clock = pg_datastore
+    tasks = _put_fleet_jobs(ds, 8)
+    ds2 = Datastore(DSN, Crypter([key]), clock)
+    handles = {"pg-a": ds, "pg-b": ds2}
+    routers = {n: FleetRouter(n, "aggregation") for n in handles}
+    for n, handle in handles.items():
+        handle.run_tx("prereg", routers[n].heartbeat)
+
+    barrier = threading.Barrier(2)
+    leased = {n: [] for n in handles}
+
+    def worker(name):
+        handle, router = handles[name], routers[name]
+        barrier.wait(timeout=30)
+        got = handle.run_tx(
+            "acq",
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 16, exclude_task_ids=router.not_owned_task_ids(tx)
+            ),
+        )
+        leased[name].extend(bytes(l.leased.task_id.data) for l in got)
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in handles]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ds2.close()
+
+    members = sorted(handles)
+    all_ids = {bytes(t.task_id.data) for t in tasks}
+    got_all = leased["pg-a"] + leased["pg-b"]
+    assert len(got_all) == len(set(got_all)) == len(all_ids), "double-lease/loss"
+    assert set(got_all) == all_ids
+    for name, ids in leased.items():
+        for tid in ids:
+            assert rendezvous_owner(tid, members) == name, "leased by non-owner"
+
+
+def test_fleet_stale_heartbeat_migration(pg_datastore):
+    """Owner death on live Postgres: once the dead replica's heartbeat
+    ages past the TTL (MockClock drives tx-time on every backend), the
+    survivor counts the migrations and — after the takeover grace —
+    owns the whole task set."""
+    from janus_tpu.core.fleet import FleetRouter
+
+    ds, key, clock = pg_datastore
+    tasks = _put_fleet_jobs(ds, 6)
+    dead = FleetRouter("pg-dead", "aggregation", heartbeat_ttl_s=10.0)
+    survivor = FleetRouter(
+        "pg-live", "aggregation", heartbeat_ttl_s=10.0, takeover_grace_s=5.0
+    )
+    ds.run_tx("hb_d", dead.heartbeat)
+    ds.run_tx("hb_s", survivor.heartbeat)
+    dead_share = set(ds.run_tx("v", lambda tx: survivor.not_owned_task_ids(tx) or []))
+    assert dead_share, "the dead replica owned nothing; split not exercised"
+
+    clock.advance(Duration(11))  # past the TTL: only the survivor beats
+    ds.run_tx("hb_s2", survivor.heartbeat)
+    graced = set(ds.run_tx("v2", lambda tx: survivor.not_owned_task_ids(tx) or []))
+    assert graced == dead_share  # detected but grace-excluded
+    assert survivor.stats()["migrations_total"] == len(dead_share)
+
+    clock.advance(Duration(6))  # past the grace
+    assert ds.run_tx("v3", survivor.not_owned_task_ids) is None
+    assert survivor.stats()["tasks_owned"] == len(tasks)
+    # and the acquisition sweep now reaches every job
+    got = ds.run_tx(
+        "acq",
+        lambda tx: tx.acquire_incomplete_aggregation_jobs(
+            Duration(600), 16, exclude_task_ids=survivor.not_owned_task_ids(tx)
+        ),
+    )
+    assert len(got) == len(tasks)
