@@ -72,6 +72,19 @@
 #                      restarts under churn, exactly-once after settle),
 #                      plus the peer-health / deadline-budget / Retry-After
 #                      unit suite (tests/test_peer_health.py).
+#   ./ci.sh chaos brownout  datastore-brownout stage (ISSUE 17): the
+#                      2-replica fleet soak with every datastore.tx.begin
+#                      blackholed/erroring for a bounded window — health
+#                      tracker SUSPECT, upload front door shedding 503
+#                      before HPKE work, both routers serving their FROZEN
+#                      ownership view (zero migrations, zero abandons,
+#                      zero breaker trips, suppression counted on
+#                      /metrics), heal -> exactly-once collection with
+#                      exact sums — plus the real-death-after-brownout
+#                      case (a replica dead past the thaw-confirmation TTL
+#                      still loses its tasks) and the db-health unit suite
+#                      (tests/test_db_health.py: classification tables,
+#                      seeded backoff, tx deadlines, freeze/thaw).
 #   ./ci.sh fpvec      gradient-aggregation gate (ISSUE 15): the
 #                      multi-gadget device FLP plane — fpvec device-vs-
 #                      oracle bit-exact fuzz (vpu + mxu, leader + helper,
@@ -235,7 +248,14 @@ case "$tier" in
         "tests/test_chaos.py::test_partition_flap_soak_suspect_dwell_restart_exactly_once" \
         tests/test_peer_health.py -q
     fi
-    exec python -m pytest tests/test_chaos.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
+    if [ "${2:-}" = "brownout" ]; then
+      # Datastore-brownout stage (ISSUE 17): the migration-storm
+      # suppression soak + the real-death-after-brownout takeover case,
+      # plus the db-health unit suite (classification, backoff, deadlines,
+      # freeze/thaw).
+      exec python -m pytest tests/test_brownout_chaos.py tests/test_db_health.py -q
+    fi
+    exec python -m pytest tests/test_chaos.py tests/test_brownout_chaos.py tests/test_db_health.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
     ;;
   mesh)
     # Multi-chip gate (ISSUE 6).  test_mesh.py is device-tier (sharded
@@ -357,7 +377,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|coldstart|fpvec|obs|load|load fast|benchdiff|fleet|postgres|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|coldstart|fpvec|obs|load|load fast|benchdiff|fleet|postgres|dryrun]" >&2
     exit 2
     ;;
 esac

@@ -29,6 +29,7 @@ from ..datastore import (
     ReportAggregation,
     ReportAggregationState,
 )
+from ..datastore.datastore import DatastoreError, DatastoreUnavailable
 from ..datastore.task import AggregatorTask
 from ..messages import (
     AggregationJobContinueReq,
@@ -186,8 +187,19 @@ class AggregationJobDriver:
             # normal abandon verdict.  (Stopping the inflation at its
             # source — peer-aware acquisition filtering — is the ROADMAP
             # follow-on.)
+            from ..core.db_health import tracker as db_tracker
             from .job_driver import heal_grace_s, peer_partition_state
 
+            # Brownout excuse first (in-memory, no datastore lookup): a
+            # datastore brownout inflates lease_attempts exactly like a
+            # peer partition does — releases without consumed budget —
+            # so the ceiling's abandon verdict must wait out the heal
+            # grace here too.
+            if db_tracker().brownout_signal(
+                heal_grace_s(self.config.retry_max_delay_s)
+            ):
+                await self._release_ceiling_partition(lease)
+                return
             verdict = await peer_partition_state(
                 self.datastore,
                 lease.leased.task_id,
@@ -212,11 +224,19 @@ class AggregationJobDriver:
                 # delivery ceiling (maximum_attempts_before_failure,
                 # checked at entry) still bounds holders that never
                 # report back.
-                from .job_driver import partition_excused
+                from ..core.db_health import tracker as db_tracker
+                from .job_driver import heal_grace_s, partition_excused
 
                 if e.retryable and (
                     lease.lease_attempts < self.config.max_step_attempts
                     or e.peer_unhealthy
+                    # attempts inflated by a datastore brownout (still
+                    # suspect, or healed within the grace) are the
+                    # database's doing — in-memory check, evaluated
+                    # before the datastore-lookup excuse below
+                    or db_tracker().brownout_signal(
+                        heal_grace_s(self.config.retry_max_delay_s)
+                    )
                     # attempts inflated by a partition (peer still
                     # unhealthy, or healed within the grace) must not
                     # abandon the post-heal delivery on its first
@@ -263,6 +283,12 @@ class AggregationJobDriver:
                     else:
                         logger.error("fatal step failure: %s", e)
                     await self.abandon_aggregation_job(lease)
+            except DatastoreUnavailable as e:
+                # Datastore brownout mid-step: treated exactly like
+                # peer_unhealthy — release with jittered backoff, budget
+                # untouched (ISSUE 17 tentpole layer 3).
+                outcome = "retried"
+                await self._release_datastore_brownout(lease, e)
         if GLOBAL_METRICS.registry is not None:
             GLOBAL_METRICS.job_steps.labels(
                 job_type="aggregation", outcome=outcome
@@ -324,7 +350,8 @@ class AggregationJobDriver:
     # ------------------------------------------------------------------
     async def _release_ceiling_partition(self, lease) -> None:
         """Release a past-ceiling lease with jittered backoff: the
-        inflated delivery count is partition pressure, not a sick job."""
+        inflated delivery count is partition/brownout pressure, not a
+        sick job."""
         from .job_driver import step_retry_delay
 
         acq = lease.leased
@@ -336,8 +363,8 @@ class AggregationJobDriver:
         )
         logger.warning(
             "job %s is past its delivery ceiling (%d attempts) but the "
-            "peer is suspect — releasing for %ds instead of abandoning "
-            "partition-pressured work",
+            "peer or datastore is suspect — releasing for %ds instead of "
+            "abandoning pressured work",
             acq.aggregation_job_id,
             lease.lease_attempts,
             delay.seconds,
@@ -346,6 +373,42 @@ class AggregationJobDriver:
             "release_agg_job",
             lambda tx: tx.release_aggregation_job(lease, delay),
         )
+
+    async def _release_datastore_brownout(self, lease, err) -> None:
+        """A step that died on ``DatastoreUnavailable`` releases WITHOUT
+        consuming the retryable budget — the failure is the database's,
+        not the job's (the exact peer_unhealthy treatment, ISSUE 17).
+        The release transaction itself runs under a short deadline and
+        tolerates failure: mid-brownout it may not commit either, and
+        lease expiry + the reaper redeliver the job regardless."""
+        from .job_driver import step_retry_delay
+
+        acq = lease.leased
+        delay = step_retry_delay(
+            lease.lease_attempts,
+            self.config.retry_initial_delay_s,
+            self.config.retry_max_delay_s,
+            jitter_key=acq.aggregation_job_id.data,
+        )
+        logger.warning(
+            "datastore unavailable mid-step for job %s — releasing for "
+            "%ds without consuming the attempt budget: %s",
+            acq.aggregation_job_id,
+            delay.seconds,
+            err,
+        )
+        try:
+            await self.datastore.run_tx_async(
+                "release_agg_job",
+                lambda tx: tx.release_aggregation_job(lease, delay),
+                deadline_s=5.0,
+            )
+        except DatastoreError:
+            logger.warning(
+                "release of job %s failed too (datastore still browned "
+                "out); lease expiry redelivers it",
+                acq.aggregation_job_id,
+            )
 
     def _gate_peer(self, task: AggregatorTask) -> None:
         """Refuse to burn lease work on a suspect peer (raises a

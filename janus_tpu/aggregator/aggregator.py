@@ -93,6 +93,7 @@ from .error import (
     UnrecognizedAggregationJob,
     UnrecognizedCollectionJob,
     UnrecognizedTask,
+    UploadShed,
 )
 from .report_writer import ReportWriteBatcher
 
@@ -378,6 +379,23 @@ class Aggregator:
     # ------------------------------------------------------------------
     # upload (reference: aggregator.rs:1522 handle_upload_generic)
 
+    @staticmethod
+    def _shed_if_datastore_suspect() -> None:
+        """Brownout shed (ISSUE 17): while the datastore tracker is
+        SUSPECT every upload would burn HPKE work only to fail at the
+        write, so refuse with the retryable 503 up front.  PROBING
+        uploads are deliberately admitted — the write attempt IS the
+        probe that heals the tracker."""
+        from ..core.db_health import DB_SUSPECT, tracker as db_tracker
+
+        if db_tracker().state() != DB_SUSPECT:
+            return
+        from ..core.metrics import GLOBAL_METRICS
+
+        if GLOBAL_METRICS.registry is not None:
+            GLOBAL_METRICS.upload_sheds.labels(reason="datastore").inc()
+        raise UploadShed("datastore suspect (brownout); retry shortly")
+
     async def handle_upload(self, task_id: TaskId, report: Report) -> None:
         from ..core.trace import current_trace, new_trace_id, trace_scope, trace_span
 
@@ -393,6 +411,7 @@ class Aggregator:
             # Admission control (ISSUE 14): shed BEFORE any per-upload
             # crypto or datastore work — past the front-door budget the
             # cheapest correct answer is the retryable 503.
+            self._shed_if_datastore_suspect()
             self.upload_opener.admit()
             ta = await self.task_aggregator_for(task_id)
             task = ta.task

@@ -27,6 +27,7 @@ from ..datastore import (
     Datastore,
     Lease,
 )
+from ..datastore.datastore import DatastoreError, DatastoreUnavailable
 from ..datastore.query_type import strategy_for
 from ..datastore.task import AggregatorTask
 from ..messages import (
@@ -102,6 +103,30 @@ class CollectionJobDriver:
 
     # ------------------------------------------------------------------
     async def step_collection_job(self, lease: Lease) -> None:
+        """Stepper entry: runs the step, classifying a mid-step
+        ``DatastoreUnavailable`` as brownout pressure — release with
+        jittered backoff WITHOUT consuming the attempt budget, exactly
+        the peer_unhealthy treatment (ISSUE 17 tentpole layer 3)."""
+        try:
+            await self._step_collection_job(lease)
+        except DatastoreUnavailable as e:
+            acq = lease.leased
+            logger.warning(
+                "datastore unavailable mid-step for collection job %s — "
+                "releasing without consuming the attempt budget: %s",
+                acq.collection_job_id,
+                e,
+            )
+            try:
+                await self._release_retryable(lease, peer_unhealthy=True)
+            except DatastoreError:
+                logger.warning(
+                    "release of collection job %s failed too (datastore "
+                    "still browned out); lease expiry redelivers it",
+                    acq.collection_job_id,
+                )
+
+    async def _step_collection_job(self, lease: Lease) -> None:
         import time as _time
 
         t_step = _time.monotonic()
@@ -112,9 +137,16 @@ class CollectionJobDriver:
             # clean peer-unhealthy releases must not abandon the job
             # while the peer is still unreachable — and within the heal
             # grace the job gets its post-heal delivery instead of an
-            # entry abandonment.
+            # entry abandonment.  Brownout excuse first (in-memory): a
+            # datastore brownout inflates the count the same way.
+            from ..core.db_health import tracker as db_tracker
             from .job_driver import heal_grace_s, peer_partition_state
 
+            if db_tracker().brownout_signal(
+                heal_grace_s(self.config.step_retry_max_delay.seconds)
+            ):
+                await self._release_retryable(lease, peer_unhealthy=True)
+                return
             verdict = await peer_partition_state(
                 self.datastore,
                 acq.task_id,
@@ -150,6 +182,10 @@ class CollectionJobDriver:
         # an aggregate can never be computed without these shares.
         try:
             await self._replay_outstanding_journal(acq)
+        except DatastoreUnavailable:
+            # brownout, not a replay problem: classify at the wrapper
+            # (release without consuming the budget)
+            raise
         except Exception as e:
             logger.warning("accumulator journal replay failed: %s", e)
             await self._release_retryable(lease)
@@ -561,11 +597,21 @@ class CollectionJobDriver:
         pressure (``peer_unhealthy`` — the peer-health tracker has the
         helper suspect) never consumes the budget: the job releases with
         jittered backoff for as long as the partition lasts."""
-        from .job_driver import partition_excused, step_retry_delay
+        from ..core.db_health import tracker as db_tracker
+        from .job_driver import (
+            heal_grace_s,
+            partition_excused,
+            step_retry_delay,
+        )
 
         if (
             lease.lease_attempts >= self.config.max_step_attempts
             and not peer_unhealthy
+            # attempts inflated by a datastore brownout are the
+            # database's doing (in-memory check, evaluated first)
+            and not db_tracker().brownout_signal(
+                heal_grace_s(self.config.step_retry_max_delay.seconds)
+            )
             # attempts inflated by a partition must not abandon the
             # post-heal delivery on its first ordinary hiccup
             and not await partition_excused(

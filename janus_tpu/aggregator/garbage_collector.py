@@ -13,6 +13,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..core import faults
+from ..core.db_health import janitor_skip as _janitor_skip
 from ..core.time import time_sub
 from ..datastore import Datastore
 from ..messages import Role
@@ -34,6 +35,8 @@ class GarbageCollector:
 
     async def run_once(self) -> int:
         """One GC pass over every task; returns rows deleted."""
+        if _janitor_skip("gc"):
+            return 0
         tasks = await self.datastore.run_tx_async(
             "gc_tasks", lambda tx: tx.get_aggregator_tasks()
         )

@@ -265,7 +265,10 @@ class JobDriver:
     async def run(self, stop: asyncio.Event) -> None:
         """Run until ``stop`` is set, then drain in-flight steppers
         (reference: job_driver.rs:100-149)."""
+        from ..datastore.datastore import DatastoreUnavailable
+
         sem = asyncio.Semaphore(self.max_concurrent_job_workers)
+        acquire_failures = 0
         while not stop.is_set():
             await self._maybe_reap()
             free = self.max_concurrent_job_workers - len(self._inflight)
@@ -273,7 +276,23 @@ class JobDriver:
             if free > 0:
                 try:
                     leases = await self.acquirer(self.worker_lease_duration, free)
+                    acquire_failures = 0
+                except DatastoreUnavailable as e:
+                    # Brownout idle-backoff (ISSUE 17): consecutive
+                    # acquisition failures stretch the discovery sleep
+                    # multiplicatively (capped) instead of polling a
+                    # struggling database on the normal cadence.  One
+                    # line per miss — the health tracker and metrics
+                    # carry the detail.
+                    acquire_failures += 1
+                    logger.warning(
+                        "job acquisition failed, datastore unavailable "
+                        "(%d consecutive; backing off): %s",
+                        acquire_failures,
+                        e,
+                    )
                 except Exception:
+                    acquire_failures += 1
                     logger.exception("job acquisition failed")
             for lease in leases:
                 task = asyncio.ensure_future(self._step(sem, lease))
@@ -282,6 +301,11 @@ class JobDriver:
             # jittered discovery sleep (reference: job_driver.rs discovery
             # interval w/ jitter); cut short if stop is requested.
             delay = self.job_discovery_interval * (0.5 + random.random())
+            if acquire_failures:
+                delay = min(
+                    delay * (2 ** min(acquire_failures, 5)),
+                    max(self.job_discovery_interval, 60.0),
+                )
             try:
                 await asyncio.wait_for(stop.wait(), timeout=delay)
             except asyncio.TimeoutError:

@@ -34,6 +34,7 @@ import logging
 from dataclasses import dataclass
 
 from ..core import faults
+from ..core.db_health import janitor_skip as _janitor_skip
 from ..core.hpke import HpkeKeypair
 from ..datastore.datastore import Datastore, TxConflict
 from ..datastore.models import HpkeKeyState
@@ -57,6 +58,8 @@ class HpkeKeyRotator:
         self.config = config or KeyRotatorConfig()
 
     async def run(self) -> None:
+        if _janitor_skip("key_rotator"):
+            return
         try:
             await self.datastore.run_tx_async("key_rotator", self._tick)
         except TxConflict:
@@ -66,6 +69,8 @@ class HpkeKeyRotator:
             logger.info("key rotator tick lost an insert race; treating as done")
 
     def run_sync(self) -> None:
+        if _janitor_skip("key_rotator"):
+            return
         try:
             self.datastore.run_tx("key_rotator", self._tick)
         except TxConflict:

@@ -120,6 +120,28 @@ class FleetConfig:
     heartbeat_ttl_s: float = 10.0
     takeover_grace_s: float = 5.0
     suspect_staleness_s: float = 30.0
+    #: Migration-storm suppression (ISSUE 17): if MORE than this fraction
+    #: of the previously-live same-role members (excluding self) go stale
+    #: in one ownership refresh, the staleness is treated as correlated
+    #: (datastore brownout) and the router freezes its last-known
+    #: ownership view instead of migrating.  0.5 means "more than half
+    #: vanished at once"; raise toward 1.0 to suppress only total
+    #: blackouts, lower toward 0.0 to make any multi-member loss freeze.
+    mass_staleness_fraction: float = 0.5
+
+
+@dataclass
+class DatastoreHealthConfig:
+    """Datastore health tracker (core/db_health.py): the brownout
+    detector fed by every run_tx retry.  Always on — the thresholds only
+    shape when consecutive transient tx failures flip the process-wide
+    verdict to SUSPECT (fleet freezes routing, upload front door sheds,
+    janitors skip their sweeps)."""
+
+    #: consecutive transient tx failures before SUSPECT
+    failure_threshold: int = 3
+    #: suspect dwell before transactions count as probes again
+    suspect_dwell_s: float = 5.0
 
 
 @dataclass
@@ -203,6 +225,9 @@ class CommonConfig:
     #: Fleet control plane (core/fleet.py): replica membership +
     #: rendezvous task routing for the job drivers; fully off by default.
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    #: Datastore health tracker thresholds (core/db_health.py); the
+    #: tracker itself is always on.
+    db_health: DatastoreHealthConfig = field(default_factory=DatastoreHealthConfig)
 
 
 @dataclass
@@ -469,6 +494,7 @@ def _merge_dataclass(cls, data: dict):
             AccumulatorStoreConfig,
             FaultInjectionConfig,
             FleetConfig,
+            DatastoreHealthConfig,
         )
     }
     kwargs = {}

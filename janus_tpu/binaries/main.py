@@ -56,6 +56,16 @@ def _bootstrap(config_common):
     configure_cost_attribution(
         getattr(config_common, "cost_task_cardinality", 64)
     )
+    # Datastore health tracker thresholds (ISSUE 17): process-wide like
+    # the peer tracker — every binary's run_tx feeds the same verdict.
+    db_cfg = getattr(config_common, "db_health", None)
+    if db_cfg is not None:
+        from ..core.db_health import tracker as db_tracker
+
+        db_tracker().configure(
+            failure_threshold=db_cfg.failure_threshold,
+            suspect_dwell_s=db_cfg.suspect_dwell_s,
+        )
     fault_cfg = getattr(config_common, "fault_injection", None)
     if fault_cfg is not None and fault_cfg.enabled:
         # Chaos mode: arm the deterministic fault registry.  Loud on
@@ -230,6 +240,7 @@ def _start_fleet_heartbeat(stop: asyncio.Event, datastore: Datastore, common):
     async def loop_():
         from ..core import peer_health
 
+        consecutive_failures = 0
         while not stop.is_set():
             try:
                 suspects = [
@@ -237,15 +248,33 @@ def _start_fleet_heartbeat(stop: asyncio.Event, datastore: Datastore, common):
                     for origin, s in peer_health.tracker().stats().items()
                     if s.get("state") == "suspect"
                 ]
+                # short per-beat deadline: a browned-out datastore must
+                # not pin this beat through the full tx retry budget —
+                # better to skip the beat and keep the loop's cadence
                 await datastore.run_tx_async(
                     "fleet_heartbeat",
                     lambda tx: router.heartbeat(tx, suspects),
+                    deadline_s=max(interval, 2.0),
                 )
+                consecutive_failures = 0
             except Exception:
-                # a missed beat only ages our row; the TTL absorbs it
-                logger.exception("fleet heartbeat failed")
+                # A missed beat only ages our row (the TTL absorbs it) —
+                # NEVER crash the binary over it.  Capped backoff: a
+                # sustained brownout stretches the cadence instead of
+                # hammering a struggling database with registration
+                # writes; first failure logs the traceback, repeats stay
+                # one line.
+                consecutive_failures += 1
+                if consecutive_failures == 1:
+                    logger.exception("fleet heartbeat failed")
+                else:
+                    logger.warning(
+                        "fleet heartbeat failed (%d consecutive; backing off)",
+                        consecutive_failures,
+                    )
+            delay = min(interval * (2 ** min(consecutive_failures, 4)), 30.0)
             try:
-                await asyncio.wait_for(stop.wait(), timeout=interval)
+                await asyncio.wait_for(stop.wait(), timeout=delay)
             except asyncio.TimeoutError:
                 pass
         try:
@@ -545,6 +574,7 @@ def _run_job_driver_binary(config_path: Optional[str], kind: str) -> None:
             heartbeat_ttl_s=fc.heartbeat_ttl_s,
             takeover_grace_s=fc.takeover_grace_s,
             suspect_staleness_s=fc.suspect_staleness_s,
+            mass_staleness_fraction=fc.mass_staleness_fraction,
         )
         datastore.run_tx("fleet_register", router.heartbeat)
         logger.info(

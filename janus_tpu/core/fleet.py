@@ -34,6 +34,16 @@ Design points:
   before acquiring a freshly-absorbed task, so an owner that was merely
   slow to heartbeat (or whose lease is in flight) gets a window to
   finish/resume before the new owner starts pulling its jobs.
+- **Migration-storm suppression.**  Heartbeat liveness is only
+  trustworthy while the datastore it lives in is: a brownout makes every
+  member's row stale *simultaneously*, which is indistinguishable from
+  mass death.  When the local datastore tracker (core/db_health.py) is
+  suspect, or more than ``mass_staleness_fraction`` of previously-live
+  same-role members go stale in one refresh, the router FREEZES its
+  last-known ownership view — no takeovers, no releases — and counts
+  ``janus_fleet_migration_suppressed_total``; it thaws only after the
+  tracker heals and a full heartbeat TTL confirms the staleness was
+  real.  See README "Datastore brownout tolerance".
 - **Fleet-shared suspects.**  Each heartbeat republishes the origins this
   replica's peer-health tracker currently holds SUSPECT onto its member
   row; ``shared_suspects`` unions fresh advertisements from *other* live
@@ -111,6 +121,7 @@ class FleetRouter:
         heartbeat_ttl_s: float = 10.0,
         takeover_grace_s: float = 5.0,
         suspect_staleness_s: float = 30.0,
+        mass_staleness_fraction: float = 0.5,
         enabled: bool = True,
     ):
         self.replica_id = replica_id
@@ -118,6 +129,12 @@ class FleetRouter:
         self.heartbeat_ttl_s = float(heartbeat_ttl_s)
         self.takeover_grace_s = float(takeover_grace_s)
         self.suspect_staleness_s = float(suspect_staleness_s)
+        #: migration-storm trigger: if MORE than this fraction of the
+        #: previously-live same-role members (excluding self — self is
+        #: live by fiat and would dilute the signal) go stale in one
+        #: refresh, the staleness is treated as correlated (datastore
+        #: brownout, not mass death) and the ownership view freezes.
+        self.mass_staleness_fraction = float(mass_staleness_fraction)
         self.enabled = enabled
         self._lock = threading.Lock()
         self._last_owner: Dict[bytes, str] = {}
@@ -126,6 +143,22 @@ class FleetRouter:
         self._tasks_owned = 0
         self._last_heartbeat_s: Optional[int] = None
         self._members_snapshot: List[dict] = []
+        # -- migration-storm suppression state (ISSUE 17) --------------
+        #: live set as of the last UNSUPPRESSED refresh — the baseline
+        #: the mass-staleness quorum check compares against
+        self._prev_live: Optional[Set[str]] = None
+        #: exclusion list as of the last unsuppressed refresh — what a
+        #: suppressed refresh serves instead of recomputing ownership
+        self._frozen_exclusions: Optional[List[bytes]] = None
+        self._suppressed = False
+        self._suppress_reason: Optional[str] = None
+        self._suppressed_total = 0
+        #: tx-time when thaw confirmation began: suppression lifts only
+        #: after the datastore tracker is healthy AND a full heartbeat
+        #: TTL passes with the trigger still absent — so staleness that
+        #: was just brownout shadow (members heartbeat again the moment
+        #: the datastore heals) never causes a takeover
+        self._thaw_started_s: Optional[int] = None
 
     # -- membership ----------------------------------------------------
 
@@ -179,6 +212,53 @@ class FleetRouter:
         live.add(self.replica_id)  # self-eviction is never the right failure mode
         return sorted(live)
 
+    # -- migration-storm suppression (ISSUE 17) ------------------------
+
+    def _suppression_verdict_locked(self, live: Set[str], now: int) -> Optional[str]:
+        """Should this refresh be served from the frozen ownership view?
+        Returns the reason string, or None to compute live.  Caller holds
+        ``self._lock``.
+
+        Triggers: the local datastore tracker says suspect/probing (a
+        brownout makes every heartbeat row stale at once — trusting the
+        table would start a migration storm), or at least two AND more
+        than ``mass_staleness_fraction`` of the previously-live
+        same-role members went stale since the last unsuppressed refresh
+        (the correlated-staleness signature, caught even when this
+        replica's own transactions happened to sail through).
+
+        Thaw: once the tracker is healthy, suppression holds for one
+        more full heartbeat TTL — members that were only brownout-shadow
+        stale heartbeat again within it and the thawed refresh routes
+        exactly as before; members still stale after it are genuinely
+        dead and their tasks migrate for real.
+        """
+        from .db_health import tracker as db_tracker
+
+        if db_tracker().is_suspect():
+            self._thaw_started_s = None  # heal restarts the confirmation
+            return "datastore_suspect"
+        if self._suppressed:
+            if self._thaw_started_s is None:
+                self._thaw_started_s = now
+            if now - self._thaw_started_s < self.heartbeat_ttl_s:
+                return self._suppress_reason or "thaw_confirmation"
+            return None  # confirmed: thaw this refresh
+        prev = self._prev_live
+        if prev:
+            others = prev - {self.replica_id}
+            stale = others - live
+            # a storm needs PLURAL simultaneous staleness: one dead peer
+            # is the normal single-failure takeover (2-replica fleets
+            # rely on the datastore-suspect trigger instead — in a real
+            # brownout this replica's own transactions fail too)
+            if (
+                len(stale) >= 2
+                and len(stale) / len(others) > self.mass_staleness_fraction
+            ):
+                return "mass_staleness"
+        return None
+
     # -- routing -------------------------------------------------------
 
     def not_owned_task_ids(self, tx) -> Optional[List[bytes]]:
@@ -189,12 +269,28 @@ class FleetRouter:
 
         Also the migration detector: an ownership transition from another
         member to this one increments ``janus_fleet_migrations_total`` and
-        opens the grace window.
+        opens the grace window.  While migration-storm suppression is
+        active the FROZEN exclusion list is returned instead — no
+        takeovers, no releases, no ``_last_owner`` churn — and
+        ``janus_fleet_migration_suppressed_total`` counts the refresh.
         """
         if not self.enabled:
             return None
         live = self._live_members(tx)
         now = tx._now_s()
+        frozen: Optional[List[bytes]] = None
+        with self._lock:
+            reason = self._suppression_verdict_locked(set(live), now)
+            if reason is not None and self._frozen_exclusions is not None:
+                self._suppressed = True
+                self._suppress_reason = reason
+                self._suppressed_total += 1
+                frozen = list(self._frozen_exclusions)
+            # reason with no frozen view (cold start): nothing useful to
+            # freeze to — compute live below, which also seeds the view
+        if frozen is not None:
+            GLOBAL_METRICS.fleet_migration_suppressed.inc()
+            return frozen or None
         excluded: List[bytes] = []
         owned = 0
         migrations = 0
@@ -221,6 +317,14 @@ class FleetRouter:
                     self._last_owner[task_id] = owner
             self._migrations += migrations
             self._tasks_owned = owned
+            # an unsuppressed refresh is the new baseline: what a future
+            # suppressed refresh freezes to, and what the mass-staleness
+            # check compares against
+            self._prev_live = set(live)
+            self._frozen_exclusions = list(excluded)
+            self._suppressed = False
+            self._suppress_reason = None
+            self._thaw_started_s = None
         if migrations:
             GLOBAL_METRICS.fleet_migrations.inc(migrations)
         GLOBAL_METRICS.fleet_tasks_owned.set(owned)
@@ -279,8 +383,13 @@ class FleetRouter:
                 "role": self.role,
                 "heartbeat_ttl_s": self.heartbeat_ttl_s,
                 "takeover_grace_s": self.takeover_grace_s,
+                "mass_staleness_fraction": self.mass_staleness_fraction,
                 "tasks_owned": self._tasks_owned,
                 "migrations_total": self._migrations,
+                "suppressed": self._suppressed,
+                "suppress_reason": self._suppress_reason,
+                "suppressed_refreshes_total": self._suppressed_total,
+                "thaw_started_s": self._thaw_started_s,
                 "last_heartbeat_s": self._last_heartbeat_s,
                 "members": list(self._members_snapshot),
             }
@@ -299,6 +408,7 @@ def configure_fleet(
     heartbeat_ttl_s: float = 10.0,
     takeover_grace_s: float = 5.0,
     suspect_staleness_s: float = 30.0,
+    mass_staleness_fraction: float = 0.5,
 ) -> FleetRouter:
     """Install the process-wide router (once, from the driver binary)."""
     global _ROUTER
@@ -308,6 +418,7 @@ def configure_fleet(
         heartbeat_ttl_s=heartbeat_ttl_s,
         takeover_grace_s=takeover_grace_s,
         suspect_staleness_s=suspect_staleness_s,
+        mass_staleness_fraction=mass_staleness_fraction,
     )
     return _ROUTER
 

@@ -342,6 +342,35 @@ class Metrics:
             ["name", "status"],
             registry=self.registry,
         )
+        # Datastore brownout tolerance (core/db_health.py): the database
+        # failure domain made observable.  The state-set gauge carries 1
+        # on the tracker's current state so alerts can match on
+        # janus_datastore_health{state="suspect"} == 1 directly; the
+        # retry counter is the brownout's intensity (every transient
+        # in-loop failure, before the attempt that eventually commits).
+        self.datastore_health = Gauge(
+            "janus_datastore_health",
+            "Datastore health state-set (1 on the tracker's current "
+            "state: healthy|suspect|probing)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.datastore_tx_retries = Counter(
+            "janus_datastore_tx_retries_total",
+            "Transient datastore transaction failures retried by run_tx "
+            "(lock contention, serialization failures, connection drops)",
+            registry=self.registry,
+        )
+        # Janitor plane gating on datastore health: sweeps skipped while
+        # the tracker is non-healthy, so GC never races a brownout-
+        # recovering replay window.
+        self.janitor_skips = Counter(
+            "janus_janitor_skips_total",
+            "Janitor sweeps skipped because the datastore tracker was "
+            "non-healthy, by component (gc|key_rotator)",
+            ["component"],
+            registry=self.registry,
+        )
         # batched device launches through the backend seam
         self.device_launches = Counter(
             "janus_device_prepare_launches_total",
@@ -587,6 +616,18 @@ class Metrics:
             "expired (live task migration events)",
             registry=self.registry,
         )
+        # Migration-storm suppression: ownership refreshes served from
+        # the FROZEN view because mass staleness (or a suspect local
+        # datastore) made the membership table untrustworthy.  A nonzero
+        # rate here during a brownout is the system working; see README
+        # "Datastore brownout tolerance" for the starter alert.
+        self.fleet_migration_suppressed = Counter(
+            "janus_fleet_migration_suppressed_total",
+            "Ownership refreshes served from the frozen view because a "
+            "migration storm was suppressed (mass staleness or suspect "
+            "datastore)",
+            registry=self.registry,
+        )
 
         # -- pipeline freshness / SLO metrics (ISSUE 5 tentpole) ---------
         # The operator question that defines a DAP deployment's SLO: how
@@ -656,7 +697,7 @@ class Metrics:
         self.upload_sheds = Counter(
             "janus_upload_shed_total",
             "Uploads shed at the front-door queue (503 + Retry-After) by "
-            "reason (queue_full|queue_delay)",
+            "reason (queue_full|queue_delay|datastore)",
             ["reason"],
             registry=self.registry,
         )

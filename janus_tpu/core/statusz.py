@@ -48,6 +48,11 @@ def runtime_status() -> dict:
         # state + failure counts — the first thing to check when a soak
         # quiesces (partition pressure vs a bug)
         "peers": _peer_stats(),
+        # Datastore health (ISSUE 17): the process-wide brownout tracker
+        # — state, consecutive/total transient tx failures, suspect
+        # transitions — what separates "the fleet froze on purpose" from
+        # "the fleet wedged"
+        "datastore": _datastore_stats(),
         # Fleet control plane (ISSUE 16): this replica's membership view,
         # owned-task count, and migration total — disabled marker when no
         # router is installed
@@ -101,6 +106,20 @@ def _peer_stats() -> dict:
         return tracker().stats()
     except Exception:
         logger.exception("peer-health stats unavailable")
+        return {"error": "unavailable"}
+
+
+def _datastore_stats() -> dict:
+    """Process-wide datastore brownout tracker (core/db_health.py);
+    failure-tolerant like every other section — and deliberately
+    process-local, so it renders even while the datastore itself is the
+    thing that's down."""
+    try:
+        from .db_health import tracker
+
+        return tracker().stats()
+    except Exception:
+        logger.exception("datastore-health stats unavailable")
         return {"error": "unavailable"}
 
 
@@ -210,6 +229,15 @@ def sample_status_metrics(datastore, clock=None) -> None:
         tracker().republish_metrics()
     except Exception:
         logger.exception("peer-health republish failed")
+    # same story for the datastore tracker: suspect->probing is purely
+    # time-driven, and during a brownout there may be no committing
+    # transaction to republish the gauge
+    try:
+        from .db_health import tracker as db_tracker
+
+        db_tracker().republish_metrics()
+    except Exception:
+        logger.exception("datastore-health republish failed")
 
     def q(tx):
         count, oldest = tx.accumulator_journal_stats()

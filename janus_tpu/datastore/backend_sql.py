@@ -149,6 +149,15 @@ class SqliteBackend:
     #: SELECT would abort a Postgres transaction; see Datastore._init_schema)
     table_exists_sql = "SELECT 1 FROM sqlite_master WHERE type='table' AND name = ?"
 
+    #: Per-connection lock wait before SQLITE_BUSY surfaces, in ms.  Set
+    #: BOTH ways on every connection — the ``timeout=`` connect kwarg and
+    #: the ``busy_timeout`` PRAGMA — because the kwarg only covers the
+    #: Python wrapper's own waits while the PRAGMA covers statements run
+    #: through the C library directly; a contended writer that exhausts
+    #: it surfaces "database is locked", which ``is_retryable`` classifies
+    #: transient so run_tx retries instead of failing the loser.
+    BUSY_TIMEOUT_MS = 10_000
+
     def __init__(self, path: str):
         import sqlite3
 
@@ -162,11 +171,13 @@ class SqliteBackend:
     def connect(self):
         import sqlite3
 
-        conn = sqlite3.connect(self.path, timeout=10.0, isolation_level=None)
+        conn = sqlite3.connect(
+            self.path, timeout=self.BUSY_TIMEOUT_MS / 1000.0, isolation_level=None
+        )
         conn.execute("PRAGMA journal_mode = WAL")
         conn.execute("PRAGMA synchronous = NORMAL")
         conn.execute("PRAGMA foreign_keys = ON")
-        conn.execute("PRAGMA busy_timeout = 10000")
+        conn.execute(f"PRAGMA busy_timeout = {self.BUSY_TIMEOUT_MS}")
         return conn
 
     # No statement translation: Transaction SQL is written in the SQLite
@@ -179,11 +190,20 @@ class SqliteBackend:
         return (sqlite3.IntegrityError,)
 
     def is_retryable(self, exc: BaseException) -> bool:
+        """SQLITE_BUSY / "database is locked" are transient weather (a
+        contended writer, a checkpoint in flight) — retry; everything
+        else (schema errors, integrity violations) stays loud."""
         import sqlite3
 
         return isinstance(exc, sqlite3.OperationalError) and (
             "locked" in str(exc) or "busy" in str(exc)
         )
+
+    def is_disconnect(self, exc: BaseException) -> bool:
+        """SQLite is in-process: there is no connection to drop.  Lock
+        contention retries on the SAME connection (reconnecting per retry
+        would add churn to the contended hot path)."""
+        return False
 
     def init_schema(self, conn, schema: str) -> None:
         """Apply DDL WITHOUT committing: the caller stamps schema_version in
@@ -287,11 +307,55 @@ class PostgresBackend:
             pass
         return tuple(out) or (_NeverRaised,)
 
+    def _disconnect_errors(self) -> tuple:
+        """Driver exception classes that mean the CONNECTION (not the
+        statement) failed: psycopg's OperationalError covers connection
+        refused/reset, server shutdown, and failover blips; InterfaceError
+        covers using a connection the driver already knows is dead."""
+        out = []
+        try:
+            import psycopg
+
+            out.extend([psycopg.OperationalError, psycopg.InterfaceError])
+        except ImportError:
+            pass
+        try:
+            import psycopg2
+
+            out.extend([psycopg2.OperationalError, psycopg2.InterfaceError])
+        except ImportError:
+            pass
+        return tuple(out) or (_NeverRaised,)
+
     def is_retryable(self, exc: BaseException) -> bool:
         # SQLSTATE 40001 serialization_failure / 40P01 deadlock_detected,
-        # exactly the classes the reference retries (datastore.rs:273-289).
+        # exactly the classes the reference retries (datastore.rs:273-289)
+        # — plus disconnect-shaped OperationalErrors (server restart,
+        # failover, reset): transient weather, not bugs.  Integrity and
+        # ProgrammingError (schema) never land here — distinct classes
+        # under the driver's hierarchy — so they stay loud.
         sqlstate = getattr(exc, "sqlstate", None) or getattr(exc, "pgcode", None)
-        return sqlstate in ("40001", "40P01")
+        if sqlstate in ("40001", "40P01"):
+            return True
+        return self.is_disconnect(exc)
+
+    def is_disconnect(self, exc: BaseException) -> bool:
+        """run_tx evicts this thread's cached connection before retrying a
+        disconnect-shaped failure — retrying a dead socket on the same
+        connection would fail all ``max_transaction_retries`` attempts.
+        Shapes: an OperationalError/InterfaceError with no SQLSTATE (the
+        driver lost the socket before the server could answer) or with a
+        connection-exception / operator-intervention class code."""
+        sqlstate = getattr(exc, "sqlstate", None) or getattr(exc, "pgcode", None)
+        return isinstance(exc, self._disconnect_errors()) and sqlstate in (
+            None,
+            "57P01",  # admin_shutdown (failover)
+            "57P02",  # crash_shutdown
+            "57P03",  # cannot_connect_now (server starting up)
+            "08000",  # connection_exception
+            "08003",  # connection_does_not_exist
+            "08006",  # connection_failure
+        )
 
     def init_schema(self, conn, schema: str) -> None:
         """Apply DDL WITHOUT committing (see SqliteBackend.init_schema)."""

@@ -268,10 +268,33 @@ class Datastore:
                 pass
 
     # -- transactions ---------------------------------------------------
-    def run_tx(self, name: str, fn: Callable[["Transaction"], T]) -> T:
+    def run_tx(
+        self,
+        name: str,
+        fn: Callable[["Transaction"], T],
+        deadline_s: Optional[float] = None,
+    ) -> T:
         """Run ``fn`` in one transaction, retrying on lock contention /
         serialization failure (reference: datastore.rs:249 run_tx /
-        :298 run_tx_once; retry classification is per-backend)."""
+        :298 run_tx_once; retry classification is per-backend).
+
+        Every transient (retryable) failure feeds the process-wide
+        datastore health tracker (core/db_health.py) and sleeps a
+        full-jitter exponential backoff; a commit resets it.  Permanent
+        errors (schema, integrity) raise immediately and say nothing
+        about datastore health.
+
+        ``deadline_s`` bounds the retry loop's total wall time: a
+        lease-holding caller (a job driver releasing mid-brownout) sets
+        it so the release attempt always returns in-band instead of
+        holding the lease through ``max_transaction_retries`` sleeps —
+        exhausting the deadline raises ``DatastoreUnavailable`` exactly
+        like exhausting the attempt budget."""
+        from ..core.db_health import tracker as db_tracker
+
+        deadline = (
+            _time.monotonic() + deadline_s if deadline_s is not None else None
+        )
         last_err: Optional[BaseException] = None
         for attempt in range(self.max_transaction_retries):
             conn = self._conn()
@@ -292,7 +315,8 @@ class Datastore:
                     self._evict_conn()
                     raise
                 last_err = e
-                _time.sleep(min(0.05 * (attempt + 1), 0.5))
+                if not self._retry_backoff(e, attempt, deadline):
+                    break
                 continue
             tx = Transaction(self, conn)
             try:
@@ -302,6 +326,7 @@ class Datastore:
                 faults.fire("datastore.tx.commit")
                 conn.commit()
                 _metrics_tx(name, "committed")
+                db_tracker().record_tx_success()
                 return result
             except BaseException as e:
                 try:
@@ -312,7 +337,8 @@ class Datastore:
                     self._evict_conn()
                 if self._is_retryable(e):
                     last_err = e
-                    _time.sleep(min(0.05 * (attempt + 1), 0.5))
+                    if not self._retry_backoff(e, attempt, deadline):
+                        break
                     continue
                 raise
         _metrics_tx(name, "exhausted")
@@ -320,16 +346,44 @@ class Datastore:
             f"transaction {name!r} exhausted retries: {last_err}"
         )
 
+    def _retry_backoff(
+        self,
+        err: BaseException,
+        attempt: int,
+        deadline: Optional[float],
+    ) -> bool:
+        """One transient-failure bookkeeping step for ``run_tx``: feed the
+        health tracker, drop a disconnect-shaped connection (retrying a
+        dead socket forever is not a retry), then sleep the jittered
+        backoff.  Returns False when the sleep would cross ``deadline`` —
+        the caller breaks to the exhausted raise instead of sleeping."""
+        from ..core.db_health import backoff_s
+        from ..core.db_health import tracker as db_tracker
+
+        db_tracker().record_tx_failure()
+        if self.backend.is_disconnect(err):
+            self._evict_conn()
+        delay = backoff_s(attempt)
+        if deadline is not None and _time.monotonic() + delay >= deadline:
+            return False
+        _time.sleep(delay)
+        return True
+
     def _is_retryable(self, e: BaseException) -> bool:
         """Backend retry classification, plus injected faults — which
         impersonate transient infrastructure failures by contract."""
         return isinstance(e, faults.FaultInjectedError) or self.backend.is_retryable(e)
 
-    async def run_tx_async(self, name: str, fn: Callable[["Transaction"], T]) -> T:
+    async def run_tx_async(
+        self,
+        name: str,
+        fn: Callable[["Transaction"], T],
+        deadline_s: Optional[float] = None,
+    ) -> T:
         """Async wrapper: runs the (synchronous) transaction in a worker
         thread so the aiohttp event loop is never blocked on the database."""
         return await asyncio.get_running_loop().run_in_executor(
-            None, lambda: self.run_tx(name, fn)
+            None, lambda: self.run_tx(name, fn, deadline_s=deadline_s)
         )
 
     def now(self) -> Time:

@@ -67,6 +67,21 @@ def pytest_configure(config):
     )
 
 
+@pytest.fixture(autouse=True)
+def _clean_db_health():
+    """The datastore health tracker is process-wide (core/db_health.py)
+    and fed by EVERY run_tx: a test that storms tx faults (p=1 begin
+    errors) would otherwise leak a suspect verdict into the next test's
+    fleet router / upload front door.  Resetting is just zeroing a
+    struct — cheap enough to do around every test."""
+    from janus_tpu.core.db_health import reset_db_health, tracker
+
+    reset_db_health()
+    tracker().configure(failure_threshold=3, suspect_dwell_s=5.0)
+    yield
+    reset_db_health()
+
+
 def pytest_collection_modifyitems(config, items):
     run_slow = os.environ.get("RUN_SLOW")
     skip = pytest.mark.skip(reason="slow; set RUN_SLOW=1 to run")

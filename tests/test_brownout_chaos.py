@@ -1,0 +1,371 @@
+"""Datastore brownout chaos soak (ISSUE 17 acceptance).
+
+``./ci.sh chaos brownout``: the full-stack proof that a datastore
+brownout degrades the fleet instead of shredding it.
+
+* ``test_brownout_soak_suppresses_migration_storm_exactly_once`` — the
+  2-replica, multi-task leader+helper soak with fleet routing on:
+  mid-soak every ``datastore.tx.begin`` blackholes/errors for a bounded
+  window.  During the window the health tracker goes SUSPECT, the upload
+  front door sheds 503+Retry-After BEFORE HPKE work, and both routers
+  serve their FROZEN ownership view (suppression observable in
+  ``janus_fleet_migration_suppressed_total``).  After the faults lift:
+  ZERO migrations, ZERO abandons, ZERO executor breaker trips, every job
+  Finished, and collection is exactly-once with exact Prio3 sums.
+* ``test_brownout_then_real_replica_death_still_migrates`` — the
+  suppression window must not become a liveness hole: a replica that
+  stays dead PAST the thaw-confirmation TTL after the brownout heals
+  loses its tasks to the survivor for real.
+
+Seeded via JANUS_CHAOS_SEED (./ci.sh chaos pins it) like the rest of the
+chaos tier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import pytest
+
+from test_chaos import NOW, SEED, TIME_PRECISION, ChaosHarness, _run  # noqa: E402
+
+from janus_tpu.core import faults
+from janus_tpu.core.db_health import DB_HEALTHY, DB_SUSPECT, tracker
+from janus_tpu.core.faults import FaultSpec
+from janus_tpu.core.fleet import FleetRouter, rendezvous_owner
+from janus_tpu.core.metrics import GLOBAL_METRICS
+from janus_tpu.datastore.datastore import DatastoreError
+from janus_tpu.executor import reset_global_executor
+from janus_tpu.messages import Duration
+
+#: tx-time fleet timings: rounds advance the MockClock 61s, so a 150s TTL
+#: keeps per-round heartbeats fresh while 3 blackout rounds (183s) age
+#: every row past it — the exact correlated-staleness shape a brownout fakes
+HEARTBEAT_TTL_S = 150.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    from janus_tpu.core.fleet import reset_fleet
+
+    faults.clear()
+    reset_fleet()
+    reset_global_executor()
+    yield
+    faults.clear()
+    reset_fleet()
+    reset_global_executor()
+
+
+def _pick_split_names(task_ids, prefix):
+    """A replica-name pair under which rendezvous gives BOTH members at
+    least one task (task ids are random per run; the suppression and
+    takeover assertions need a real ownership split)."""
+    for i in range(64):
+        a, b = f"{prefix}-a{i}", f"{prefix}-b{i}"
+        if {rendezvous_owner(t, [a, b]) for t in task_ids} == {a, b}:
+            return a, b
+    raise AssertionError("no splitting name pair found")
+
+
+def _metric_value(name):
+    text = GLOBAL_METRICS.export().decode()
+    m = re.search(rf"^{re.escape(name)} (\S+)", text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+async def _drive_round(harness, routers):
+    """One fleet-filtered discovery+step round on both replicas; each
+    replica heartbeats in its acquisition tx (exactly the binary's
+    shape).  Datastore brownouts surface as DatastoreError — tolerated,
+    the round just idles."""
+
+    async def replica(driver, router):
+        def q(tx):
+            router.heartbeat(tx)
+            return tx.acquire_incomplete_aggregation_jobs(
+                Duration(60), 4, exclude_task_ids=router.not_owned_task_ids(tx)
+            )
+
+        try:
+            leases = await harness.leader_ds.datastore.run_tx_async("acquire", q)
+        except DatastoreError:
+            return
+        for lease in leases:
+            try:
+                await driver.step_aggregation_job(lease)
+            except Exception:
+                pass  # lease expires; redelivered next round
+
+    await asyncio.gather(
+        *(replica(d, r) for d, r in zip(harness.drivers, routers))
+    )
+    harness.clock.advance(Duration(61))
+
+
+def _new_harness():
+    harness = ChaosHarness(n_tasks=2)
+    # a browning-out transaction must fail FAST in the soak (the default
+    # 30-attempt budget is ~8s of backoff per tx)
+    harness.leader_ds.datastore.max_transaction_retries = 2
+    harness.helper_ds.datastore.max_transaction_retries = 2
+    # long dwell: the tracker stays strictly SUSPECT until a real commit
+    # heals it, so the upload-shed and frozen-view windows are deterministic
+    tracker().configure(failure_threshold=3, suspect_dwell_s=60.0)
+    return harness
+
+
+async def _upload_expect_shed(harness, task_idx):
+    """An upload during the brownout: 503 + Retry-After BEFORE any HPKE
+    open (reason="datastore" on the shed counter)."""
+    from janus_tpu.client import prepare_report
+
+    task_id, leader_task, helper_task = harness.tasks[task_idx]
+    report = prepare_report(
+        leader_task.vdaf_instance(),
+        task_id,
+        leader_task.hpke_keys[0].config,
+        helper_task.hpke_keys[0].config,
+        TIME_PRECISION,
+        1,
+        time=NOW,
+    )
+    resp = await harness.leader_client.put(
+        f"/tasks/{task_id}/reports", data=report.get_encoded()
+    )
+    assert resp.status == 503, await resp.text()
+    assert resp.headers.get("Retry-After"), "shed must carry Retry-After"
+
+
+def test_brownout_soak_suppresses_migration_storm_exactly_once():
+    harness = _new_harness()
+    measurements = {0: [1, 0, 1, 1], 1: [1, 1, 0, 1]}
+
+    async def flow():
+        await harness.start()
+        routers = None
+        try:
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)  # report batcher flush
+            await harness.create_jobs()
+
+            names = _pick_split_names(
+                [t[0].data for t in harness.tasks], "bz"
+            )
+            routers = [
+                FleetRouter(
+                    n,
+                    "aggregation",
+                    heartbeat_ttl_s=HEARTBEAT_TTL_S,
+                    takeover_grace_s=0.0,
+                )
+                for n in names
+            ]
+            ds = harness.leader_ds.datastore
+            for r in routers:
+                ds.run_tx("prereg", r.heartbeat)
+            # clean rounds seed each router's frozen-view baseline
+            for _ in range(2):
+                await _drive_round(harness, routers)
+            ex_before = {
+                r.replica_id: set(
+                    ds.run_tx("v", lambda tx, r=r: r.not_owned_task_ids(tx) or [])
+                )
+                for r in routers
+            }
+            suppressed_before = _metric_value(
+                "janus_fleet_migration_suppressed_total"
+            )
+
+            # -- the brownout window: every BEGIN errors or blackholes --
+            faults.configure(
+                [
+                    FaultSpec("datastore.tx.begin", "error", 1.0),
+                    # the blackhole flavor rides along: a short hang THEN
+                    # the error (a browned-out disk is slow before it fails)
+                    FaultSpec("datastore.tx.begin", "hang", 0.3, hang_s=0.01),
+                ],
+                seed=SEED,
+            )
+            for _ in range(3):
+                await _drive_round(harness, routers)
+            assert tracker().state() == DB_SUSPECT, tracker().stats()
+            metrics_text = GLOBAL_METRICS.export().decode()
+            assert 'janus_datastore_health{state="suspect"} 1.0' in metrics_text
+            # front door sheds BEFORE HPKE work, with the datastore reason
+            await _upload_expect_shed(harness, 0)
+            metrics_text = GLOBAL_METRICS.export().decode()
+            assert 'janus_upload_shed_total{reason="datastore"}' in metrics_text
+
+            # -- heal: the first refresh is the suppressed one (verdict
+            # computed while still suspect), its commit heals the tracker,
+            # and the thaw-confirmation TTL absorbs the shadow staleness
+            faults.clear()
+            for _ in range(40):
+                await _drive_round(harness, routers)
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            assert tracker().state() == DB_HEALTHY
+
+            states = harness.agg_job_states()
+            assert len(states) >= 2, "both tasks must have aggregation jobs"
+            assert all(s == "Finished" for s in states), states
+            assert "Abandoned" not in states
+
+            # suppression observable; the storm itself never happened
+            assert (
+                _metric_value("janus_fleet_migration_suppressed_total")
+                > suppressed_before
+            )
+            total_suppressed = sum(
+                r.stats()["suppressed_refreshes_total"] for r in routers
+            )
+            assert total_suppressed >= 1, [r.stats() for r in routers]
+            # jobs may finish while the thaw confirmation is still
+            # running (the frozen view IS the correct ownership) — drain
+            # the confirmation TTL and prove the thaw lands clean
+            for _ in range(8):
+                if not any(r.stats()["suppressed"] for r in routers):
+                    break
+                await _drive_round(harness, routers)
+            for r in routers:
+                s = r.stats()
+                assert s["migrations_total"] == 0, s
+                assert not s["suppressed"], s
+            ex_after = {
+                r.replica_id: set(
+                    ds.run_tx("v", lambda tx, r=r: r.not_owned_task_ids(tx) or [])
+                )
+                for r in routers
+            }
+            assert ex_after == ex_before, "ownership moved across the brownout"
+
+            # the brownout is not an executor failure: zero breaker trips
+            ex = harness.drivers[0]._executor
+            assert all(
+                s["trips"] == 0 for s in ex.circuit_stats().values()
+            ), ex.circuit_stats()
+
+            # collection under a healed sky: exactly-once, exact sums
+            for t, ms in measurements.items():
+                result = await harness.collect_task(t)
+                assert result.report_count == len(ms), (t, result)
+                assert result.aggregate_result == sum(ms), (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    _run(flow(), timeout=240.0)
+    reset_global_executor()
+
+
+def test_brownout_then_real_replica_death_still_migrates():
+    """Past the suppression window the fleet must still believe real
+    death: the brownout heals, one replica never comes back, and after
+    the thaw-confirmation TTL the survivor absorbs its tasks and
+    finishes every job."""
+    harness = _new_harness()
+    measurements = {0: [1, 0, 1], 1: [0, 1, 1]}
+
+    async def flow():
+        await harness.start()
+        try:
+            for t, ms in measurements.items():
+                for m in ms:
+                    await harness.upload(t, m)
+            await asyncio.sleep(0.1)
+            await harness.create_jobs()
+
+            dead_name, survivor_name = _pick_split_names(
+                [t[0].data for t in harness.tasks], "bzd"
+            )
+            dead = FleetRouter(
+                dead_name,
+                "aggregation",
+                heartbeat_ttl_s=HEARTBEAT_TTL_S,
+                takeover_grace_s=0.0,
+            )
+            survivor = FleetRouter(
+                survivor_name,
+                "aggregation",
+                heartbeat_ttl_s=HEARTBEAT_TTL_S,
+                takeover_grace_s=0.0,
+            )
+            ds = harness.leader_ds.datastore
+            ds.run_tx("prereg_d", dead.heartbeat)
+            ds.run_tx("prereg_s", survivor.heartbeat)
+            # seed both routers' frozen-view baselines WITHOUT stepping
+            # any job: the dead replica must still own unfinished work
+            # when it dies, or there is nothing left to take over
+            ds.run_tx("seed_d", lambda tx: dead.not_owned_task_ids(tx))
+            dead_share = set(
+                ds.run_tx("seed_s", lambda tx: survivor.not_owned_task_ids(tx) or [])
+            )
+            assert dead_share, "name picking guaranteed a split"
+
+            faults.configure(
+                [FaultSpec("datastore.tx.begin", "error", 1.0)], seed=SEED
+            )
+            for _ in range(3):
+                await _drive_round(harness, [dead, survivor])
+            assert tracker().state() == DB_SUSPECT
+            faults.clear()
+
+            # the dead replica never heartbeats again: survivor-only
+            # rounds walk through suppression -> thaw confirmation ->
+            # REAL takeover, then finish everything
+            survivor_driver = harness.drivers[1]
+            for _ in range(40):
+                await _drive_round_single(harness, survivor_driver, survivor)
+                states = harness.agg_job_states()
+                if states and all(s == "Finished" for s in states):
+                    break
+            states = harness.agg_job_states()
+            assert all(s == "Finished" for s in states), states
+            assert "Abandoned" not in states
+
+            s = survivor.stats()
+            assert s["migrations_total"] == len(dead_share), s
+            assert not s["suppressed"], s
+            assert s["suppressed_refreshes_total"] >= 1, (
+                "takeover must have PASSED THROUGH suppression, not skipped it"
+            )
+            assert ds.run_tx("vf", survivor.not_owned_task_ids) is None
+
+            for t, ms in measurements.items():
+                result = await harness.collect_task(t)
+                assert result.report_count == len(ms), (t, result)
+                assert result.aggregate_result == sum(ms), (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    _run(flow(), timeout=240.0)
+    reset_global_executor()
+
+
+async def _drive_round_single(harness, driver, router):
+    def q(tx):
+        router.heartbeat(tx)
+        return tx.acquire_incomplete_aggregation_jobs(
+            Duration(60), 8, exclude_task_ids=router.not_owned_task_ids(tx)
+        )
+
+    try:
+        leases = await harness.leader_ds.datastore.run_tx_async("acquire", q)
+    except DatastoreError:
+        leases = []
+    for lease in leases:
+        try:
+            await driver.step_aggregation_job(lease)
+        except Exception:
+            pass
+    harness.clock.advance(Duration(61))

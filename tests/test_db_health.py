@@ -1,0 +1,624 @@
+"""Datastore brownout tolerance suite (ISSUE 17 tentpole).
+
+Layers, smallest to largest:
+
+* ``backoff_s`` determinism: seeded-rng reproducibility, jitter bounds,
+  the cap.
+* Transient/permanent classification tables for both backends: SQLite
+  busy/locked retries, integrity/schema stays loud; Postgres
+  serialization + disconnect SQLSTATE shapes (driver-independent via a
+  fake exception class — the live-driver twin is in
+  test_postgres_live.py).
+* The ``DbHealthTracker`` state machine: healthy -> suspect after the
+  threshold, suspect -> probing after the dwell (real time), a failing
+  probe restarts the dwell, the first commit heals, and the
+  ``brownout_signal`` heal-grace window.
+* ``run_tx`` integration: exhausted transient retries raise
+  ``DatastoreUnavailable`` and mark the tracker suspect; a commit heals
+  it; ``deadline_s`` bounds the retry loop's total sleep so lease
+  holders release in-band instead of sitting through 30 backoffs.
+* Migration-storm suppression on ``FleetRouter``: the datastore-suspect
+  freeze (no takeovers, counted refreshes), the thaw-confirmation TTL
+  (a brownout-shadowed peer that heartbeats again never migrates; a
+  genuinely dead one migrates after the window), the mass-staleness
+  quorum trigger, and the plural-staleness floor (one dead peer is a
+  normal takeover, never a storm).
+* Consumer gates: upload front door sheds strictly on SUSPECT (probing
+  uploads are the probe), janitors no-op (counted) while non-healthy,
+  /statusz carries the tracker section.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_datastore import make_task  # noqa: E402
+
+from janus_tpu.core.db_health import (
+    DB_HEALTHY,
+    DB_PROBING,
+    DB_SUSPECT,
+    DbHealthTracker,
+    backoff_s,
+    reset_db_health,
+    tracker,
+)
+from janus_tpu.core.fleet import FleetRouter, reset_fleet
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Time
+
+NOW = Time(1_600_000_000)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet():
+    reset_fleet()
+    yield
+    reset_fleet()
+
+
+@pytest.fixture()
+def eds():
+    e = EphemeralDatastore(MockClock(NOW))
+    yield e
+    e.cleanup()
+
+
+def _put_tasks(ds, n):
+    tasks = [make_task() for _ in range(n)]
+    for t in tasks:
+        ds.run_tx("put", lambda tx, t=t: tx.put_aggregator_task(t))
+    return tasks
+
+
+# ---------------------------------------------------------------------------
+# backoff
+
+
+class TestBackoff:
+    def test_seeded_rng_is_deterministic(self):
+        a = [backoff_s(i, rng=random.Random(42)) for i in range(6)]
+        b = [backoff_s(i, rng=random.Random(42)) for i in range(6)]
+        assert a == b
+
+    def test_jitter_bounds_and_cap(self):
+        rng = random.Random(7)
+        for attempt in range(12):
+            base = min(0.5, 0.025 * 2**attempt)
+            d = backoff_s(attempt, rng=rng)
+            assert base * 0.5 <= d < base, (attempt, d)
+        # deep attempts never exceed the cap
+        assert backoff_s(50, rng=random.Random(1)) < 0.5
+
+    def test_negative_attempt_clamps(self):
+        assert 0 < backoff_s(-3, rng=random.Random(1)) < 0.025
+
+
+# ---------------------------------------------------------------------------
+# classification tables
+
+
+class TestSqliteClassification:
+    def _backend(self):
+        from janus_tpu.datastore.backend_sql import SqliteBackend
+
+        return SqliteBackend(":memory:")
+
+    @pytest.mark.parametrize(
+        "exc_text,retryable",
+        [
+            ("database is locked", True),
+            ("database table is locked", True),
+            ("database is busy", True),
+            ("no such table: foo", False),
+        ],
+    )
+    def test_operational_error_table(self, exc_text, retryable):
+        import sqlite3
+
+        b = self._backend()
+        assert b.is_retryable(sqlite3.OperationalError(exc_text)) is retryable
+
+    def test_integrity_error_stays_loud(self):
+        import sqlite3
+
+        b = self._backend()
+        assert not b.is_retryable(sqlite3.IntegrityError("UNIQUE constraint"))
+
+    def test_never_disconnect_shaped(self):
+        """In-process sqlite has no connection to evict: lock contention
+        retries on the SAME connection."""
+        import sqlite3
+
+        b = self._backend()
+        assert not b.is_disconnect(sqlite3.OperationalError("database is locked"))
+
+    def test_busy_timeout_applied_on_connect(self, tmp_path):
+        from janus_tpu.datastore.backend_sql import SqliteBackend
+
+        b = SqliteBackend(str(tmp_path / "t.db"))
+        conn = b.connect()
+        try:
+            (ms,) = conn.execute("PRAGMA busy_timeout").fetchone()
+            assert ms == SqliteBackend.BUSY_TIMEOUT_MS
+        finally:
+            conn.close()
+
+
+class _FakePgError(Exception):
+    """Driver-independent stand-in: carries ``sqlstate`` the way psycopg3
+    exceptions do (psycopg2 uses ``pgcode`` — also read by the backend)."""
+
+    def __init__(self, sqlstate=None):
+        super().__init__(sqlstate or "connection dropped")
+        self.sqlstate = sqlstate
+
+
+class TestPostgresClassification:
+    def _backend(self, monkeypatch):
+        from janus_tpu.datastore.backend_sql import PostgresBackend
+
+        b = PostgresBackend("postgres://unused/db")
+        # the classification logic is sqlstate-driven; substitute the fake
+        # class so the table runs without a psycopg install
+        monkeypatch.setattr(b, "_disconnect_errors", lambda: (_FakePgError,))
+        return b
+
+    @pytest.mark.parametrize(
+        "sqlstate,retryable,disconnect",
+        [
+            ("40001", True, False),  # serialization_failure
+            ("40P01", True, False),  # deadlock_detected
+            (None, True, True),  # socket died before the server answered
+            ("57P01", True, True),  # admin_shutdown (failover)
+            ("57P02", True, True),  # crash_shutdown
+            ("57P03", True, True),  # cannot_connect_now
+            ("08006", True, True),  # connection_failure
+            ("23505", False, False),  # unique_violation: loud
+            ("42P01", False, False),  # undefined_table: loud
+        ],
+    )
+    def test_sqlstate_table(self, monkeypatch, sqlstate, retryable, disconnect):
+        b = self._backend(monkeypatch)
+        exc = _FakePgError(sqlstate)
+        assert b.is_retryable(exc) is retryable, sqlstate
+        assert b.is_disconnect(exc) is disconnect, sqlstate
+
+    def test_non_driver_exception_never_disconnect(self, monkeypatch):
+        b = self._backend(monkeypatch)
+        assert not b.is_disconnect(ValueError("not a driver error"))
+        assert not b.is_retryable(ValueError("not a driver error"))
+
+    def test_serialization_failure_on_driver_class_still_retryable(
+        self, monkeypatch
+    ):
+        """40001 retries even when raised from a disconnect-shaped driver
+        class (is_retryable checks the code before the class)."""
+        b = self._backend(monkeypatch)
+        assert b.is_retryable(_FakePgError("40001"))
+
+
+# ---------------------------------------------------------------------------
+# the tracker state machine
+
+
+class TestTrackerStateMachine:
+    def test_threshold_then_suspect(self):
+        t = DbHealthTracker(failure_threshold=3, suspect_dwell_s=60.0)
+        t.record_tx_failure()
+        t.record_tx_failure()
+        assert t.state() == DB_HEALTHY and not t.is_suspect()
+        t.record_tx_failure()
+        assert t.state() == DB_SUSPECT and t.is_suspect()
+        assert t.stats()["suspect_transitions"] == 1
+
+    def test_success_resets_the_consecutive_count(self):
+        t = DbHealthTracker(failure_threshold=3, suspect_dwell_s=60.0)
+        for _ in range(5):
+            t.record_tx_failure()
+            t.record_tx_failure()
+            t.record_tx_success()
+        assert t.state() == DB_HEALTHY
+        assert t.stats()["tx_failures_total"] == 10
+
+    def test_dwell_moves_suspect_to_probing(self):
+        t = DbHealthTracker(failure_threshold=1, suspect_dwell_s=0.05)
+        t.record_tx_failure()
+        assert t.state() == DB_SUSPECT
+        time.sleep(0.06)
+        assert t.state() == DB_PROBING
+        assert t.is_suspect(), "probing still gates fleet takeovers"
+
+    def test_failing_probe_restarts_the_dwell(self):
+        t = DbHealthTracker(failure_threshold=1, suspect_dwell_s=0.05)
+        t.record_tx_failure()
+        time.sleep(0.06)
+        assert t.state() == DB_PROBING
+        t.record_tx_failure()  # the probe failed
+        assert t.state() == DB_SUSPECT, "dwell restarted"
+
+    def test_commit_heals_and_opens_the_grace_window(self):
+        t = DbHealthTracker(failure_threshold=1, suspect_dwell_s=0.05)
+        t.record_tx_failure()
+        assert t.brownout_signal(10.0)
+        t.record_tx_success()
+        assert t.state() == DB_HEALTHY and not t.is_suspect()
+        # heal grace: still a brownout signal inside the window
+        assert t.recently_healed(10.0)
+        assert t.brownout_signal(10.0)
+        assert not t.recently_healed(0.0)
+
+    def test_never_suspected_has_no_heal_window(self):
+        t = DbHealthTracker(failure_threshold=1, suspect_dwell_s=0.05)
+        t.record_tx_success()
+        assert not t.recently_healed(10.0)
+        assert not t.brownout_signal(10.0)
+
+    def test_zero_threshold_disables(self):
+        t = DbHealthTracker(failure_threshold=0, suspect_dwell_s=0.05)
+        for _ in range(10):
+            t.record_tx_failure()
+        assert t.state() == DB_HEALTHY
+
+    def test_stats_shape(self):
+        t = DbHealthTracker(failure_threshold=1, suspect_dwell_s=60.0)
+        t.record_tx_failure()
+        s = t.stats()
+        assert s["state"] == DB_SUSPECT
+        assert s["suspected_age_s"] >= 0
+        assert s["failure_threshold"] == 1 and s["suspect_dwell_s"] == 60.0
+
+
+# ---------------------------------------------------------------------------
+# run_tx integration
+
+
+class TestRunTxIntegration:
+    def test_exhausted_retries_raise_unavailable_and_mark_suspect(self):
+        from janus_tpu.core import faults
+        from janus_tpu.core.faults import FaultSpec
+        from janus_tpu.datastore.datastore import DatastoreUnavailable
+
+        eph = EphemeralDatastore()
+        eph.datastore.max_transaction_retries = 3
+        tracker().configure(failure_threshold=3, suspect_dwell_s=60.0)
+        try:
+            faults.configure(
+                [FaultSpec("datastore.tx.begin", "error", 1.0)], seed=1
+            )
+            with pytest.raises(DatastoreUnavailable):
+                eph.datastore.run_tx("doomed", lambda tx: None)
+            assert tracker().state() == DB_SUSPECT
+            faults.clear()
+            # the next commit is the healing probe
+            eph.datastore.run_tx("probe", lambda tx: None)
+            assert tracker().state() == DB_HEALTHY
+            assert tracker().recently_healed(10.0)
+        finally:
+            faults.clear()
+            eph.cleanup()
+
+    def test_deadline_bounds_the_retry_loop(self):
+        """A lease-holding caller passes ``deadline_s`` so a brownout
+        surfaces in-band instead of after 30 exhausted backoffs."""
+        from janus_tpu.core import faults
+        from janus_tpu.core.faults import FaultSpec
+        from janus_tpu.datastore.datastore import DatastoreUnavailable
+
+        eph = EphemeralDatastore()
+        try:
+            faults.configure(
+                [FaultSpec("datastore.tx.begin", "error", 1.0)], seed=1
+            )
+            t0 = time.monotonic()
+            with pytest.raises(DatastoreUnavailable):
+                eph.datastore.run_tx("leased", lambda tx: None, deadline_s=0.2)
+            elapsed = time.monotonic() - t0
+            # well under the full 30-attempt budget (~8s of capped sleeps);
+            # generous ceiling for slow CI boxes
+            assert elapsed < 2.0, elapsed
+        finally:
+            faults.clear()
+            eph.cleanup()
+
+    def test_permanent_errors_do_not_feed_the_tracker(self):
+        eph = EphemeralDatastore()
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        try:
+
+            def boom(tx):
+                raise ValueError("a bug, not weather")
+
+            for _ in range(3):
+                with pytest.raises(ValueError):
+                    eph.datastore.run_tx("buggy", boom)
+            assert tracker().state() == DB_HEALTHY
+            assert tracker().stats()["tx_failures_total"] == 0
+        finally:
+            eph.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# migration-storm suppression (core/fleet.py)
+
+
+class TestMigrationSuppression:
+    def _seed(self, eds, n_tasks=6, **kw):
+        """Two routers, both live, one unsuppressed refresh to seed the
+        frozen view + the staleness baseline.  Returns (ds, clock, r0, r1,
+        r0's task ids as seen excluded by r1)."""
+        ds = eds.datastore
+        clock = eds.clock if hasattr(eds, "clock") else None
+        _put_tasks(ds, n_tasks)
+        kw.setdefault("heartbeat_ttl_s", 10.0)
+        kw.setdefault("takeover_grace_s", 0.0)
+        r0 = FleetRouter("sup-0", "aggregation", **kw)
+        r1 = FleetRouter("sup-1", "aggregation", **kw)
+        ds.run_tx("hb0", r0.heartbeat)
+        ds.run_tx("hb1", r1.heartbeat)
+        ex1 = set(ds.run_tx("v", lambda tx: r1.not_owned_task_ids(tx) or []))
+        assert ex1, "rendezvous should give sup-0 at least one of 6 tasks"
+        return ds, r0, r1, ex1
+
+    def test_datastore_suspect_freezes_the_view(self, eds):
+        ds, r0, r1, ex1 = self._seed(eds)
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        # r0 goes heartbeat-stale — exactly what a brownout fakes
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb1b", r1.heartbeat)
+        # the failure lands AFTER r1's heartbeat commit (a committing tx
+        # heals the tracker — exactly as in production, where a brownout
+        # fails the heartbeats too)
+        tracker().record_tx_failure()
+        frozen = set(ds.run_tx("v2", lambda tx: r1.not_owned_task_ids(tx) or []))
+        assert frozen == ex1, "ownership view must not move while suspect"
+        s = r1.stats()
+        assert s["suppressed"] and s["suppress_reason"] == "datastore_suspect"
+        assert s["suppressed_refreshes_total"] >= 1
+        assert s["migrations_total"] == 0
+
+    def test_thaw_needs_a_full_ttl_of_confirmation(self, eds):
+        """After the tracker heals, a peer that was only brownout-shadow
+        stale heartbeats again inside the confirmation TTL and never
+        migrates."""
+        ds, r0, r1, ex1 = self._seed(eds)
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb1b", r1.heartbeat)
+        tracker().record_tx_failure()
+        assert set(ds.run_tx("v2", lambda tx: r1.not_owned_task_ids(tx) or [])) == ex1
+        # v2's commit healed the tracker; the FIRST healthy refresh starts
+        # (not completes) the confirmation window
+        assert tracker().state() == DB_HEALTHY
+        frozen = set(ds.run_tx("v3", lambda tx: r1.not_owned_task_ids(tx) or []))
+        assert frozen == ex1, "thaw confirmation still serves the frozen view"
+        # the shadow-stale peer recovers within the window
+        ds.run_tx("hb0b", r0.heartbeat)
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb0c", r0.heartbeat)
+        ds.run_tx("hb1c", r1.heartbeat)
+        ex_after = set(ds.run_tx("v4", lambda tx: r1.not_owned_task_ids(tx) or []))
+        assert ex_after == ex1, "nothing migrated: the staleness was shadow"
+        s = r1.stats()
+        assert not s["suppressed"]
+        assert s["migrations_total"] == 0
+
+    def test_thaw_with_a_genuinely_dead_peer_migrates_for_real(self, eds):
+        ds, r0, r1, ex1 = self._seed(eds)
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb1b", r1.heartbeat)
+        tracker().record_tx_failure()
+        ds.run_tx("v2", lambda tx: r1.not_owned_task_ids(tx))
+        # v2's commit healed the tracker
+        # confirmation window: r0 stays silent — it really is dead
+        ds.run_tx("v3", lambda tx: r1.not_owned_task_ids(tx))
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb1c", r1.heartbeat)
+        ex_after = ds.run_tx("v4", lambda tx: r1.not_owned_task_ids(tx))
+        assert not ex_after, "sole survivor absorbs everything"
+        s = r1.stats()
+        assert not s["suppressed"]
+        assert s["migrations_total"] == len(ex1)
+
+    def test_mass_staleness_quorum_triggers_without_local_failures(self, eds):
+        """Even when this replica's own transactions sail through, >half
+        of previously-live peers going stale at once is the correlated
+        signature and freezes the view."""
+        ds = eds.datastore
+        _put_tasks(ds, 8)
+        routers = [
+            FleetRouter(f"ms-{i}", "aggregation", heartbeat_ttl_s=10.0,
+                        takeover_grace_s=0.0)
+            for i in range(4)
+        ]
+        for i, r in enumerate(routers):
+            ds.run_tx(f"hb{i}", r.heartbeat)
+        survivor = routers[3]
+        ex = set(ds.run_tx("v", lambda tx: survivor.not_owned_task_ids(tx) or []))
+        # three peers go stale simultaneously (3/3 > 0.5, plural)
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb3", survivor.heartbeat)
+        frozen = set(
+            ds.run_tx("v2", lambda tx: survivor.not_owned_task_ids(tx) or [])
+        )
+        assert frozen == ex
+        s = survivor.stats()
+        assert s["suppressed"] and s["suppress_reason"] == "mass_staleness"
+        assert s["migrations_total"] == 0
+
+    def test_single_dead_peer_is_a_takeover_not_a_storm(self, eds):
+        """The plural-staleness floor: in a 2-replica fleet one stale peer
+        is 100%% of others, but a storm needs >= 2 — the normal
+        single-failure takeover proceeds."""
+        ds, r0, r1, ex1 = self._seed(eds)
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb1b", r1.heartbeat)
+        ex_after = ds.run_tx("v2", lambda tx: r1.not_owned_task_ids(tx))
+        assert not ex_after, "survivor takes over immediately"
+        s = r1.stats()
+        assert not s["suppressed"]
+        assert s["migrations_total"] == len(ex1)
+
+    def test_cold_start_under_suspicion_computes_normally(self, eds):
+        """No frozen view yet (first refresh ever): nothing useful to
+        serve, so the router computes live even while suspect — and that
+        refresh seeds the view for the next one."""
+        ds = eds.datastore
+        _put_tasks(ds, 4)
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        tracker().record_tx_failure()
+        r0 = FleetRouter("cold-0", "aggregation")
+        ds.run_tx("hb", r0.heartbeat)
+        ds.run_tx("v", lambda tx: r0.not_owned_task_ids(tx))
+        s = r0.stats()
+        assert not s["suppressed"]
+        assert s["tasks_owned"] == 4
+
+    def test_suppressed_refreshes_are_counted_on_metrics(self, eds):
+        from janus_tpu.core.metrics import GLOBAL_METRICS
+
+        ds, r0, r1, ex1 = self._seed(eds)
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        tracker().record_tx_failure()
+        eds.clock.advance(Duration(11))
+        ds.run_tx("hb1b", r1.heartbeat)
+        ds.run_tx("v2", lambda tx: r1.not_owned_task_ids(tx))
+        if GLOBAL_METRICS.registry is not None:
+            text = GLOBAL_METRICS.export().decode()
+            assert "janus_fleet_migration_suppressed_total" in text
+
+
+# ---------------------------------------------------------------------------
+# consumer gates
+
+
+class TestConsumerGates:
+    def test_upload_shed_strictly_on_suspect(self):
+        from janus_tpu.aggregator.aggregator import Aggregator
+        from janus_tpu.aggregator.error import UploadShed
+
+        tracker().configure(failure_threshold=1, suspect_dwell_s=0.05)
+        Aggregator._shed_if_datastore_suspect()  # healthy: no-op
+        tracker().record_tx_failure()
+        with pytest.raises(UploadShed) as ei:
+            Aggregator._shed_if_datastore_suspect()
+        assert ei.value.status == 503 and ei.value.retry_after
+        # probing uploads are the probe: admitted
+        time.sleep(0.06)
+        assert tracker().state() == DB_PROBING
+        Aggregator._shed_if_datastore_suspect()
+
+    def test_janitors_skip_while_non_healthy(self):
+        import asyncio
+
+        from janus_tpu.aggregator.garbage_collector import GarbageCollector
+        from janus_tpu.aggregator.key_rotator import HpkeKeyRotator
+
+        class _Untouchable:
+            """Any datastore call while gated is the bug being tested for."""
+
+            def __getattr__(self, name):
+                raise AssertionError(f"janitor touched the datastore: {name}")
+
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        tracker().record_tx_failure()
+        gc = GarbageCollector(_Untouchable())
+        assert asyncio.run(gc.run_once()) == 0
+        rot = HpkeKeyRotator(_Untouchable())
+        rot.run_sync()
+        asyncio.run(rot.run())
+
+    def test_janitor_skips_counted(self):
+        from janus_tpu.core.db_health import janitor_skip
+        from janus_tpu.core.metrics import GLOBAL_METRICS
+
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        assert not janitor_skip("gc")
+        tracker().record_tx_failure()
+        assert janitor_skip("gc") and janitor_skip("key_rotator")
+        if GLOBAL_METRICS.registry is not None:
+            text = GLOBAL_METRICS.export().decode()
+            assert "janus_janitor_skips_total" in text
+
+    def test_janitors_run_again_after_heal(self, eds):
+        import asyncio
+
+        from janus_tpu.aggregator.key_rotator import HpkeKeyRotator
+
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        tracker().record_tx_failure()
+        rot = HpkeKeyRotator(eds.datastore)
+        rot.run_sync()  # gated no-op
+        assert not eds.datastore.run_tx(
+            "peek", lambda tx: tx.get_global_hpke_keypairs()
+        )
+        tracker().record_tx_success()
+        asyncio.run(rot.run())
+        keys = eds.datastore.run_tx("get", lambda tx: tx.get_global_hpke_keypairs())
+        assert len(keys) == 1, "healed rotator bootstraps the first key"
+
+    def test_statusz_carries_the_tracker_section(self):
+        from janus_tpu.core.statusz import runtime_status
+
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        tracker().record_tx_failure()
+        doc = runtime_status()
+        assert doc["datastore"]["state"] == DB_SUSPECT
+        assert doc["datastore"]["suspect_transitions"] == 1
+
+    def test_sampler_republishes_the_gauge_even_when_wedged(self):
+        """The republish runs BEFORE the sampler's datastore query: a
+        wedged datastore (the exact moment the suspect gauge matters)
+        still gets the time-driven state refreshed."""
+        from janus_tpu.core.metrics import GLOBAL_METRICS
+        from janus_tpu.core.statusz import sample_status_metrics
+        from janus_tpu.datastore.datastore import DatastoreUnavailable
+
+        class _Wedged:
+            def run_tx(self, name, fn, deadline_s=None):
+                raise DatastoreUnavailable("browned out")
+
+        tracker().configure(failure_threshold=1, suspect_dwell_s=60.0)
+        tracker().record_tx_failure()
+        with pytest.raises(DatastoreUnavailable):
+            sample_status_metrics(_Wedged())
+        if GLOBAL_METRICS.registry is not None:
+            text = GLOBAL_METRICS.export().decode()
+            assert 'janus_datastore_health{state="suspect"} 1.0' in text
+
+    def test_cost_report_datastore_section(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+        import cost_report
+
+        statusz = {
+            "pid": 1,
+            "uptime_s": 10.0,
+            "datastore": {
+                "state": "suspect",
+                "tx_failures_total": 4,
+                "suspect_transitions": 1,
+            },
+        }
+        metrics_text = "\n".join(
+            [
+                "janus_datastore_tx_retries_total 4.0",
+                "janus_fleet_migration_suppressed_total 2.0",
+                'janus_upload_shed_total{reason="datastore"} 3.0',
+            ]
+        )
+        report = cost_report.build_report(statusz, metrics_text)
+        ds = report["datastore"]
+        assert ds["state"] == "suspect"
+        assert ds["tx_retries"] == 4
+        assert ds["migrations_suppressed"] == 2
+        assert ds["upload_sheds"] == {"datastore": 3}
+        rendered = cost_report.render(report)
+        assert "state=suspect" in rendered

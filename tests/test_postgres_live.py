@@ -289,3 +289,49 @@ def test_fleet_stale_heartbeat_migration(pg_datastore):
         ),
     )
     assert len(got) == len(tasks)
+
+
+# ---------------------------------------------------------------------------
+# datastore brownout tolerance against live Postgres (ISSUE 17 satellite:
+# the disconnect classification + eviction path must recover on a real
+# server-side connection kill, not just fake sqlstate shapes)
+
+
+def test_connection_drop_is_classified_evicted_and_recovered(pg_datastore):
+    """pg_terminate_backend kills this handle's server process mid-use:
+    the next transaction's failure is disconnect-shaped (is_disconnect),
+    run_tx evicts the dead connection, reconnects, retries, and commits —
+    one transparent recovery, with the health tracker fed exactly one
+    transient failure and healed by the committing retry."""
+    from janus_tpu.core.db_health import tracker
+
+    ds, key, clock = pg_datastore
+    task = _make_task()
+    ds.run_tx("put", lambda tx: tx.put_aggregator_task(task))
+
+    victim_pid = ds.run_tx(
+        "pid", lambda tx: tx.conn.execute("SELECT pg_backend_pid()").fetchone()[0]
+    )
+    ds2 = Datastore(DSN, Crypter([key]), clock)
+    try:
+        ds2.run_tx(
+            "kill",
+            lambda tx: tx.conn.execute(
+                "SELECT pg_terminate_backend(?)", (victim_pid,)
+            ).fetchone(),
+        )
+        tracker().configure(failure_threshold=3, suspect_dwell_s=60.0)
+        # the terminated socket surfaces on the next BEGIN/statement;
+        # run_tx must absorb it (evict + reconnect + retry) and commit
+        got = ds.run_tx("recover", lambda tx: tx.get_aggregator_task(task.task_id))
+        assert got is not None and got.task_id == task.task_id
+        new_pid = ds.run_tx(
+            "pid2",
+            lambda tx: tx.conn.execute("SELECT pg_backend_pid()").fetchone()[0],
+        )
+        assert new_pid != victim_pid, "dead connection was not evicted"
+        stats = tracker().stats()
+        assert stats["tx_failures_total"] >= 1, "disconnect never fed the tracker"
+        assert stats["state"] == "healthy", "the committing retry must heal"
+    finally:
+        ds2.close()

@@ -10,7 +10,9 @@ Renders "which task is burning the chip" from one live replica's
   uptime, and mean executor queue delay;
 * per bucket: pad-waste%% — mask-padded rows (pow2 canonicalization +
   mesh tails) as a share of everything the chip computed for the bucket;
-* the flight-recorder digest: ring occupancy and dump counts.
+* the flight-recorder digest: ring occupancy and dump counts;
+* the datastore brownout rollup: tracker state, transient tx retries,
+  suppressed fleet migrations, and upload sheds per reason.
 
 Usage::
 
@@ -133,6 +135,25 @@ def build_report(statusz: dict, metrics_text: str) -> dict:
         k: v for k, v in (ex.get("flights") or {}).items() if k != "records"
     } or None
     report["cost_attribution"] = ex.get("cost_attribution")
+
+    # -- datastore brownout rollup (ISSUE 17) -----------------------------
+    ds = statusz.get("datastore") or {}
+    sheds = {
+        dict(labels).get("reason", "?"): int(v)
+        for labels, v in samples.get("janus_upload_shed_total", {}).items()
+    }
+    report["datastore"] = {
+        "state": ds.get("state"),
+        "tx_failures_total": ds.get("tx_failures_total"),
+        "suspect_transitions": ds.get("suspect_transitions"),
+        "tx_retries": int(
+            sum(samples.get("janus_datastore_tx_retries_total", {}).values())
+        ),
+        "migrations_suppressed": int(
+            sum(samples.get("janus_fleet_migration_suppressed_total", {}).values())
+        ),
+        "upload_sheds": sheds or None,
+    }
     return report
 
 
@@ -176,6 +197,18 @@ def render(report: dict) -> str:
         lines.append(f"  flight recorder: {report['flights']}")
     if report["cost_attribution"]:
         lines.append(f"  attribution ledger: {report['cost_attribution']}")
+    ds = report.get("datastore") or {}
+    if ds.get("state") is not None:
+        sheds = ds.get("upload_sheds")
+        lines.append(
+            "  datastore: state=%s tx_retries=%d suppressed_migrations=%d sheds=%s"
+            % (
+                ds["state"],
+                ds.get("tx_retries") or 0,
+                ds.get("migrations_suppressed") or 0,
+                sheds if sheds else "-",
+            )
+        )
     return "\n".join(lines)
 
 
