@@ -1542,6 +1542,133 @@ def run_upload_frontdoor_config(args, scaled: bool = False) -> dict:
             result["error"] = "loadgen pass breached its SLO or shed"
     except Exception as e:  # the opens/s halves still record
         result["loadgen_skipped"] = f"{type(e).__name__}: {str(e)[:200]}"
+
+    # -- ISSUE 18: upload -> first-prepare A/B (journaled vs synchronous)
+    # The zero-copy ingest unit: the SAME sealed reports through both
+    # ingest modes, measuring upload-start -> first prepare-ready
+    # aggregation job.  Parity-fenced first: journaled materialization
+    # must store byte-identical rows before any latency is recorded.
+    try:
+        import sqlite3 as _sqlite3
+
+        from janus_tpu.aggregator import (
+            AggregationJobCreator,
+            Aggregator,
+            Config,
+            CreatorConfig,
+        )
+        from janus_tpu.core.time import MockClock
+        from janus_tpu.datastore.test_util import EphemeralDatastore
+
+        from test_aggregator_handlers import NOW as _NOW
+        from test_aggregator_handlers import make_pair_tasks as _make_pair
+        from test_upload_frontdoor import _reports, _stored_rows
+
+        B2 = 32 if scaled else 128
+        leader2, helper2, _ = _make_pair({"type": "Prio3Count"})
+        sealed = _reports(leader2, helper2, B2)
+
+        def _agg(mode, stage_direct):
+            eds = EphemeralDatastore(MockClock(_NOW))
+            eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader2))
+            agg = Aggregator(
+                eds.datastore,
+                eds.clock,
+                Config(
+                    vdaf_backend="oracle",
+                    upload_open_backend="batched",
+                    upload_open_batch_delay=0.002,
+                    ingest_mode=mode,
+                    ingest_journal_write_delay=0.002,
+                    ingest_stage_direct=stage_direct,
+                ),
+            )
+            return eds, agg
+
+        async def _upload_all(agg):
+            await asyncio.gather(
+                *(agg.handle_upload(leader2.task_id, r) for r in sealed)
+            )
+
+        # parity fence (stage off so journaled rows MATERIALIZE instead
+        # of scrubbing): decrypted stored rows must match bit-for-bit
+        rows = {}
+        for mode in ("synchronous", "journaled"):
+            eds, agg = _agg(mode, stage_direct=False)
+            loop = asyncio.new_event_loop()
+            try:
+                loop.run_until_complete(_upload_all(agg))
+                loop.run_until_complete(agg.shutdown())
+                if agg.ingest is not None:
+                    loop.run_until_complete(agg.ingest.drain())
+                rows[mode] = _stored_rows(eds.datastore, leader2.task_id)
+            finally:
+                loop.close()
+                eds.cleanup()
+        if rows["journaled"] != rows["synchronous"] or len(rows["journaled"]) != B2:
+            result["error"] = "journaled materialization parity broke"
+            return result
+
+        def _packed(path):
+            conn = _sqlite3.connect(path)
+            try:
+                return conn.execute(
+                    "SELECT COUNT(*) FROM report_aggregations"
+                ).fetchone()[0]
+            finally:
+                conn.close()
+
+        async def _first_prepare_ms(mode):
+            eds, agg = _agg(mode, stage_direct=True)
+            creator = AggregationJobCreator(
+                eds.datastore,
+                CreatorConfig(
+                    min_aggregation_job_size=1,
+                    max_aggregation_job_size=256,
+                    journal_replay_min_age_s=0.0,
+                ),
+            )
+            try:
+                t0 = time.monotonic()
+                await _upload_all(agg)
+                first = None
+                for _ in range(200):
+                    if agg.ingest is not None:
+                        # the zero-copy handoff: staged cohorts pack with
+                        # no client_reports read-back
+                        await creator.run_staged_once(agg.ingest)
+                    else:
+                        await creator.run_once()
+                    n = _packed(eds.path)
+                    if first is None and n > 0:
+                        first = time.monotonic()
+                    if n >= B2:
+                        break
+                    if agg.ingest is not None:
+                        await agg.ingest.materialize_once(1024)
+                        await creator.run_once()
+                assert _packed(eds.path) >= B2, "A/B never packed every report"
+                await agg.shutdown()
+                if agg.ingest is not None:
+                    await agg.ingest.drain()
+                return round((first - t0) * 1000, 2)
+            finally:
+                eds.cleanup()
+
+        ab = {}
+        for mode in ("synchronous", "journaled"):
+            loop = asyncio.new_event_loop()
+            try:
+                ab[mode] = loop.run_until_complete(_first_prepare_ms(mode))
+            finally:
+                loop.close()
+        result["upload_to_first_prepare_ms"] = ab
+        result["first_prepare_ab_reports"] = B2
+        result["first_prepare_journaled_vs_synchronous"] = round(
+            ab["synchronous"] / ab["journaled"], 2
+        )
+    except Exception as e:  # the opens/s + loadgen halves still record
+        result["ingest_ab_skipped"] = f"{type(e).__name__}: {str(e)[:200]}"
     return result
 
 

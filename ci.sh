@@ -131,6 +131,16 @@
 #                      runs only the scaled-down in-process smoke plus
 #                      the front-door unit suite (batched-open parity,
 #                      shed paths, flush-race regression).
+#   ./ci.sh ingest     zero-copy ingest gate (ISSUE 18): the write-behind
+#                      report-journal unit/e2e suite (tests/test_ingest.py —
+#                      journaled-vs-synchronous byte parity, ACK-before-
+#                      materialize durability, replay idempotence, the
+#                      direct-staging handoff, GC/journal coexistence,
+#                      wedged-writer sheds, the loadgen first-prepare
+#                      percentile math) plus the binary-level journaled
+#                      crash case (SIGKILL between ACK and materialization
+#                      with GC running -> replay exactly once, duplicate
+#                      re-uploads absorbed, decoy proves GC live).
 #   ./ci.sh benchdiff  bench-trajectory regression gate (ISSUE 12): runs
 #                      tools/bench_compare.py over the checked-in
 #                      BENCH_r*.json rows (newest run vs best prior per
@@ -320,6 +330,15 @@ case "$tier" in
     RUN_SLOW=1 exec python -m pytest tests/test_load_soak.py \
       tests/test_upload_frontdoor.py -q
     ;;
+  ingest)
+    # Zero-copy ingest gate (ISSUE 18).  The fast suite runs everywhere;
+    # the journaled SIGKILL-mid-flush crash case spawns real binaries and
+    # is slow-marked, so RUN_SLOW pulls it in here without touching the
+    # tier-1 budget.
+    python -m pytest tests/test_ingest.py -q
+    RUN_SLOW=1 exec python -m pytest tests/test_crash_chaos.py -q \
+      -k journaled_ingest
+    ;;
   benchdiff)
     # Bench-trajectory regression gate (ISSUE 12).  Two halves: (1) the
     # checked-in trajectory must pass (neutral rows — structured skips,
@@ -377,7 +396,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|coldstart|fpvec|obs|load|load fast|benchdiff|fleet|postgres|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|coldstart|fpvec|obs|load|load fast|ingest|benchdiff|fleet|postgres|dryrun]" >&2
     exit 2
     ;;
 esac

@@ -120,6 +120,22 @@ class Config:
     #: open has waited upload_shed_delay_s.  <= 0 disables either signal.
     upload_queue_max: int = 1024
     upload_shed_delay_s: float = 2.0
+    #: Ingest mode (ISSUE 18): "synchronous" commits every report through
+    #: the legacy ReportWriteBatcher put_client_report path (the
+    #: bit-for-bit default); "journaled" ACKs uploads on the write-behind
+    #: report journal and hands opened shares directly to the aggregation
+    #: pipeline's staging side (core/ingest.py IngestPlane).
+    ingest_mode: str = "synchronous"
+    #: journal-writer size/delay/bound (the ReportWriteBatcher pattern;
+    #: queue_max is the reason="journal" admission bound)
+    ingest_journal_batch_size: int = 100
+    ingest_journal_write_delay: float = 0.05
+    ingest_journal_queue_max: int = 2048
+    #: direct staging: hand journaled cohorts to the in-process creator
+    #: (False = journal-only write-behind; everything reaches aggregation
+    #: through the materializer's read-back path)
+    ingest_stage_direct: bool = True
+    ingest_stage_max_reports: int = 4096
     batch_aggregation_shard_count: int = 8
     task_counter_shard_count: int = 8
     task_cache_ttl: float = 30.0
@@ -243,6 +259,27 @@ class Aggregator:
             max_queue=self.config.upload_queue_max,
             shed_delay_s=self.config.upload_shed_delay_s,
         )
+        # Zero-copy ingest plane (ISSUE 18): in journaled mode the upload
+        # write seam becomes the write-behind report journal + direct
+        # staging handoff; synchronous keeps the legacy writer bit-for-bit.
+        if self.config.ingest_mode not in ("synchronous", "journaled"):
+            raise ValueError(
+                f"unknown ingest_mode {self.config.ingest_mode!r} "
+                f"(synchronous|journaled)"
+            )
+        self.ingest = None
+        if self.config.ingest_mode == "journaled":
+            from ..core.ingest import IngestPlane
+
+            self.ingest = IngestPlane(
+                datastore,
+                max_batch_size=self.config.ingest_journal_batch_size,
+                max_write_delay=self.config.ingest_journal_write_delay,
+                queue_max=self.config.ingest_journal_queue_max,
+                counter_shard_count=self.config.task_counter_shard_count,
+                stage_direct=self.config.ingest_stage_direct,
+                stage_max_reports=self.config.ingest_stage_max_reports,
+            )
         # Helper-side executor routing: share the process-wide continuous
         # batcher (and its per-shape circuit breakers) with the drivers.
         #: canonical keys whose twin backend failed to build (negative
@@ -413,6 +450,11 @@ class Aggregator:
             # cheapest correct answer is the retryable 503.
             self._shed_if_datastore_suspect()
             self.upload_opener.admit()
+            # Journaled-mode backpressure composes here (ISSUE 18): a
+            # slow journal writer surfaces as reason="journal" sheds at
+            # the same pre-crypto gate, never as unbounded memory.
+            if self.ingest is not None:
+                self.ingest.admit()
             ta = await self.task_aggregator_for(task_id)
             task = ta.task
             if task.role != Role.LEADER:
@@ -453,7 +495,32 @@ class Aggregator:
             except ReportRejection as rej:
                 await self.report_writer.write_rejection(task_id, rej)
                 raise rej.to_error()
-            await self.report_writer.write_report(stored)
+            if self.ingest is not None:
+                # journaled: the ACK resolves when the journal row is
+                # durable; the opened share rides to the staging side
+                # without a put_client_report round-trip
+                await self.ingest.submit(
+                    stored, shape_key=self._ingest_shape_key(ta)
+                )
+            else:
+                await self.report_writer.write_report(stored)
+
+    @staticmethod
+    def _ingest_shape_key(ta: TaskAggregator):
+        """Staging bucket identity for the ingest plane: the task's vdaf
+        shape (the executor's bucketing axis), or None for cohorts the
+        direct path cannot consume — agg-param VDAFs (jobs come from
+        collection requests) and FixedSize tasks (jobs come from
+        outstanding-batch filling) journal and reach aggregation through
+        the materializer instead."""
+        if ta.task.query_type.kind != "TimeInterval":
+            return None
+        if getattr(ta.vdaf, "REQUIRES_AGG_PARAM", False):
+            return None
+        return (
+            type(ta.vdaf).__name__,
+            tuple(sorted((k, repr(v)) for k, v in ta.task.vdaf.items())),
+        )
 
     def _validate_report_pre_open(self, ta: TaskAggregator, report: Report):
         """The CHEAP upload checks, run inline before the open is queued:

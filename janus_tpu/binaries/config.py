@@ -387,6 +387,63 @@ class JobDriverConfig:
 
 
 @dataclass
+class IngestConfig:
+    """Zero-copy ingest plane (core/ingest.py, ISSUE 18).  Mode
+    ``synchronous`` (the default) keeps the legacy write path bit-for-bit:
+    every upload commits its client_reports row inline via the
+    ReportWriteBatcher before the 200 is sent.  Mode ``journaled`` flips
+    the front door to the write-behind report journal::
+
+        ingest:
+          mode: journaled
+          journal_batch_size: 100
+          journal_write_delay_ms: 50
+          journal_queue_max: 2048
+          stage_direct: true
+          stage_max_reports: 4096
+          staged_consume_interval_ms: 250
+          materialize_interval_ms: 1000
+          materialize_batch_size: 256
+
+    Durability contract: an upload is ACKed only after its journal row is
+    durable — write-behind defers the client_reports MATERIALIZATION (the
+    aggregation-visible copy), never the ACK.  Freshly journaled reports
+    are additionally staged in-process, pre-bucketed by (task, vdaf
+    shape), and the embedded staged consumer packs them straight into
+    aggregation jobs without the creator's read-back round-trip.
+    """
+
+    #: "synchronous" | "journaled"
+    mode: str = "synchronous"
+    #: journal-writer flush trigger: rows per flush tx / max delay a
+    #: report waits for co-batching before its flush fires anyway
+    journal_batch_size: int = 100
+    journal_write_delay_ms: int = 50
+    #: admission bound on queued+in-flight journal writes: past it the
+    #: front door sheds 503s (janus_upload_shed_total{reason="journal"})
+    #: instead of queueing unboundedly behind a slow journal writer
+    journal_queue_max: int = 2048
+    #: hand freshly journaled reports straight to the in-process staged
+    #: consumer (false = journal only; the materializer read-back path
+    #: carries everything)
+    stage_direct: bool = True
+    #: staged-buffer bound (reports across all cohorts); beyond it fresh
+    #: reports fall back to the read-back path, never unbounded memory
+    stage_max_reports: int = 4096
+    #: embedded staged-consumer cadence (aggregator binary): how often
+    #: staged cohorts are packed into aggregation jobs
+    staged_consume_interval_ms: int = 250
+    #: background materializer cadence + per-pass row bound: the
+    #: write-behind half that folds journal rows into client_reports
+    materialize_interval_ms: int = 1000
+    materialize_batch_size: int = 256
+    #: staged job sizing (mirrors JobCreatorConfig min/max): cohorts
+    #: below min stay journaled for the periodic creator to fold in
+    staged_min_job_size: int = 10
+    staged_max_job_size: int = 256
+
+
+@dataclass
 class AggregatorConfig:
     common: CommonConfig = field(default_factory=CommonConfig)
     listen_address: str = "0.0.0.0:8080"
@@ -405,6 +462,10 @@ class AggregatorConfig:
     #: janus_upload_shed_total) instead of drowning the event loop.
     upload_queue_max: int = 1024
     upload_shed_delay_s: float = 2.0
+    #: Zero-copy ingest plane (ISSUE 18): write-behind report journal +
+    #: direct upload->staging handoff; mode "synchronous" is the
+    #: bit-for-bit legacy default.
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     batch_aggregation_shard_count: int = 8
     task_counter_shard_count: int = 8
     #: "tpu" routes whole-job prepare through one batched device launch.
@@ -447,6 +508,11 @@ class JobCreatorConfig:
     min_aggregation_job_size: int = 10
     max_aggregation_job_size: int = 256
     batch_aggregation_shard_count: int = 8
+    #: Report-journal replay grace (ISSUE 18): journal rows younger than
+    #: this are left for the upload replica's direct staged consumer —
+    #: replaying them here is safe (delete-linearized) but wastes the
+    #: zero-copy handoff.  0 replays everything immediately.
+    journal_replay_min_age_s: float = 5.0
 
 
 @dataclass
@@ -495,6 +561,7 @@ def _merge_dataclass(cls, data: dict):
             FaultInjectionConfig,
             FleetConfig,
             DatastoreHealthConfig,
+            IngestConfig,
         )
     }
     kwargs = {}

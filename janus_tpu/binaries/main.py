@@ -411,6 +411,12 @@ def run_aggregator(config_path: Optional[str]) -> None:
             upload_open_batch_delay=cfg.upload_open_batch_delay_ms / 1000.0,
             upload_queue_max=cfg.upload_queue_max,
             upload_shed_delay_s=cfg.upload_shed_delay_s,
+            ingest_mode=cfg.ingest.mode,
+            ingest_journal_batch_size=cfg.ingest.journal_batch_size,
+            ingest_journal_write_delay=cfg.ingest.journal_write_delay_ms / 1000.0,
+            ingest_journal_queue_max=cfg.ingest.journal_queue_max,
+            ingest_stage_direct=cfg.ingest.stage_direct,
+            ingest_stage_max_reports=cfg.ingest.stage_max_reports,
             batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
             task_counter_shard_count=cfg.task_counter_shard_count,
             vdaf_backend=cfg.vdaf_backend,
@@ -454,6 +460,57 @@ def run_aggregator(config_path: Optional[str]) -> None:
         sampler = _start_status_sampler(stop, datastore, cfg.common)
         if sampler is not None:
             tasks.append(sampler)
+        if agg.ingest is not None:
+            # Zero-copy ingest plane (ISSUE 18).  Startup replay FIRST: a
+            # previous journaled incarnation's ACKed-but-unmaterialized
+            # rows become client_reports rows before traffic lands, so a
+            # crash between ACK and flush loses nothing.
+            from ..core.ingest import replay_report_journal
+
+            replayed = await replay_report_journal(datastore)
+            if replayed:
+                logger.info(
+                    "report-journal replay materialized %d report(s)", replayed
+                )
+            # The embedded staged consumer: packs direct-staged cohorts
+            # into aggregation jobs without the creator's read-back
+            # round-trip.  Sizing mirrors the standalone creator's knobs.
+            from ..aggregator import AggregationJobCreator, CreatorConfig
+
+            staged_creator = AggregationJobCreator(
+                datastore,
+                CreatorConfig(
+                    min_aggregation_job_size=cfg.ingest.staged_min_job_size,
+                    max_aggregation_job_size=cfg.ingest.staged_max_job_size,
+                    batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+                ),
+            )
+
+            async def staged_pass():
+                await staged_creator.run_staged_once(agg.ingest)
+
+            tasks.append(
+                asyncio.ensure_future(
+                    periodic(
+                        "staged consumer",
+                        staged_pass,
+                        max(0.01, cfg.ingest.staged_consume_interval_ms / 1000.0),
+                    )
+                )
+            )
+
+            async def materialize_pass():
+                await agg.ingest.materialize_once(cfg.ingest.materialize_batch_size)
+
+            tasks.append(
+                asyncio.ensure_future(
+                    periodic(
+                        "ingest materializer",
+                        materialize_pass,
+                        max(0.01, cfg.ingest.materialize_interval_ms / 1000.0),
+                    )
+                )
+            )
         if cfg.garbage_collection_interval_s:
             gc = GarbageCollector(datastore)
             tasks.append(
@@ -481,6 +538,10 @@ def run_aggregator(config_path: Optional[str]) -> None:
         for t in tasks:
             t.cancel()
         await agg.shutdown()
+        if agg.ingest is not None:
+            # flush queued journal writes, then fold the journal backlog
+            # into client_reports; anything left is crash-replay's job
+            await agg.ingest.drain()
         if cfg.device_executor.enabled:
             # This binary owns the process-wide executor: flush pending
             # mega-batches, then spill any resident accumulator state
@@ -514,6 +575,7 @@ def run_aggregation_job_creator(config_path: Optional[str]) -> None:
             min_aggregation_job_size=cfg.min_aggregation_job_size,
             max_aggregation_job_size=cfg.max_aggregation_job_size,
             batch_aggregation_shard_count=cfg.batch_aggregation_shard_count,
+            journal_replay_min_age_s=cfg.journal_replay_min_age_s,
         ),
     )
 

@@ -118,6 +118,11 @@ KNOWN_POINTS = (
     # survivor re-deriving a dead replica's un-drained shares must itself
     # be crash-safe (the replay tx is the exactly-once point)
     "accumulator.replay",
+    # write-behind report journal (core/ingest.py, ISSUE 18): head of each
+    # journal-flush transaction — delay mode wedges the writer so the
+    # bounded queue backs up into reason="journal" sheds, error mode
+    # impersonates a commit failure fanned to every waiting ACK
+    "ingest.journal",
 )
 
 MODES = ("error", "delay", "hang", "skew", "blackhole", "reset", "flap")

@@ -697,7 +697,7 @@ class Metrics:
         self.upload_sheds = Counter(
             "janus_upload_shed_total",
             "Uploads shed at the front-door queue (503 + Retry-After) by "
-            "reason (queue_full|queue_delay|datastore)",
+            "reason (queue_full|queue_delay|datastore|journal)",
             ["reason"],
             registry=self.registry,
         )
@@ -722,6 +722,40 @@ class Metrics:
         self.upload_queue_depth = Gauge(
             "janus_upload_queue_depth",
             "Front-door uploads pending in the batched HPKE-open queue",
+            registry=self.registry,
+        )
+        # -- zero-copy ingest plane (core/ingest.py, ISSUE 18) -----------
+        # The write-behind report journal: reports waiting on their
+        # durability-ACK journal flush (staged + in-flight — the bound the
+        # reason="journal" shed reads), how long each flush transaction
+        # takes, where staged reports went (direct = handed in-memory to
+        # the job creator's staging side; readback = materialized into
+        # client_reports and consumed through the classic read path), and
+        # rows replayed into client_reports after a crash or migration.
+        self.ingest_journal_depth = Gauge(
+            "janus_ingest_journal_depth",
+            "Reports pending their report-journal durability flush "
+            "(staged + in-flight)",
+            registry=self.registry,
+        )
+        self.ingest_journal_flush_seconds = Histogram(
+            "janus_ingest_journal_flush_seconds",
+            "Report-journal flush transaction wall time per batch",
+            registry=self.registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self.ingest_staged_total = Counter(
+            "janus_ingest_staged_reports_total",
+            "Journaled-ingest reports by aggregation-visibility path "
+            "(direct: staged cohort packed in-memory; readback: "
+            "materialized into client_reports for the classic read path)",
+            ["path"],
+            registry=self.registry,
+        )
+        self.ingest_journal_replayed = Counter(
+            "janus_ingest_journal_replayed_total",
+            "Report-journal rows materialized into client_reports by "
+            "replay (startup, creator pre-pass, or migration handoff)",
             registry=self.registry,
         )
         # -- SLO evaluation plane (core/slo.py) --------------------------

@@ -61,6 +61,10 @@ def runtime_status() -> dict:
         # counts per reason, and batch/open totals — the overload story
         # at a glance (None on binaries that serve no uploads)
         "upload": _upload_stats(),
+        # Zero-copy ingest plane (ISSUE 18): journal-writer depth/sheds,
+        # staged-cohort occupancy, and materializer totals — None in
+        # synchronous mode or on binaries that serve no uploads
+        "ingest": _ingest_stats(),
     }
 
     from ..executor import peek_global_executor
@@ -150,6 +154,18 @@ def _upload_stats():
         return {"error": "unavailable"}
 
 
+def _ingest_stats():
+    """Ingest-plane stats (core/ingest.py); failure-tolerant like every
+    other section."""
+    try:
+        from .ingest import ingest_stats
+
+        return ingest_stats()
+    except Exception:
+        logger.exception("ingest stats unavailable")
+        return {"error": "unavailable"}
+
+
 def _cost_stats() -> dict:
     """Per-task cost-attribution occupancy (core/costs.py); failure-
     tolerant like every other section."""
@@ -186,11 +202,14 @@ async def statusz_snapshot(datastore=None, clock=None) -> dict:
 
     def q(tx):
         count, oldest = tx.accumulator_journal_stats()
+        r_count, r_oldest = tx.report_journal_stats()
         # lease_summary carries the per-type 'acquirable' counts — it is
         # the single read-side source for the acquisition predicate
         return {
             "journal_rows": count,
             "journal_oldest": oldest,
+            "report_journal_rows": r_count,
+            "report_journal_oldest": r_oldest,
             "leases": tx.lease_summary(),
         }
 
@@ -201,6 +220,7 @@ async def statusz_snapshot(datastore=None, clock=None) -> dict:
         # process-local sections are exactly what the operator needs then
         logger.exception("statusz datastore sections unavailable")
         doc["journal"] = {"error": "datastore unavailable"}
+        doc["report_journal"] = {"error": "datastore unavailable"}
         doc["leases"] = {"error": "datastore unavailable"}
         return doc
     now_s = (clock or datastore.clock).now().seconds
@@ -208,6 +228,16 @@ async def statusz_snapshot(datastore=None, clock=None) -> dict:
     doc["journal"] = {
         "outstanding_rows": shared["journal_rows"],
         "oldest_age_s": max(0, now_s - oldest) if oldest is not None else None,
+    }
+    # report journal (ISSUE 18): ACKed-but-unmaterialized reports.  A
+    # rising oldest-age means the materializer stopped (or a journaled
+    # replica died and nothing has replayed its rows yet).
+    r_oldest = shared["report_journal_oldest"]
+    doc["report_journal"] = {
+        "outstanding_rows": shared["report_journal_rows"],
+        "oldest_age_s": (
+            max(0, now_s - r_oldest) if r_oldest is not None else None
+        ),
     }
     doc["leases"] = shared["leases"]
     return doc

@@ -825,12 +825,25 @@ class Transaction:
         ).fetchone()[0]
 
     def delete_expired_client_reports(self, task_id: TaskId, expiry: Time, limit: int) -> int:
-        """reference: datastore.rs:4691"""
+        """reference: datastore.rs:4691
+
+        Reports with an OUTSTANDING report-journal row are skipped — the
+        same guard shape as ``delete_expired_aggregation_artifacts``'s
+        accumulator-journal clause.  A journal row outliving its
+        materialized client_reports row would RESURRECT the report on
+        replay after GC deleted it (or double-pack it if a staged
+        consumer raced the delete); the replay/materializer consumes the
+        row first and the next GC pass collects the report."""
         pk = self._task_pk(task_id)
         cur = self.conn.execute(
             """DELETE FROM client_reports WHERE id IN (
-                 SELECT id FROM client_reports
-                 WHERE task_id = ? AND client_timestamp < ? LIMIT ?)""",
+                 SELECT cr.id FROM client_reports cr
+                 WHERE cr.task_id = ? AND cr.client_timestamp < ?
+                   AND NOT EXISTS (
+                     SELECT 1 FROM report_journal rj
+                     WHERE rj.task_id = cr.task_id
+                       AND rj.report_id = cr.report_id)
+                 LIMIT ?)""",
             (pk, expiry.seconds, limit),
         )
         return cur.rowcount
@@ -2368,6 +2381,180 @@ class Transaction:
                WHERE task_id = ? AND batch_identifier = ?
                  AND aggregation_param = ? AND aggregation_job_id = ?""",
             (pk, batch_identifier, aggregation_parameter, aggregation_job_id.data),
+        )
+        return cur.rowcount > 0
+
+    # ------------------------------------------------------------------
+    # report journal (write-behind ingest, core/ingest.py; schema.py
+    # _REPORT_JOURNAL_SCHEMA).  One row per ACKed-but-unmaterialized
+    # report; the journal-flush transaction that inserts it is the
+    # client's durability ACK and the ONLY place report_success is
+    # counted — materialization/consumption never touches counters.
+
+    def put_report_journal_row(self, report: LeaderStoredReport) -> None:
+        """Park one ACKed report's full payload until the background
+        materializer (or crash replay, or a surviving replica's creator)
+        consumes it.  The share ciphertext is bound to the client_reports
+        AAD — deliberately, so materialization is a verbatim column copy
+        with no decrypt/re-encrypt hop."""
+        pk = self._task_pk(report.task_id)
+        row_ident = report.task_id.data + report.report_id.data
+        enc_share = self.crypter.encrypt(
+            "client_reports", row_ident, "leader_input_share", report.leader_input_share
+        )
+        try:
+            self.conn.execute(
+                """INSERT INTO report_journal (task_id, report_id, client_timestamp,
+                    extensions, public_share, leader_input_share,
+                    helper_encrypted_input_share, trace_id, created_at)
+                   VALUES (?,?,?,?,?,?,?,?,?)""",
+                (
+                    pk,
+                    report.report_id.data,
+                    report.time.seconds,
+                    _encode_extensions(report.leader_extensions),
+                    report.public_share,
+                    enc_share,
+                    report.helper_encrypted_input_share.get_encoded(),
+                    report.trace_id,
+                    self._now_s(),
+                ),
+            )
+        except self.ds.backend.integrity_errors as e:
+            raise TxConflict(
+                f"journal row for report {report.report_id} already exists"
+            ) from e
+
+    def delete_report_journal_row(self, task_id: TaskId, report_id: ReportId) -> bool:
+        """Consume one journal row; returns False when it was already
+        consumed (the materializer and a staged-cohort consumer raced —
+        the loser MUST NOT write anything for this report, or it lands in
+        client_reports / an aggregation job twice)."""
+        pk = self._task_pk(task_id)
+        cur = self.conn.execute(
+            "DELETE FROM report_journal WHERE task_id = ? AND report_id = ?",
+            (pk, report_id.data),
+        )
+        return cur.rowcount > 0
+
+    def get_report_journal_reports(
+        self, task_id: TaskId, limit: int = 512
+    ) -> List[LeaderStoredReport]:
+        """Full (decrypted) journaled reports for one task, oldest first —
+        introspection and the per-task replay fallback; the bulk path is
+        ``materialize_report_journal_rows``, which never decrypts."""
+        pk = self._task_pk(task_id)
+        rows = self.conn.execute(
+            """SELECT report_id, client_timestamp, extensions, public_share,
+                      leader_input_share, helper_encrypted_input_share, trace_id
+               FROM report_journal WHERE task_id = ? ORDER BY id LIMIT ?""",
+            (pk, limit),
+        ).fetchall()
+        out = []
+        for rid, ts, ext_b, public_share, enc_share, helper_b, trace_id in rows:
+            share = self.crypter.decrypt(
+                "client_reports", task_id.data + rid, "leader_input_share", enc_share
+            )
+            out.append(
+                LeaderStoredReport(
+                    task_id=task_id,
+                    metadata=ReportMetadata(ReportId(rid), Time(ts)),
+                    public_share=public_share,
+                    leader_extensions=_decode_extensions(ext_b) if ext_b else [],
+                    leader_input_share=share,
+                    helper_encrypted_input_share=HpkeCiphertext.get_decoded(helper_b),
+                    trace_id=trace_id,
+                )
+            )
+        return out
+
+    def count_report_journal_rows(self, task_id: Optional[TaskId] = None) -> int:
+        if task_id is None:
+            return self.conn.execute(
+                "SELECT COUNT(*) FROM report_journal"
+            ).fetchone()[0]
+        pk = self._task_pk(task_id)
+        return self.conn.execute(
+            "SELECT COUNT(*) FROM report_journal WHERE task_id = ?", (pk,)
+        ).fetchone()[0]
+
+    def materialize_report_journal_rows(
+        self, limit: int, min_age_s: float = 0.0
+    ) -> Tuple[int, int]:
+        """Move up to ``limit`` journal rows (oldest first, across every
+        task) into client_reports and consume them; returns (consumed,
+        materialized).  A row whose report already exists in
+        client_reports (a duplicate that raced in through the synchronous
+        path) is consumed without inserting — counters were settled at
+        journal-flush time either way.  Pure SQL column copies: the share
+        ciphertext moves between tables without ever being decrypted.
+
+        ``min_age_s`` restricts the pass to rows at least that old — the
+        creator's periodic pre-pass uses it as a grace window so it does
+        not steal rows out from under the upload replica's direct
+        staged-cohort consumer (stealing is SAFE — the row delete
+        linearizes the race — but it downgrades a zero-copy packing to a
+        read-back for no reason)."""
+        ids = [
+            r[0]
+            for r in self.conn.execute(
+                "SELECT id FROM report_journal WHERE created_at <= ?"
+                " ORDER BY id LIMIT ?",
+                (self._now_s() - min_age_s, limit),
+            )
+        ]
+        if not ids:
+            return 0, 0
+        ph = ",".join("?" * len(ids))
+        cur = self.conn.execute(
+            f"""INSERT INTO client_reports (task_id, report_id, client_timestamp,
+                    extensions, public_share, leader_input_share,
+                    helper_encrypted_input_share, trace_id, created_at)
+                SELECT rj.task_id, rj.report_id, rj.client_timestamp,
+                       rj.extensions, rj.public_share, rj.leader_input_share,
+                       rj.helper_encrypted_input_share, rj.trace_id, rj.created_at
+                FROM report_journal rj
+                WHERE rj.id IN ({ph}) AND NOT EXISTS (
+                    SELECT 1 FROM client_reports cr
+                    WHERE cr.task_id = rj.task_id
+                      AND cr.report_id = rj.report_id)""",
+            ids,
+        )
+        materialized = cur.rowcount
+        self.conn.execute(f"DELETE FROM report_journal WHERE id IN ({ph})", ids)
+        return len(ids), materialized
+
+    def report_journal_stats(self) -> Tuple[int, Optional[int]]:
+        """(outstanding rows, oldest created_at) across every task — the
+        /statusz ingest section + journal-depth sampler input."""
+        count, oldest = self.conn.execute(
+            "SELECT COUNT(*), MIN(created_at) FROM report_journal"
+        ).fetchone()
+        return int(count or 0), (int(oldest) if oldest is not None else None)
+
+    def put_scrubbed_client_report(
+        self,
+        task_id: TaskId,
+        report_id: ReportId,
+        client_timestamp: Time,
+        trace_id: Optional[str],
+    ) -> bool:
+        """Tombstone insert for the direct-staged consumption path
+        (core/ingest.py): the report goes straight from the upload batch
+        into an aggregation job, so its client_reports row is born
+        already scrubbed (NULL payloads, aggregation_started) — exactly
+        what put + scrub would have left, minus the round-trip; trace_id
+        is kept so collection-time trace linking still sees the upload.
+        Returns False when a row already exists (a synchronous-mode
+        duplicate raced us in): the caller must NOT pack the report —
+        the existing row's owner already has it."""
+        pk = self._task_pk(task_id)
+        cur = self.conn.execute(
+            """INSERT INTO client_reports (task_id, report_id, client_timestamp,
+                aggregation_started, trace_id, created_at)
+               VALUES (?,?,?,1,?,?)
+               ON CONFLICT(task_id, report_id) DO NOTHING""",
+            (pk, report_id.data, client_timestamp.seconds, trace_id, self._now_s()),
         )
         return cur.rowcount > 0
 

@@ -309,6 +309,39 @@ CREATE INDEX IF NOT EXISTS fleet_members_by_role
     ON fleet_members(role, heartbeat);
 """
 
+_REPORT_JOURNAL_SCHEMA = """
+-- Write-behind report journal (core/ingest.py, ISSUE 18): one row per
+-- report that has been ACKed to its client but whose authoritative
+-- client_reports row is not yet materialized.  The journaled ingest mode
+-- commits THIS row on the upload critical path (the durability ACK) and
+-- defers the client_reports insert to a bounded background materializer
+-- (write-behind for the aggregation-visibility path, never for the ACK).
+-- An outstanding row therefore means exactly: "this report was accepted
+-- and counted, but client_reports does not know it yet" — crash replay
+-- (and the surviving replicas' creators, for the migration handoff)
+-- materialize or consume it, and the report_success counter was already
+-- incremented by the journal-flush transaction, so neither path touches
+-- counters.  Columns mirror client_reports verbatim; leader_input_share
+-- is encrypted under the SAME ("client_reports", task||report,
+-- "leader_input_share") AAD so materialization is a ciphertext column
+-- copy — no decrypt/re-encrypt round-trip on the background path.
+CREATE TABLE IF NOT EXISTS report_journal (
+    id INTEGER PRIMARY KEY,
+    task_id INTEGER NOT NULL REFERENCES tasks(id) ON DELETE CASCADE,
+    report_id BLOB NOT NULL,
+    client_timestamp INTEGER NOT NULL,
+    extensions BLOB,
+    public_share BLOB,
+    leader_input_share BLOB,                    -- encrypted (client_reports AAD)
+    helper_encrypted_input_share BLOB,
+    trace_id TEXT,
+    created_at INTEGER NOT NULL,
+    UNIQUE(task_id, report_id)
+);
+CREATE INDEX IF NOT EXISTS report_journal_by_task
+    ON report_journal(task_id, client_timestamp);
+"""
+
 #: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
 #: edit an entry that has shipped (existing stores have already applied it).
 MIGRATIONS = [
@@ -317,6 +350,7 @@ MIGRATIONS = [
     _TRACE_CONTEXT_SCHEMA,
     _UPLOAD_TRACE_SCHEMA,
     _FLEET_MEMBERS_SCHEMA,
+    _REPORT_JOURNAL_SCHEMA,
 ]
 
 SCHEMA_VERSION = len(MIGRATIONS)

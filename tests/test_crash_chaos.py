@@ -1288,6 +1288,238 @@ device_executor:
 
 
 # ---------------------------------------------------------------------------
+# zero-copy ingest: SIGKILL between ACK and materialization (ISSUE 18), with
+# the GC loop live through the whole replay window (ROADMAP direction 4)
+
+
+@pytest.mark.slow
+def test_journaled_ingest_sigkill_replay_exactly_once_with_gc(tmp_path):
+    """THE INGEST CRASH CASE (ISSUE 18 acceptance): an aggregator binary
+    in journaled mode ACKs uploads off the report-journal write alone
+    (materializer and staged consumer are parked far out, so every
+    admitted report sits in the replay window), is SIGKILLed there, and
+    the restarted incarnation's startup replay materializes every row —
+    zero admitted-then-lost.  The GC loop runs at 0.2s the WHOLE time
+    (ROADMAP direction 4's GC-mid-SIGKILL case): it provably executes
+    deletions (an aged decoy report is reaped) yet never touches a
+    journal row inside the replay window.  Re-uploading every ACKed
+    report after recovery changes nothing (cross-crash, cross-path
+    dedup), the upload-success counter reads exactly N, and the creator
+    then packs each report into exactly one aggregation job."""
+    import asyncio
+
+    from janus_tpu.aggregator import AggregationJobCreator, CreatorConfig
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.client import prepare_report
+    from janus_tpu.messages import InputShareAad
+
+    key = generate_key()
+    leader_db = str(tmp_path / "leader.sqlite3")
+    agg_port, agg_health = _free_port(), _free_port()
+
+    clock = RealClock()
+    leader_ds = Datastore(leader_db, Crypter([key]), clock)
+    agg_token = AuthenticationToken.new_bearer("agg-token-ingest")
+    collector_keys = HpkeKeypair.generate(9)
+    now = clock.now()
+    report_time = Time(now.seconds - now.seconds % TIME_PRECISION.seconds)
+
+    task_id = TaskId.random()
+    leader_kp, helper_kp = HpkeKeypair.generate(1), HpkeKeypair.generate(2)
+    leader_task = AggregatorTask(
+        task_id=task_id,
+        peer_aggregator_endpoint="http://127.0.0.1:1/",  # never called
+        role=Role.LEADER,
+        aggregator_auth_token=agg_token,
+        hpke_keys=[leader_kp],
+        query_type=TaskQueryType.time_interval(),
+        vdaf={"type": "Prio3Count"},
+        vdaf_verify_key=bytes([0x60]) * 16,
+        min_batch_size=1,
+        time_precision=TIME_PRECISION,
+        collector_hpke_config=collector_keys.config,
+        report_expiry_age=Duration(2 * 3600),
+    )
+    leader_ds.run_tx("putl", lambda tx: tx.put_aggregator_task(leader_task))
+
+    vdaf = leader_task.vdaf_instance()
+
+    def _sealed(m, time):
+        return prepare_report(
+            vdaf,
+            task_id,
+            leader_kp.config,
+            helper_kp.config,
+            TIME_PRECISION,
+            m,
+            time=time,
+        )
+
+    # the GC BAIT: an aged report written straight into client_reports
+    # (the upload path would reject it as expired) — its disappearance is
+    # the proof that the 0.2s GC loop is executing real deletions while
+    # the journal rows sit in the replay window beside it
+    decoy = _sealed(1, Time(report_time.seconds - 3 * 3600))
+    aad = InputShareAad(task_id, decoy.metadata, decoy.public_share).get_encoded()
+    info = HpkeApplicationInfo.new(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    plain = PlaintextInputShare.get_decoded(
+        open_(leader_kp, info, decoy.leader_encrypted_input_share, aad)
+    )
+    asyncio.run(
+        ReportWriteBatcher(leader_ds, max_batch_size=1).write_report(
+            LeaderStoredReport(
+                task_id=task_id,
+                metadata=decoy.metadata,
+                public_share=decoy.public_share,
+                leader_extensions=[],
+                leader_input_share=plain.payload,
+                helper_encrypted_input_share=decoy.helper_encrypted_input_share,
+            )
+        )
+    )
+
+    measurements = [1, 0, 1, 1, 0, 1, 1, 1]
+    N = len(measurements)
+    encodeds = [_sealed(m, report_time).get_encoded() for m in measurements]
+
+    def _success_total():
+        return _sql(
+            leader_db,
+            "SELECT COALESCE(SUM(report_success), 0) FROM task_upload_counters",
+        )[0][0]
+
+    success_before = _success_total()  # the decoy's seed write counted one
+
+    cfg = tmp_path / "ingest-agg.yaml"
+    cfg.write_text(
+        f"""
+common:
+  database: {{path: {leader_db}}}
+  health_check_listen_address: 127.0.0.1:{agg_health}
+  status_sample_interval_s: 0.5
+listen_address: 127.0.0.1:{agg_port}
+vdaf_backend: oracle
+upload_open_batch_delay_ms: 2
+garbage_collection_interval_s: 0.2
+ingest:
+  mode: journaled
+  journal_write_delay_ms: 5
+  materialize_interval_ms: 600000
+  staged_consume_interval_ms: 600000
+"""
+    )
+
+    env = dict(os.environ)
+    env["DATASTORE_KEYS"] = base64.urlsafe_b64encode(key).decode().rstrip("=")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    def _spawn(tag):
+        log = open(tmp_path / f"{tag}.log", "wb")
+        return subprocess.Popen(
+            [sys.executable, "-c", _BOOT, "aggregator", "--config-file", str(cfg)],
+            env=env,
+            cwd=str(REPO),
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def _put_report(encoded):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{agg_port}/tasks/{task_id}/reports",
+            data=encoded,
+            method="PUT",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status
+
+    def _journal():
+        return _sql(leader_db, "SELECT COUNT(*) FROM report_journal")[0][0]
+
+    def _reports_rows():
+        return _sql(leader_db, "SELECT COUNT(*) FROM client_reports")[0][0]
+
+    proc = _spawn("ingest-agg-1")
+    try:
+        _wait_http(f"http://127.0.0.1:{agg_health}/healthz", 120)
+        for enc in encodeds:
+            assert _put_report(enc) == 201
+        # ACK semantics: every 201 above returned only after its journal
+        # row committed — and with the materializer parked, the journal
+        # IS the only durable home of the admitted reports
+        assert _journal() == N
+        # GC provably executes during the window: the aged decoy goes...
+        deadline = time.monotonic() + 60
+        while _reports_rows() > 0:
+            assert time.monotonic() < deadline, "GC never reaped the aged decoy"
+            time.sleep(0.2)
+        # ...while several more GC passes never touch a journal row
+        time.sleep(1.0)
+        assert _journal() == N
+        # the replica's own /statusz sees the replay window (shared
+        # datastore section) and reports the journaled ingest plane
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{agg_health}/statusz", timeout=10
+        ) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["report_journal"]["outstanding_rows"] == N, doc["report_journal"]
+        assert doc["ingest"]["mode"] == "journaled", doc["ingest"]
+
+        # -- SIGKILL between ACK and materialization ------------------------
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert _journal() == N, "journal rows must survive the SIGKILL"
+        assert _reports_rows() == 0, "nothing materialized before the crash"
+
+        # -- restart: startup replay drains the journal, GC still live ------
+        proc = _spawn("ingest-agg-2")
+        _wait_http(f"http://127.0.0.1:{agg_health}/healthz", 120)
+        deadline = time.monotonic() + 120
+        while _journal() > 0:
+            assert time.monotonic() < deadline, "startup replay never drained"
+            time.sleep(0.2)
+        assert _reports_rows() == N, "zero admitted-then-lost after replay"
+        # several GC cycles post-replay: fresh reports stay put
+        time.sleep(1.0)
+        assert _reports_rows() == N
+
+        # -- duplicate re-uploads after the crash change NOTHING ------------
+        for enc in encodeds:
+            assert _put_report(enc) == 201
+        assert _journal() == 0
+        assert _reports_rows() == N
+        # exactly-once admission accounting across crash + duplicates
+        assert _success_total() - success_before == N
+        # the survivor's replay counter moved by exactly the orphan count
+        scraped = _scrape(agg_health)
+        assert (
+            _metric_total(scraped, "janus_ingest_journal_replayed_total") == N
+        ), scraped
+
+        # graceful close-out: SIGTERM drains the (empty) plane cleanly
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0, "SIGTERM exit must be clean"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # -- exactly-once collection: each report lands in ONE job --------------
+    creator = AggregationJobCreator(
+        leader_ds,
+        CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=3),
+    )
+    n_jobs = asyncio.run(creator.run_once())
+    assert n_jobs >= 1, n_jobs
+    total, distinct = _sql(
+        leader_db,
+        "SELECT COUNT(*), COUNT(DISTINCT report_id) FROM report_aggregations",
+    )[0]
+    assert total == N and distinct == N, (total, distinct)
+    leader_ds.close()
+
+
+# ---------------------------------------------------------------------------
 # flight recorder SIGKILL semantics + per-task cost attribution (ISSUE 12)
 
 

@@ -1,0 +1,640 @@
+"""Zero-copy ingest plane (ISSUE 18): write-behind report journal +
+upload -> staging handoff.
+
+Covers the tentpole's contracts and the satellites' failure modes:
+
+* DURABILITY ACK — a journaled upload resolves only after its journal
+  row is durable, and the row carries everything client_reports needs
+  (materialization is a ciphertext column copy, no decrypt).
+* BYTE PARITY — the SAME sealed reports through ``ingest.mode:
+  journaled`` and ``synchronous`` decrypt to identical stored rows.
+* ZERO-COPY STAGING — direct-staged cohorts pack into aggregation jobs
+  from in-memory payloads (born-scrubbed tombstones, journal consumed),
+  and the consume race with the materializer stays exactly-once.
+* COUNTER CORRECTNESS — duplicate uploads (in-batch, cross-flush, and
+  cross-mode after materialization) count report_success exactly once.
+* BACKPRESSURE — a wedged journal writer (``ingest.journal`` delay
+  fault) degrades to counted reason="journal" sheds; an error fault
+  fans the commit failure to every waiter (no stranded futures).
+* GC GUARD — ``delete_expired_client_reports`` never reaps a report
+  whose journal row is outstanding (the replay-resurrection hazard).
+* CRASH REPLAY + MIGRATION — a restarted replica (fresh Datastore over
+  the same file) replays ACKed-but-unmaterialized rows; a cohort staged
+  on a dead replica A is collectable through replica B's creator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from janus_tpu.aggregator import (
+    Aggregator,
+    AggregationJobCreator,
+    Config,
+    CreatorConfig,
+)
+from janus_tpu.aggregator.error import UploadShed
+from janus_tpu.core import faults
+from janus_tpu.core.ingest import IngestPlane, replay_report_journal
+from janus_tpu.core.metrics import GLOBAL_METRICS
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import Datastore
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Interval, Time
+
+from test_aggregator_handlers import NOW, make_pair_tasks
+from test_upload_frontdoor import _reports, _stored_rows
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _sample(name, labels=None):
+    return GLOBAL_METRICS.get_sample_value(name, labels or {}) or 0.0
+
+
+def _journaled_config(**overrides):
+    base = dict(
+        vdaf_backend="oracle",
+        upload_open_backend="batched",
+        upload_open_batch_delay=0.002,
+        ingest_mode="journaled",
+        ingest_journal_batch_size=100,
+        ingest_journal_write_delay=0.005,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def _make_env(config: Config):
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    eds = EphemeralDatastore(MockClock(NOW))
+    eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+    agg = Aggregator(eds.datastore, eds.clock, config)
+    return eds, agg, leader, helper
+
+
+def _journal_count(datastore):
+    return datastore.run_tx("count", lambda tx: tx.count_report_journal_rows())
+
+
+def _upload_all(loop, agg, leader, reports):
+    async def flow():
+        await asyncio.gather(
+            *(agg.handle_upload(leader.task_id, r) for r in reports)
+        )
+
+    loop.run_until_complete(flow())
+
+
+def _acquired_jobs(datastore):
+    return datastore.run_tx(
+        "acq",
+        lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 100),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the durability ACK + write-behind materialization
+
+
+def test_journaled_upload_acks_into_journal_then_materializes(loop):
+    """An ACKed journaled upload is a journal row (client_reports empty);
+    one materializer pass turns it into an ordinary client_reports row —
+    decrypting to the same bytes the upload carried — and consumes the
+    journal."""
+    eds, agg, leader, helper = _make_env(_journaled_config(ingest_stage_direct=False))
+    _upload_all(loop, agg, leader, _reports(leader, helper, 4))
+
+    assert _journal_count(eds.datastore) == 4
+    assert _stored_rows(eds.datastore, leader.task_id) == []
+    # the ACK already counted report_success (the journal row IS the ACK)
+    counter = eds.datastore.run_tx(
+        "ctr", lambda tx: tx.get_task_upload_counter(leader.task_id)
+    )
+    assert counter.report_success == 4
+
+    consumed, materialized = loop.run_until_complete(
+        agg.ingest.materialize_once()
+    )
+    assert (consumed, materialized) == (4, 4)
+    assert _journal_count(eds.datastore) == 0
+    assert len(_stored_rows(eds.datastore, leader.task_id)) == 4
+    # materialization moves rows, never re-counts
+    counter = eds.datastore.run_tx(
+        "ctr", lambda tx: tx.get_task_upload_counter(leader.task_id)
+    )
+    assert counter.report_success == 4
+    eds.cleanup()
+
+
+def test_journaled_byte_parity_vs_synchronous(loop):
+    """The SAME sealed reports through both ingest modes (fresh datastore
+    each, same task keys) decrypt to byte-identical stored rows — the
+    journal hop (encrypt under the client_reports AAD, column-copy
+    materialize) is invisible downstream."""
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    reports = _reports(leader, helper, 6)
+    stored = {}
+    for mode in ("synchronous", "journaled"):
+        eds = EphemeralDatastore(MockClock(NOW))
+        eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        agg = Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(
+                vdaf_backend="oracle",
+                upload_open_backend="batched",
+                upload_open_batch_delay=0.002,
+                ingest_mode=mode,
+                ingest_journal_write_delay=0.005,
+                ingest_stage_direct=False,
+            ),
+        )
+        _upload_all(loop, agg, leader, reports)
+        if agg.ingest is not None:
+            loop.run_until_complete(agg.ingest.drain())
+        assert _journal_count(eds.datastore) == 0
+        rows = _stored_rows(eds.datastore, leader.task_id)
+        assert len(rows) == 6
+        stored[mode] = rows
+        eds.cleanup()
+    assert stored["journaled"] == stored["synchronous"]
+
+
+def test_duplicate_uploads_count_once_across_paths(loop):
+    """report_success settles at the first durable journal row: in-batch
+    dups, a re-upload after the flush, and a re-upload after
+    materialization are all idempotent successes with no second count."""
+    eds, agg, leader, helper = _make_env(_journaled_config(ingest_stage_direct=False))
+    (report,) = _reports(leader, helper, 1)
+
+    def counter():
+        return eds.datastore.run_tx(
+            "ctr", lambda tx: tx.get_task_upload_counter(leader.task_id)
+        ).report_success
+
+    # in-batch duplicate: both ACK, one row, one count
+    _upload_all(loop, agg, leader, [report, report])
+    assert _journal_count(eds.datastore) == 1
+    assert counter() == 1
+    # journal-row duplicate (separate flush)
+    _upload_all(loop, agg, leader, [report])
+    assert _journal_count(eds.datastore) == 1
+    assert counter() == 1
+    # cross-path duplicate: after materialization the report lives in
+    # client_reports; a retried upload must not re-journal or re-count
+    loop.run_until_complete(agg.ingest.materialize_once())
+    _upload_all(loop, agg, leader, [report])
+    assert _journal_count(eds.datastore) == 0
+    assert counter() == 1
+    assert len(_stored_rows(eds.datastore, leader.task_id)) == 1
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# zero-copy staging
+
+
+def test_staged_cohort_packs_jobs_without_readback(loop):
+    """Direct-staged reports become an aggregation job straight from
+    in-memory payloads: journal consumed, born-scrubbed tombstones in
+    client_reports (no payload ever materialized), job acquirable."""
+    eds, agg, leader, helper = _make_env(_journaled_config())
+    direct_before = _sample(
+        "janus_ingest_staged_reports_total", {"path": "direct"}
+    )
+    _upload_all(loop, agg, leader, _reports(leader, helper, 5))
+    assert _journal_count(eds.datastore) == 5
+    assert agg.ingest.stats()["staged_reports"] == 5
+
+    creator = AggregationJobCreator(
+        eds.datastore,
+        CreatorConfig(min_aggregation_job_size=1, batch_aggregation_shard_count=2),
+    )
+    created = loop.run_until_complete(creator.run_staged_once(agg.ingest))
+    assert created == 1
+    assert _journal_count(eds.datastore) == 0
+    assert agg.ingest.stats()["staged_reports"] == 0
+    # tombstones only: scrubbed rows, nothing decryptable left behind
+    assert _stored_rows(eds.datastore, leader.task_id) == []
+    scrubbed = eds.datastore.run_tx(
+        "cnt",
+        lambda tx: tx.conn.execute(
+            "SELECT COUNT(*) FROM client_reports WHERE aggregation_started = 1"
+            " AND leader_input_share IS NULL"
+        ).fetchone()[0],
+    )
+    assert scrubbed == 5
+    leases = _acquired_jobs(eds.datastore)
+    assert len(leases) == 1
+    assert (
+        _sample("janus_ingest_staged_reports_total", {"path": "direct"})
+        - direct_before
+        == 5
+    )
+    eds.cleanup()
+
+
+def test_staged_consume_race_is_exactly_once(loop):
+    """A cohort whose journal rows were consumed elsewhere (materializer,
+    another replica's replay) packs NOTHING: the row delete is the
+    linearization point and the loser writes nothing."""
+    eds, agg, leader, helper = _make_env(_journaled_config())
+    _upload_all(loop, agg, leader, _reports(leader, helper, 4))
+    assert agg.ingest.stats()["staged_reports"] == 4
+    # the materializer wins the race first
+    loop.run_until_complete(agg.ingest.materialize_once())
+    assert _journal_count(eds.datastore) == 0
+
+    creator = AggregationJobCreator(
+        eds.datastore,
+        CreatorConfig(min_aggregation_job_size=1, batch_aggregation_shard_count=2),
+    )
+    created = loop.run_until_complete(creator.run_staged_once(agg.ingest))
+    assert created == 0  # lost every row delete -> wrote nothing
+    rows = _stored_rows(eds.datastore, leader.task_id)
+    assert len(rows) == 4  # the materialized rows, unscrubbed, exactly once
+    assert _acquired_jobs(eds.datastore) == []
+    eds.cleanup()
+
+
+def test_stage_buffer_bound_overflows_to_readback(loop):
+    """Past ingest_stage_max_reports fresh reports are NOT staged — they
+    stay journaled for the materializer (overflow degrades to read-back,
+    never to unbounded memory)."""
+    eds, agg, leader, helper = _make_env(
+        _journaled_config(ingest_stage_max_reports=3)
+    )
+    _upload_all(loop, agg, leader, _reports(leader, helper, 5))
+    st = agg.ingest.stats()
+    assert st["staged_reports"] == 3
+    assert st["stage_overflow_total"] == 2
+    assert _journal_count(eds.datastore) == 5  # every ACK is still durable
+    # the overflow reports reach aggregation through the classic path
+    loop.run_until_complete(agg.ingest.materialize_once())
+    assert len(_stored_rows(eds.datastore, leader.task_id)) == 5
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# backpressure + fault injection
+
+
+def test_journal_delay_fault_sheds_with_reason_journal(loop):
+    """A wedged journal writer (ingest.journal delay) composes with
+    admission control: past ingest_journal_queue_max uploads shed 503
+    with reason="journal"; admitted ones still ACK once the wedge
+    clears."""
+    eds, agg, leader, helper = _make_env(
+        _journaled_config(
+            ingest_journal_batch_size=1,  # every submit detaches to flight
+            ingest_journal_queue_max=2,
+        )
+    )
+    faults.configure(
+        [faults.FaultSpec("ingest.journal", "delay", 1.0, delay_s=0.3)], seed=7
+    )
+    reports = _reports(leader, helper, 3)
+    shed_before = _sample("janus_upload_shed_total", {"reason": "journal"})
+
+    async def flow():
+        futs = [
+            asyncio.ensure_future(agg.handle_upload(leader.task_id, r))
+            for r in reports[:2]
+        ]
+        await asyncio.sleep(0.1)
+        assert agg.ingest.queue_depth() == 2  # both in-flight, none durable
+        with pytest.raises(UploadShed):
+            await agg.handle_upload(leader.task_id, reports[2])
+        await asyncio.gather(*futs)  # the wedge clears; ACKs land
+
+    loop.run_until_complete(flow())
+    assert _journal_count(eds.datastore) == 2
+    assert agg.ingest.stats()["sheds"] >= 1
+    assert (
+        _sample("janus_upload_shed_total", {"reason": "journal"}) - shed_before
+        >= 1
+    )
+    eds.cleanup()
+
+
+def test_journal_error_fault_fans_to_every_waiter(loop):
+    """An ingest.journal error (commit failure) rejects every waiting
+    upload — no stranded futures, nothing ACKed, nothing counted."""
+    eds, agg, leader, helper = _make_env(_journaled_config())
+    faults.configure([faults.FaultSpec("ingest.journal", "error", 1.0)], seed=7)
+    reports = _reports(leader, helper, 3)
+
+    async def flow():
+        return await asyncio.gather(
+            *(agg.handle_upload(leader.task_id, r) for r in reports),
+            return_exceptions=True,
+        )
+
+    results = loop.run_until_complete(flow())
+    assert len(results) == 3
+    for r in results:
+        assert isinstance(r, Exception), r
+    assert _journal_count(eds.datastore) == 0
+    counter = eds.datastore.run_tx(
+        "ctr", lambda tx: tx.get_task_upload_counter(leader.task_id)
+    )
+    assert counter.report_success == 0
+    assert agg.ingest.queue_depth() == 0  # nothing leaked into _inflight
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the GC guard (replay-resurrection hazard)
+
+
+def test_gc_never_reaps_report_with_outstanding_journal_row(loop):
+    """delete_expired_client_reports skips reports whose journal row is
+    outstanding: GC landing inside the replay window would otherwise let
+    replay resurrect a deleted report.  Once the row is consumed the next
+    GC pass collects normally."""
+    eds, agg, leader, helper = _make_env(_journaled_config(ingest_stage_direct=False))
+    _upload_all(loop, agg, leader, _reports(leader, helper, 2))
+    # materialize ONE report by hand; leave the other's journal row
+    # outstanding, then re-create the client_reports row shape GC sees
+    # by materializing both and re-journaling one (the crash-window
+    # state: row in client_reports AND journal row outstanding).
+    reports = eds.datastore.run_tx(
+        "peek", lambda tx: tx.get_report_journal_reports(leader.task_id)
+    )
+    loop.run_until_complete(agg.ingest.materialize_once())
+    eds.datastore.run_tx(
+        "rejournal", lambda tx: tx.put_report_journal_row(reports[0])
+    )
+
+    expiry = Time(NOW.seconds + 10_000)
+    deleted = eds.datastore.run_tx(
+        "gc",
+        lambda tx: tx.delete_expired_client_reports(leader.task_id, expiry, 100),
+    )
+    assert deleted == 1  # only the journal-free report
+    assert _journal_count(eds.datastore) == 1
+    # consume the row (replay); NOW the report is collectable by GC
+    loop.run_until_complete(replay_report_journal(eds.datastore))
+    assert _journal_count(eds.datastore) == 0
+    deleted = eds.datastore.run_tx(
+        "gc2",
+        lambda tx: tx.delete_expired_client_reports(leader.task_id, expiry, 100),
+    )
+    assert deleted == 1
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# crash replay + two-replica migration handoff
+
+
+def test_replay_after_crash_between_ack_and_materialize(loop):
+    """Replica dies after ACK, before materialization: a fresh process
+    over the same datastore file replays the journal and the standard
+    creator packs the reports — zero admitted-then-lost."""
+    eds, agg, leader, helper = _make_env(_journaled_config())
+    _upload_all(loop, agg, leader, _reports(leader, helper, 4))
+    assert _journal_count(eds.datastore) == 4
+    # "SIGKILL": the plane (and its staged buffer) simply vanishes; only
+    # the datastore file survives
+    del agg
+    crashed = eds.datastore
+    reopened = Datastore(eds.path, eds.crypter, eds.clock)
+    replayed = loop.run_until_complete(replay_report_journal(reopened))
+    assert replayed == 4
+    assert reopened.run_tx("c", lambda tx: tx.count_report_journal_rows()) == 0
+    creator = AggregationJobCreator(
+        reopened,
+        CreatorConfig(
+            min_aggregation_job_size=1,
+            batch_aggregation_shard_count=2,
+            journal_replay_min_age_s=0.0,
+        ),
+    )
+    created = loop.run_until_complete(creator.run_once())
+    assert created == 1
+    assert len(_acquired_jobs(reopened)) == 1
+    reopened.close()
+    eds.datastore = crashed
+    eds.cleanup()
+
+
+def test_two_replica_handoff_staged_cohort_survives_death(loop):
+    """A cohort direct-staged on replica A (never consumed — A dies) is
+    still collectable: its journal rows are global state, and replica B's
+    ordinary creator pass (replay pre-pass included) packs them."""
+    eds, agg_a, leader, helper = _make_env(_journaled_config())
+    _upload_all(loop, agg_a, leader, _reports(leader, helper, 3))
+    assert agg_a.ingest.stats()["staged_reports"] == 3
+    assert _journal_count(eds.datastore) == 3
+    del agg_a  # replica A dies with the cohort staged, pre-flush
+
+    # replica B: a second datastore handle over the shared store; the
+    # replay grace is aged past by the mock clock, as in production
+    replica_b = Datastore(eds.path, eds.crypter, eds.clock)
+    eds.clock.advance(Duration(30))
+    creator = AggregationJobCreator(
+        replica_b,
+        CreatorConfig(
+            min_aggregation_job_size=1,
+            batch_aggregation_shard_count=2,
+            journal_replay_min_age_s=5.0,
+        ),
+    )
+    created = loop.run_until_complete(creator.run_once())
+    assert created == 1
+    assert replica_b.run_tx("c", lambda tx: tx.count_report_journal_rows()) == 0
+    assert len(_acquired_jobs(replica_b)) == 1
+    replica_b.close()
+    eds.cleanup()
+
+
+def test_creator_replay_grace_leaves_fresh_rows(loop):
+    """run_once's replay pre-pass must NOT steal rows younger than
+    journal_replay_min_age_s — they belong to the upload replica's own
+    staged consumer."""
+    eds, agg, leader, helper = _make_env(_journaled_config())
+    _upload_all(loop, agg, leader, _reports(leader, helper, 2))
+    creator = AggregationJobCreator(
+        eds.datastore,
+        CreatorConfig(
+            min_aggregation_job_size=1,
+            batch_aggregation_shard_count=2,
+            journal_replay_min_age_s=60.0,
+        ),
+    )
+    loop.run_until_complete(creator.run_once())
+    assert _journal_count(eds.datastore) == 2  # untouched: too fresh
+    eds.clock.advance(Duration(120))
+    loop.run_until_complete(creator.run_once())
+    assert _journal_count(eds.datastore) == 0
+    eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# config + introspection seams
+
+
+def test_unknown_ingest_mode_rejected():
+    eds = EphemeralDatastore(MockClock(NOW))
+    with pytest.raises(ValueError, match="ingest_mode"):
+        Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(vdaf_backend="oracle", ingest_mode="Journaled"),
+        )
+    eds.cleanup()
+
+
+def test_ingest_config_yaml_roundtrip():
+    from janus_tpu.binaries.config import AggregatorConfig, load_config
+
+    cfg = load_config(
+        AggregatorConfig,
+        text="""
+ingest:
+  mode: journaled
+  journal_batch_size: 42
+  journal_write_delay_ms: 7
+  journal_queue_max: 99
+  stage_direct: false
+  stage_max_reports: 123
+  staged_consume_interval_ms: 333
+  materialize_interval_ms: 444
+  materialize_batch_size: 55
+  staged_min_job_size: 2
+  staged_max_job_size: 20
+""",
+    )
+    assert cfg.ingest.mode == "journaled"
+    assert cfg.ingest.journal_batch_size == 42
+    assert cfg.ingest.journal_write_delay_ms == 7
+    assert cfg.ingest.journal_queue_max == 99
+    assert cfg.ingest.stage_direct is False
+    assert cfg.ingest.stage_max_reports == 123
+    assert cfg.ingest.staged_consume_interval_ms == 333
+    assert cfg.ingest.materialize_interval_ms == 444
+    assert cfg.ingest.materialize_batch_size == 55
+    assert cfg.ingest.staged_min_job_size == 2
+    assert cfg.ingest.staged_max_job_size == 20
+    # the default stays bit-for-bit legacy
+    assert load_config(AggregatorConfig, text="{}").ingest.mode == "synchronous"
+
+
+def test_statusz_ingest_and_report_journal_sections(loop):
+    eds, agg, leader, helper = _make_env(_journaled_config())
+    _upload_all(loop, agg, leader, _reports(leader, helper, 2))
+    from janus_tpu.core.statusz import runtime_status, statusz_snapshot
+
+    ing = runtime_status()["ingest"]
+    assert ing["mode"] == "journaled"
+    assert ing["journaled"] == 2
+    assert ing["staged_reports"] == 2
+    doc = loop.run_until_complete(statusz_snapshot(eds.datastore))
+    assert doc["report_journal"]["outstanding_rows"] == 2
+    assert doc["report_journal"]["oldest_age_s"] is not None
+    assert _sample("janus_ingest_journal_depth") == 0  # all flushed
+    eds.cleanup()
+
+
+def test_ingest_plane_flush_timer_stale_generation(loop):
+    """The ReportWriteBatcher stale-timer contract holds for the journal
+    writer too: a timer armed for a flushed cohort must not flush (or
+    cancel the timer of) the next cohort."""
+    eds, agg, leader, helper = _make_env(
+        _journaled_config(ingest_journal_batch_size=2, ingest_journal_write_delay=60.0)
+    )
+    plane: IngestPlane = agg.ingest
+    reports = _reports(leader, helper, 3)
+
+    async def flow():
+        s1 = asyncio.ensure_future(agg.handle_upload(leader.task_id, reports[0]))
+        for _ in range(200):
+            if plane._flush_handle is not None:
+                break
+            await asyncio.sleep(0.005)
+        stale_gen = plane._flush_gen
+        assert plane._flush_handle is not None
+        await agg.handle_upload(leader.task_id, reports[1])  # size trigger
+        await s1
+        s3 = asyncio.ensure_future(agg.handle_upload(leader.task_id, reports[2]))
+        for _ in range(200):
+            if plane._flush_handle is not None:
+                break
+            await asyncio.sleep(0.005)
+        live = plane._flush_handle
+        assert live is not None
+        await plane._flush(stale_gen)  # the stale timer finally fires
+        assert len(plane._queue) == 1  # cohort 2 untouched
+        assert plane._flush_handle is live and not live.cancelled()
+        await plane._flush(plane._flush_gen)
+        await s3
+
+    loop.run_until_complete(flow())
+    assert _journal_count(eds.datastore) == 3
+    eds.cleanup()
+
+
+def test_loadgen_first_prepare_percentiles_from_trace(tmp_path):
+    """The loadgen-side ingest unit (ISSUE 18 satellite): sampled upload
+    trace ids resolve to upload -> first-prepare latencies through the
+    merged chrome-trace timeline (job_create links stitch the upload
+    trace to the job trace carrying the flush span); unsampled and
+    unresolvable ids contribute nothing."""
+    import json as _json
+    import pathlib
+    import sys as _sys
+
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "tools"))
+    from loadgen import first_prepare_percentiles
+
+    up_a, up_b, job = "aa" * 16, "bb" * 16, "cc" * 16
+    events = [
+        # per-pid clock_sync metadata: merge_events drops spans from pids
+        # without a wall-clock rebase offset (epoch 0 keeps ts verbatim)
+        *(
+            {"ph": "M", "name": "clock_sync", "pid": pid, "args": {"epoch_t0": 0}}
+            for pid in (1, 2, 3)
+        ),
+        {"ph": "X", "name": "upload", "ts": 1_000, "dur": 10, "pid": 1,
+         "tid": 1, "args": {"trace_id": up_a}},
+        {"ph": "X", "name": "upload", "ts": 2_000, "dur": 10, "pid": 1,
+         "tid": 1, "args": {"trace_id": up_b}},
+        # the creator's link span unions both upload traces with the job's
+        {"ph": "X", "name": "job_create", "ts": 3_000, "dur": 5, "pid": 2,
+         "tid": 1, "args": {"trace_id": job, "links": [up_a, up_b]}},
+        {"ph": "X", "name": "flush_share", "ts": 5_000, "dur": 50, "pid": 3,
+         "tid": 1, "args": {"trace_id": job}},
+    ]
+    trace = tmp_path / "trace.json"
+    # the ChromeTracer writes one event per line; load_events parses that
+    trace.write_text("\n".join(_json.dumps(e) + "," for e in events))
+
+    # only up_a is SAMPLED; its own upload start (not the group minimum)
+    # anchors the latency: (5000 - 1000) us -> 4.0 ms
+    out = first_prepare_percentiles([str(tmp_path / "*.json")], [up_a])
+    assert out == {"samples": 1, "p50": 4.0, "p90": 4.0, "p99": 4.0}, out
+    # both sampled: per-id anchors give 4.0 and 3.0 ms
+    out = first_prepare_percentiles([str(trace)], [up_a, up_b])
+    assert out["samples"] == 2 and out["p50"] in (3.0, 4.0), out
+    assert out["p99"] == 4.0, out
+    # an id with no flush anywhere in its merged trace resolves to nothing
+    out = first_prepare_percentiles([str(trace)], ["dd" * 16])
+    assert out == {"samples": 0, "p50": None, "p90": None, "p99": None}, out

@@ -198,6 +198,59 @@ class LoadStats:
         }
 
 
+def first_prepare_percentiles(trace_paths: List[str], sampled_ids: List[str]) -> dict:
+    """Upload -> first-prepare percentiles for the SAMPLED uploads (the
+    ISSUE 18 ingest unit): per sampled trace id, the wall time from its
+    upload span's start to the first device-prepare span (flush_share /
+    executor_flush / prep_launch) anywhere in its merged trace — the
+    handoff's moment of truth, read straight off the replicas' chrome
+    trace files (incrementally flushed, so they are live-readable).
+    ``trace_paths`` may contain globs.  Returns ``{"samples", "p50",
+    "p90", "p99"}`` in milliseconds (None when nothing resolved)."""
+    import glob as globmod
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from trace_merge import merge_events, trace_stats
+
+    paths: List[str] = []
+    for pat in trace_paths:
+        hits = sorted(globmod.glob(pat))
+        paths.extend(hits if hits else ([pat] if os.path.exists(pat) else []))
+    sampled = set(sampled_ids)
+    out = {"samples": 0, "p50": None, "p90": None, "p99": None}
+    if not paths or not sampled:
+        return out
+    events = merge_events(paths)
+    # each sampled id's OWN earliest upload-span start (a merged group may
+    # carry many sampled uploads; the group minimum would skew them all)
+    upload_ts = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") == "upload":
+            tid = ev.get("args", {}).get("trace_id")
+            if tid in sampled:
+                ts = ev.get("ts", 0)
+                if tid not in upload_ts or ts < upload_ts[tid]:
+                    upload_ts[tid] = ts
+    vals: List[float] = []
+    for g in trace_stats(events)["merged_traces"]:
+        flush_ts = g["stages_ts_us"].get("first_flush")
+        if flush_ts is None:
+            continue
+        for tid in set(g["trace_ids"]) & sampled:
+            t0 = upload_ts.get(tid)
+            if t0 is not None and flush_ts >= t0:
+                vals.append((flush_ts - t0) / 1e3)
+    vals.sort()
+    if vals:
+        out = {
+            "samples": len(vals),
+            "p50": round(_percentile(vals, 0.50), 3),
+            "p90": round(_percentile(vals, 0.90), 3),
+            "p99": round(_percentile(vals, 0.99), 3),
+        }
+    return out
+
+
 async def fetch_hpke_config(session, endpoint: str, task_id: TaskId):
     url = endpoint.rstrip("/") + "/hpke_config?task_id=" + str(task_id)
     async with session.get(url) as resp:
@@ -333,6 +386,11 @@ def main(argv=None) -> int:
     p.add_argument("--now", type=int, default=0,
                    help="fixed report timestamp (0 = wall clock); harnesses "
                    "with MockClock-seeded tasks pin this")
+    p.add_argument("--trace-files", nargs="+", default=None,
+                   help="replica chrome-trace files/globs; with "
+                   "--trace-sample, the --json summary gains "
+                   "upload_to_first_prepare_ms percentiles for the "
+                   "sampled uploads (ISSUE 18)")
     p.add_argument("--json", action="store_true", help="print the summary JSON")
     args = p.parse_args(argv)
 
@@ -354,6 +412,10 @@ def main(argv=None) -> int:
             now_fn=now_fn,
         )
     )
+    if args.trace_files:
+        summary["upload_to_first_prepare_ms"] = first_prepare_percentiles(
+            args.trace_files, summary["trace_ids"]
+        )
     if args.json:
         print(json.dumps(summary))
     else:
