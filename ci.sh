@@ -85,6 +85,20 @@
 #                      still loses its tasks) and the db-health unit suite
 #                      (tests/test_db_health.py: classification tables,
 #                      seeded backoff, tx deadlines, freeze/thaw).
+#   ./ci.sh chaos poison  blast-radius stage (ISSUE 19): the poisoned-batch
+#                      soak on the journaled fleet — marked-poison uploads
+#                      failing the vectorized HPKE open, poison report rows
+#                      failing the executor's prep staging, and a mid-soak
+#                      bit-flip/truncation wave over stored journal rows —
+#                      every poison row lands in quarantined_reports (batch
+#                      bisection isolates offenders in O(log B) passes,
+#                      journal CRC32C fences catch the corrupt rows), zero
+#                      global breaker trips, exactly-once exact-sum
+#                      collection of the healthy cohort; plus the
+#                      bisection/CRC/quarantine unit suite
+#                      (tests/test_quarantine.py) and the poison-free
+#                      parity fence (stored rows and prepare messages
+#                      bit-identical with the machinery armed).
 #   ./ci.sh fpvec      gradient-aggregation gate (ISSUE 15): the
 #                      multi-gadget device FLP plane — fpvec device-vs-
 #                      oracle bit-exact fuzz (vpu + mxu, leader + helper,
@@ -265,7 +279,13 @@ case "$tier" in
       # freeze/thaw).
       exec python -m pytest tests/test_brownout_chaos.py tests/test_db_health.py -q
     fi
-    exec python -m pytest tests/test_chaos.py tests/test_brownout_chaos.py tests/test_db_health.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
+    if [ "${2:-}" = "poison" ]; then
+      # Blast-radius stage (ISSUE 19): poisoned-batch bisection quarantine
+      # + corruption-tolerant journal replay.  The soak plus the
+      # bisection-harness/CRC32C/quarantine-ledger unit suite.
+      exec python -m pytest tests/test_poison_chaos.py tests/test_quarantine.py -q
+    fi
+    exec python -m pytest tests/test_chaos.py tests/test_brownout_chaos.py tests/test_poison_chaos.py tests/test_quarantine.py tests/test_db_health.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
     ;;
   mesh)
     # Multi-chip gate (ISSUE 6).  test_mesh.py is device-tier (sharded
@@ -396,7 +416,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|coldstart|fpvec|obs|load|load fast|ingest|benchdiff|fleet|postgres|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|chaos poison|coldstart|fpvec|obs|load|load fast|ingest|benchdiff|fleet|postgres|dryrun]" >&2
     exit 2
     ;;
 esac

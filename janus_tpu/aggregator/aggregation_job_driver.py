@@ -140,6 +140,13 @@ class AggregationJobDriver:
         self._canon_build_failed: set = set()
         # key -> [(verify_key, prep_rows, future)] awaiting a coalesced launch
         self._pending_prep: Dict[int, list] = {}
+        # Quarantine ledger sink (ISSUE 19): bisection offenders found
+        # while this driver's flushes sieve persist durably (last
+        # configured datastore wins — one per process in production).
+        if self.datastore is not None:
+            from ..core import quarantine
+
+            quarantine.configure_sink(self.datastore)
         # Process-wide continuous batcher: every driver in the process
         # feeds ONE executor so concurrent tasks form one saturated
         # pipeline rather than N contending ones.

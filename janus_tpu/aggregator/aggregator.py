@@ -280,6 +280,14 @@ class Aggregator:
                 stage_direct=self.config.ingest_stage_direct,
                 stage_max_reports=self.config.ingest_stage_max_reports,
             )
+        # Quarantine ledger sink (ISSUE 19): poison offenders found by the
+        # batched-open / executor bisection sieves persist into this
+        # datastore's quarantined_reports table (failure-tolerant,
+        # background thread — see core/quarantine.py).
+        if datastore is not None:
+            from ..core import quarantine
+
+            quarantine.configure_sink(datastore)
         # Helper-side executor routing: share the process-wide continuous
         # batcher (and its per-shape circuit breakers) with the drivers.
         #: canonical keys whose twin backend failed to build (negative
@@ -471,7 +479,16 @@ class Aggregator:
             try:
                 if self.config.upload_open_backend == "batched":
                     plaintext = await self.upload_opener.open(
-                        keypair, info, report.leader_encrypted_input_share, aad
+                        keypair,
+                        info,
+                        report.leader_encrypted_input_share,
+                        aad,
+                        # report identity for the quarantine ledger, should
+                        # bisection isolate this row as poison
+                        ident=(
+                            task_id.data.hex(),
+                            report.metadata.report_id.data,
+                        ),
                     )
                 else:
                     import time as _time

@@ -255,8 +255,8 @@ class UploadOpenBatcher:
         self.max_batch_delay = max_batch_delay
         self.max_queue = max_queue
         self.shed_delay_s = shed_delay_s
-        #: (request 4-tuple, waiter, enqueue-monotonic)
-        self._queue: List[Tuple[tuple, asyncio.Future, float]] = []
+        #: (request 4-tuple, waiter, enqueue-monotonic, report ident)
+        self._queue: List[Tuple[tuple, asyncio.Future, float, Optional[tuple]]] = []
         #: detached-but-unresolved batches: seq -> (rows, oldest enqueue).
         #: Admission control MUST count these — the staging queue drains
         #: into flight at max_batch_size/max_batch_delay granularity, so
@@ -270,6 +270,8 @@ class UploadOpenBatcher:
         self._sheds = {"queue_full": 0, "queue_delay": 0}
         self._batches = 0
         self._opened = 0
+        self._bisections = 0
+        self._quarantined = 0
         global _FRONTDOOR
         _FRONTDOOR = self
 
@@ -310,12 +312,17 @@ class UploadOpenBatcher:
         raise UploadShed(f"upload front door over {reason} budget; retry")
 
     # -- the open stage --------------------------------------------------
-    async def open(self, keypair, info, ciphertext, aad) -> bytes:
+    async def open(self, keypair, info, ciphertext, aad, ident=None) -> bytes:
         """Resolve to the plaintext when this report's batch opens;
-        raises HpkeError on a per-report decrypt failure."""
+        raises HpkeError on a per-report decrypt failure.  ``ident`` is an
+        optional (task_hex, report_id_bytes) pair carried alongside the
+        request so a poison row isolated by bisection can be recorded in
+        the quarantine ledger under its report identity."""
         fut = asyncio.get_running_loop().create_future()
         async with self._lock:
-            self._queue.append(((keypair, info, ciphertext, aad), fut, time.monotonic()))
+            self._queue.append(
+                ((keypair, info, ciphertext, aad), fut, time.monotonic(), ident)
+            )
             self._publish_depth()
             if len(self._queue) >= self.max_batch_size:
                 await self._flush_locked()
@@ -355,7 +362,7 @@ class UploadOpenBatcher:
     async def _run_batch(self, batch, seq: int) -> None:
         from ..core.metrics import GLOBAL_METRICS
 
-        requests = [item for item, _fut, _enq in batch]
+        requests = [item for item, _fut, _enq, _ident in batch]
         t0 = time.monotonic()
         try:
             loop = asyncio.get_running_loop()
@@ -364,14 +371,15 @@ class UploadOpenBatcher:
                     None, _open_batch_worker, requests
                 )
             except Exception:
-                # batch-LEVEL failure: per-report fallback — STILL on the
-                # thread pool (a batch bug, or an injected upload.open
-                # error, must reject nothing the inline path would
-                # accept, and must not dump a batch of serial crypto
-                # onto the event loop either)
-                results = await loop.run_in_executor(
-                    None, _open_fallback_worker, requests
+                # batch-LEVEL failure: bisect the cohort on the thread
+                # pool to isolate the poison row(s) — O(log B) extra
+                # passes, not B serial opens — while rejecting nothing
+                # the inline path would accept (a failing singleton
+                # falls through to the inline open, errors as values)
+                results, offenders = await loop.run_in_executor(
+                    None, _open_bisect_worker, requests
                 )
+                self._note_offenders(batch, offenders)
             took = time.monotonic() - t0
             self._batches += 1
             self._opened += len(batch)
@@ -381,7 +389,7 @@ class UploadOpenBatcher:
         except BaseException as e:
             # nothing above should throw, but a stranded upload handler
             # (future never resolved) is the one unacceptable outcome
-            for _item, fut, _enq in batch:
+            for _item, fut, _enq, _ident in batch:
                 if not fut.done():
                     fut.set_exception(
                         e if isinstance(e, Exception) else RuntimeError(str(e))
@@ -390,13 +398,32 @@ class UploadOpenBatcher:
         finally:
             self._inflight.pop(seq, None)
             self._publish_depth()
-        for (_item, fut, _enq), result in zip(batch, results):
+        for (_item, fut, _enq, _ident), result in zip(batch, results):
             if fut.done():
                 continue
             if isinstance(result, Exception):
                 fut.set_exception(result)
             else:
                 fut.set_result(result)
+
+    def _note_offenders(self, batch, offenders) -> None:
+        """Record bisection-isolated poison rows in the quarantine ledger
+        under their report identity (when the caller supplied one)."""
+        from ..core import quarantine
+
+        quarantine.note_bisection()
+        self._bisections += 1
+        for idx, err in offenders:
+            item, _fut, _enq, ident = batch[idx]
+            task_hex, report_id = ident if ident is not None else (None, None)
+            quarantine.record(
+                "upload_open",
+                task=task_hex,
+                report_id=report_id,
+                error=err,
+                payload=item[2],  # the ciphertext
+            )
+            self._quarantined += 1
 
     def _publish_depth(self) -> None:
         from ..core.metrics import GLOBAL_METRICS
@@ -416,6 +443,8 @@ class UploadOpenBatcher:
             "sheds": dict(self._sheds),
             "batches": self._batches,
             "opened": self._opened,
+            "bisections": self._bisections,
+            "quarantined": self._quarantined,
         }
 
 
@@ -430,9 +459,27 @@ def _open_batch_worker(requests):
     return open_batch(requests)
 
 
-def _open_fallback_worker(requests):
-    """Per-report inline opens (errors as values) — the batch-level
-    failure fallback, also on the thread pool."""
-    from ..core.hpke_batch import _open_one
+def _open_bisect_worker(requests):
+    """Batch-level failure fallback: bisect the cohort to isolate the
+    poison row(s) instead of re-running the FULL batch inline serially (a
+    healthy 499-report cohort must not pay 499 serial opens for one
+    poison row).  The bisection attempt is ``open_batch`` WITHOUT the
+    ``upload.open`` fault hook — an injected transient must heal on the
+    full-cohort retry, not quarantine healthy reports.  A singleton that
+    still fails the batch path gets the per-report inline open (errors as
+    values), so nothing the inline path would accept is ever rejected.
+    Returns (results, offenders) where offenders is [(index, error)]."""
+    from ..core.hpke_batch import _open_one, open_batch
+    from ..core.quarantine import bisect_batch
 
-    return [_open_one(*r) for r in requests]
+    outcome = bisect_batch(requests, open_batch)
+    results = [None] * len(requests)
+    for i, r in outcome.results.items():
+        results[i] = r
+    offenders = []
+    for i, err in outcome.offenders:
+        one = _open_one(*requests[i])
+        results[i] = one
+        if isinstance(one, Exception):
+            offenders.append((i, err))
+    return results, offenders

@@ -229,6 +229,60 @@ def add_taskprov_peer_aggregator(
     click.echo("ok")
 
 
+@cli.command("quarantine-list")
+@click.option("--task", default=None, help="hex task id filter")
+@click.option(
+    "--stage",
+    default=None,
+    help="stage filter (upload_open|prep_init|combine|journal|accumulator_journal)",
+)
+@click.option("--limit", type=int, default=256)
+@click.option("--config-file", type=click.Path(exists=True), default=None)
+def quarantine_list(task, stage, limit, config_file):
+    """List quarantined poison/corrupt rows (ISSUE 19): what the bisection
+    sieve and the journal checksum fence pulled out of the pipeline."""
+    from ..core.time import RealClock
+    from ..datastore import Crypter, Datastore
+    from .config import AggregatorConfig, datastore_keys_from_env, load_config
+
+    cfg = load_config(AggregatorConfig, config_file)
+    ds = Datastore(
+        cfg.common.database.path, Crypter(datastore_keys_from_env()), RealClock()
+    )
+    rows = ds.run_tx(
+        "quarantine_list",
+        lambda tx: tx.get_quarantined_reports(task=task, stage=stage, limit=limit),
+    )
+    for row in rows:
+        click.echo(json.dumps(row))
+    click.echo(f"{len(rows)} quarantined row(s)", err=True)
+
+
+@cli.command("quarantine-purge")
+@click.option("--task", default=None, help="hex task id filter")
+@click.option("--stage", default=None, help="stage filter")
+@click.option("--config-file", type=click.Path(exists=True), default=None)
+@click.confirmation_option(
+    prompt="Purge matching quarantined rows? The offender record is the only "
+    "trace of what was dropped."
+)
+def quarantine_purge(task, stage, config_file):
+    """Purge quarantined rows after investigation (ISSUE 19)."""
+    from ..core.time import RealClock
+    from ..datastore import Crypter, Datastore
+    from .config import AggregatorConfig, datastore_keys_from_env, load_config
+
+    cfg = load_config(AggregatorConfig, config_file)
+    ds = Datastore(
+        cfg.common.database.path, Crypter(datastore_keys_from_env()), RealClock()
+    )
+    purged = ds.run_tx(
+        "quarantine_purge",
+        lambda tx: tx.purge_quarantined_reports(task=task, stage=stage),
+    )
+    click.echo(f"purged {purged} quarantined row(s)")
+
+
 @cli.command("dap-decode")
 @click.argument("message_file", type=click.Path(exists=True))
 @click.option(

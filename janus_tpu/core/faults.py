@@ -32,7 +32,9 @@ tests/test_chaos.py cross-checks them):
 Modes: ``error`` raises :class:`FaultInjectedError`, ``delay`` sleeps
 ``delay_s``, ``hang`` sleeps ``hang_s`` (long enough to trip whatever
 timeout guards the call site), ``skew`` offsets a clock by up to
-``skew_s`` seconds in either direction.  Each point draws from its own
+``skew_s`` seconds in either direction, ``corrupt`` bit-flips or
+truncates a durable payload at a ``corrupt_bytes()`` call site (the
+data failure domain — ISSUE 19).  Each point draws from its own
 ``random.Random`` seeded by ``(seed, point)``, so per-point decision
 sequences are reproducible regardless of how threads interleave across
 points.
@@ -123,9 +125,14 @@ KNOWN_POINTS = (
     # bounded queue backs up into reason="journal" sheds, error mode
     # impersonates a commit failure fanned to every waiting ACK
     "ingest.journal",
+    # durable-row corruption (datastore journal writes, ISSUE 19): a
+    # corrupt-mode spec here bit-flips or truncates journal payload bytes
+    # AFTER the row CRC is computed — impersonating a torn write / media
+    # bit rot that the materialize/replay checksum pass must catch
+    "journal.corrupt",
 )
 
-MODES = ("error", "delay", "hang", "skew", "blackhole", "reset", "flap")
+MODES = ("error", "delay", "hang", "skew", "blackhole", "reset", "flap", "corrupt")
 
 
 class FaultInjectedError(Exception):
@@ -392,6 +399,37 @@ class FaultRegistry:
             rng = self._rngs.get(point)  # None if reconfigured mid-call
             return rng.offset(spec.skew_s) if rng is not None else 0
 
+    def corrupt(
+        self, point: str, data: bytes, target: Optional[str] = None
+    ) -> bytes:
+        """Maybe mangle ``data`` (corrupt-mode specs only).
+
+        Returns ``data`` unchanged when the point is quiet.  When a
+        corrupt-mode spec fires, the payload is either bit-flipped at a
+        deterministically drawn position or truncated (torn write) — both
+        drawn from the point's seeded RNG so a corruption soak replays
+        bit-for-bit.  Empty payloads pass through untouched.
+        """
+        if not data:
+            return data
+        spec = self._decide(point, target)
+        if spec is None or spec.mode != "corrupt":
+            return data
+        self._record(spec)
+        with self._lock:
+            rng = self._rngs.get(point)  # None if reconfigured mid-call
+            if rng is None:
+                return data
+            flip = rng.roll() < 0.5
+            pos_roll = rng.roll()
+        if flip or len(data) == 1:
+            pos = min(int(pos_roll * len(data) * 8), len(data) * 8 - 1)
+            mangled = bytearray(data)
+            mangled[pos // 8] ^= 1 << (pos % 8)
+            return bytes(mangled)
+        # torn write: keep a strict prefix (possibly empty)
+        return data[: int(pos_roll * (len(data) - 1))]
+
 
 class _PointRng:
     """random.Random seeded stably from (seed, point-name)."""
@@ -473,3 +511,12 @@ async def fire_async(point: str, target: Optional[str] = None) -> None:
 
 def skew(point: str = "clock.skew") -> int:
     return _REGISTRY.skew(point) if _REGISTRY.active else 0
+
+
+def corrupt_bytes(point: str, data: bytes, target: Optional[str] = None) -> bytes:
+    """Corruption hook: passthrough when faults are off, else maybe-mangle.
+    Call sites apply this to durable payloads AFTER computing the row CRC,
+    so the stored checksum witnesses the original bytes."""
+    if _REGISTRY.active:
+        return _REGISTRY.corrupt(point, data, target)
+    return data

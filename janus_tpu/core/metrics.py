@@ -758,6 +758,32 @@ class Metrics:
             "replay (startup, creator pre-pass, or migration handoff)",
             registry=self.registry,
         )
+        # -- poison/corruption failure domain (core/quarantine.py, -------
+        # ISSUE 19).  Vectorized passes fail at cohort granularity; the
+        # bisection harness restores per-report failure semantics and
+        # these families are its blast-radius ledger: rows pulled out of
+        # a cohort (by stage), bisection sieves run, and durable journal
+        # rows that failed their CRC32C check at materialize/replay.
+        self.quarantined_reports = Counter(
+            "janus_quarantined_reports_total",
+            "Reports quarantined out of a vectorized cohort, by stage "
+            "(upload_open|prep_init|combine|journal|accumulator_journal|"
+            "bucket)",
+            ["stage"],
+            registry=self.registry,
+        )
+        self.batch_bisections = Counter(
+            "janus_batch_bisections_total",
+            "Batch-level failures routed through the bisection harness "
+            "(each sieve isolates poison rows in O(log B) extra passes)",
+            registry=self.registry,
+        )
+        self.journal_corrupt_rows = Counter(
+            "janus_journal_corrupt_rows_total",
+            "Durable journal rows (report_journal / accumulator_journal) "
+            "that failed CRC32C verification and were quarantined+skipped",
+            registry=self.registry,
+        )
         # -- SLO evaluation plane (core/slo.py) --------------------------
         # Burn rate = window error rate / error budget: 1.0 means the SLO
         # spends its budget exactly at the sustainable pace, >1 means it

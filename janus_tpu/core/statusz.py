@@ -65,6 +65,11 @@ def runtime_status() -> dict:
         # staged-cohort occupancy, and materializer totals — None in
         # synchronous mode or on binaries that serve no uploads
         "ingest": _ingest_stats(),
+        # Blast-radius isolation (ISSUE 19): per-stage quarantine counts,
+        # bisection sieves run, checksum-failed journal rows, and the most
+        # recent offenders — what the operator reads when the quarantine
+        # alert fires
+        "quarantine": _quarantine_stats(),
     }
 
     from ..executor import peek_global_executor
@@ -93,6 +98,9 @@ def runtime_status() -> dict:
             # per-task cost-attribution ledger occupancy: tracked labels
             # vs the cardinality cap, and how much landed on "other"
             "cost_attribution": _cost_stats(),
+            # shape buckets quarantined to the oracle (ISSUE 19) + the
+            # per-shape failure streaks feeding the quarantine gate
+            "bucket_quarantine": ex.bucket_quarantine_stats(),
         }
         doc["accumulator"] = (
             ex.accumulator.stats() if ex.accumulator is not None else None
@@ -166,6 +174,18 @@ def _ingest_stats():
         return {"error": "unavailable"}
 
 
+def _quarantine_stats() -> dict:
+    """Poison/corruption quarantine stats (core/quarantine.py);
+    failure-tolerant like every other section."""
+    try:
+        from .quarantine import quarantine_stats
+
+        return quarantine_stats()
+    except Exception:
+        logger.exception("quarantine stats unavailable")
+        return {"error": "unavailable"}
+
+
 def _cost_stats() -> dict:
     """Per-task cost-attribution occupancy (core/costs.py); failure-
     tolerant like every other section."""
@@ -210,6 +230,7 @@ async def statusz_snapshot(datastore=None, clock=None) -> dict:
             "journal_oldest": oldest,
             "report_journal_rows": r_count,
             "report_journal_oldest": r_oldest,
+            "quarantined_rows": tx.count_quarantined_reports(),
             "leases": tx.lease_summary(),
         }
 
@@ -239,6 +260,10 @@ async def statusz_snapshot(datastore=None, clock=None) -> dict:
             max(0, now_s - r_oldest) if r_oldest is not None else None
         ),
     }
+    # durable offender ledger row count rides on the process-local
+    # quarantine section (the in-memory stats cover this process only)
+    if isinstance(doc.get("quarantine"), dict):
+        doc["quarantine"]["durable_rows"] = shared["quarantined_rows"]
     doc["leases"] = shared["leases"]
     return doc
 

@@ -35,6 +35,7 @@ import time as _time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
 
 from ..core import faults
+from ..core.quarantine import chain_crc
 from ..core.auth_tokens import AuthenticationToken, AuthenticationTokenHash
 from ..core.hpke import HpkeKeypair
 from ..core.time import Clock
@@ -137,6 +138,29 @@ def _decode_extensions(data: bytes) -> List[Extension]:
     out = r.items_u16(Extension._decode)
     r.finish()
     return out
+
+
+def _report_journal_crc(
+    rid: bytes,
+    ts: int,
+    ext_b: Optional[bytes],
+    public_share: Optional[bytes],
+    enc_share: Optional[bytes],
+    helper_b: Optional[bytes],
+) -> int:
+    """CRC32C witness over a report_journal row's payload columns (ISSUE
+    19).  Computed at write time over the bytes as stored (the share
+    ciphertext, not its plaintext) so verification never needs a decrypt."""
+    return chain_crc(
+        rid, int(ts).to_bytes(8, "big"), ext_b, public_share, enc_share, helper_b
+    )
+
+
+def _accumulator_journal_crc(
+    batch_identifier: bytes, param: bytes, job_id: bytes, rids_b: bytes
+) -> int:
+    """CRC32C witness over an accumulator_journal row's payload columns."""
+    return chain_crc(batch_identifier, param, job_id, rids_b)
 
 
 def _metrics_tx(name: str, status: str) -> None:
@@ -2296,18 +2320,29 @@ class Transaction:
         SAME transaction as the writer commit that records these reports
         Finished — the journal row and the FINISHED states are one fact."""
         pk = self._task_pk(task_id)
+        rids_b = b"".join(report_ids)
+        row_crc = _accumulator_journal_crc(
+            batch_identifier, aggregation_parameter, aggregation_job_id.data, rids_b
+        )
+        # corruption fault point AFTER the CRC: stored bytes may lie, the
+        # checksum witnesses what SHOULD have been stored
+        rids_b = faults.corrupt_bytes(
+            "journal.corrupt", rids_b, target="accumulator_journal"
+        )
         try:
             self.conn.execute(
                 """INSERT INTO accumulator_journal (task_id, batch_identifier,
-                    aggregation_param, aggregation_job_id, report_ids, created_at)
-                   VALUES (?,?,?,?,?,?)""",
+                    aggregation_param, aggregation_job_id, report_ids, created_at,
+                    row_crc)
+                   VALUES (?,?,?,?,?,?,?)""",
                 (
                     pk,
                     batch_identifier,
                     aggregation_parameter,
                     aggregation_job_id.data,
-                    b"".join(report_ids),
+                    rids_b,
                     self._now_s(),
+                    row_crc,
                 ),
             )
         except self.ds.backend.integrity_errors as e:
@@ -2319,8 +2354,8 @@ class Transaction:
         self, task_id: TaskId, batch_identifier: Optional[bytes] = None
     ) -> List[AccumulatorJournalEntry]:
         pk = self._task_pk(task_id)
-        sql = """SELECT batch_identifier, aggregation_param, aggregation_job_id,
-                        report_ids, created_at
+        sql = """SELECT id, batch_identifier, aggregation_param, aggregation_job_id,
+                        report_ids, created_at, row_crc
                  FROM accumulator_journal WHERE task_id = ?"""
         args: List[Any] = [pk]
         if batch_identifier is not None:
@@ -2328,7 +2363,21 @@ class Transaction:
             args.append(batch_identifier)
         sql += " ORDER BY id"
         out = []
-        for ident, param, job_id, rids_b, created in self.conn.execute(sql, args):
+        for rowid, ident, param, job_id, rids_b, created, row_crc in self.conn.execute(
+            sql, args
+        ):
+            # NULL row_crc = pre-migration row, accepted unverified
+            if row_crc is not None and row_crc != _accumulator_journal_crc(
+                ident, param, job_id, rids_b or b""
+            ):
+                self._quarantine_corrupt_journal_row(
+                    "accumulator_journal",
+                    "DELETE FROM accumulator_journal WHERE id = ?",
+                    rowid,
+                    task_hex=task_id.data.hex(),
+                    payload=rids_b,
+                )
+                continue
             out.append(
                 AccumulatorJournalEntry(
                     task_id=task_id,
@@ -2342,6 +2391,33 @@ class Transaction:
                 )
             )
         return out
+
+    def _quarantine_corrupt_journal_row(
+        self,
+        stage: str,
+        delete_sql: str,
+        rowid: int,
+        task_hex: Optional[str],
+        payload: Optional[bytes],
+        report_id: Optional[bytes] = None,
+    ) -> None:
+        """Pull a checksum-failed durable row out of its journal: record it
+        in quarantined_reports and DELETE it in the same transaction (a
+        corrupt row left in place would wedge collection readiness gates
+        and re-fail every materialize pass forever).  Counting happens via
+        the process recorder; a tx retry may double-count the metric but
+        the SQL effects re-apply atomically."""
+        from ..core import quarantine
+
+        self.put_quarantined_report(
+            task=task_hex,
+            report_id=report_id,
+            stage=stage,
+            error_class="ChecksumMismatch",
+            payload_digest=quarantine.payload_digest(payload or b""),
+        )
+        self.conn.execute(delete_sql, (rowid,))
+        quarantine.note_corrupt_row(stage)
 
     def count_accumulator_journal_entries_for_batch(
         self,
@@ -2402,22 +2478,39 @@ class Transaction:
         enc_share = self.crypter.encrypt(
             "client_reports", row_ident, "leader_input_share", report.leader_input_share
         )
+        ext_b = _encode_extensions(report.leader_extensions)
+        helper_b = report.helper_encrypted_input_share.get_encoded()
+        row_crc = _report_journal_crc(
+            report.report_id.data,
+            report.time.seconds,
+            ext_b,
+            report.public_share,
+            enc_share,
+            helper_b,
+        )
+        # corruption fault point AFTER the CRC: a fired corrupt-mode spec
+        # stores mangled ciphertext under the honest checksum — exactly
+        # what a torn write / bit rot looks like to the verify pass
+        enc_share = faults.corrupt_bytes(
+            "journal.corrupt", enc_share, target="report_journal"
+        )
         try:
             self.conn.execute(
                 """INSERT INTO report_journal (task_id, report_id, client_timestamp,
                     extensions, public_share, leader_input_share,
-                    helper_encrypted_input_share, trace_id, created_at)
-                   VALUES (?,?,?,?,?,?,?,?,?)""",
+                    helper_encrypted_input_share, trace_id, created_at, row_crc)
+                   VALUES (?,?,?,?,?,?,?,?,?,?)""",
                 (
                     pk,
                     report.report_id.data,
                     report.time.seconds,
-                    _encode_extensions(report.leader_extensions),
+                    ext_b,
                     report.public_share,
                     enc_share,
-                    report.helper_encrypted_input_share.get_encoded(),
+                    helper_b,
                     report.trace_id,
                     self._now_s(),
+                    row_crc,
                 ),
             )
         except self.ds.backend.integrity_errors as e:
@@ -2445,13 +2538,29 @@ class Transaction:
         ``materialize_report_journal_rows``, which never decrypts."""
         pk = self._task_pk(task_id)
         rows = self.conn.execute(
-            """SELECT report_id, client_timestamp, extensions, public_share,
-                      leader_input_share, helper_encrypted_input_share, trace_id
+            """SELECT id, report_id, client_timestamp, extensions, public_share,
+                      leader_input_share, helper_encrypted_input_share, trace_id,
+                      row_crc
                FROM report_journal WHERE task_id = ? ORDER BY id LIMIT ?""",
             (pk, limit),
         ).fetchall()
         out = []
-        for rid, ts, ext_b, public_share, enc_share, helper_b, trace_id in rows:
+        for rowid, rid, ts, ext_b, public_share, enc_share, helper_b, trace_id, crc in rows:
+            # checksum fence BEFORE the decrypt: a torn/bit-flipped
+            # ciphertext would fail its AEAD tag and crash the replay —
+            # quarantine + skip instead (NULL crc = pre-migration row)
+            if crc is not None and crc != _report_journal_crc(
+                rid, ts, ext_b, public_share, enc_share, helper_b
+            ):
+                self._quarantine_corrupt_journal_row(
+                    "journal",
+                    "DELETE FROM report_journal WHERE id = ?",
+                    rowid,
+                    task_hex=task_id.data.hex(),
+                    payload=enc_share,
+                    report_id=rid,
+                )
+                continue
             share = self.crypter.decrypt(
                 "client_reports", task_id.data + rid, "leader_input_share", enc_share
             )
@@ -2494,17 +2603,38 @@ class Transaction:
         not steal rows out from under the upload replica's direct
         staged-cohort consumer (stealing is SAFE — the row delete
         linearizes the race — but it downgrades a zero-copy packing to a
-        read-back for no reason)."""
-        ids = [
-            r[0]
-            for r in self.conn.execute(
-                "SELECT id FROM report_journal WHERE created_at <= ?"
-                " ORDER BY id LIMIT ?",
-                (self._now_s() - min_age_s, limit),
-            )
-        ]
+        read-back for no reason).
+
+        Every candidate row's CRC32C is verified first (ISSUE 19): a
+        checksum-failed row is quarantined + consumed WITHOUT materializing
+        — corruption costs one counted report, never a crashed binary or a
+        materializer that re-fails the same fold forever.  Corrupt rows
+        count as consumed in the returned tuple."""
+        candidates = self.conn.execute(
+            """SELECT rj.id, rj.report_id, rj.client_timestamp, rj.extensions,
+                      rj.public_share, rj.leader_input_share,
+                      rj.helper_encrypted_input_share, rj.row_crc, t.task_id
+               FROM report_journal rj JOIN tasks t ON t.id = rj.task_id
+               WHERE rj.created_at <= ? ORDER BY rj.id LIMIT ?""",
+            (self._now_s() - min_age_s, limit),
+        ).fetchall()
+        ids = []
+        for rowid, rid, ts, ext_b, public, enc, helper_b, crc, task_blob in candidates:
+            if crc is not None and crc != _report_journal_crc(
+                rid, ts, ext_b, public, enc, helper_b
+            ):
+                self._quarantine_corrupt_journal_row(
+                    "journal",
+                    "DELETE FROM report_journal WHERE id = ?",
+                    rowid,
+                    task_hex=bytes(task_blob).hex(),
+                    payload=enc,
+                    report_id=rid,
+                )
+                continue
+            ids.append(rowid)
         if not ids:
-            return 0, 0
+            return len(candidates), 0
         ph = ",".join("?" * len(ids))
         cur = self.conn.execute(
             f"""INSERT INTO client_reports (task_id, report_id, client_timestamp,
@@ -2522,7 +2652,7 @@ class Transaction:
         )
         materialized = cur.rowcount
         self.conn.execute(f"DELETE FROM report_journal WHERE id IN ({ph})", ids)
-        return len(ids), materialized
+        return len(candidates), materialized
 
     def report_journal_stats(self) -> Tuple[int, Optional[int]]:
         """(outstanding rows, oldest created_at) across every task — the
@@ -2557,6 +2687,90 @@ class Transaction:
             (pk, report_id.data, client_timestamp.seconds, trace_id, self._now_s()),
         )
         return cur.rowcount > 0
+
+    # ------------------------------------------------------------------
+    # quarantined reports (blast-radius isolation, core/quarantine.py;
+    # schema.py _QUARANTINE_SCHEMA).  The durable offender ledger: rows
+    # pulled out of a vectorized cohort by bisection, or durable journal
+    # rows that failed their CRC.  Writes are idempotent (dedupe index +
+    # DO NOTHING) so replays and client retries of the same poison report
+    # record once.
+
+    def put_quarantined_report(
+        self,
+        task: Optional[str],
+        report_id: Optional[bytes],
+        stage: str,
+        error_class: str,
+        payload_digest: Optional[str] = None,
+    ) -> bool:
+        cur = self.conn.execute(
+            """INSERT INTO quarantined_reports
+                   (task, report_id, stage, error_class, payload_digest,
+                    created_at)
+               VALUES (?,?,?,?,?,?)
+               ON CONFLICT DO NOTHING""",
+            (task, report_id, stage, error_class, payload_digest, self._now_s()),
+        )
+        return cur.rowcount > 0
+
+    def get_quarantined_reports(
+        self,
+        task: Optional[str] = None,
+        stage: Optional[str] = None,
+        limit: int = 256,
+    ) -> List[Dict[str, Any]]:
+        sql = (
+            "SELECT task, report_id, stage, error_class, payload_digest,"
+            " created_at FROM quarantined_reports"
+        )
+        conds, args = [], []
+        if task is not None:
+            conds.append("task = ?")
+            args.append(task)
+        if stage is not None:
+            conds.append("stage = ?")
+            args.append(stage)
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        sql += " ORDER BY id LIMIT ?"
+        args.append(limit)
+        return [
+            {
+                "task": t,
+                "report_id": bytes(rid).hex() if rid is not None else None,
+                "stage": s,
+                "error_class": ec,
+                "payload_digest": dig,
+                "created_at": int(created),
+            }
+            for t, rid, s, ec, dig, created in self.conn.execute(sql, args)
+        ]
+
+    def count_quarantined_reports(self, stage: Optional[str] = None) -> int:
+        if stage is None:
+            return self.conn.execute(
+                "SELECT COUNT(*) FROM quarantined_reports"
+            ).fetchone()[0]
+        return self.conn.execute(
+            "SELECT COUNT(*) FROM quarantined_reports WHERE stage = ?", (stage,)
+        ).fetchone()[0]
+
+    def purge_quarantined_reports(
+        self, task: Optional[str] = None, stage: Optional[str] = None
+    ) -> int:
+        sql = "DELETE FROM quarantined_reports"
+        conds, args = [], []
+        if task is not None:
+            conds.append("task = ?")
+            args.append(task)
+        if stage is not None:
+            conds.append("stage = ?")
+            args.append(stage)
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        cur = self.conn.execute(sql, args)
+        return cur.rowcount
 
     # ------------------------------------------------------------------
     # upload counters (reference: datastore.rs:5326-5429)

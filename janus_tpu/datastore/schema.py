@@ -342,6 +342,40 @@ CREATE INDEX IF NOT EXISTS report_journal_by_task
     ON report_journal(task_id, client_timestamp);
 """
 
+_QUARANTINE_SCHEMA = """
+-- Blast-radius isolation (core/quarantine.py, ISSUE 19).
+--
+-- quarantined_reports: the durable offender ledger.  A row means a report
+-- (or durable journal row) was pulled out of a vectorized cohort — poison
+-- isolated by batch bisection, or a CRC32C checksum failure at journal
+-- materialize/replay — so the healthy remainder could proceed.  `task` is
+-- the hex task id (TEXT: executor stages may only know an opaque task
+-- label); `report_id` is NULL for offenders with no per-report identity
+-- (combine rows, torn journal rows whose id column itself is suspect).
+-- The UNIQUE index + ON CONFLICT DO NOTHING writes make recording
+-- idempotent across replays and client retries of the same poison report.
+CREATE TABLE IF NOT EXISTS quarantined_reports (
+    id INTEGER PRIMARY KEY,
+    task TEXT,
+    report_id BLOB,
+    stage TEXT NOT NULL,
+    error_class TEXT NOT NULL,
+    payload_digest TEXT,
+    created_at INTEGER NOT NULL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS quarantined_reports_dedupe
+    ON quarantined_reports(task, report_id, stage);
+CREATE INDEX IF NOT EXISTS quarantined_reports_by_stage
+    ON quarantined_reports(stage, created_at);
+
+-- row_crc: CRC32C over a length-prefixed concatenation of the row's
+-- payload columns, computed at write time and verified at materialize /
+-- replay / readback.  NULL marks a pre-migration row (accepted
+-- unverified — the checksum cannot be retrofitted without the plaintext).
+ALTER TABLE report_journal ADD COLUMN row_crc INTEGER;
+ALTER TABLE accumulator_journal ADD COLUMN row_crc INTEGER;
+"""
+
 #: MIGRATIONS[k]: DDL taking schema version k -> k+1.  Append-only — never
 #: edit an entry that has shipped (existing stores have already applied it).
 MIGRATIONS = [
@@ -351,6 +385,7 @@ MIGRATIONS = [
     _UPLOAD_TRACE_SCHEMA,
     _FLEET_MEMBERS_SCHEMA,
     _REPORT_JOURNAL_SCHEMA,
+    _QUARANTINE_SCHEMA,
 ]
 
 SCHEMA_VERSION = len(MIGRATIONS)

@@ -152,6 +152,28 @@ class ExecutorConfig:
     #: device-resident accumulator store (accumulator.AccumulatorConfig);
     #: None or .enabled=False = out shares read back per flush (legacy)
     accumulator: Optional[object] = None
+    #: batch bisection quarantine (ISSUE 19): a NON-injected batch-level
+    #: launch failure retries the cohort in halves (core/quarantine.py) to
+    #: isolate poison rows — healthy rows resolve normally, offenders get
+    #: in-band VdafError outcomes and land in the quarantine ledger.  A
+    #: poison report costs O(log B) extra passes once, never a wedged
+    #: pipeline or a permanently-tripped breaker.  False = legacy fail-all.
+    bisection_enabled: bool = True
+    #: per-report retry-charge cap during a bisection sieve; a range whose
+    #: most-charged row hits the budget is quarantined wholesale
+    bisection_per_item_budget: int = 16
+    #: repeated NON-injected device failures confined to ONE shape while
+    #: another shape on the same breaker domain stays healthy quarantine
+    #: that shape bucket to the CPU oracle instead of opening the shared
+    #: (mesh-wide) breaker — blast-radius reduction; 0 = off
+    bucket_quarantine_threshold: int = 2
+    #: how long a quarantined shape bucket routes to the oracle before
+    #: device submissions flow again
+    bucket_quarantine_s: float = 60.0
+    #: a failing shape only quarantines (vs counting against the breaker)
+    #: when ANOTHER shape on its breaker domain succeeded within this
+    #: window — the proof the mesh itself is healthy
+    bucket_quarantine_success_window_s: float = 30.0
 
 
 class CircuitBreaker:
@@ -441,6 +463,17 @@ class DeviceExecutor:
         #: means there is nowhere durable to spill — shutdown falls back
         #: to the logged discard (redelivery / journal replay re-derives).
         self._spill_sink = None
+        #: blast-radius quarantine (ISSUE 19): shape_key -> quarantine
+        #: expiry (monotonic).  While set, circuit_open() peeks True and
+        #: submit() raises CircuitOpenError for the shape — callers serve
+        #: from the CPU oracle — WITHOUT the shared breaker tripping.
+        self._quarantined_shapes: Dict[tuple, float] = {}
+        #: shape_key -> consecutive non-injected launch-failure streak
+        self._shape_fail_streak: Dict[tuple, int] = {}
+        #: breaker domain -> (monotonic time, shape_key) of last success:
+        #: the mesh-health witness the quarantine gate consults
+        self._domain_last_success: Dict[object, tuple] = {}
+        self._bucket_quarantines = 0
         if acc_cfg is not None and getattr(acc_cfg, "enabled", False):
             from .accumulator import DeviceAccumulatorStore
 
@@ -686,6 +719,14 @@ class DeviceExecutor:
             raise CircuitOpenError(
                 f"device circuit {breaker.label} is open after "
                 f"{breaker.consecutive_failures} consecutive launch failure(s)"
+            )
+        if self._bucket_quarantined(shape_key):
+            # the shape bucket is quarantined to the oracle (ISSUE 19):
+            # same caller-visible contract as an open circuit, but scoped
+            # to this one shape — the rest of the mesh keeps launching
+            raise CircuitOpenError(
+                f"shape bucket #{_shape_digest(shape_key)} is quarantined "
+                f"to the CPU oracle"
             )
         loop = asyncio.get_running_loop()
         now = time.monotonic()
@@ -1197,6 +1238,7 @@ class DeviceExecutor:
                 return
             if bucket.breaker is not None:
                 bucket.breaker.record_success()
+            self._note_launch_success(bucket)
             launch_ok = True
             done = time.monotonic()
             launch_s = done - t_launch
@@ -1258,6 +1300,33 @@ class DeviceExecutor:
             )
         except Exception as e:  # surface the launch failure to every job
             done = time.monotonic()
+            if (
+                not launch_ok
+                and self.config.bisection_enabled
+                and not isinstance(e, faults.FaultInjectedError)
+                and bucket.kind in (KIND_PREP_INIT, KIND_COMBINE)
+                and rows >= 2
+            ):
+                # Batch-level failure that is NOT an injected transient:
+                # sieve the cohort for poison rows before condemning the
+                # whole flush (and the device) for one bad report.  An
+                # injected fault takes the legacy path — chaos soaks
+                # assert transient faults heal via retry/breaker, and
+                # bisecting them would quarantine healthy reports.
+                if await self._bisect_failed_flush(
+                    bucket,
+                    live,
+                    e,
+                    trigger,
+                    rows,
+                    padded_rows,
+                    queue_delay_max,
+                    model,
+                    stage_s,
+                    t_launch,
+                ):
+                    return
+                done = time.monotonic()
             if not launch_ok:
                 launch_s = max(0.0, done - t_launch)
                 # attribute whatever the chip DID spend before failing,
@@ -1288,8 +1357,7 @@ class DeviceExecutor:
                     fault=isinstance(e, faults.FaultInjectedError),
                     error=e,
                 )
-                if bucket.breaker is not None:
-                    bucket.breaker.record_failure()
+                self._record_flush_failure(bucket, e)
             else:
                 logger.exception(
                     "flush bookkeeping failed after a successful launch "
@@ -1299,6 +1367,276 @@ class DeviceExecutor:
             for s in live:
                 self._finish(bucket, s, done)
                 self._resolve(s, exc=e)
+
+    async def _bisect_failed_flush(
+        self,
+        bucket: _Bucket,
+        live: List[_Submission],
+        exc: Exception,
+        trigger: str,
+        rows: int,
+        padded_rows: int,
+        queue_delay_max: float,
+        model,
+        stage_s: float,
+        t_launch: float,
+    ) -> bool:
+        """Sieve a failed mega-batch for poison rows (ISSUE 19).
+
+        Runs the cohort through ``quarantine.bisect_batch`` on the launch
+        pool: the full cohort is retried once (an absorbed transient costs
+        one extra pass and quarantines nothing), then failing halves split
+        until the poison row(s) are isolated within the per-report budget.
+        Healthy rows resolve with their real results and the breaker
+        records a SUCCESS (the device demonstrably works); offenders get
+        in-band VdafError outcomes — the exact value drivers already map
+        to PrepareError.VDAF_PREP_ERROR — and land in the quarantine
+        ledger under their report identity.
+
+        Returns False (caller runs the legacy fail-all path) when every
+        singleton failed — that is the PASS failing, not a poison row —
+        or when the sieve itself errored.  Bisection retries never pass
+        ``retain_store``: retried rows return host vectors, which every
+        caller already handles (mixed batches fall back the same way).
+        """
+        from ..core import quarantine
+
+        items: List[tuple] = []
+        if bucket.kind == KIND_PREP_INIT:
+            for si, s in enumerate(live):
+                for row in s.payload[1]:
+                    items.append((si, row))
+
+            def attempt(subset):
+                by_sub: Dict[int, list] = {}
+                for si, row in subset:
+                    by_sub.setdefault(si, []).append(row)
+                reqs = []
+                for si in sorted(by_sub):
+                    p = live[si].payload
+                    # preserve the payload's tail (canonical backends ride
+                    # the task vdaf as a third element)
+                    reqs.append((p[0], by_sub[si]) + tuple(p[2:]))
+                staged = bucket.backend.stage_prep_init_multi(bucket.agg_id, reqs)
+                outs = bucket.backend.launch_prep_init_multi(staged, reqs)
+                return [o for per_req in outs for o in per_req]
+
+        else:  # KIND_COMBINE
+            for si, s in enumerate(live):
+                for row in s.payload:
+                    items.append((si, row))
+
+            def attempt(subset):
+                return bucket.backend.prep_shares_to_prep_batch(
+                    [row for _si, row in subset]
+                )
+
+        loop = asyncio.get_running_loop()
+        _, launch_pool = self._pools()
+        try:
+            outcome = await loop.run_in_executor(
+                launch_pool,
+                lambda: quarantine.bisect_batch(
+                    items, attempt, self.config.bisection_per_item_budget
+                ),
+            )
+        except Exception:
+            logger.exception("bisection sieve failed (bucket %s)", bucket.label)
+            return False
+        quarantine.note_bisection()
+        if outcome.offenders and not outcome.attributable:
+            # every singleton failed: the pass is broken (device lost, bad
+            # build) — not poison.  Legacy path: fail-all + breaker (or
+            # bucket quarantine when the rest of the domain is healthy).
+            return False
+
+        from ..vdaf.prio3 import VdafError
+
+        stage = "prep_init" if bucket.kind == KIND_PREP_INIT else "combine"
+        poisoned: Dict[int, VdafError] = {}
+        for idx, err in outcome.offenders:
+            si, row = items[idx]
+            report_id = None
+            if (
+                bucket.kind == KIND_PREP_INIT
+                and isinstance(row, tuple)
+                and row
+                and isinstance(row[0], (bytes, bytearray))
+            ):
+                report_id = bytes(row[0])
+            task = live[si].task
+            quarantine.record(
+                stage,
+                task=(
+                    task.hex()
+                    if isinstance(task, (bytes, bytearray))
+                    else (str(task) if task is not None else None)
+                ),
+                report_id=report_id,
+                error=err,
+                payload=row,
+            )
+            poisoned[idx] = VdafError(
+                f"row quarantined by batch bisection: {type(err).__name__}"
+            )
+
+        per_sub: List[list] = [[] for _ in live]
+        for idx, (si, _row) in enumerate(items):
+            if idx in poisoned:
+                per_sub[si].append(poisoned[idx])
+            else:
+                per_sub[si].append(outcome.results[idx])
+
+        done = time.monotonic()
+        launch_s = max(0.0, done - t_launch)
+        if bucket.breaker is not None:
+            # the sieve proved the device healthy — a poison row must
+            # never trip the circuit
+            bucket.breaker.record_success()
+        self._note_launch_success(bucket)
+        bucket.flushes += 1
+        bucket.flushed_rows += rows
+        bucket.flushed_jobs += len(live)
+        self._observe_flush(bucket, rows, launch_s)
+        self._observe_pad(bucket, padded_rows)
+        model.attribute_flush(
+            [(s.task, s.rows) for s in live],
+            {"stage": stage_s, "launch": launch_s},
+            path="device",
+        )
+        offender_rows: Dict[int, int] = {}
+        for idx in poisoned:
+            si = items[idx][0]
+            offender_rows[si] = offender_rows.get(si, 0) + 1
+        for si, s in enumerate(live):
+            bad = offender_rows.get(si, 0)
+            if s.rows - bad:
+                model.observe_rows(s.task, "ok", s.rows - bad)
+            if bad:
+                model.observe_rows(s.task, "error", bad)
+            self._finish(bucket, s, done)
+            self._observe_wait(bucket, done - s.enqueued)
+            self._resolve(s, result=per_sub[si])
+        self.flight_recorder.record(
+            bucket=bucket.label,
+            trigger=trigger,
+            rows=rows,
+            padded_rows=padded_rows,
+            tasks=[model.label_for(s.task) for s in live],
+            queue_delay_max_s=queue_delay_max,
+            stage_s=stage_s,
+            launch_s=launch_s,
+            outcome="bisected",
+            breaker_state=self._breaker_state_name(bucket),
+            fault=False,
+            error=exc,
+        )
+        logger.warning(
+            "bisected failed flush (bucket %s): %d/%d row(s) quarantined "
+            "in %d attempt(s)%s",
+            bucket.label,
+            len(outcome.offenders),
+            len(items),
+            outcome.attempts,
+            " [budget exhausted]" if outcome.exhausted else "",
+        )
+        return True
+
+    def _note_launch_success(self, bucket: _Bucket) -> None:
+        """A launch landed: clear the shape's failure streak and stamp its
+        breaker domain's health witness (the quarantine gate's evidence
+        that the mesh itself works)."""
+        shape_key = bucket.key[0]
+        with self._lock:
+            self._shape_fail_streak.pop(shape_key, None)
+            self._quarantined_shapes.pop(shape_key, None)
+            domain = breaker_domain(shape_key, bucket.backend)
+            self._domain_last_success[domain] = (time.monotonic(), shape_key)
+
+    def _record_flush_failure(self, bucket: _Bucket, exc: Exception) -> None:
+        """Count a launch failure.  Usually the breaker — but repeated
+        NON-injected failures confined to ONE shape while another shape on
+        the same breaker domain stays demonstrably healthy quarantine that
+        shape bucket to the oracle instead (ISSUE 19): a shape-local
+        failure (bad compile, pathological input shape) must not open the
+        mesh-wide circuit and drag every healthy shape to the oracle with
+        it."""
+        shape_key = bucket.key[0]
+        if self.config.bucket_quarantine_threshold > 0 and not isinstance(
+            exc, faults.FaultInjectedError
+        ):
+            now = time.monotonic()
+            quarantined = False
+            with self._lock:
+                streak = self._shape_fail_streak.get(shape_key, 0) + 1
+                self._shape_fail_streak[shape_key] = streak
+                domain = breaker_domain(shape_key, bucket.backend)
+                last = self._domain_last_success.get(domain)
+                domain_healthy = (
+                    last is not None
+                    and last[1] != shape_key
+                    and now - last[0]
+                    <= self.config.bucket_quarantine_success_window_s
+                )
+                if (
+                    streak >= self.config.bucket_quarantine_threshold
+                    and domain_healthy
+                ):
+                    self._quarantined_shapes[shape_key] = (
+                        now + self.config.bucket_quarantine_s
+                    )
+                    self._bucket_quarantines += 1
+                    quarantined = True
+            if quarantined:
+                from ..core import quarantine
+
+                quarantine.record(
+                    "bucket",
+                    task=bucket.label,
+                    error=exc,
+                    durable=False,
+                )
+                logger.warning(
+                    "quarantined shape bucket %s to the CPU oracle for %.0fs "
+                    "after %d shape-local failure(s); breaker %s stays closed",
+                    bucket.label,
+                    self.config.bucket_quarantine_s,
+                    streak,
+                    bucket.breaker.label if bucket.breaker else "<none>",
+                )
+                return
+        if bucket.breaker is not None:
+            bucket.breaker.record_failure()
+
+    def _bucket_quarantined(self, shape_key: tuple) -> bool:
+        """Is the shape bucket inside its quarantine dwell?  Expired
+        entries are reaped on the way out (the next submission runs on the
+        device and a success clears the streak)."""
+        now = time.monotonic()
+        with self._lock:
+            exp = self._quarantined_shapes.get(shape_key)
+            if exp is None:
+                return False
+            if now >= exp:
+                del self._quarantined_shapes[shape_key]
+                return False
+            return True
+
+    def bucket_quarantine_stats(self) -> dict:
+        """The /statusz face of the shape-bucket quarantine."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "total": self._bucket_quarantines,
+                "quarantined": {
+                    f"#{_shape_digest(k)}": round(max(0.0, exp - now), 2)
+                    for k, exp in self._quarantined_shapes.items()
+                },
+                "fail_streaks": {
+                    f"#{_shape_digest(k)}": v
+                    for k, v in self._shape_fail_streak.items()
+                },
+            }
 
     @staticmethod
     def _release_dropped_refs(store, outcomes) -> None:
@@ -1436,7 +1774,11 @@ class DeviceExecutor:
         once the dwell has elapsed so the next real submission runs the
         half-open probe that can close the circuit.  Mesh-backed shapes
         share their mesh's breaker, so after a device loss this returns
-        True for EVERY shape on that mesh."""
+        True for EVERY shape on that mesh.  A quarantined shape bucket
+        (ISSUE 19) also peeks True — same oracle routing, scoped to the
+        one shape — until its quarantine dwell expires."""
+        if self._bucket_quarantined(shape_key):
+            return True
         with self._lock:
             br = self._breaker_by_shape.get(shape_key) or self._breakers.get(
                 shape_key

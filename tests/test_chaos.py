@@ -130,6 +130,7 @@ def test_every_known_point_is_wired():
         "accumulator.evict": "janus_tpu/executor/accumulator.py",
         "accumulator.replay": "janus_tpu/aggregator/collection_job_driver.py",
         "ingest.journal": "janus_tpu/core/ingest.py",
+        "journal.corrupt": "janus_tpu/datastore/datastore.py",
     }
     assert set(wiring) == set(faults.KNOWN_POINTS)
     for point, rel in wiring.items():

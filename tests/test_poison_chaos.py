@@ -1,0 +1,417 @@
+"""Poisoned-batch blast-radius chaos soak (ISSUE 19 acceptance).
+
+``./ci.sh chaos poison``: the full-stack proof that a poison row costs
+O(log B) extra passes and one quarantine ledger entry — never a wedged
+batch, a tripped breaker, or a lost healthy cohort.
+
+* ``test_poison_soak_quarantines_every_stage_and_collects_healthy_cohort``
+  — the journaled leader + helper fleet takes three poison flavors in
+  one soak: (A) ciphertexts that wedge the vectorized HPKE open batch
+  (bisection isolates them; the singleton retry rejects them 400 the
+  same way the inline path would), (B) bit-flipped report-journal rows
+  (``journal.corrupt`` fault — CRC32C catches them at materialize), and
+  (C) a prep row that wedges every device flush containing it (executor
+  bisection resolves it to an in-band VdafError).  Every offender lands
+  in ``quarantined_reports`` under its report id, zero breaker trips,
+  every job Finished, and collection is exactly-once with exact Prio3
+  sums over the healthy cohort only.
+* ``test_poison_free_run_is_bit_for_bit_unchanged`` — the parity fence
+  on STORED ROWS: with all the quarantine machinery armed (it always
+  is), a poison-free journaled run still decrypts to byte-identical
+  client_reports vs the synchronous path, with zero quarantine/bisection
+  activity.
+* ``test_poison_free_prepare_messages_unchanged_by_bisection_machinery``
+  — the parity fence on PREPARE MESSAGES: the same cohort staged through
+  the executor with ``bisection_enabled`` on vs off produces identical
+  prepare-share wire bytes (the sieve is a failure path, not a rewrite
+  of the happy path).
+
+Seeded via JANUS_CHAOS_SEED (./ci.sh chaos pins it) like the rest of the
+chaos tier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import pytest
+
+from test_chaos import NOW, SEED, TIME_PRECISION, ChaosHarness, _run
+
+from janus_tpu.core import faults, quarantine
+from janus_tpu.core.faults import FaultSpec
+from janus_tpu.core.metrics import GLOBAL_METRICS
+from janus_tpu.executor import reset_global_executor
+
+#: recognizable prefix a poisoned upload carries — the patched vector
+#: open wedges the WHOLE batch on it (the adversarial shape bisection
+#: exists for: a row that crashes the vectorized pass, not one that
+#: merely fails to decrypt)
+POISON_MARK = b"\xde\xadPOISON\xbe\xef"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    quarantine.reset()
+    reset_global_executor()
+    yield
+    faults.clear()
+    quarantine.reset()
+    reset_global_executor()
+
+
+def _sample(name, labels=None):
+    return GLOBAL_METRICS.get_sample_value(name, labels or {}) or 0.0
+
+
+def _make_report(harness, task_idx, measurement):
+    from janus_tpu.client import prepare_report
+
+    task_id, leader_task, helper_task = harness.tasks[task_idx]
+    return prepare_report(
+        leader_task.vdaf_instance(),
+        task_id,
+        leader_task.hpke_keys[0].config,
+        helper_task.hpke_keys[0].config,
+        TIME_PRECISION,
+        measurement,
+        time=NOW,
+    )
+
+
+async def _upload_raw(harness, task_idx, report):
+    """harness.upload asserts 201; the poison legs need the raw status."""
+    task_id = harness.tasks[task_idx][0]
+    return await harness.leader_client.put(
+        f"/tasks/{task_id}/reports", data=report.get_encoded()
+    )
+
+
+def _poisoned(report):
+    """Same report, leader ciphertext payload prefixed with the poison
+    mark (config id + encapsulated key stay valid so the keypair lookup
+    succeeds and the row reaches the vectorized open)."""
+    from janus_tpu.messages import HpkeCiphertext, Report
+
+    ct = report.leader_encrypted_input_share
+    return Report(
+        report.metadata,
+        report.public_share,
+        HpkeCiphertext(ct.config_id, ct.encapsulated_key, POISON_MARK + ct.payload),
+        report.helper_encrypted_input_share,
+    )
+
+
+def _quarantined_by_stage(datastore):
+    rows = datastore.run_tx(
+        "quarantined", lambda tx: tx.get_quarantined_reports(limit=1024)
+    )
+    by_stage = {}
+    for row in rows:
+        by_stage.setdefault(row["stage"], set()).add(row["report_id"])
+    return rows, by_stage
+
+
+def test_poison_soak_quarantines_every_stage_and_collects_healthy_cohort():
+    from janus_tpu.aggregator import Aggregator, Config
+
+    measurements = {0: [1, 0, 1, 1], 1: [1, 1, 0, 1]}
+
+    async def flow():
+        harness = ChaosHarness(n_tasks=2)
+        # the soak runs the ISSUE 18 journaled front door (journal rows
+        # are where the corrupt-leg CRCs live) — swap the leader BEFORE
+        # start() builds the HTTP app from harness.leader_agg
+        old_leader = harness.leader_agg
+        harness.leader_agg = Aggregator(
+            harness.leader_ds.datastore,
+            harness.clock,
+            Config(
+                vdaf_backend="oracle",
+                max_upload_batch_write_delay=0.02,
+                upload_open_backend="batched",
+                upload_open_batch_delay=0.02,
+                ingest_mode="journaled",
+                ingest_stage_direct=False,
+                ingest_journal_write_delay=0.02,
+            ),
+        )
+        await old_leader.shutdown()
+        bisections_before = _sample("janus_batch_bisections_total")
+        try:
+            await harness.start()
+            healthy = {
+                t: [_make_report(harness, t, m) for m in ms]
+                for t, ms in measurements.items()
+            }
+            poison_uploads = {
+                t: _poisoned(_make_report(harness, t, 1)) for t in measurements
+            }
+
+            # -- leg A: poisoned ciphertexts wedge the vectorized open --
+            # The REAL open_batch rejects garbage in-band (HpkeError as a
+            # value); the adversarial case is a row that crashes the
+            # whole vector pass.  Patch the module attr (_open_batch_worker
+            # and _open_bisect_worker import it per call) so any cohort
+            # carrying the mark raises batch-level — bisection must
+            # isolate it while the singleton retry falls through to the
+            # inline open and rejects it exactly like a serial upload.
+            from janus_tpu.core import hpke_batch
+
+            real_open_batch = hpke_batch.open_batch
+
+            def wedging_open_batch(requests):
+                if any(POISON_MARK in req[2].payload for req in requests):
+                    raise RuntimeError("vector open wedged by poisoned ciphertext")
+                return real_open_batch(requests)
+
+            hpke_batch.open_batch = wedging_open_batch
+            try:
+                # one gather so healthy + poison coalesce into shared
+                # open batches — the sieve must carve, not reject-all
+                uploads = [
+                    (t, r) for t, rs in healthy.items() for r in rs
+                ] + [(t, poison_uploads[t]) for t in measurements]
+                statuses = await asyncio.gather(
+                    *(_upload_raw(harness, t, r) for t, r in uploads)
+                )
+            finally:
+                hpke_batch.open_batch = real_open_batch
+            n_healthy = sum(len(rs) for rs in healthy.values())
+            assert [r.status for r in statuses[:n_healthy]] == [201] * n_healthy, [
+                (r.status, await r.text()) for r in statuses
+            ]
+            assert [r.status for r in statuses[n_healthy:]] == [400, 400], [
+                (r.status, await r.text()) for r in statuses[n_healthy:]
+            ]
+
+            # -- leg B: bit-flipped journal rows --------------------------
+            # these ACK 201 (the journal row IS the ACK; the CRC witnesses
+            # what SHOULD have been stored) but fail the checksum at
+            # materialize: quarantined + consumed, never client_reports
+            corrupt_reports = {t: _make_report(harness, t, 1) for t in measurements}
+            faults.configure(
+                [FaultSpec("journal.corrupt", "corrupt", 1.0, target="report_journal")],
+                seed=SEED,
+            )
+            try:
+                rs = await asyncio.gather(
+                    *(_upload_raw(harness, t, corrupt_reports[t]) for t in measurements)
+                )
+                assert all(r.status == 201 for r in rs), [r.status for r in rs]
+            finally:
+                faults.clear()
+
+            # write-behind materialize: healthy journal rows column-copy
+            # into client_reports, the corrupt pair quarantines
+            for _ in range(16):
+                consumed, _materialized = await harness.leader_agg.ingest.materialize_once()
+                if consumed == 0:
+                    break
+            assert (
+                harness.leader_ds.datastore.run_tx(
+                    "count", lambda tx: tx.count_report_journal_rows()
+                )
+                == 0
+            )
+
+            # -- leg C: a poison prep row wedges every device flush -------
+            # (covers leader drivers AND the helper: both prep through
+            # TpuBackend on the shared executor, both bisect)
+            from janus_tpu.vdaf.backend import TpuBackend
+
+            poison_prep_id = healthy[0][0].metadata.report_id.data  # measurement 1
+            real_stage = TpuBackend.stage_prep_init_multi
+
+            def wedging_stage(self, agg_id, requests, pad_to=None):
+                for req in requests:
+                    for row in req[1]:
+                        if (
+                            isinstance(row, tuple)
+                            and row
+                            and row[0] == poison_prep_id
+                        ):
+                            raise RuntimeError("device wedged by poisoned prep row")
+                return real_stage(self, agg_id, requests, pad_to=pad_to)
+
+            TpuBackend.stage_prep_init_multi = wedging_stage
+            try:
+                await harness.create_jobs()
+                states = []
+                for _ in range(40):
+                    await harness.drive_round()
+                    states = harness.agg_job_states()
+                    if states and all(s == "Finished" for s in states):
+                        break
+            finally:
+                TpuBackend.stage_prep_init_multi = real_stage
+            # zero batch wedges: every job converges despite the poison
+            assert states and all(s == "Finished" for s in states), states
+            assert "Abandoned" not in states
+
+            # poison is NOT a device failure: zero breaker trips, and the
+            # (task, shape) bucket never quarantined (failures were
+            # attributable to rows, not the bucket)
+            ex = harness.drivers[0]._executor
+            assert all(
+                s["trips"] == 0 for s in ex.circuit_stats().values()
+            ), ex.circuit_stats()
+            assert ex.bucket_quarantine_stats()["total"] == 0
+
+            # -- the ledger: every poison row under its report id ---------
+            assert quarantine.recorder().drain(10.0)
+            rows, by_stage = _quarantined_by_stage(harness.leader_ds.datastore)
+            assert by_stage.get("upload_open") == {
+                poison_uploads[t].metadata.report_id.data.hex() for t in measurements
+            }, by_stage
+            assert by_stage.get("journal") == {
+                corrupt_reports[t].metadata.report_id.data.hex() for t in measurements
+            }, by_stage
+            assert by_stage.get("prep_init") == {poison_prep_id.hex()}, by_stage
+            assert all(
+                r["error_class"] == "ChecksumMismatch"
+                for r in rows
+                if r["stage"] == "journal"
+            ), rows
+            # leader + helper both bisected the poison prep row; dedupe
+            # keeps the durable ledger at one row per (task, id, stage)
+            assert len(rows) == 5, rows
+
+            # observability: the sieve ran, counters + /statusz agree
+            assert _sample("janus_batch_bisections_total") - bisections_before >= 2
+            assert _sample("janus_journal_corrupt_rows_total") >= 2
+            assert (
+                _sample("janus_quarantined_reports_total", {"stage": "upload_open"})
+                >= 2
+            )
+            from janus_tpu.core.statusz import runtime_status
+
+            qz = runtime_status()["quarantine"]
+            assert {"upload_open", "journal", "prep_init"} <= set(qz["stages"]), qz
+
+            # -- exactly-once exact-sum collection of the healthy cohort --
+            # task 0 lost its poisoned prep report (measurement 1); the
+            # corrupt-journal reports never materialized; the 400-rejected
+            # uploads never existed downstream
+            expect = {
+                0: (len(measurements[0]) - 1, sum(measurements[0]) - 1),
+                1: (len(measurements[1]), sum(measurements[1])),
+            }
+            for t, (count, total) in expect.items():
+                result = await harness.collect_task(t)
+                assert result.report_count == count, (t, result)
+                assert result.aggregate_result == total, (t, result)
+        finally:
+            faults.clear()
+            await harness.stop()
+
+    _run(flow(), timeout=240.0)
+    reset_global_executor()
+
+
+def test_poison_free_run_is_bit_for_bit_unchanged(loop):
+    """Parity fence, stored rows: the quarantine machinery is always
+    armed — a poison-free journaled run must still produce byte-identical
+    client_reports vs the synchronous path, with ZERO quarantine,
+    bisection, or corrupt-row activity and an empty offender ledger."""
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.core.time import MockClock
+    from janus_tpu.datastore.test_util import EphemeralDatastore
+
+    from test_aggregator_handlers import NOW as HNOW, make_pair_tasks
+    from test_ingest import _journal_count, _upload_all
+    from test_upload_frontdoor import _reports, _stored_rows
+
+    bisections_before = _sample("janus_batch_bisections_total")
+    corrupt_before = _sample("janus_journal_corrupt_rows_total")
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    reports = _reports(leader, helper, 6)
+    stored, ledgers = {}, {}
+    for mode in ("synchronous", "journaled"):
+        eds = EphemeralDatastore(MockClock(HNOW))
+        eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        agg = Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(
+                vdaf_backend="oracle",
+                upload_open_backend="batched",
+                upload_open_batch_delay=0.002,
+                ingest_mode=mode,
+                ingest_journal_write_delay=0.005,
+                ingest_stage_direct=False,
+            ),
+        )
+        _upload_all(loop, agg, leader, reports)
+        if agg.ingest is not None:
+            loop.run_until_complete(agg.ingest.drain())
+        assert _journal_count(eds.datastore) == 0
+        stored[mode] = _stored_rows(eds.datastore, leader.task_id)
+        assert len(stored[mode]) == 6
+        ledgers[mode] = eds.datastore.run_tx(
+            "count", lambda tx: tx.count_quarantined_reports()
+        )
+        loop.run_until_complete(agg.shutdown())
+        eds.cleanup()
+    assert stored["journaled"] == stored["synchronous"]
+    assert ledgers == {"synchronous": 0, "journaled": 0}
+    stats = quarantine.quarantine_stats()
+    assert stats["total"] == 0 and stats["bisections"] == 0, stats
+    assert _sample("janus_batch_bisections_total") == bisections_before
+    assert _sample("janus_journal_corrupt_rows_total") == corrupt_before
+
+
+def test_poison_free_prepare_messages_unchanged_by_bisection_machinery():
+    """Parity fence, prepare messages: the same cohort staged through the
+    executor with the bisection sieve enabled vs disabled produces
+    IDENTICAL prepare-share wire bytes — the sieve is a failure path, not
+    a rewrite of the happy path."""
+    from janus_tpu.executor import DeviceExecutor, ExecutorConfig
+    from janus_tpu.utils.test_util import det_rng
+    from janus_tpu.vdaf.backend import make_backend
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+
+    vdaf = vdaf_from_instance({"type": "Prio3Count"})
+    rng = det_rng("poison-free-prep-parity")
+    verify_key = rng(vdaf.VERIFY_KEY_SIZE)
+    rows = []
+    for m in [1, 0, 1, 1, 0, 1]:
+        nonce = rng(vdaf.NONCE_SIZE)
+        public_share, input_shares = vdaf.shard(m, nonce, rng(vdaf.RAND_SIZE))
+        rows.append((nonce, public_share, input_shares[0]))
+
+    wire = {}
+    for flag in (True, False):
+        ex = DeviceExecutor(
+            ExecutorConfig(
+                flush_window_s=0.005,
+                flush_max_rows=10_000,
+                bisection_enabled=flag,
+            )
+        )
+        backend = make_backend(vdaf, "tpu")
+
+        async def go(ex=ex, backend=backend):
+            return await ex.submit(
+                ("parity",), "prep_init", (verify_key, rows), backend=backend
+            )
+
+        out = _run(go())
+        ex.shutdown()
+        assert len(out) == len(rows)
+        wire[flag] = [share.encode(vdaf) for _state, share in out]
+    assert wire[True] == wire[False]
+    stats = quarantine.quarantine_stats()
+    assert stats["total"] == 0 and stats["bisections"] == 0, stats
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()

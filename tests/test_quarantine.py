@@ -1,0 +1,717 @@
+"""Blast-radius isolation units (ISSUE 19): bisection harness, CRC32C
+journal fences, the quarantine ledger, and the executor-side sieves.
+
+* BISECTION TABLE — 0/1/2(adjacent + split)/all-poison cohorts, the
+  full-cohort transient heal, and budget exhaustion: poison costs
+  O(log B) extra passes, never an unbounded retry loop, and an
+  all-offenders outcome is NOT attributable (that is the pass failing,
+  not a poison row).
+* CRC32C — the Castagnoli check value, chaining, and the chain_crc
+  column-boundary sensitivity the journal checksums rely on.
+* CORRUPT FAULT MODE — seeded determinism, passthrough when inactive,
+  and flip-vs-truncate both reachable.
+* QUARANTINE LEDGER — recorder stats/metrics, the durable sink, the
+  datastore dedupe/filter/purge surface.
+* JOURNAL REPLAY — one startup replay over duplicate + corrupt + fresh
+  rows: corrupt quarantined, duplicate absorbed, healthy exactly-once,
+  second replay a no-op.
+* ACCUMULATOR JOURNAL — a corrupt row is quarantined AND deleted, so the
+  collection-readiness count unblocks instead of wedging forever.
+* EXECUTOR SIEVE — a poison row in a mega-batch resolves to an in-band
+  VdafError while healthy rows keep real results and the breaker stays
+  closed; an all-rows failure takes the legacy breaker path.
+* BUCKET QUARANTINE — repeated non-injected failures confined to one
+  shape while another shape on the same mesh stays healthy quarantine
+  that shape to the oracle (zero breaker trips), and the dwell expires.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from janus_tpu.core import faults, quarantine
+from janus_tpu.core.faults import FaultSpec
+from janus_tpu.core.metrics import GLOBAL_METRICS
+from janus_tpu.core.quarantine import (
+    BisectionOutcome,
+    BudgetExhausted,
+    bisect_batch,
+    chain_crc,
+    crc32c,
+    payload_digest,
+)
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.executor import (
+    CircuitOpenError,
+    DeviceExecutor,
+    ExecutorConfig,
+)
+from janus_tpu.messages import AggregationJobId, Time
+
+from test_aggregator_handlers import NOW, make_pair_tasks
+from test_upload_frontdoor import _reports, _stored_rows
+
+SEED = 7
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    quarantine.reset()
+    yield
+    faults.clear()
+    quarantine.reset()
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def _run(coro, timeout=60.0):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+def _sample(name, labels=None):
+    return GLOBAL_METRICS.get_sample_value(name, labels or {}) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# CRC32C + chain_crc
+
+
+def test_crc32c_castagnoli_check_value():
+    # the canonical CRC-32C check value ("123456789" -> 0xE3069283); a
+    # plain zlib.crc32 (0xEDB88320 polynomial) gives 0xCBF43926 here
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) != 0
+
+
+def test_crc32c_chaining_matches_concatenation():
+    a, b = b"journal-row", b"-payload-bytes"
+    assert crc32c(a + b) == crc32c(b, crc32c(a))
+
+
+def test_chain_crc_is_column_boundary_sensitive():
+    assert chain_crc(b"ab", b"c") != chain_crc(b"a", b"bc")
+    assert chain_crc(b"abc") != chain_crc(b"ab", b"c")
+    # NULL column != empty column (both occur in journal rows)
+    assert chain_crc(None) != chain_crc(b"")
+    assert chain_crc(b"x", None) != chain_crc(b"x", b"")
+    # deterministic
+    assert chain_crc(b"x", None, b"y") == chain_crc(b"x", None, b"y")
+
+
+def test_payload_digest_stable_for_bytes_and_objects():
+    assert payload_digest(b"poison") == payload_digest(b"poison")
+    assert len(payload_digest(b"poison")) == 16
+    assert payload_digest((b"rid", 1)) == payload_digest((b"rid", 1))
+    assert payload_digest(b"a") != payload_digest(b"b")
+
+
+# ---------------------------------------------------------------------------
+# the bisection harness
+
+
+class _PoisonAttempt:
+    """attempt() that fails whenever the subset intersects ``poison``."""
+
+    def __init__(self, poison=(), transient_failures=0):
+        self.poison = set(poison)
+        self.transient = transient_failures
+        self.calls = 0
+
+    def __call__(self, subset):
+        self.calls += 1
+        if self.transient > 0:
+            self.transient -= 1
+            raise RuntimeError("transient batch failure")
+        if self.poison & set(subset):
+            raise ValueError("poison row in cohort")
+        return [("ok", item) for item in subset]
+
+
+def test_bisect_clean_cohort_single_pass():
+    attempt = _PoisonAttempt()
+    out = bisect_batch(list(range(8)), attempt)
+    assert not out.offenders and not out.exhausted
+    assert out.attempts == 1 and attempt.calls == 1
+    assert out.results == {i: ("ok", i) for i in range(8)}
+    assert not out.attributable  # zero offenders is not an isolation
+
+
+def test_bisect_transient_heals_on_full_retry():
+    """A transient batch-level failure costs ONE extra pass and
+    quarantines nothing (the caller retries the full cohort first)."""
+    attempt = _PoisonAttempt(transient_failures=1)
+    out = bisect_batch(list(range(8)), attempt)
+    assert not out.offenders
+    # the failed full pass split once; both halves then succeeded
+    assert out.attempts == 3
+    assert out.results == {i: ("ok", i) for i in range(8)}
+
+
+@pytest.mark.parametrize(
+    "poison",
+    [
+        {3},  # single poison row
+        {3, 4},  # adjacent pair straddling the first midpoint
+        {0, 7},  # split pair at both extremes
+        {1, 2, 6},  # three across both halves
+    ],
+)
+def test_bisect_isolates_poison_subsets(poison):
+    items = list(range(8))
+    attempt = _PoisonAttempt(poison=poison)
+    out = bisect_batch(items, attempt)
+    assert set(out.offender_indices) == poison
+    assert all(isinstance(e, ValueError) for _, e in out.offenders)
+    assert out.attributable and not out.exhausted
+    healthy = set(items) - poison
+    assert set(out.results) == healthy
+    assert all(out.results[i] == ("ok", i) for i in healthy)
+    # O(log B) isolation: way below the 2*B an exhaustive sweep would pay
+    assert out.attempts <= 2 + len(poison) * 8
+
+
+def test_bisect_all_poison_is_not_attributable():
+    out = bisect_batch(list(range(4)), _PoisonAttempt(poison={0, 1, 2, 3}))
+    assert len(out.offenders) == 4 and not out.results
+    assert not out.attributable, "all-offenders = the PASS failed, not poison"
+
+
+def test_bisect_empty_cohort_is_a_noop():
+    attempt = _PoisonAttempt()
+    out = bisect_batch([], attempt)
+    assert out == BisectionOutcome(total=0)
+    assert attempt.calls == 0
+
+
+def test_bisect_budget_exhaustion_marks_range_wholesale():
+    """An always-failing attempt cannot loop forever: once the charged
+    item hits the budget its remaining range is marked offender with a
+    BudgetExhausted error instead of retried."""
+
+    def always_fail(subset):
+        raise RuntimeError("never succeeds")
+
+    out = bisect_batch(list(range(8)), always_fail, per_item_budget=2)
+    assert out.exhausted
+    assert set(out.offender_indices) == set(range(8))
+    assert any(isinstance(e, BudgetExhausted) for _, e in out.offenders)
+    # the fence bounds total passes: full + halves only, never singles
+    assert out.attempts <= 4
+
+
+def test_bisect_singleton_offender_keeps_original_error():
+    out = bisect_batch([0, 1], _PoisonAttempt(poison={1}))
+    assert out.offender_indices == [1]
+    assert isinstance(out.offenders[0][1], ValueError)
+    assert out.results == {0: ("ok", 0)}
+
+
+# ---------------------------------------------------------------------------
+# the corrupt fault mode
+
+
+def test_corrupt_bytes_passthrough_when_inactive():
+    data = b"pristine journal payload"
+    assert faults.corrupt_bytes("journal.corrupt", data) is data
+
+
+def test_corrupt_bytes_mangles_deterministically_under_seed():
+    data = b"journal payload bytes to mangle" * 4
+
+    def mangle():
+        faults.clear()
+        faults.configure(
+            [FaultSpec("journal.corrupt", "corrupt", 1.0)], seed=SEED
+        )
+        out = [faults.corrupt_bytes("journal.corrupt", data) for _ in range(24)]
+        faults.clear()
+        return out
+
+    a, b = mangle(), mangle()
+    assert a == b, "corruption schedule must replay under one seed"
+    assert all(x != data for x in a), "p=1.0 must mangle every call"
+    # both corruption flavors are reachable: a truncation shortens, a
+    # bit-flip preserves length
+    lengths = {len(x) for x in a}
+    assert any(n < len(data) for n in lengths), a
+    assert len(data) in lengths, a
+
+
+def test_corrupt_bytes_respects_target_scope():
+    faults.configure(
+        [
+            FaultSpec(
+                "journal.corrupt", "corrupt", 1.0, target="accumulator_journal"
+            )
+        ],
+        seed=SEED,
+    )
+    data = b"scoped payload bytes"
+    assert faults.corrupt_bytes("journal.corrupt", data, target="report_journal") == (
+        data
+    )
+    assert (
+        faults.corrupt_bytes("journal.corrupt", data, target="accumulator_journal")
+        != data
+    )
+
+
+def test_corrupt_mode_never_raises_and_other_modes_passthrough():
+    faults.configure([FaultSpec("journal.corrupt", "error", 1.0)], seed=SEED)
+    data = b"payload"
+    # an error-mode spec on the corrupt hook must not mangle (corrupt_bytes
+    # only applies corrupt-mode specs; fire() owns raising)
+    assert faults.corrupt_bytes("journal.corrupt", data) == data
+
+
+# ---------------------------------------------------------------------------
+# the quarantine recorder + durable ledger
+
+
+def test_recorder_counts_stages_and_metrics():
+    before = _sample("janus_quarantined_reports_total", {"stage": "prep_init"})
+    quarantine.record(
+        "prep_init",
+        task="ab" * 16,
+        report_id=b"r" * 16,
+        error=ValueError("bad row"),
+        payload=b"row-bytes",
+    )
+    quarantine.note_bisection()
+    quarantine.note_corrupt_row()
+    stats = quarantine.quarantine_stats()
+    assert stats["stages"]["prep_init"] == 1
+    assert stats["stages"]["journal"] == 1
+    assert stats["bisections"] == 1 and stats["corrupt_rows"] == 1
+    assert stats["recent"][-1]["error_class"] == "ValueError"
+    assert stats["recent"][-1]["report_id"] == (b"r" * 16).hex()
+    assert (
+        _sample("janus_quarantined_reports_total", {"stage": "prep_init"})
+        == before + 1
+    )
+    assert _sample("janus_batch_bisections_total") >= 1
+    assert _sample("janus_journal_corrupt_rows_total") >= 1
+
+
+def test_recorder_durable_sink_writes_ledger_rows():
+    eds = EphemeralDatastore(MockClock(NOW))
+    try:
+        quarantine.configure_sink(eds.datastore)
+        quarantine.record(
+            "upload_open",
+            task="cd" * 16,
+            report_id=b"s" * 16,
+            error=RuntimeError("hpke refused"),
+            payload=b"ciphertext",
+        )
+        assert quarantine.recorder().drain(timeout=10.0)
+        rows = eds.datastore.run_tx(
+            "peek", lambda tx: tx.get_quarantined_reports(stage="upload_open")
+        )
+        assert len(rows) == 1
+        assert rows[0]["task"] == "cd" * 16
+        assert rows[0]["report_id"] == (b"s" * 16).hex()
+        assert rows[0]["error_class"] == "RuntimeError"
+        assert rows[0]["payload_digest"] == payload_digest(b"ciphertext")
+    finally:
+        eds.cleanup()
+
+
+def test_ledger_dedupe_filters_and_purge():
+    eds = EphemeralDatastore(MockClock(NOW))
+    try:
+        ds = eds.datastore
+
+        def seed(tx):
+            assert tx.put_quarantined_report(
+                task="aa", report_id=b"r1", stage="journal", error_class="E"
+            )
+            # exact (task, report_id, stage) duplicate: absorbed
+            assert not tx.put_quarantined_report(
+                task="aa", report_id=b"r1", stage="journal", error_class="E2"
+            )
+            # same report, different stage: a distinct fact
+            assert tx.put_quarantined_report(
+                task="aa", report_id=b"r1", stage="prep_init", error_class="E"
+            )
+            assert tx.put_quarantined_report(
+                task="bb", report_id=b"r2", stage="journal", error_class="E"
+            )
+
+        ds.run_tx("seed", seed)
+        assert ds.run_tx("c", lambda tx: tx.count_quarantined_reports()) == 3
+        assert (
+            ds.run_tx("cj", lambda tx: tx.count_quarantined_reports("journal")) == 2
+        )
+        rows = ds.run_tx(
+            "get", lambda tx: tx.get_quarantined_reports(task="aa")
+        )
+        assert [r["stage"] for r in rows] == ["journal", "prep_init"]
+        purged = ds.run_tx(
+            "purge", lambda tx: tx.purge_quarantined_reports(stage="journal")
+        )
+        assert purged == 2
+        assert ds.run_tx("c2", lambda tx: tx.count_quarantined_reports()) == 1
+    finally:
+        eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# report-journal replay: duplicate + corrupt + fresh in ONE startup
+
+
+def test_replay_idempotent_under_duplicate_and_corrupt_rows(loop):
+    """One startup replay over three row flavors at once: a clean
+    duplicate of an already-materialized report (absorbed), a corrupt
+    re-journaled row (quarantined + consumed), and a fresh healthy report
+    (materialized exactly once).  A second replay is a no-op."""
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.core.ingest import replay_report_journal
+
+    leader, helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    eds = EphemeralDatastore(MockClock(NOW))
+    try:
+        eds.datastore.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        agg = Aggregator(
+            eds.datastore,
+            eds.clock,
+            Config(
+                vdaf_backend="oracle",
+                ingest_mode="journaled",
+                ingest_stage_direct=False,
+                ingest_journal_write_delay=0.002,
+            ),
+        )
+        reports = _reports(leader, helper, 4)
+
+        async def upload(rs):
+            await asyncio.gather(
+                *(agg.handle_upload(leader.task_id, r) for r in rs)
+            )
+
+        loop.run_until_complete(upload(reports[:3]))
+        journaled = eds.datastore.run_tx(
+            "peek", lambda tx: tx.get_report_journal_reports(leader.task_id)
+        )
+        assert len(journaled) == 3
+        loop.run_until_complete(agg.ingest.materialize_once())
+        assert len(_stored_rows(eds.datastore, leader.task_id)) == 3
+
+        # the crash-window state, reconstructed: one CLEAN duplicate row,
+        # one CORRUPT row (mangled ciphertext under an honest CRC), one
+        # fresh healthy report — all outstanding at "startup"
+        eds.datastore.run_tx(
+            "dup", lambda tx: tx.put_report_journal_row(journaled[0])
+        )
+        faults.configure(
+            [FaultSpec("journal.corrupt", "corrupt", 1.0, target="report_journal")],
+            seed=SEED,
+        )
+        eds.datastore.run_tx(
+            "corrupt", lambda tx: tx.put_report_journal_row(journaled[1])
+        )
+        faults.clear()
+        loop.run_until_complete(upload(reports[3:]))
+        assert (
+            eds.datastore.run_tx("c", lambda tx: tx.count_report_journal_rows())
+            == 3
+        )
+
+        corrupt_before = _sample("janus_journal_corrupt_rows_total")
+        replayed = loop.run_until_complete(replay_report_journal(eds.datastore))
+        assert replayed == 1, "only the fresh report materializes"
+        assert (
+            eds.datastore.run_tx("c2", lambda tx: tx.count_report_journal_rows())
+            == 0
+        )
+        rows = _stored_rows(eds.datastore, leader.task_id)
+        assert len(rows) == 4, "duplicate absorbed, healthy exactly-once"
+        assert len({r[0] for r in rows}) == 4
+        quarantined = eds.datastore.run_tx(
+            "q", lambda tx: tx.get_quarantined_reports(stage="journal")
+        )
+        assert len(quarantined) == 1
+        assert quarantined[0]["report_id"] == journaled[1].report_id.data.hex()
+        assert quarantined[0]["error_class"] == "ChecksumMismatch"
+        assert _sample("janus_journal_corrupt_rows_total") >= corrupt_before + 1
+
+        # idempotence: a second startup replay finds nothing to do
+        assert loop.run_until_complete(replay_report_journal(eds.datastore)) == 0
+        assert len(_stored_rows(eds.datastore, leader.task_id)) == 4
+        assert (
+            eds.datastore.run_tx(
+                "q2", lambda tx: tx.count_quarantined_reports("journal")
+            )
+            == 1
+        )
+    finally:
+        eds.cleanup()
+
+
+def test_accumulator_journal_corrupt_row_quarantined_and_unblocks_readiness(loop):
+    """A corrupt accumulator-journal row is quarantined AND deleted on
+    read — leaving it in place would wedge the collection-readiness count
+    (outstanding rows > 0) forever."""
+    leader, _helper, _ = make_pair_tasks({"type": "Prio3Count"})
+    eds = EphemeralDatastore(MockClock(NOW))
+    try:
+        ds = eds.datastore
+        ds.run_tx("put", lambda tx: tx.put_aggregator_task(leader))
+        batch = b"batch-ident"
+        good_job, bad_job = AggregationJobId.random(), AggregationJobId.random()
+        ds.run_tx(
+            "good",
+            lambda tx: tx.put_accumulator_journal_entry(
+                leader.task_id, batch, b"", good_job, [b"g" * 16]
+            ),
+        )
+        faults.configure(
+            [
+                FaultSpec(
+                    "journal.corrupt", "corrupt", 1.0, target="accumulator_journal"
+                )
+            ],
+            seed=SEED,
+        )
+        ds.run_tx(
+            "bad",
+            lambda tx: tx.put_accumulator_journal_entry(
+                leader.task_id, batch, b"", bad_job, [b"b" * 16, b"c" * 16]
+            ),
+        )
+        faults.clear()
+        assert (
+            ds.run_tx(
+                "c",
+                lambda tx: tx.count_accumulator_journal_entries_for_batch(
+                    leader.task_id, batch
+                ),
+            )
+            == 2
+        )
+        entries = ds.run_tx(
+            "read", lambda tx: tx.get_accumulator_journal_entries(leader.task_id)
+        )
+        assert [e.aggregation_job_id for e in entries] == [good_job]
+        assert entries[0].report_ids == (b"g" * 16,)
+        # the corrupt row is GONE: readiness unblocks
+        assert (
+            ds.run_tx(
+                "c2",
+                lambda tx: tx.count_accumulator_journal_entries_for_batch(
+                    leader.task_id, batch
+                ),
+            )
+            == 1
+        )
+        assert (
+            ds.run_tx(
+                "q",
+                lambda tx: tx.count_quarantined_reports("accumulator_journal"),
+            )
+            == 1
+        )
+    finally:
+        eds.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# the executor-side sieve
+
+
+class _PoisonBackend:
+    """Mega-batch seam that fails any launch whose rows include a poison
+    marker — the (task, row)-local failure shape the sieve isolates."""
+
+    class _V:
+        pass
+
+    def __init__(self, poison=(), mesh_devices=None):
+        from types import SimpleNamespace
+
+        self.vdaf = self._V()
+        self.poison = set(poison)
+        self.launches = 0
+        if mesh_devices is not None:
+            self.mesh = SimpleNamespace(
+                devices=SimpleNamespace(flat=list(mesh_devices))
+            )
+
+    def stage_prep_init_multi(self, agg_id, requests, pad_to=None):
+        from types import SimpleNamespace
+
+        rows = sum(len(r[1]) for r in requests)
+        return SimpleNamespace(agg_id=agg_id, placed=None, pad_to=rows, rows=rows)
+
+    def launch_prep_init_multi(self, staged, requests):
+        self.launches += 1
+        for req in requests:
+            for row in req[1]:
+                if row[0] in self.poison:
+                    raise RuntimeError(f"device rejects row {row[0]!r}")
+        return [[("ok", row) for row in req[1]] for req in requests]
+
+
+def _sieve_config(**kw):
+    base = dict(
+        flush_window_s=0.005,
+        flush_max_rows=10_000,
+        breaker_failure_threshold=2,
+        breaker_reset_timeout_s=60.0,
+    )
+    base.update(kw)
+    return ExecutorConfig(**base)
+
+
+def test_executor_bisects_poison_row_to_inband_vdaf_error():
+    """One poison row in an 8-row mega-batch: healthy rows resolve with
+    real results, the poison slot is an in-band VdafError (the value
+    drivers map to PrepareError.VDAF_PREP_ERROR), the breaker records a
+    SUCCESS, and the offender lands in the quarantine ledger under its
+    report id."""
+    from janus_tpu.vdaf.prio3 import VdafError
+
+    rows = [(b"rid-%02d" % i, f"payload-{i}") for i in range(8)]
+    backend = _PoisonBackend(poison={b"rid-03"})
+    ex = DeviceExecutor(_sieve_config())
+
+    async def go():
+        out = await ex.submit(
+            ("sh",), "prep_init", (b"vk", rows), backend=backend, task_ident=b"t1"
+        )
+        assert len(out) == 8
+        assert isinstance(out[3], VdafError)
+        for i in (0, 1, 2, 4, 5, 6, 7):
+            assert out[i] == ("ok", rows[i]), out[i]
+
+    _run(go())
+    ex.shutdown()
+    (st,) = ex.circuit_stats().values()
+    assert st["trips"] == 0 and st["state"] == "closed"
+    assert st["consecutive_failures"] == 0
+    stats = quarantine.quarantine_stats()
+    assert stats["stages"].get("prep_init") == 1
+    assert stats["bisections"] == 1
+    assert stats["recent"][-1]["report_id"] == b"rid-03".hex()
+    assert stats["recent"][-1]["task"] == b"t1".hex()
+
+
+def test_executor_all_rows_failing_takes_legacy_breaker_path():
+    """Every row failing is the PASS failing (device lost), not poison:
+    the sieve declines, the breaker counts the failure, and the circuit
+    opens at its threshold exactly as before ISSUE 19."""
+    rows = [(b"rid-%02d" % i, i) for i in range(4)]
+    backend = _PoisonBackend(poison={r[0] for r in rows})
+    ex = DeviceExecutor(_sieve_config())
+
+    async def go():
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                await ex.submit(("sh",), "prep_init", (b"vk", rows), backend=backend)
+        with pytest.raises(CircuitOpenError):
+            await ex.submit(("sh",), "prep_init", (b"vk", rows), backend=backend)
+
+    _run(go())
+    ex.shutdown()
+    (st,) = ex.circuit_stats().values()
+    assert st["trips"] == 1 and st["state"] == "open"
+    assert not quarantine.quarantine_stats()["stages"].get("prep_init")
+
+
+def test_bucket_quarantine_isolates_shape_without_tripping_mesh_breaker():
+    """Shape A fails repeatedly (non-injected) while shape B keeps
+    succeeding on the SAME mesh breaker domain: A is quarantined to the
+    oracle (CircuitOpenError, circuit_open(A) True) while B keeps
+    launching and the shared breaker never trips.  The dwell expires and
+    a healed A launches again."""
+    backend = _PoisonBackend(poison={b"A"}, mesh_devices=["dev:0", "dev:1"])
+    ex = DeviceExecutor(
+        _sieve_config(
+            breaker_failure_threshold=10,
+            bucket_quarantine_threshold=2,
+            bucket_quarantine_s=0.3,
+            bucket_quarantine_success_window_s=30.0,
+        )
+    )
+
+    async def go():
+        # B's success stamps the mesh domain's health witness
+        assert await ex.submit(
+            ("B",), "prep_init", (b"vk", [(b"B", 0)]), backend=backend
+        ) == [("ok", (b"B", 0))]
+        # two shape-local failures (single-row: the sieve never engages)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                await ex.submit(
+                    ("A",), "prep_init", (b"vk", [(b"A", 0)]), backend=backend
+                )
+        # quarantined: fail-fast without touching the device…
+        launches = backend.launches
+        with pytest.raises(CircuitOpenError, match="quarantined"):
+            await ex.submit(
+                ("A",), "prep_init", (b"vk", [(b"A", 0)]), backend=backend
+            )
+        assert backend.launches == launches
+        assert ex.circuit_open(("A",)) is True
+        # …while shape B and the shared breaker stay healthy
+        assert ex.circuit_open(("B",)) is False
+        assert await ex.submit(
+            ("B",), "prep_init", (b"vk", [(b"B", 1)]), backend=backend
+        ) == [("ok", (b"B", 1))]
+        (st,) = ex.circuit_stats().values()
+        assert st["trips"] == 0 and st["state"] == "closed"
+        bq = ex.bucket_quarantine_stats()
+        assert bq["total"] == 1 and len(bq["quarantined"]) == 1
+
+        # the dwell expires; a healed shape relaunches and clears state
+        await asyncio.sleep(0.35)
+        backend.poison.clear()
+        assert await ex.submit(
+            ("A",), "prep_init", (b"vk", [(b"A", 1)]), backend=backend
+        ) == [("ok", (b"A", 1))]
+        assert ex.circuit_open(("A",)) is False
+        assert not ex.bucket_quarantine_stats()["quarantined"]
+        assert not ex.bucket_quarantine_stats()["fail_streaks"]
+
+    _run(go())
+    ex.shutdown()
+    assert quarantine.quarantine_stats()["stages"].get("bucket") == 1
+
+
+def test_injected_faults_never_engage_sieve_or_bucket_quarantine():
+    """Chaos-injected flush faults keep their legacy semantics: they
+    count toward the breaker (the existing soaks depend on it) and never
+    bisect or quarantine."""
+    from janus_tpu.core.faults import FaultInjectedError
+
+    rows = [(b"rid-%02d" % i, i) for i in range(4)]
+    backend = _PoisonBackend()
+    ex = DeviceExecutor(_sieve_config(bucket_quarantine_threshold=2))
+    faults.configure([FaultSpec("executor.flush", "error", 1.0)], seed=SEED)
+
+    async def go():
+        for _ in range(2):
+            with pytest.raises(FaultInjectedError):
+                await ex.submit(("sh",), "prep_init", (b"vk", rows), backend=backend)
+        with pytest.raises(CircuitOpenError):
+            await ex.submit(("sh",), "prep_init", (b"vk", rows), backend=backend)
+
+    _run(go())
+    ex.shutdown()
+    assert backend.launches == 0
+    stats = quarantine.quarantine_stats()
+    assert stats["bisections"] == 0 and stats["total"] == 0

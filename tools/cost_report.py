@@ -12,7 +12,10 @@ Renders "which task is burning the chip" from one live replica's
   mesh tails) as a share of everything the chip computed for the bucket;
 * the flight-recorder digest: ring occupancy and dump counts;
 * the datastore brownout rollup: tracker state, transient tx retries,
-  suppressed fleet migrations, and upload sheds per reason.
+  suppressed fleet migrations, and upload sheds per reason;
+* the quarantine rollup (ISSUE 19): poison/corrupt rows pulled out of the
+  pipeline per stage, bisection sieves run, checksum-failed journal rows,
+  and the durable offender-ledger row count.
 
 Usage::
 
@@ -136,6 +139,23 @@ def build_report(statusz: dict, metrics_text: str) -> dict:
     } or None
     report["cost_attribution"] = ex.get("cost_attribution")
 
+    # -- quarantine rollup (ISSUE 19) -------------------------------------
+    quarantined = {
+        dict(labels).get("stage", "?"): int(v)
+        for labels, v in samples.get("janus_quarantined_reports_total", {}).items()
+    }
+    qz = statusz.get("quarantine") or {}
+    report["quarantine"] = {
+        "by_stage": quarantined or None,
+        "bisections": int(
+            sum(samples.get("janus_batch_bisections_total", {}).values())
+        ),
+        "corrupt_journal_rows": int(
+            sum(samples.get("janus_journal_corrupt_rows_total", {}).values())
+        ),
+        "durable_rows": qz.get("durable_rows") if isinstance(qz, dict) else None,
+    }
+
     # -- datastore brownout rollup (ISSUE 17) -----------------------------
     ds = statusz.get("datastore") or {}
     sheds = {
@@ -197,6 +217,17 @@ def render(report: dict) -> str:
         lines.append(f"  flight recorder: {report['flights']}")
     if report["cost_attribution"]:
         lines.append(f"  attribution ledger: {report['cost_attribution']}")
+    qz = report.get("quarantine") or {}
+    if qz.get("by_stage") or qz.get("bisections") or qz.get("corrupt_journal_rows"):
+        lines.append(
+            "  quarantine: by_stage=%s bisections=%d corrupt_rows=%d durable_rows=%s"
+            % (
+                qz.get("by_stage") or "-",
+                qz.get("bisections") or 0,
+                qz.get("corrupt_journal_rows") or 0,
+                qz.get("durable_rows") if qz.get("durable_rows") is not None else "-",
+            )
+        )
     ds = report.get("datastore") or {}
     if ds.get("state") is not None:
         sheds = ds.get("upload_sheds")
