@@ -285,7 +285,14 @@ case "$tier" in
       # bisection-harness/CRC32C/quarantine-ledger unit suite.
       exec python -m pytest tests/test_poison_chaos.py tests/test_quarantine.py -q
     fi
-    exec python -m pytest tests/test_chaos.py tests/test_brownout_chaos.py tests/test_poison_chaos.py tests/test_quarantine.py tests/test_db_health.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py -q -m "not slow"
+    exec python -m pytest tests/test_chaos.py tests/test_brownout_chaos.py tests/test_poison_chaos.py tests/test_quarantine.py tests/test_db_health.py tests/test_peer_health.py tests/test_accumulator.py tests/test_crash_chaos.py tests/test_canary.py -q -m "not slow"
+    ;;
+  canary)
+    # Canary plane gate (ISSUE 20): the black-box prober's verdict state
+    # machine, degradation-aware backoff (db-SUSPECT + shed escalation),
+    # the corrupt-aggregate fence and blackout chaos case against a real
+    # in-process pair, and the trace-percentile extractor units.
+    exec python -m pytest tests/test_canary.py tests/test_trace_percentiles.py -q -m "not slow"
     ;;
   mesh)
     # Multi-chip gate (ISSUE 6).  test_mesh.py is device-tier (sharded
@@ -416,7 +423,7 @@ print("entry() compile ok")
 EOF
     ;;
   *)
-    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|chaos poison|coldstart|fpvec|obs|load|load fast|ingest|benchdiff|fleet|postgres|dryrun]" >&2
+    echo "usage: ./ci.sh [fast|heavy|slow|all|tier1|mxu|mesh|poplar|chaos|chaos crash|chaos partition|chaos brownout|chaos poison|canary|coldstart|fpvec|obs|load|load fast|ingest|benchdiff|fleet|postgres|dryrun]" >&2
     exit 2
     ;;
 esac

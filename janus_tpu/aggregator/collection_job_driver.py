@@ -334,12 +334,24 @@ class CollectionJobDriver:
             return
         helper_share = AggregateShare.get_decoded(body)
 
+        # Chaos seam (ISSUE 20): the canary's wrong-answer fence.  A
+        # corrupt-mode spec on this point mangles the encoded leader
+        # aggregate share right before it is sealed into the finished
+        # job — a fault no transport/lease/health signal can see; only a
+        # known-plaintext probe verifying the collected sum catches it.
+        from ..core import faults
+
+        leader_share_bytes = faults.corrupt_bytes(
+            "collection.aggregate_share",
+            vdaf.field_for_agg_param(
+                vdaf.decode_agg_param(job.aggregation_parameter)
+            ).encode_vec(share),
+            target=str(task.task_id),
+        )
         finished = job.finished(
             report_count=count,
             client_timestamp_interval=interval,
-            leader_aggregate_share=vdaf.field_for_agg_param(
-                vdaf.decode_agg_param(job.aggregation_parameter)
-            ).encode_vec(share),
+            leader_aggregate_share=leader_share_bytes,
             helper_aggregate_share=helper_share.encrypted_aggregate_share,
         )
 

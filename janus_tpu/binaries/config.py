@@ -444,6 +444,58 @@ class IngestConfig:
 
 
 @dataclass
+class CanaryConfig:
+    """The canary plane's prober (core/canary.py; ISSUE 20): black-box
+    known-plaintext probes through the real upload -> aggregate ->
+    collect path, one auto-provisioned task per VDAF family.
+
+        canary:
+          leader_endpoint: "http://127.0.0.1:8080"
+          helper_endpoint: "http://127.0.0.1:8081"
+          leader_task_api: "http://127.0.0.1:9080"
+          helper_task_api: "http://127.0.0.1:9081"
+          task_api_auth_token: "admin-token"
+          families: [prio3_sum, prio3_histogram]
+          probe_interval_s: 30
+          trace_globs: ["/tmp/traces/*.trace"]
+    """
+
+    #: DAP endpoints the probes travel through (the real front doors)
+    leader_endpoint: str = ""
+    helper_endpoint: str = ""
+    #: management APIs (aggregator task_api_listen_address) the prober
+    #: provisions its canary tasks against
+    leader_task_api: str = ""
+    helper_task_api: str = ""
+    task_api_auth_token: str = ""
+    #: VDAF families to probe (each gets its own canary task); names
+    #: resolve through core/canary.py FAMILIES
+    families: List[str] = field(default_factory=lambda: ["prio3_sum", "prio3_histogram"])
+    #: probe cadence and collection-poll budget
+    probe_interval_s: float = 30.0
+    poll_interval_s: float = 0.5
+    collect_timeout_s: float = 60.0
+    #: consecutive probe failures before a family's verdict is "failing"
+    #: (one failure = "degraded")
+    fail_threshold: int = 2
+    #: consecutive 503-shed suppressions before the next shed counts as a
+    #: loud upload failure — a front door that never reopens must page
+    shed_escalate_after: int = 3
+    #: canary-task time precision; each probe cycle aggregates its own
+    #: already-closed bucket, walking backward so batches never overlap
+    time_precision_s: int = 3600
+    #: chrome-trace globs (the replicas' trace files) for per-stage
+    #: commit/first-prepare attribution; empty = prober-clock stages only
+    trace_globs: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CanaryBinaryConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    canary: CanaryConfig = field(default_factory=CanaryConfig)
+
+
+@dataclass
 class AggregatorConfig:
     common: CommonConfig = field(default_factory=CommonConfig)
     listen_address: str = "0.0.0.0:8080"
@@ -493,6 +545,13 @@ class AggregatorConfig:
     #: circuit breaker with the drivers.
     device_executor: DeviceExecutorConfig = field(default_factory=DeviceExecutorConfig)
     garbage_collection_interval_s: Optional[float] = None
+    #: Management REST API (aggregator_api.py): task CRUD + HPKE key
+    #: management, bearer-auth, served on its OWN address (never the DAP
+    #: port — provisioning must not share the front door's shed/auth
+    #: story).  Empty disables; the canary plane provisions its probe
+    #: tasks through this.
+    task_api_listen_address: str = ""
+    task_api_auth_tokens: List[str] = field(default_factory=list)
     #: Global-HPKE key rotation loop (reference: binaries/aggregator.rs:31-150
     #: runs the maintenance loops beside the server); None disables.
     key_rotator_interval_s: Optional[float] = None
@@ -562,6 +621,7 @@ def _merge_dataclass(cls, data: dict):
             FleetConfig,
             DatastoreHealthConfig,
             IngestConfig,
+            CanaryConfig,
         )
     }
     kwargs = {}

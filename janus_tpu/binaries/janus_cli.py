@@ -283,6 +283,88 @@ def quarantine_purge(task, stage, config_file):
     click.echo(f"purged {purged} quarantined row(s)")
 
 
+def _fetch_statusz(replica: str, timeout_s: float) -> dict:
+    """GET one replica's /statusz (stdlib only — the ops CLI must work on
+    a box with nothing but the repo)."""
+    import urllib.request
+
+    url = replica.rstrip("/") + "/statusz"
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt_ms(seconds) -> str:
+    return f"{seconds * 1e3:.1f}ms" if seconds is not None else "-"
+
+
+@cli.command("canary-status")
+@click.argument("replica", required=False, default=None)
+@click.option(
+    "--replicas",
+    default=None,
+    help="comma-separated health addresses (host:port) to query",
+)
+@click.option("--timeout", "timeout_s", type=float, default=5.0)
+def canary_status(replica, replicas, timeout_s):
+    """Fetch + pretty-print the /statusz canary section (ISSUE 20):
+    verdict, per-stage p50/p99, last-good time per replica.  Exits
+    non-zero when any replica's rolled-up verdict is "failing"."""
+    import time as _time
+
+    targets = []
+    if replica:
+        targets.append(replica)
+    if replicas:
+        targets.extend(r.strip() for r in replicas.split(",") if r.strip())
+    if not targets:
+        raise click.ClickException("give a replica address or --replicas")
+
+    failing = False
+    for target in targets:
+        try:
+            doc = _fetch_statusz(target, timeout_s)
+        except Exception as e:
+            click.echo(f"{target}: UNREACHABLE ({e})")
+            failing = True
+            continue
+        canary = doc.get("canary") or {}
+        if not canary.get("enabled"):
+            click.echo(f"{target}: canary disabled")
+            continue
+        verdict = canary.get("verdict", "unknown")
+        failing = failing or verdict == "failing"
+        click.echo(f"{target}: verdict={verdict}")
+        for name, fam in sorted((canary.get("families") or {}).items()):
+            last_good = fam.get("last_good_unix")
+            ago = (
+                f"{max(0.0, _time.time() - last_good):.0f}s ago"
+                if last_good
+                else "never"
+            )
+            line = (
+                f"  {name:<18} {fam.get('verdict', '?'):<9}"
+                f" probes={fam.get('probes', 0)}"
+                f" suppressed={fam.get('suppressed', 0)}"
+                f" last_good={ago}"
+            )
+            if fam.get("failing_stage"):
+                line += f" failing_stage={fam['failing_stage']}"
+            if fam.get("last_outcome") and fam["last_outcome"] != "ok":
+                line += f" last_outcome={fam['last_outcome']}"
+            click.echo(line)
+        for stage, pcts in sorted((canary.get("stage_latency_s") or {}).items()):
+            if pcts.get("samples"):
+                click.echo(
+                    f"  stage {stage:<14} p50={_fmt_ms(pcts.get('p50'))}"
+                    f" p99={_fmt_ms(pcts.get('p99'))}"
+                    f" n={pcts['samples']}"
+                )
+    if failing:
+        sys.exit(1)
+
+
 @cli.command("dap-decode")
 @click.argument("message_file", type=click.Path(exists=True))
 @click.option(

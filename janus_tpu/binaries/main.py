@@ -28,6 +28,7 @@ from ..datastore import Crypter, Datastore
 from ..messages import Duration
 from .config import (
     AggregatorConfig,
+    CanaryBinaryConfig,
     ConfigError,
     JobCreatorConfig,
     JobDriverBinaryConfig,
@@ -443,6 +444,20 @@ def run_aggregator(config_path: Optional[str]) -> None:
         await site.start()
         logger.info("aggregator serving on %s", cfg.listen_address)
 
+        # Management REST API (ISSUE 20): task CRUD on its OWN listener,
+        # never the DAP port — the canary plane provisions through this.
+        task_api_runner = None
+        if cfg.task_api_listen_address:
+            from ..aggregator_api import aggregator_api_app
+
+            task_api_runner = web.AppRunner(
+                aggregator_api_app(datastore, cfg.task_api_auth_tokens)
+            )
+            await task_api_runner.setup()
+            api_host, api_port = parse_listen_address(cfg.task_api_listen_address)
+            await web.TCPSite(task_api_runner, api_host, api_port).start()
+            logger.info("task API serving on %s", cfg.task_api_listen_address)
+
         async def periodic(name: str, fn, interval_s: float):
             """Run ``fn`` every interval until stop; failures log, not kill
             (the maintenance-loop shape of reference binaries/aggregator.rs)."""
@@ -555,7 +570,73 @@ def run_aggregator(config_path: Optional[str]) -> None:
                 except Exception:
                     logger.exception("executor drain failed during shutdown")
                 ex.shutdown(drain=True)
+        if task_api_runner is not None:
+            await task_api_runner.cleanup()
         await runner.cleanup()
+        await health.cleanup()
+        _close_tracing()
+
+    asyncio.run(main())
+
+
+def run_canary(config_path: Optional[str]) -> None:
+    """The canary plane's prober (core/canary.py; ISSUE 20): continuous
+    black-box end-to-end probes against a live fleet.  Deliberately
+    datastore-free — the canary judges the fleet exactly the way a
+    client + collector pair would, through the front doors only."""
+    cfg = load_config(CanaryBinaryConfig, config_path)
+
+    from ..core.trace import TraceConfiguration, install_trace_subscriber
+
+    install_trace_subscriber(TraceConfiguration(level=cfg.common.log_level))
+    if getattr(cfg.common, "slos", None):
+        from ..core.slo import configure_slos
+
+        evaluator = configure_slos(cfg.common.slos)
+        logger.info(
+            "slo evaluator armed: %s",
+            ", ".join(t.name for t in evaluator.targets),
+        )
+    from ..core.canary import configure_canary
+
+    plane = configure_canary(cfg.canary)
+
+    async def main():
+        import aiohttp
+
+        from ..core.slo import evaluate_tick
+
+        loop = asyncio.get_running_loop()
+        stop = _stop_event_on_signals(loop)
+        health = await _serve_health(cfg.common.health_check_listen_address)
+        logger.info(
+            "canary probing %s every %.1fs (families: %s)",
+            cfg.canary.leader_endpoint,
+            cfg.canary.probe_interval_s,
+            ", ".join(cfg.canary.families),
+        )
+        session = aiohttp.ClientSession()
+        try:
+            while not stop.is_set():
+                # provisioning retries inside the cycle: a fleet that is
+                # still coming up just delays the first verdict
+                try:
+                    await plane.ensure_provisioned(session)
+                    await plane.probe_once(session)
+                except Exception:
+                    logger.exception("canary probe cycle failed")
+                try:
+                    evaluate_tick()
+                except Exception:
+                    logger.exception("slo evaluation tick failed")
+                try:
+                    await asyncio.wait_for(
+                        stop.wait(), timeout=max(0.1, cfg.canary.probe_interval_s)
+                    )
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            await session.close()
         await health.cleanup()
         _close_tracing()
 
@@ -861,7 +942,7 @@ def main(argv=None) -> int:
         print(
             "usage: python -m janus_tpu.binaries "
             "{aggregator|aggregation_job_creator|aggregation_job_driver|"
-            "collection_job_driver|janus_cli} [--config-file F] ...",
+            "collection_job_driver|canary|janus_cli} [--config-file F] ...",
             file=sys.stderr,
         )
         return 2
@@ -878,6 +959,8 @@ def main(argv=None) -> int:
         _run_job_driver_binary(config_path, "aggregation")
     elif binary == "collection_job_driver":
         _run_job_driver_binary(config_path, "collection")
+    elif binary == "canary":
+        run_canary(config_path)
     elif binary == "janus_cli":
         from .janus_cli import cli
 

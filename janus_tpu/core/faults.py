@@ -125,6 +125,12 @@ KNOWN_POINTS = (
     # bounded queue backs up into reason="journal" sheds, error mode
     # impersonates a commit failure fanned to every waiting ACK
     "ingest.journal",
+    # leader aggregate-share corruption (collection_job_driver.py, ISSUE
+    # 20): a corrupt-mode spec here bit-flips or truncates the encoded
+    # leader aggregate share as the collection job finishes — the WRONG-
+    # ANSWER fault only the canary plane's known-plaintext verification
+    # can catch (outcome="corrupt"); every other signal stays green
+    "collection.aggregate_share",
     # durable-row corruption (datastore journal writes, ISSUE 19): a
     # corrupt-mode spec here bit-flips or truncates journal payload bytes
     # AFTER the row CRC is computed — impersonating a torn write / media

@@ -865,6 +865,57 @@ class Metrics:
             ["bucket"],
             registry=self.registry,
         )
+        # -- canary plane (core/canary.py, ISSUE 20) ---------------------
+        # Black-box known-plaintext probes through the real upload ->
+        # aggregate -> collect path.  The verdict counter is the only
+        # family that can say "the fleet aggregated WRONG" (outcome=
+        # corrupt: collected aggregate != the exact expected sum, or the
+        # share failed to decrypt/decode); per-stage attribution rides
+        # the probe_seconds histogram and the SLO plane reads the e2e +
+        # outcome histograms (canary_e2e_latency / canary_success).
+        self.canary_verdicts = Counter(
+            "janus_canary_verdict_total",
+            "Canary probe verdicts by canary task and outcome "
+            "(ok|error|timeout|corrupt)",
+            ["task", "outcome"],
+            registry=self.registry,
+        )
+        self.canary_probe_seconds = Histogram(
+            "janus_canary_probe_seconds",
+            "Canary per-stage latency attribution (upload_ack|commit|"
+            "first_prepare|collection|e2e)",
+            ["stage"],
+            buckets=_AGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.canary_e2e = Histogram(
+            "janus_canary_e2e_seconds",
+            "Canary probe end-to-end latency (first upload to verified "
+            "collection)",
+            buckets=_AGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.canary_probe_outcome = Histogram(
+            "janus_canary_probe_outcome",
+            "Canary probe outcomes as an SLO-shaped histogram (observes "
+            "0.0 on success, 2.0 on failure; good = samples <= 0.5)",
+            buckets=(0.5, 1.0),
+            registry=self.registry,
+        )
+        self.canary_backoffs = Counter(
+            "janus_canary_backoffs_total",
+            "Canary probes suppressed by degradation-aware backoff, by "
+            "reason (db_suspect|upload_shed) — counted, never alerting",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.canary_verdict_state = Gauge(
+            "janus_canary_verdict_state",
+            "Canary rolled-up verdict per task (0 healthy, 1 degraded, "
+            "2 failing)",
+            ["task"],
+            registry=self.registry,
+        )
 
     # -- introspection ---------------------------------------------------
     def get_sample_value(self, name: str, labels: Optional[dict] = None):

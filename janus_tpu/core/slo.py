@@ -46,7 +46,28 @@ SIGNALS = {
     "job_age_at_acquire": "janus_job_age_at_acquire_seconds",
     "collection_e2e": "janus_collection_e2e_seconds",
     "first_flush": "janus_executor_wait_duration_seconds",
+    # Canary plane (core/canary.py): black-box probe end-to-end latency
+    # and probe success rate (the outcome histogram observes 0.0 on
+    # success / 2.0 on failure, so any threshold_s in [0.5, 2) makes
+    # good == successes under the standard histogram_totals math).
+    "canary_e2e_latency": "janus_canary_e2e_seconds",
+    "canary_success": "janus_canary_probe_outcome",
 }
+
+
+def _known_histogram_families() -> set:
+    """Histogram family names from the live metric catalog — the set a
+    raw ``janus_*`` SLO signal must resolve into.  A signal naming a
+    family that does not exist (or is not a histogram) would silently
+    evaluate over zero events forever; better to fail startup."""
+    from .metrics import GLOBAL_METRICS
+
+    out = set()
+    for line in GLOBAL_METRICS.catalog():
+        name, kind, _labels = line.split("|", 2)
+        if kind == "histogram":
+            out.add(name)
+    return out
 
 
 @dataclass
@@ -82,7 +103,13 @@ class SloTarget:
         if fam is not None:
             return fam
         if self.signal.startswith("janus_"):
-            return self.signal
+            if self.signal in _known_histogram_families():
+                return self.signal
+            raise ValueError(
+                f"slo {self.name}: raw signal {self.signal!r} is not a "
+                f"histogram family in the metric catalog — a typo'd SLO "
+                f"must fail startup, not silently evaluate zero events"
+            )
         raise ValueError(
             f"slo {self.name}: unknown signal {self.signal!r} "
             f"(known: {sorted(SIGNALS)} or a raw janus_* histogram name)"

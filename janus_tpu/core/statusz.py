@@ -70,6 +70,11 @@ def runtime_status() -> dict:
         # recent offenders — what the operator reads when the quarantine
         # alert fires
         "quarantine": _quarantine_stats(),
+        # Canary plane (ISSUE 20): rolled-up fleet verdict, per-family
+        # probe state + failing stage, stage-latency percentiles, and
+        # counted backoffs — the one pageable signal; disabled marker on
+        # binaries that run no prober
+        "canary": _canary_stats(),
     }
 
     from ..executor import peek_global_executor
@@ -183,6 +188,18 @@ def _quarantine_stats() -> dict:
         return quarantine_stats()
     except Exception:
         logger.exception("quarantine stats unavailable")
+        return {"error": "unavailable"}
+
+
+def _canary_stats() -> dict:
+    """Canary-plane verdict rollup (core/canary.py); failure-tolerant
+    like every other section."""
+    try:
+        from .canary import canary_stats
+
+        return canary_stats()
+    except Exception:
+        logger.exception("canary stats unavailable")
         return {"error": "unavailable"}
 
 

@@ -1,0 +1,838 @@
+"""Canary plane tests (ISSUE 20): verdict state machine, degradation-aware
+backoff, and the black-box probe cycle against a real in-process
+leader+helper pair.
+
+The e2e layer reuses the ``InProcessPair`` shape (test_integration_pair):
+both aggregators as aiohttp TestServers over ephemeral datastores, the
+canary plane adopted (or API-provisioned) onto a dedicated task, and the
+creator/driver/collection loops driven concurrently with the probe.  The
+chaos case is the acceptance fence: a ``datastore.tx.begin`` blackout
+flips the fleet verdict to ``failing`` at the upload stage, strict
+db-SUSPECT suppresses further probes with a COUNTED backoff (no state
+movement, no canary pressure), the fleet heals back to ``healthy``, and
+real traffic uploaded before the window still collects exactly once.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from janus_tpu.aggregator import (
+    Aggregator,
+    AggregationJobCreator,
+    AggregationJobDriver,
+    CollectionJobDriver,
+    Config,
+    CreatorConfig,
+    DriverConfig,
+    aggregator_app,
+)
+from janus_tpu.client import prepare_report
+from janus_tpu.collector import Collector
+from janus_tpu.core import faults
+from janus_tpu.core.auth_tokens import AuthenticationToken
+from janus_tpu.core.canary import (
+    FAMILIES,
+    CanaryPlane,
+    _matches,
+    canary_stats,
+    configure_canary,
+)
+from janus_tpu.core.db_health import DB_SUSPECT, reset_db_health, tracker
+from janus_tpu.core.hpke import HpkeKeypair
+from janus_tpu.core.metrics import Metrics
+from janus_tpu.core.retries import HttpRetryPolicy
+from janus_tpu.core.time import MockClock
+from janus_tpu.datastore import AggregatorTask, TaskQueryType
+from janus_tpu.datastore.test_util import EphemeralDatastore
+from janus_tpu.messages import Duration, Interval, Query, Role, TaskId, Time
+
+TIME_PRECISION = Duration(3600)
+NOW = Time(1_600_002_000)  # aligned to TIME_PRECISION
+
+AGG_TOKEN = AuthenticationToken.new_bearer("agg-token-canary")
+COL_TOKEN = AuthenticationToken.new_bearer("col-token-canary")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _plane(families=("prio3_sum",), metrics=None, **overrides):
+    cfg = SimpleNamespace(
+        leader_endpoint="http://leader.invalid",
+        helper_endpoint="http://helper.invalid",
+        leader_task_api="",
+        helper_task_api="",
+        task_api_auth_token="",
+        families=list(families),
+        probe_interval_s=30.0,
+        poll_interval_s=0.05,
+        collect_timeout_s=20.0,
+        fail_threshold=2,
+        time_precision_s=3600,
+        trace_globs=[],
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return CanaryPlane(
+        cfg,
+        metrics=metrics or Metrics(force_fallback=True),
+        wall_fn=lambda: NOW.seconds,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Unit layer: registry, verdict machine, backoff
+
+
+def test_known_plaintext_families():
+    """The probe's whole premise: expected sums are fixed constants."""
+    assert FAMILIES["prio3_sum"].expected == sum(
+        FAMILIES["prio3_sum"].measurements
+    )
+    hist = FAMILIES["prio3_histogram"]
+    expect = [0] * hist.vdaf_instance["length"]
+    for m in hist.measurements:
+        expect[m] += 1
+    assert hist.expected == expect
+    assert _matches(62, 62) and _matches([1, 0], (1, 0))
+    assert not _matches(61, 62) and not _matches([1], [1, 0])
+    assert not _matches(None, 62) and not _matches("x", 62)
+
+
+def test_unknown_family_rejected_at_construction():
+    with pytest.raises(ValueError, match="unknown family"):
+        _plane(families=("prio3_sum", "prio3_sumvec"))
+
+
+def test_verdict_state_machine():
+    m = Metrics(force_fallback=True)
+    plane = _plane(metrics=m, fail_threshold=2)
+    task = SimpleNamespace(family=FAMILIES["prio3_sum"])
+    assert plane.fleet_verdict() == "healthy"
+
+    plane._finish(task, "error", "upload", detail="boom")
+    assert plane.fleet_verdict() == "degraded"
+    st = plane.stats()["families"]["prio3_sum"]
+    assert st["failing_stage"] == "upload" and st["consecutive_failures"] == 1
+
+    plane._finish(task, "timeout", "collection")
+    assert plane.fleet_verdict() == "failing"
+    st = plane.stats()["families"]["prio3_sum"]
+    assert st["failing_stage"] == "collection" and st["last_outcome"] == "timeout"
+    assert st["last_good_unix"] is None
+
+    plane._finish(task, "ok", None, stages_s={"upload_ack": 0.01, "e2e": 0.5})
+    assert plane.fleet_verdict() == "healthy"
+    st = plane.stats()["families"]["prio3_sum"]
+    assert st["consecutive_failures"] == 0 and st["failing_stage"] is None
+    assert st["last_good_unix"] == NOW.seconds
+
+    # the outcome counter and the 0/2 success histogram both moved
+    assert m.canary_verdicts._values[("prio3_sum", "error")] == 1.0
+    assert m.canary_verdicts._values[("prio3_sum", "ok")] == 1.0
+    count, total, _ = m.canary_probe_outcome._hist[()]
+    assert (count, total) == (3, 4.0)  # 2 failures at 2.0 + 1 ok at 0.0
+    # stage latency rollup renders in stats
+    lat = plane.stats()["stage_latency_s"]
+    assert lat["e2e"]["samples"] == 1 and lat["e2e"]["p50"] == 0.5
+
+
+def test_fleet_verdict_is_worst_family():
+    plane = _plane(families=("prio3_sum", "prio3_histogram"), fail_threshold=1)
+    plane._finish(
+        SimpleNamespace(family=FAMILIES["prio3_histogram"]), "corrupt", "verify"
+    )
+    assert plane.stats()["families"]["prio3_sum"]["verdict"] == "healthy"
+    assert plane.fleet_verdict() == "failing"
+
+
+def test_db_suspect_backoff_counts_without_moving_state():
+    """Strict-SUSPECT suppression: counted, never probed, verdict frozen."""
+    m = Metrics(force_fallback=True)
+    plane = _plane(metrics=m)
+    plane.adopt_task(
+        "prio3_sum",
+        TaskId.random(),
+        None,
+        HpkeKeypair.generate(50),
+        COL_TOKEN,
+    )
+    tracker().configure(failure_threshold=1, suspect_dwell_s=300.0)
+    try:
+        tracker().record_tx_failure()
+        assert tracker().state() == DB_SUSPECT
+        results = run(plane.probe_once(session=None))  # no session touched
+        assert [r.outcome for r in results] == ["suppressed"]
+        assert results[0].reason == "db_suspect"
+        st = plane.stats()["families"]["prio3_sum"]
+        assert st["probes"] == 0 and st["suppressed"] == 1
+        assert plane.fleet_verdict() == "healthy"
+        assert plane.stats()["backoffs"] == {"db_suspect": 1}
+        assert m.canary_backoffs._values[("db_suspect",)] == 1.0
+    finally:
+        reset_db_health()
+
+
+class _FakeResp:
+    def __init__(self, status, body=""):
+        self.status = status
+        self._body = body
+
+    async def text(self):
+        return self._body
+
+
+class _FakeCtx:
+    def __init__(self, resp):
+        self._resp = resp
+
+    async def __aenter__(self):
+        return self._resp
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class _ShedSession:
+    """Every PUT sheds with 503 — the overloaded front door."""
+
+    def __init__(self):
+        self.puts = 0
+
+    def put(self, url, data=None, headers=None):
+        self.puts += 1
+        return _FakeCtx(_FakeResp(503, "shed"))
+
+
+def test_upload_shed_backoff_counts_without_moving_state():
+    m = Metrics(force_fallback=True)
+    plane = _plane(metrics=m)
+    fam = FAMILIES["prio3_sum"]
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+
+    plane.adopt_task(
+        "prio3_sum",
+        TaskId.random(),
+        vdaf_from_instance(fam.vdaf_instance),
+        HpkeKeypair.generate(51),
+        COL_TOKEN,
+        leader_hpke_config=HpkeKeypair.generate(52).config,
+        helper_hpke_config=HpkeKeypair.generate(53).config,
+    )
+    session = _ShedSession()
+    results = run(plane.probe_once(session=session))
+    assert [r.outcome for r in results] == ["suppressed"]
+    assert results[0].reason == "upload_shed"
+    assert session.puts == 1  # stood down at the FIRST shed
+    st = plane.stats()["families"]["prio3_sum"]
+    assert st["probes"] == 0 and st["suppressed"] == 1
+    assert plane.fleet_verdict() == "healthy"
+    assert m.canary_backoffs._values[("upload_shed",)] == 1.0
+
+
+def test_bucket_walk_survives_precision_boundary():
+    """Regression (live-fleet find): deriving the bucket from the live
+    wall clock each cycle collides whenever a precision boundary crosses
+    between two probes — "now" advances one precision while the sequence
+    advances one step, and the leader rejects the second collect with
+    batchQueriedTooManyTimes.  The allocator must walk monotonically
+    backward from FIRST use, regardless of the clock."""
+    wall = {"now": NOW.seconds}
+    plane = _plane()
+    plane._wall = lambda: wall["now"]
+    plane.adopt_task(
+        "prio3_sum", TaskId.random(), None, HpkeKeypair.generate(60), COL_TOKEN
+    )
+    task = plane._tasks["prio3_sum"]
+
+    b1 = plane._alloc_bucket(task, 3600)
+    assert b1 == NOW.seconds - 3600  # most recent CLOSED bucket
+    wall["now"] += 3600  # the hour flips between probes
+    b2 = plane._alloc_bucket(task, 3600)
+    assert b2 == b1 - 3600  # the old math would have re-issued b1
+    wall["now"] += 7200  # even a multi-hour stall never revisits
+    b3 = plane._alloc_bucket(task, 3600)
+    assert b3 == b1 - 7200
+    assert len({b1, b2, b3}) == 3 and task.seq == 3
+
+
+def test_consumed_bucket_suppresses_and_advances(monkeypatch):
+    """A collect rejected with batchQueriedTooManyTimes (restarted prober
+    re-walking pre-crash ground) is a counted bucket_collision backoff —
+    verdict frozen — and the allocator has already moved past it."""
+    from janus_tpu.collector import CollectorError
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+
+    m = Metrics(force_fallback=True)
+    plane = _plane(metrics=m)
+    fam = FAMILIES["prio3_sum"]
+    plane.adopt_task(
+        "prio3_sum",
+        TaskId.random(),
+        vdaf_from_instance(fam.vdaf_instance),
+        HpkeKeypair.generate(61),
+        COL_TOKEN,
+        leader_hpke_config=HpkeKeypair.generate(62).config,
+        helper_hpke_config=HpkeKeypair.generate(63).config,
+    )
+
+    class _OkSession:
+        def put(self, url, data=None, headers=None):
+            return _FakeCtx(_FakeResp(201))
+
+    async def _rejected(self, query, session=None):
+        raise CollectorError(
+            'collection create failed: 400 {"type": "urn:ietf:params:ppm:'
+            'dap:error:batchQueriedTooManyTimes"}'
+        )
+
+    monkeypatch.setattr("janus_tpu.collector.Collector.collect", _rejected)
+    results = run(plane.probe_once(session=_OkSession()))
+    assert [r.outcome for r in results] == ["suppressed"]
+    assert results[0].reason == "bucket_collision"
+    st = plane.stats()["families"]["prio3_sum"]
+    assert st["probes"] == 0 and st["suppressed"] == 1
+    assert plane.fleet_verdict() == "healthy"
+    assert plane.stats()["backoffs"] == {"bucket_collision": 1}
+    # the consumed bucket is behind us: the next cycle probes one older
+    assert plane._tasks["prio3_sum"].next_bucket == NOW.seconds - 7200
+
+
+def test_persistent_shed_escalates_to_error():
+    """The anti-masking fence: an unbroken 503-shed streak past
+    ``shed_escalate_after`` stops counting as polite backoff — a front
+    door that never reopens is an outage, and the verdict must move."""
+    m = Metrics(force_fallback=True)
+    plane = _plane(metrics=m, shed_escalate_after=2, fail_threshold=2)
+    fam = FAMILIES["prio3_sum"]
+    from janus_tpu.vdaf.instances import vdaf_from_instance
+
+    plane.adopt_task(
+        "prio3_sum",
+        TaskId.random(),
+        vdaf_from_instance(fam.vdaf_instance),
+        HpkeKeypair.generate(54),
+        COL_TOKEN,
+        leader_hpke_config=HpkeKeypair.generate(55).config,
+        helper_hpke_config=HpkeKeypair.generate(56).config,
+    )
+    session = _ShedSession()
+    for expect in ("suppressed", "suppressed", "error", "error"):
+        (r,) = run(plane.probe_once(session=session))
+        assert r.outcome == expect, (expect, r.outcome, r.detail)
+    st = plane.stats()["families"]["prio3_sum"]
+    assert st["suppressed"] == 2 and st["probes"] == 2
+    assert st["failing_stage"] == "upload"
+    assert plane.fleet_verdict() == "failing"
+    # a datastore-unavailable 503 is loud IMMEDIATELY, no streak needed
+    plane2 = _plane(metrics=m)
+    plane2.adopt_task(
+        "prio3_sum",
+        TaskId.random(),
+        vdaf_from_instance(fam.vdaf_instance),
+        HpkeKeypair.generate(57),
+        COL_TOKEN,
+        leader_hpke_config=HpkeKeypair.generate(58).config,
+        helper_hpke_config=HpkeKeypair.generate(59).config,
+    )
+
+    class _DbDown:
+        def put(self, url, data=None, headers=None):
+            return _FakeCtx(_FakeResp(503, "datastore unavailable"))
+
+    (r,) = run(plane2.probe_once(session=_DbDown()))
+    assert r.outcome == "error" and r.stage == "upload", (r.outcome, r.detail)
+
+
+def test_timeout_stage_attribution(monkeypatch):
+    plane = _plane()
+    # no trace globs configured: the only thing known is the poll timed out
+    assert plane._attribute_timeout_stage(["aa" * 16]) == "collection"
+    import janus_tpu.core.canary as canary_mod
+
+    plane.cfg.trace_globs = ["/tmp/nonexistent-*.json"]
+    monkeypatch.setattr(
+        canary_mod,
+        "probe_stage_latencies",
+        lambda globs, ids: {"commit": [0.01], "first_prepare": [0.02]},
+    )
+    # the reports DID reach device prepare: collection is what stalled
+    assert plane._attribute_timeout_stage(["aa" * 16]) == "collection"
+    monkeypatch.setattr(
+        canary_mod,
+        "probe_stage_latencies",
+        lambda globs, ids: {"commit": [0.01], "first_prepare": []},
+    )
+    # committed but never prepared: the pipeline stalled before the device
+    assert plane._attribute_timeout_stage(["aa" * 16]) == "prepare"
+
+
+def test_canary_statusz_section():
+    assert canary_stats() == {"enabled": False}
+    from janus_tpu.core.statusz import runtime_status
+
+    assert runtime_status()["canary"] == {"enabled": False}
+    plane = _plane()
+    import janus_tpu.core.canary as canary_mod
+
+    canary_mod._PLANE = plane
+    try:
+        doc = runtime_status()["canary"]
+        assert doc["enabled"] and doc["verdict"] == "healthy"
+        assert doc["families"]["prio3_sum"]["provisioned"] is False
+    finally:
+        canary_mod._PLANE = None
+
+
+def test_configure_canary_install_and_clear():
+    cfg = SimpleNamespace(families=["prio3_sum"], fail_threshold=2)
+    plane = configure_canary(cfg, metrics=Metrics(force_fallback=True))
+    try:
+        assert canary_stats()["enabled"] is True
+    finally:
+        configure_canary(None)
+    assert plane is not None and canary_stats() == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# E2E layer: the probe against a real in-process pair
+
+
+class CanaryHarness:
+    """Leader+helper over TestServers, the canary task(s) pre-provisioned
+    in both datastores and adopted by a CanaryPlane, plus an optional
+    REAL Prio3Count task to prove batch isolation."""
+
+    def __init__(self, families=("prio3_sum",), real_task=False):
+        self.families = list(families)
+        self.with_real_task = real_task
+        self.clock = MockClock(NOW)
+        self.leader_ds = EphemeralDatastore(self.clock)
+        self.helper_ds = EphemeralDatastore(self.clock)
+        cfg = Config(vdaf_backend="oracle", max_upload_batch_write_delay=0.02)
+        self.leader_agg = Aggregator(self.leader_ds.datastore, self.clock, cfg)
+        self.helper_agg = Aggregator(self.helper_ds.datastore, self.clock, cfg)
+        self.metrics = Metrics(force_fallback=True)
+
+    def _put_pair_task(self, task_id, vdaf_desc, min_batch_size, collector_keys):
+        common = dict(
+            task_id=task_id,
+            query_type=TaskQueryType.time_interval(),
+            vdaf=vdaf_desc,
+            vdaf_verify_key=b"\x2a" * 16,
+            min_batch_size=min_batch_size,
+            time_precision=TIME_PRECISION,
+            collector_hpke_config=collector_keys.config,
+        )
+        leader = AggregatorTask(
+            peer_aggregator_endpoint=self.helper_url,
+            role=Role.LEADER,
+            aggregator_auth_token=AGG_TOKEN,
+            collector_auth_token_hash=COL_TOKEN.hash(),
+            hpke_keys=[HpkeKeypair.generate(1)],
+            **common,
+        )
+        helper = AggregatorTask(
+            peer_aggregator_endpoint=self.leader_url,
+            role=Role.HELPER,
+            aggregator_auth_token_hash=AGG_TOKEN.hash(),
+            hpke_keys=[HpkeKeypair.generate(2)],
+            **common,
+        )
+        self.leader_ds.datastore.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(leader)
+        )
+        self.helper_ds.datastore.run_tx(
+            "put", lambda tx: tx.put_aggregator_task(helper)
+        )
+        return leader, helper
+
+    async def start(self):
+        from janus_tpu.vdaf.instances import vdaf_from_instance
+
+        self.leader_client = TestClient(TestServer(aggregator_app(self.leader_agg)))
+        self.helper_client = TestClient(TestServer(aggregator_app(self.helper_agg)))
+        await self.leader_client.start_server()
+        await self.helper_client.start_server()
+        self.leader_url = str(self.leader_client.make_url("/")).rstrip("/")
+        self.helper_url = str(self.helper_client.make_url("/")).rstrip("/")
+
+        self.cfg = SimpleNamespace(
+            leader_endpoint=self.leader_url,
+            helper_endpoint=self.helper_url,
+            leader_task_api="",
+            helper_task_api="",
+            task_api_auth_token="",
+            families=self.families,
+            probe_interval_s=30.0,
+            poll_interval_s=0.05,
+            collect_timeout_s=30.0,
+            fail_threshold=2,
+            time_precision_s=TIME_PRECISION.seconds,
+            trace_globs=[],
+        )
+        self.plane = CanaryPlane(
+            self.cfg, metrics=self.metrics, wall_fn=lambda: NOW.seconds
+        )
+        self.canary_task_ids = {}
+        for idx, name in enumerate(self.families):
+            fam = FAMILIES[name]
+            task_id = TaskId.random()
+            collector_keys = HpkeKeypair.generate(30 + idx)
+            self._put_pair_task(
+                task_id, fam.vdaf_instance, len(fam.measurements), collector_keys
+            )
+            self.plane.adopt_task(
+                name,
+                task_id,
+                vdaf_from_instance(fam.vdaf_instance),
+                collector_keys,
+                COL_TOKEN,
+            )
+            self.canary_task_ids[name] = task_id
+
+        if self.with_real_task:
+            self.real_task_id = TaskId.random()
+            self.real_collector_keys = HpkeKeypair.generate(40)
+            self.real_leader_task, self.real_helper_task = self._put_pair_task(
+                self.real_task_id, {"type": "Prio3Count"}, 3, self.real_collector_keys
+            )
+
+    async def stop(self):
+        await self.leader_agg.shutdown()
+        await self.helper_agg.shutdown()
+        await self.leader_client.close()
+        await self.helper_client.close()
+        self.leader_ds.cleanup()
+        self.helper_ds.cleanup()
+
+    async def upload_real(self, measurement):
+        vdaf = self.real_leader_task.vdaf_instance()
+        report = prepare_report(
+            vdaf,
+            self.real_task_id,
+            self.real_leader_task.hpke_keys[0].config,
+            self.real_helper_task.hpke_keys[0].config,
+            TIME_PRECISION,
+            measurement,
+            time=NOW,
+        )
+        resp = await self.leader_client.put(
+            f"/tasks/{self.real_task_id}/reports", data=report.get_encoded()
+        )
+        assert resp.status == 201, await resp.text()
+
+    async def _drive(self, done):
+        """Creator + aggregation + collection loops until ``done``; fault
+        storms must not kill the loop (the chaos case blacks out txs)."""
+        creator = AggregationJobCreator(
+            self.leader_ds.datastore,
+            CreatorConfig(min_aggregation_job_size=1, max_aggregation_job_size=100),
+        )
+        driver = AggregationJobDriver(
+            self.leader_ds.datastore,
+            aiohttp.ClientSession,
+            DriverConfig(http_retry=HttpRetryPolicy(0.01, 0.1, 2.0, 1.0, 3)),
+        )
+        cdriver = CollectionJobDriver(self.leader_ds.datastore, aiohttp.ClientSession)
+        try:
+            while not done.is_set():
+                try:
+                    await creator.run_once()
+                    leases = await self.leader_ds.datastore.run_tx_async(
+                        "acq_agg",
+                        lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                            Duration(600), 10
+                        ),
+                    )
+                    for lease in leases:
+                        await driver.step_aggregation_job(lease)
+                    cleases = await self.leader_ds.datastore.run_tx_async(
+                        "acq_coll",
+                        lambda tx: tx.acquire_incomplete_collection_jobs(
+                            Duration(600), 10
+                        ),
+                    )
+                    for lease in cleases:
+                        await cdriver.step_collection_job(lease)
+                except Exception:
+                    pass  # chaos: keep driving, the probe judges the outcome
+                # march past the stepped not-ready retry delays
+                self.clock.advance(Duration(30))
+                try:
+                    await asyncio.wait_for(done.wait(), timeout=0.05)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            await driver.close()
+            await cdriver.close()
+
+    async def probe(self):
+        """One probe cycle with the pipeline driven concurrently."""
+        async with aiohttp.ClientSession() as session:
+            done = asyncio.Event()
+
+            async def run_probe():
+                try:
+                    return await self.plane.probe_once(session)
+                finally:
+                    done.set()
+
+            results, _ = await asyncio.gather(run_probe(), self._drive(done))
+            return results
+
+    async def collect_real(self, expected_count, expected_sum):
+        collector = Collector(
+            task_id=self.real_task_id,
+            leader_endpoint=self.leader_url,
+            vdaf=self.real_leader_task.vdaf_instance(),
+            auth_token=COL_TOKEN,
+            hpke_keypair=self.real_collector_keys,
+            poll_interval=0.05,
+            max_poll_time=30.0,
+        )
+        done = asyncio.Event()
+
+        async def run_collect():
+            try:
+                return await collector.collect(
+                    Query.new_time_interval(Interval(NOW, TIME_PRECISION)),
+                    session=None,
+                )
+            finally:
+                done.set()
+
+        result, _ = await asyncio.gather(run_collect(), self._drive(done))
+        assert result.report_count == expected_count, result.report_count
+        assert result.aggregate_result == expected_sum, result.aggregate_result
+        return result
+
+
+def test_probe_ok_end_to_end():
+    """Both families through the real path: upload -> aggregate ->
+    collect -> verified known sum; a second cycle walks to the next
+    (older) bucket rather than re-querying the first."""
+    h = CanaryHarness(families=("prio3_sum", "prio3_histogram"))
+
+    async def flow():
+        await h.start()
+        try:
+            results = await h.probe()
+            assert [r.outcome for r in results] == ["ok", "ok"], [
+                (r.outcome, r.stage, r.detail) for r in results
+            ]
+            assert results[0].actual == FAMILIES["prio3_sum"].expected
+            assert list(results[1].actual) == FAMILIES["prio3_histogram"].expected
+            for r in results:
+                assert set(r.stages_s) >= {"upload_ack", "collection", "e2e"}
+            assert h.plane.fleet_verdict() == "healthy"
+            st = h.plane.stats()
+            assert st["families"]["prio3_sum"]["last_good_unix"] == NOW.seconds
+            assert st["stage_latency_s"]["e2e"]["samples"] == 2
+
+            # cycle 2: a fresh batch interval, fresh reports, same verdict
+            results = await h.probe()
+            assert [r.outcome for r in results] == ["ok", "ok"], [
+                (r.outcome, r.stage, r.detail) for r in results
+            ]
+            assert h.plane.stats()["families"]["prio3_sum"]["probes"] == 2
+            # e2e histogram moved for every ok probe
+            count, _, _ = h.metrics.canary_e2e._hist[()]
+            assert count == 4
+        finally:
+            await h.stop()
+
+    run(flow())
+
+
+def test_corrupt_aggregate_yields_corrupt_verdict_and_isolation():
+    """The correctness fence: a corrupt-mode fault on the leader's
+    aggregate share makes the fleet ANSWER WRONGLY — only the canary's
+    known-plaintext verification can catch it (outcome="corrupt").  The
+    mixed soak in the same harness proves canary reports never leak into
+    the real task's batches: its collected count is exactly its own
+    uploads."""
+    h = CanaryHarness(families=("prio3_sum",), real_task=True)
+
+    async def flow():
+        await h.start()
+        try:
+            for m in (1, 0, 1):
+                await h.upload_real(m)
+            faults.configure(
+                [
+                    faults.FaultSpec(
+                        point="collection.aggregate_share",
+                        mode="corrupt",
+                        probability=1.0,
+                        target=str(h.canary_task_ids["prio3_sum"]),
+                    )
+                ],
+                seed=7,
+            )
+            try:
+                (r,) = await h.probe()
+            finally:
+                faults.clear()
+            assert r.outcome == "corrupt", (r.outcome, r.stage, r.detail)
+            assert r.stage == "verify"
+            st = h.plane.stats()["families"]["prio3_sum"]
+            assert st["last_outcome"] == "corrupt"
+            assert h.plane.fleet_verdict() == "degraded"  # 1 < fail_threshold
+            assert h.metrics.canary_verdicts._values[("prio3_sum", "corrupt")] == 1.0
+
+            # the REAL task's batch carries exactly its own three reports —
+            # the canary's known-plaintext uploads are bit-for-bit absent
+            # (target-scoped corruption also never touched this task)
+            await h.collect_real(expected_count=3, expected_sum=2)
+
+            # heal: the next probe (fresh bucket) verifies clean
+            (r,) = await h.probe()
+            assert r.outcome == "ok", (r.outcome, r.stage, r.detail)
+            assert h.plane.fleet_verdict() == "healthy"
+        finally:
+            await h.stop()
+
+    run(flow())
+
+
+def test_chaos_blackout_flips_verdict_then_suppresses_then_heals():
+    """The acceptance chaos case: mid-soak ``datastore.tx.begin``
+    blackout -> probes fail loudly at the upload stage and the verdict
+    flips to failing; strict db-SUSPECT -> probes are SUPPRESSED with a
+    counted backoff (no verdict movement, no canary pressure); heal ->
+    verdict returns to healthy and the real traffic uploaded BEFORE the
+    window still collects exactly once."""
+    h = CanaryHarness(families=("prio3_sum",), real_task=True)
+
+    async def flow():
+        await h.start()
+        try:
+            # healthy baseline (also caches the task HPKE configs)
+            (r,) = await h.probe()
+            assert r.outcome == "ok", (r.outcome, r.stage, r.detail)
+
+            # real traffic lands BEFORE the blackout
+            for m in (1, 1, 0):
+                await h.upload_real(m)
+
+            # keep the tracker out of SUSPECT while the blackout rages so
+            # the loud-failure phase is deterministic
+            tracker().configure(failure_threshold=10_000, suspect_dwell_s=300.0)
+            faults.configure(
+                [faults.FaultSpec(point="datastore.tx.begin", mode="error")],
+                seed=3,
+            )
+            try:
+                async with aiohttp.ClientSession() as session:
+                    for _ in range(h.cfg.fail_threshold):
+                        (r,) = await h.plane.probe_once(session)
+                        assert r.outcome == "error", (r.outcome, r.detail)
+                        assert r.stage == "upload"
+                assert h.plane.fleet_verdict() == "failing"
+                st = h.plane.stats()["families"]["prio3_sum"]
+                assert st["failing_stage"] == "upload"
+
+                # brownout detected: strict SUSPECT suppresses the prober
+                tracker().configure(failure_threshold=1)
+                tracker().record_tx_failure()
+                assert tracker().state() == DB_SUSPECT
+                before = h.plane.stats()["families"]["prio3_sum"]["probes"]
+                (r,) = await h.plane.probe_once(session=None)
+                assert r.outcome == "suppressed" and r.reason == "db_suspect"
+                after = h.plane.stats()["families"]["prio3_sum"]
+                # counted, not probed: no state movement, no upload attempt
+                assert after["probes"] == before
+                assert after["suppressed"] == 1
+                assert h.plane.stats()["backoffs"] == {"db_suspect": 1}
+                assert h.plane.fleet_verdict() == "failing"  # frozen, not reset
+            finally:
+                faults.clear()
+                reset_db_health()
+
+            # heal: the next full probe goes back to healthy
+            (r,) = await h.probe()
+            assert r.outcome == "ok", (r.outcome, r.stage, r.detail)
+            assert h.plane.fleet_verdict() == "healthy"
+            assert (
+                h.plane.stats()["families"]["prio3_sum"]["last_good_unix"]
+                == NOW.seconds
+            )
+
+            # exactly-once: the pre-blackout real uploads collect with the
+            # exact count and sum — nothing lost, nothing duplicated
+            await h.collect_real(expected_count=3, expected_sum=2)
+        finally:
+            await h.stop()
+
+    run(flow())
+
+
+def test_ensure_provisioned_via_task_api_then_probe():
+    """The production provisioning path: the prober creates its own task
+    pair through both aggregators' management APIs (aggregator_api.py),
+    then drives a verified probe through the task it provisioned."""
+    from janus_tpu.aggregator_api import aggregator_api_app
+
+    h = CanaryHarness(families=())  # harness only for the DAP pair + drive
+
+    async def flow():
+        await h.start()
+        leader_api = TestClient(
+            TestServer(aggregator_api_app(h.leader_ds.datastore, ["api-tok"]))
+        )
+        helper_api = TestClient(
+            TestServer(aggregator_api_app(h.helper_ds.datastore, ["api-tok"]))
+        )
+        await leader_api.start_server()
+        await helper_api.start_server()
+        try:
+            cfg = SimpleNamespace(
+                leader_endpoint=h.leader_url,
+                helper_endpoint=h.helper_url,
+                leader_task_api=str(leader_api.make_url("/")).rstrip("/"),
+                helper_task_api=str(helper_api.make_url("/")).rstrip("/"),
+                task_api_auth_token="api-tok",
+                families=["prio3_sum"],
+                probe_interval_s=30.0,
+                poll_interval_s=0.05,
+                collect_timeout_s=30.0,
+                fail_threshold=2,
+                time_precision_s=TIME_PRECISION.seconds,
+                trace_globs=[],
+            )
+            plane = CanaryPlane(
+                cfg, metrics=h.metrics, wall_fn=lambda: NOW.seconds
+            )
+            async with aiohttp.ClientSession() as session:
+                await plane.ensure_provisioned(session)
+                # idempotent: a second call must not re-POST or re-key
+                await plane.ensure_provisioned(session)
+            task_id = plane._tasks["prio3_sum"].task_id
+            for ds, role in ((h.leader_ds, Role.LEADER), (h.helper_ds, Role.HELPER)):
+                task = ds.datastore.run_tx(
+                    "get", lambda tx: tx.get_aggregator_task(task_id)
+                )
+                assert task is not None and task.role == role
+                assert task.min_batch_size == len(FAMILIES["prio3_sum"].measurements)
+
+            h.plane = plane  # probe through the API-provisioned task
+            (r,) = await h.probe()
+            assert r.outcome == "ok", (r.outcome, r.stage, r.detail)
+            assert r.actual == FAMILIES["prio3_sum"].expected
+            assert plane.stats()["families"]["prio3_sum"]["provisioned"]
+        finally:
+            await leader_api.close()
+            await helper_api.close()
+            await h.stop()
+
+    run(flow())

@@ -231,6 +231,37 @@ class TestConfig:
         )
         assert t.family == "janus_collection_e2e_seconds"
 
+    def test_raw_family_typo_fails_at_startup(self):
+        """ISSUE 20 satellite: a raw ``janus_*`` signal that is not a
+        histogram family in the metric catalog used to be accepted
+        verbatim and silently evaluate zero events forever — it must
+        fail configuration instead."""
+        with pytest.raises(ValueError, match="not a histogram family"):
+            targets_from_config(
+                {"typo": {"signal": "janus_colection_e2e_seconds", "threshold_s": 5}}
+            )
+        # a real family of the wrong KIND (counter) is equally a typo
+        with pytest.raises(ValueError, match="not a histogram family"):
+            targets_from_config(
+                {"ctr": {"signal": "janus_upload_shed_total", "threshold_s": 5}}
+            )
+
+    def test_canary_signals_resolve(self):
+        """The canary plane's two SLO signals (ISSUE 20) map onto the
+        probe histograms."""
+        targets = targets_from_config(
+            {
+                "canary_e2e": {"signal": "canary_e2e_latency", "threshold_s": 30},
+                # good == successful probes: the outcome histogram
+                # observes 0.0 for ok and 2.0 for failure, so any
+                # threshold in [0.5, 2) counts exactly the successes
+                "canary_ok": {"signal": "canary_success", "threshold_s": 1.0},
+            }
+        )
+        by_name = {t.name: t for t in targets}
+        assert by_name["canary_e2e"].family == "janus_canary_e2e_seconds"
+        assert by_name["canary_ok"].family == "janus_canary_probe_outcome"
+
     def test_typos_fail_loudly(self):
         with pytest.raises(ValueError, match="unknown keys"):
             targets_from_config({"commit_age": {"threshold_s": 1, "burn_fast": 2}})

@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""fleetz: merge /statusz JSON from N replicas into one fleet table.
+
+Every replica serves a rich per-process /statusz document (core/statusz.py)
+— but a fleet is judged as a whole, and until now the operator had to curl
+each replica and eyeball the sections side by side.  This tool fetches (or
+reads from files) N /statusz documents and merges them into the missing
+fleet-wide view:
+
+  * one row per replica: datastore health, canary verdict (+failing
+    stage), fleet membership view (members seen / tasks owned /
+    migrations), quarantine depth;
+  * a membership cross-check: replicas QUERIED vs the union of fleet
+    member rows the replicas SEE — a replica present in nobody's
+    membership view is partitioned or dead, a member row with no queried
+    replica behind it is a ghost waiting out its TTL;
+  * a fleet verdict: the worst canary verdict across replicas.
+
+Usage:
+    python tools/fleetz.py host1:9641 host2:9642 ...
+    python tools/fleetz.py --json statusz_a.json statusz_b.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+_VERDICT_LEVEL = {"healthy": 0, "degraded": 1, "failing": 2}
+
+
+def fetch_statusz(replica: str, timeout_s: float = 5.0) -> dict:
+    url = replica.rstrip("/") + "/statusz"
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _canary_summary(doc: dict) -> Tuple[str, Optional[str]]:
+    """(verdict, failing stage) from one doc's canary section."""
+    canary = doc.get("canary") or {}
+    if not canary.get("enabled"):
+        return "off", None
+    stage = None
+    for fam in (canary.get("families") or {}).values():
+        if fam.get("failing_stage"):
+            stage = fam["failing_stage"]
+    return canary.get("verdict", "unknown"), stage
+
+
+def _quarantine_depth(doc: dict):
+    q = doc.get("quarantine") or {}
+    if not isinstance(q, dict) or "error" in q:
+        return None
+    depth = q.get("durable_rows")
+    if depth is None:
+        # fall back to the in-memory per-stage counters when the durable
+        # ledger count is absent (no datastore on this binary)
+        stages = q.get("by_stage") or q.get("stages") or {}
+        if isinstance(stages, dict):
+            depth = sum(v for v in stages.values() if isinstance(v, int))
+    return depth
+
+
+def merge_fleet(docs: Dict[str, Optional[dict]]) -> dict:
+    """Pure merge: {replica_addr: statusz doc | None (unreachable)} ->
+    the fleet table structure the CLI renders.  Kept I/O-free so the unit
+    suite can feed it synthetic documents."""
+    rows = []
+    seen_members: set = set()
+    replica_ids: set = set()
+    worst = "healthy"
+    any_canary = False
+    for addr in sorted(docs):
+        doc = docs[addr]
+        if doc is None:
+            rows.append({"replica": addr, "reachable": False})
+            worst = "failing"
+            continue
+        fleet = doc.get("fleet") or {}
+        members = fleet.get("members") or []
+        for m in members:
+            mid = m.get("replica_id") if isinstance(m, dict) else m
+            if mid:
+                seen_members.add(mid)
+        if fleet.get("replica_id"):
+            replica_ids.add(fleet["replica_id"])
+        verdict, failing_stage = _canary_summary(doc)
+        if verdict in _VERDICT_LEVEL:
+            any_canary = True
+            if _VERDICT_LEVEL[verdict] > _VERDICT_LEVEL.get(worst, 0):
+                worst = verdict
+        ds = doc.get("datastore") or {}
+        rows.append(
+            {
+                "replica": addr,
+                "reachable": True,
+                "uptime_s": doc.get("uptime_s"),
+                "replica_id": fleet.get("replica_id"),
+                "role": fleet.get("role"),
+                "members_seen": len(members) if fleet.get("enabled") else None,
+                "tasks_owned": fleet.get("tasks_owned"),
+                "migrations": fleet.get("migrations_total"),
+                "db_state": ds.get("state", "?"),
+                "db_failures": ds.get("tx_failures_total"),
+                "canary": verdict,
+                "canary_failing_stage": failing_stage,
+                "quarantine_rows": _quarantine_depth(doc),
+            }
+        )
+    # membership cross-check: member rows nobody queried are ghosts (dead
+    # replicas waiting out their TTL); queried replicas absent from every
+    # membership view are partitioned from the datastore's fleet table
+    ghosts = sorted(seen_members - replica_ids)
+    unseen = sorted(replica_ids - seen_members)
+    return {
+        "replicas": rows,
+        "fleet_verdict": worst if any_canary else "unknown",
+        "membership": {
+            "queried": len([r for r in rows if r.get("reachable")]),
+            "member_rows_seen": len(seen_members),
+            "ghost_members": ghosts,
+            "unlisted_replicas": unseen,
+        },
+    }
+
+
+def render(table: dict) -> str:
+    cols = [
+        ("replica", 24),
+        ("role", 12),
+        ("db_state", 9),
+        ("canary", 9),
+        ("members_seen", 12),
+        ("tasks_owned", 11),
+        ("quarantine_rows", 15),
+    ]
+    lines = ["  ".join(name.ljust(width) for name, width in cols)]
+    for row in table["replicas"]:
+        if not row.get("reachable"):
+            lines.append(f"{row['replica']:<24}  UNREACHABLE")
+            continue
+        vals = []
+        for name, width in cols:
+            v = row.get(name)
+            if name == "canary" and row.get("canary_failing_stage"):
+                v = f"{v}!{row['canary_failing_stage']}"
+            vals.append(("-" if v is None else str(v)).ljust(width))
+        lines.append("  ".join(vals))
+    mem = table["membership"]
+    lines.append(
+        f"fleet verdict: {table['fleet_verdict']}  "
+        f"(queried={mem['queried']}, member_rows={mem['member_rows_seen']})"
+    )
+    if mem["ghost_members"]:
+        lines.append(f"ghost members (TTL pending): {', '.join(mem['ghost_members'])}")
+    if mem["unlisted_replicas"]:
+        lines.append(
+            f"replicas missing from membership: {', '.join(mem['unlisted_replicas'])}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("replicas", nargs="+", help="health addresses or (with --json) files")
+    ap.add_argument("--json", action="store_true", help="read statusz docs from files")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument(
+        "--output-json", action="store_true", help="emit the merged table as JSON"
+    )
+    args = ap.parse_args(argv)
+
+    docs: Dict[str, Optional[dict]] = {}
+    for target in args.replicas:
+        if args.json:
+            with open(target) as f:
+                docs[target] = json.load(f)
+        else:
+            try:
+                docs[target] = fetch_statusz(target, args.timeout)
+            except Exception as e:
+                print(f"warning: {target}: {e}", file=sys.stderr)
+                docs[target] = None
+    table = merge_fleet(docs)
+    print(json.dumps(table, indent=2) if args.output_json else render(table))
+    return 1 if table["fleet_verdict"] == "failing" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
